@@ -1,0 +1,23 @@
+"""Test harness: an 8-device virtual CPU mesh on one host.
+
+This is the TPU-native version of the reference's own validation trick —
+running N MPI ranks on a single node to exercise multi-node code paths
+without a cluster (/root/reference/mpicuda2.cu:31-32, SURVEY.md §4.2).
+``force_cpu_devices`` must run before jax initializes backends, hence
+module scope here; it also defuses this image's axon TPU plugin, which
+otherwise makes every ``jax.devices()`` call dial the real chip.
+"""
+
+from tpuscratch.runtime.hostenv import force_cpu_devices
+
+force_cpu_devices(8)
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,202 @@
+"""Tests for mesh construction, runtime context, config, errors, logging.
+
+Parity targets: mpi1 (hello/rank/size), mpi2 (error policies), the config
+switch tiers (SURVEY.md §5 config), and the single-write logging pattern
+(mpi7.cpp:56-62).
+"""
+
+import io
+
+import jax
+import pytest
+
+from tpuscratch.runtime.config import Config
+from tpuscratch.runtime.context import initialize, node_census
+from tpuscratch.runtime.errors import CommError, ErrorPolicy, guarded, guards
+from tpuscratch.runtime.log import RankLogger, coord_filename
+from tpuscratch.runtime.mesh import (
+    make_mesh,
+    make_mesh_1d,
+    make_mesh_2d,
+    shard_along,
+    topology_of,
+)
+
+
+class TestMesh:
+    def test_1d_all_devices(self, devices):
+        mesh = make_mesh_1d("x")
+        assert mesh.devices.shape == (len(devices),)
+        assert mesh.axis_names == ("x",)
+
+    def test_2d_default_factorization(self, devices):
+        mesh = make_mesh_2d()
+        assert mesh.devices.shape == (2, 4)
+        assert mesh.axis_names == ("row", "col")
+
+    def test_2d_explicit(self):
+        mesh = make_mesh_2d((4, 2), ("a", "b"))
+        assert mesh.devices.shape == (4, 2)
+
+    def test_device_order_row_major(self, devices):
+        # contract: mesh position == CartTopology rank == flat device index
+        mesh = make_mesh_2d((2, 4))
+        assert mesh.devices[0, 3] == devices[3]
+        assert mesh.devices[1, 0] == devices[4]
+
+    def test_too_many(self, devices):
+        with pytest.raises(ValueError):
+            make_mesh((len(devices) + 1,), ("x",))
+
+    def test_topology_of(self):
+        mesh = make_mesh_2d((2, 4))
+        topo = topology_of(mesh, periodic=True)
+        assert topo.dims == (2, 4)
+        assert topo.periodic == (True, True)
+
+    def test_shard_along(self):
+        mesh = make_mesh_2d((2, 4))
+        s = shard_along(mesh, "row", "col")
+        assert s.mesh is not None
+
+
+class TestContext:
+    def test_initialize_single_host(self, devices):
+        ctx = initialize()
+        assert ctx.process_index == 0
+        assert ctx.process_count == 1
+        assert ctx.global_device_count == len(devices)
+        assert ctx.backend == "cpu"
+        assert node_census(ctx) == 1
+
+    def test_hello(self):
+        ctx = initialize()
+        h = ctx.hello()
+        assert "process 0 of 1" in h
+        assert ctx.hostname in h
+
+
+class TestConfig:
+    def test_defaults(self):
+        cfg = Config()
+        assert cfg.halo_width == 2  # 5x5 stencil -> ghost depth 2
+        assert cfg.jnp_dtype == jax.numpy.float32
+
+    def test_argv_tile_and_stencil(self):
+        cfg = Config.from_argv(["32", "24", "3", "7"])
+        assert (cfg.tile_width, cfg.tile_height) == (32, 24)
+        # the reference's stencilHeight self-assignment bug is fixed here:
+        # CLI stencil height must actually apply (-cuda.cu:137)
+        assert (cfg.stencil_width, cfg.stencil_height) == (3, 7)
+        assert (cfg.halo_width, cfg.halo_height) == (1, 3)
+
+    def test_argv_elements(self):
+        cfg = Config.from_argv(["1048576"])
+        assert cfg.elements == 1048576
+
+    def test_env(self):
+        cfg = Config.from_env(
+            {"TPUSCRATCH_DTYPE": "bfloat16", "TPUSCRATCH_NO_LOG": "1",
+             "TPUSCRATCH_MESH": "2x4", "TPUSCRATCH_ABORT_ON_ERROR": "1"}
+        )
+        assert cfg.dtype == "bfloat16"
+        assert cfg.log is False
+        assert cfg.mesh_shape == (2, 4)
+        assert cfg.error_policy is ErrorPolicy.ABORT
+
+    def test_bad_dtype(self):
+        with pytest.raises(ValueError):
+            _ = Config(dtype="float16x").jnp_dtype
+
+
+class TestErrors:
+    def test_guarded_raises_comm_error(self):
+        with pytest.raises(CommError) as ei:
+            with guarded("mesh build", ErrorPolicy.RAISE, rank=3):
+                raise ValueError("boom")
+        assert "[rank 3] mesh build" in str(ei.value)
+        assert "ValueError" in str(ei.value)
+
+    def test_guarded_passthrough(self):
+        with guarded("noop"):
+            pass
+
+    def test_comm_error_not_double_wrapped(self):
+        with pytest.raises(CommError) as ei:
+            with guarded("outer"):
+                with guarded("inner"):
+                    raise RuntimeError("x")
+        assert ei.value.op == "inner"
+
+    def test_guards_decorator(self):
+        @guards("op-name")
+        def f():
+            raise KeyError("k")
+
+        with pytest.raises(CommError) as ei:
+            f()
+        assert ei.value.op == "op-name"
+
+
+class TestLogging:
+    def test_prefix(self):
+        out = io.StringIO()
+        log = RankLogger(rank=2, coords=(0, 2), stream=out)
+        log("hello", 42)
+        assert out.getvalue() == "[rank 2 (0,2)] hello 42\n"
+
+    def test_buffered_single_write(self):
+        out = io.StringIO()
+        with RankLogger(rank=1, buffered=True, stream=out) as log:
+            log("a")
+            log("b")
+            assert out.getvalue() == ""  # nothing until flush
+        assert out.getvalue() == "[rank 1] a\n[rank 1] b\n"
+
+    def test_disabled(self):
+        out = io.StringIO()
+        RankLogger(enabled=False, stream=out)("hidden")
+        assert out.getvalue() == ""
+
+    def test_log0(self):
+        out = io.StringIO()
+        RankLogger(rank=3, stream=out).log0("root only")
+        assert out.getvalue() == ""
+        RankLogger(rank=0, stream=out).log0("root only")
+        assert "root only" in out.getvalue()
+
+    def test_coord_filename(self):
+        assert coord_filename((0, 2)) == "0_2"
+        assert coord_filename((1, 1), prefix="tile_") == "tile_1_1"
+
+
+class TestReviewRegressions:
+    """Fixes from the first code review pass."""
+
+    def test_dims_coerced_to_tuple(self):
+        from tpuscratch.runtime.topology import CartTopology
+
+        t = CartTopology([3, 3])
+        assert hash(t) == hash(CartTopology((3, 3)))
+        assert t == CartTopology((3, 3))
+
+    def test_argv_three_positionals_apply_stencil_width(self):
+        cfg = Config.from_argv(["32", "24", "7"])
+        assert cfg.stencil_width == 7
+        assert cfg.stencil_height == 5
+
+    def test_abort_env_value_respected(self):
+        cfg = Config.from_env({"TPUSCRATCH_ABORT_ON_ERROR": "0"})
+        assert cfg.error_policy is ErrorPolicy.RAISE
+
+    def test_system_exit_passes_through_guard(self):
+        with pytest.raises(SystemExit) as ei:
+            with guarded("clean exit"):
+                raise SystemExit(0)
+        assert ei.value.code == 0
+
+    def test_initialize_kwargs_not_silently_dropped(self):
+        # Asking for a multi-process rendezvous on a single-host test run
+        # must fail loudly, not return a bogus 1-process context.
+        with pytest.raises(CommError):
+            initialize(num_processes=4, process_id=2)

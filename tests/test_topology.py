@@ -1,0 +1,144 @@
+"""Unit tests for the pure topology math (no devices needed).
+
+Parity targets: MPI_Cart_coords/rank/shift round-trips (mpi10.cpp:27-42),
+periodic wrap + 8-neighborhood (stencil2D.h:232-299), and the golden-output
+fact that on a periodic 3x3 grid rank (0,0)'s top-left neighbor is rank 8
+(stencil2d/sample-output/0_0).
+"""
+
+import pytest
+
+from tpuscratch.runtime.topology import (
+    ALL_DIRECTIONS,
+    CartTopology,
+    Direction,
+    factor2d,
+    square_grid,
+)
+
+
+class TestRankCoords:
+    def test_roundtrip_exhaustive(self):
+        topo = CartTopology((3, 4), (False, False))
+        for r in topo.ranks():
+            assert topo.rank_at(topo.coords(r)) == r
+
+    def test_row_major(self):
+        topo = CartTopology((2, 3))
+        assert topo.coords(0) == (0, 0)
+        assert topo.coords(2) == (0, 2)
+        assert topo.coords(3) == (1, 0)
+        assert topo.coords(5) == (1, 2)
+
+    def test_3d(self):
+        topo = CartTopology((2, 3, 4))
+        assert topo.size == 24
+        for r in topo.ranks():
+            assert topo.rank_at(topo.coords(r)) == r
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            CartTopology(())
+        with pytest.raises(ValueError):
+            CartTopology((2, 0))
+        with pytest.raises(ValueError):
+            CartTopology((2, 2), (True,))
+        with pytest.raises(ValueError):
+            CartTopology((4,)).coords(4)
+
+
+class TestNeighbors:
+    def test_open_boundary_is_none(self):
+        # mpi5/mpi10 semantics: off-grid neighbor = MPI_PROC_NULL
+        topo = CartTopology((3, 3), (False, False))
+        assert topo.neighbor(0, Direction.TOP) is None
+        assert topo.neighbor(0, Direction.LEFT) is None
+        assert topo.neighbor(8, Direction.BOTTOM_RIGHT) is None
+        assert topo.neighbor(4, Direction.TOP) == 1
+
+    def test_periodic_wrap_corners(self):
+        # Golden-output oracle: on periodic 3x3, rank 0's 8-neighborhood
+        # wraps so its TOP_LEFT neighbor is rank 8 (sample-output/0_0).
+        topo = square_grid(9, periodic=True)
+        n = topo.neighbors8(0)
+        assert n[Direction.TOP_LEFT] == 8
+        assert n[Direction.TOP] == 6
+        assert n[Direction.TOP_RIGHT] == 7
+        assert n[Direction.LEFT] == 2
+        assert n[Direction.RIGHT] == 1
+        assert n[Direction.BOTTOM_LEFT] == 5
+        assert n[Direction.BOTTOM] == 3
+        assert n[Direction.BOTTOM_RIGHT] == 4
+
+    def test_center_rank_neighbors(self):
+        # sample-output/1_1: rank 4 (center) sees 0..8 minus itself
+        topo = square_grid(9, periodic=True)
+        n = topo.neighbors8(4)
+        assert sorted(v for v in n.values()) == [0, 1, 2, 3, 5, 6, 7, 8]
+
+    def test_shift_matches_mpi_cart_shift(self):
+        topo = CartTopology((3, 3), (False, False))
+        # rank 4 center: shifting along rows by +1 -> source above, dest below
+        src, dst = topo.shift(4, axis=0, disp=1)
+        assert (src, dst) == (1, 7)
+        # open boundary: rank 0 shifted along cols by -1 has no dest
+        src, dst = topo.shift(0, axis=1, disp=-1)
+        assert dst is None and src == 1
+
+    def test_opposite(self):
+        for d in ALL_DIRECTIONS:
+            assert d.opposite.opposite is d
+        assert Direction.TOP_LEFT.opposite is Direction.BOTTOM_RIGHT
+
+
+class TestPermutations:
+    def test_ring_is_full_cycle(self):
+        topo = CartTopology((8,), (True,))
+        perm = topo.ring_permutation(0, 1)
+        assert sorted(perm) == [(i, (i + 1) % 8) for i in range(8)]
+
+    def test_open_ring_drops_boundary(self):
+        # mpi5 semantics: non-periodic 1D, endpoints skip the missing side
+        topo = CartTopology((4,), (False,))
+        perm = topo.send_permutation((1,))
+        assert perm == [(0, 1), (1, 2), (2, 3)]
+
+    def test_diagonal_permutation_is_single_hop(self):
+        topo = square_grid(9, periodic=True)
+        perm = dict(topo.send_permutation(Direction.BOTTOM_RIGHT))
+        # every rank sends somewhere; bijection on periodic grids
+        assert len(perm) == 9
+        assert sorted(perm.values()) == list(range(9))
+        assert perm[0] == 4
+        assert perm[8] == 0
+
+    def test_permutation_srcs_and_dsts_unique(self):
+        topo = CartTopology((2, 4), (True, True))
+        for d in ALL_DIRECTIONS:
+            pairs = topo.send_permutation(d)
+            srcs = [s for s, _ in pairs]
+            dsts = [t for _, t in pairs]
+            assert len(set(srcs)) == len(srcs)
+            assert len(set(dsts)) == len(dsts)
+
+
+class TestFactor2D:
+    def test_square(self):
+        assert factor2d(16) == (4, 4)
+
+    def test_mostly_square(self):
+        assert factor2d(8) == (2, 4)
+        assert factor2d(12) == (3, 4)
+
+    def test_prime(self):
+        assert factor2d(7) == (1, 7)
+
+    def test_square_grid_rejects_nonsquare(self):
+        with pytest.raises(ValueError):
+            square_grid(8)
+
+
+class TestGridString:
+    def test_3x3(self):
+        topo = square_grid(9)
+        assert topo.grid_string() == "0 1 2\n3 4 5\n6 7 8"
