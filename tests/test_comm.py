@@ -1,0 +1,259 @@
+"""Parity tests for the collectives + p2p layer on an 8-device CPU mesh.
+
+Each test mirrors one reference program's observable behavior (SURVEY.md
+§2.2): mpi3 pair exchange, mpi4 token passing, mpi5 neighbor exchange with
+open boundaries, mpi6 gather of neighbor triples, mpi9 sub-communicator
+allreduce, mpi10 cartesian 4-neighborhood, plus the collectives the CUDA
+programs use (Reduce/Bcast/Scatter).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.comm import (
+    all_gather,
+    all_to_all,
+    allreduce_max,
+    allreduce_sum,
+    broadcast,
+    gather_to_root,
+    neighbor_exchange,
+    pingpong,
+    reduce_scatter,
+    reduce_to_root,
+    ring_shift,
+    run_spmd,
+    scatter_from_root,
+    send_pairs,
+    token_ring,
+)
+from tpuscratch.runtime.mesh import make_mesh, make_mesh_1d, make_mesh_2d
+from tpuscratch.runtime.topology import CartTopology, Direction
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh1d():
+    return make_mesh_1d("x")
+
+
+@pytest.fixture(scope="module")
+def ranks():
+    return jnp.arange(N, dtype=jnp.float32)
+
+
+class TestCollectives:
+    def test_allreduce_sum(self, mesh1d, ranks):
+        f = run_spmd(mesh1d, lambda x: allreduce_sum(x, "x"), P("x"), P("x"))
+        np.testing.assert_array_equal(f(ranks), np.full(N, 28.0))
+
+    def test_allreduce_max(self, mesh1d, ranks):
+        f = run_spmd(mesh1d, lambda x: allreduce_max(x, "x"), P("x"), P("x"))
+        np.testing.assert_array_equal(f(ranks), np.full(N, 7.0))
+
+    def test_reduce_to_root(self, mesh1d, ranks):
+        # mpicuda2.cu:293 — MPI_Reduce SUM to rank 0
+        f = run_spmd(mesh1d, lambda x: reduce_to_root(x, "x"), P("x"), P("x"))
+        np.testing.assert_array_equal(f(ranks), [28, 0, 0, 0, 0, 0, 0, 0])
+
+    def test_broadcast(self, mesh1d, ranks):
+        # mpicuda2.cu:154 — Bcast node count from rank 0; here from rank 3
+        f = run_spmd(
+            mesh1d, lambda x: broadcast(x, "x", root=3), P("x"), P("x")
+        )
+        np.testing.assert_array_equal(f(ranks), np.full(N, 3.0))
+
+    def test_all_gather(self, mesh1d, ranks):
+        # tiled: every rank ends up holding the full concatenated vector
+        f = run_spmd(
+            mesh1d, lambda x: all_gather(x, "x", tiled=True), P("x"), P("x")
+        )
+        out = np.asarray(f(ranks)).reshape(N, N)  # row i = rank i's copy
+        for row in out:
+            np.testing.assert_array_equal(row, np.arange(N))
+
+    def test_gather_to_root(self, mesh1d, ranks):
+        # mpi6.cpp:89-100 — root holds everyone's data, others don't
+        f = run_spmd(
+            mesh1d,
+            lambda x: gather_to_root(x, "x", tiled=True),
+            P("x"),
+            P("x"),
+        )
+        out = np.asarray(f(ranks)).reshape(N, N)
+        np.testing.assert_array_equal(out[0], np.arange(N))
+        assert (out[1:] == 0).all()
+
+    def test_scatter_from_root(self, mesh1d):
+        # mpicuda2.cu:145-152 — root's array split evenly, piece i to rank i
+        data = jnp.arange(16.0)
+        f = run_spmd(
+            mesh1d, lambda x: scatter_from_root(x, "x"), P(), P("x")
+        )
+        np.testing.assert_array_equal(f(data), np.arange(16.0))
+
+    def test_reduce_scatter(self, mesh1d):
+        # every rank holds an 8-vector of ones; rank i receives sum of slot i
+        data = jnp.ones(N * N, dtype=jnp.float32)
+        f = run_spmd(
+            mesh1d,
+            lambda x: reduce_scatter(x, "x", tiled=True),
+            P("x"),
+            P("x"),
+        )
+        np.testing.assert_array_equal(f(data), np.full(N, 8.0))
+
+    def test_all_to_all(self, mesh1d):
+        # transpose of ownership: rank i's slot j -> rank j's slot i
+        data = jnp.arange(N * N, dtype=jnp.float32).reshape(N, N)
+        f = run_spmd(
+            mesh1d,
+            lambda x: all_to_all(x, "x", split_axis=1, concat_axis=0, tiled=True),
+            P("x", None),
+            P("x", None),
+        )
+        out = np.asarray(f(data)).reshape(N, N)
+        np.testing.assert_array_equal(out, np.arange(64.0).reshape(N, N).T)
+
+
+class TestSubCommunicators:
+    """mpi9 parity: world split in halves; concurrent per-half allreduce
+    plus whole-world allreduce, via a ('half','local') 2-axis mesh instead
+    of MPI groups/Comm_create."""
+
+    def test_half_vs_world_allreduce(self):
+        mesh = make_mesh((2, 4), ("half", "local"))
+        vals = jnp.arange(8, dtype=jnp.float32).reshape(2, 4)
+
+        def body(x):
+            per_half = allreduce_sum(x, "local")
+            world = allreduce_sum(x, ("half", "local"))
+            return per_half, world
+
+        f = run_spmd(
+            mesh, body, P("half", "local"),
+            (P("half", "local"), P("half", "local")),
+        )
+        per_half, world = f(vals)
+        np.testing.assert_array_equal(
+            np.asarray(per_half), [[6, 6, 6, 6], [22, 22, 22, 22]]
+        )
+        np.testing.assert_array_equal(np.asarray(world), np.full((2, 4), 28.0))
+
+    def test_reduce_to_root_within_half(self):
+        mesh = make_mesh((2, 4), ("half", "local"))
+        vals = jnp.ones((2, 4), dtype=jnp.float32)
+        f = run_spmd(
+            mesh,
+            lambda x: reduce_to_root(x, "local"),
+            P("half", "local"),
+            P("half", "local"),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(f(vals)), [[4, 0, 0, 0], [4, 0, 0, 0]]
+        )
+
+
+class TestP2P:
+    def test_send_pairs_exchange(self, mesh1d, ranks):
+        # mpi3: two ranks swap values (everyone else gets zeros)
+        f = run_spmd(
+            mesh1d,
+            lambda x: send_pairs(x, "x", [(0, 1), (1, 0)]),
+            P("x"),
+            P("x"),
+        )
+        np.testing.assert_array_equal(f(ranks), [1, 0, 0, 0, 0, 0, 0, 0])
+
+    def test_neighbor_exchange_open(self, mesh1d, ranks):
+        # mpi5: rank i learns (i-1, i+1); boundaries get zeros
+        f = run_spmd(
+            mesh1d,
+            lambda x: neighbor_exchange(x, "x", N, periodic=False),
+            P("x"),
+            (P("x"), P("x")),
+        )
+        from_left, from_right = f(ranks)
+        np.testing.assert_array_equal(from_left, [0, 0, 1, 2, 3, 4, 5, 6])
+        np.testing.assert_array_equal(from_right, [1, 2, 3, 4, 5, 6, 7, 0])
+
+    def test_ring_shift_periodic(self, mesh1d, ranks):
+        f = run_spmd(
+            mesh1d, lambda x: ring_shift(x, "x", N, 1), P("x"), P("x")
+        )
+        np.testing.assert_array_equal(f(ranks), [7, 0, 1, 2, 3, 4, 5, 6])
+
+    def test_pingpong_round_trip(self, mesh1d, ranks):
+        # test-benchmark parity: data echoed back must equal original on A
+        f = run_spmd(
+            mesh1d,
+            lambda x: pingpong(x, "x", a=0, b=1, rounds=3),
+            P("x"),
+            P("x"),
+        )
+        out = np.asarray(f(ranks))
+        assert out[0] == 0.0  # returned home unchanged
+
+    def test_token_ring(self, mesh1d, ranks):
+        # mpi4 generalized: token hops the ring, +1 per hop; after N hops
+        # every rank holds its own starting value + N
+        f = run_spmd(
+            mesh1d, lambda x: token_ring(x, "x", N, hops=N), P("x"), P("x")
+        )
+        np.testing.assert_array_equal(f(ranks), np.arange(N) + N)
+
+    def test_token_ring_partial(self, mesh1d, ranks):
+        # after 3 hops rank i holds rank (i-3)'s token + 3
+        f = run_spmd(
+            mesh1d, lambda x: token_ring(x, "x", N, hops=3), P("x"), P("x")
+        )
+        np.testing.assert_array_equal(
+            f(ranks), (np.arange(N) - 3) % N + 3
+        )
+
+
+class TestCartesian2D:
+    """mpi10 parity: 4-neighborhood exchange on a 2D periodic grid, plus the
+    diagonal single-hop permutes the halo library depends on."""
+
+    def test_four_neighbor_ids(self):
+        mesh = make_mesh_2d((2, 4))
+        topo = CartTopology((2, 4), (True, True))
+
+        def body(x):
+            out = {}
+            for d in (Direction.TOP, Direction.BOTTOM, Direction.LEFT, Direction.RIGHT):
+                # receive from direction d == everyone sends toward opposite
+                perm = topo.send_permutation(d.opposite)
+                out[d.name] = jax.lax.ppermute(x, ("row", "col"), perm)
+            return out["TOP"], out["BOTTOM"], out["LEFT"], out["RIGHT"]
+
+        ids = jnp.arange(8, dtype=jnp.float32).reshape(2, 4)
+        f = run_spmd(
+            mesh, body, P("row", "col"), tuple(P("row", "col") for _ in range(4))
+        )
+        top, bottom, left, right = (np.asarray(a) for a in f(ids))
+        # rank (0,1)=1: top neighbor wraps to (1,1)=5, bottom=5, left=0, right=2
+        assert top[0, 1] == 5 and bottom[0, 1] == 5
+        assert left[0, 1] == 0 and right[0, 1] == 2
+        # full maps
+        np.testing.assert_array_equal(top, [[4, 5, 6, 7], [0, 1, 2, 3]])
+        np.testing.assert_array_equal(left, [[3, 0, 1, 2], [7, 4, 5, 6]])
+
+    def test_diagonal_single_hop(self):
+        mesh = make_mesh_2d((2, 4))
+        topo = CartTopology((2, 4), (True, True))
+        perm = topo.send_permutation(Direction.BOTTOM_RIGHT)
+        f = run_spmd(
+            mesh,
+            lambda x: jax.lax.ppermute(x, ("row", "col"), perm),
+            P("row", "col"),
+            P("row", "col"),
+        )
+        out = np.asarray(f(jnp.arange(8.0).reshape(2, 4)))
+        # value v of rank r lands on r's bottom-right neighbor
+        np.testing.assert_array_equal(out, [[7, 4, 5, 6], [3, 0, 1, 2]])
