@@ -173,7 +173,7 @@ class TestP2P:
         # mpi5: rank i learns (i-1, i+1); boundaries get zeros
         f = run_spmd(
             mesh1d,
-            lambda x: neighbor_exchange(x, "x", N, periodic=False),
+            lambda x: neighbor_exchange(x, "x", periodic=False),
             P("x"),
             (P("x"), P("x")),
         )
@@ -183,33 +183,36 @@ class TestP2P:
 
     def test_ring_shift_periodic(self, mesh1d, ranks):
         f = run_spmd(
-            mesh1d, lambda x: ring_shift(x, "x", N, 1), P("x"), P("x")
+            mesh1d, lambda x: ring_shift(x, "x", 1), P("x"), P("x")
         )
         np.testing.assert_array_equal(f(ranks), [7, 0, 1, 2, 3, 4, 5, 6])
 
     def test_pingpong_round_trip(self, mesh1d, ranks):
-        # test-benchmark parity: data echoed back must equal original on A
+        # test-benchmark parity: data echoed back must equal original on A.
+        # Nonzero start + nonzero rank pair so the echo is distinguishable
+        # from ppermute's zero fill.
         f = run_spmd(
             mesh1d,
-            lambda x: pingpong(x, "x", a=0, b=1, rounds=3),
+            lambda x: pingpong(x + 10.0, "x", a=2, b=5, rounds=3),
             P("x"),
             P("x"),
         )
         out = np.asarray(f(ranks))
-        assert out[0] == 0.0  # returned home unchanged
+        assert out[2] == 12.0  # rank 2's value (2+10) returned home
+        assert (out[[0, 1, 3, 4, 6, 7]] == 0.0).all()
 
     def test_token_ring(self, mesh1d, ranks):
         # mpi4 generalized: token hops the ring, +1 per hop; after N hops
         # every rank holds its own starting value + N
         f = run_spmd(
-            mesh1d, lambda x: token_ring(x, "x", N, hops=N), P("x"), P("x")
+            mesh1d, lambda x: token_ring(x, "x", hops=N), P("x"), P("x")
         )
         np.testing.assert_array_equal(f(ranks), np.arange(N) + N)
 
     def test_token_ring_partial(self, mesh1d, ranks):
         # after 3 hops rank i holds rank (i-3)'s token + 3
         f = run_spmd(
-            mesh1d, lambda x: token_ring(x, "x", N, hops=3), P("x"), P("x")
+            mesh1d, lambda x: token_ring(x, "x", hops=3), P("x"), P("x")
         )
         np.testing.assert_array_equal(
             f(ranks), (np.arange(N) - 3) % N + 3

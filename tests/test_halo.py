@@ -1,0 +1,334 @@
+"""Tests for the flagship halo-exchange library.
+
+Four oracle layers, mirroring the reference's own strategy (SURVEY.md §4):
+1. pure region-geometry unit tests (TestSubRegionExtraction parity);
+2. the golden-file oracle — core = own rank id, each halo piece = the
+   periodic neighbor's rank id (stencil2d/sample-output semantics), run
+   live on a 2x4 CPU mesh AND cross-checked against the reference's
+   checked-in 3x3 golden dumps by pure geometry;
+3. a dual-backend oracle: K distributed stencil steps == K steps of a
+   plain single-array jnp stencil on the undecomposed grid;
+4. a deliberate-miswiring ablation (the NO_SYNC negative-test idea,
+   ref_parallel-dot-product-atomics.cu:26-32): a wrong permutation must be
+   caught by the golden oracle.
+"""
+
+import pathlib
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.dtypes import SubarraySpec
+from tpuscratch.halo import HaloSpec, Region, TileLayout, halo_exchange, sub_region
+from tpuscratch.halo.stencil import five_point, run_stencil, stencil_step
+from tpuscratch.runtime.mesh import make_mesh_2d
+from tpuscratch.runtime.topology import ALL_DIRECTIONS, CartTopology, Direction
+
+REF_SAMPLES = pathlib.Path("/root/reference/stencil2d/sample-output")
+
+
+class TestRegionGeometry:
+    """13-region math on a 32x32 grid with a 5x5 stencil (halo 2) — the
+    same configuration the reference's in-header self-test exercises."""
+
+    BASE = SubarraySpec((0, 0), (32, 32))
+
+    def test_center(self):
+        c = sub_region(self.BASE, 2, 2, Region.CENTER)
+        assert c.offsets == (2, 2) and c.shape == (28, 28)
+
+    def test_corners(self):
+        tl = sub_region(self.BASE, 2, 2, Region.TOP_LEFT)
+        br = sub_region(self.BASE, 2, 2, Region.BOTTOM_RIGHT)
+        assert tl.offsets == (0, 0) and tl.shape == (2, 2)
+        assert br.offsets == (30, 30) and br.shape == (2, 2)
+
+    def test_edges(self):
+        top = sub_region(self.BASE, 2, 2, Region.TOP)
+        left = sub_region(self.BASE, 2, 2, Region.LEFT)
+        assert top.offsets == (0, 2) and top.shape == (2, 28)
+        assert left.offsets == (2, 0) and left.shape == (28, 2)
+
+    def test_strips_full_length(self):
+        ts = sub_region(self.BASE, 2, 2, Region.TOP_STRIP)
+        rs = sub_region(self.BASE, 2, 2, Region.RIGHT_STRIP)
+        assert ts.offsets == (0, 0) and ts.shape == (2, 32)
+        assert rs.offsets == (0, 30) and rs.shape == (32, 2)
+
+    def test_composition_grid_core_region(self):
+        # double application: grid -> CENTER -> TOP of core
+        core = sub_region(self.BASE, 2, 2, Region.CENTER)
+        top_of_core = sub_region(core, 2, 2, Region.TOP)
+        assert top_of_core.offsets == (2, 4)
+        assert top_of_core.shape == (2, 24)
+
+    def test_asymmetric_halo(self):
+        r = sub_region(self.BASE, 1, 3, Region.BOTTOM_LEFT)
+        assert r.offsets == (31, 0) and r.shape == (1, 3)
+
+    def test_halo_swallows_base(self):
+        with pytest.raises(ValueError):
+            sub_region(SubarraySpec((0, 0), (4, 4)), 2, 2, Region.CENTER)
+
+
+class TestTileLayout:
+    def test_for_stencil(self):
+        lay = TileLayout.for_stencil(16, 16, 5, 5)
+        assert (lay.halo_y, lay.halo_x) == (2, 2)
+        assert lay.padded_shape == (20, 20)
+        assert lay.core.offsets == (2, 2) and lay.core.shape == (16, 16)
+
+    def test_send_recv_sizes_match(self):
+        lay = TileLayout(8, 12, 2, 3)
+        for d in ALL_DIRECTIONS:
+            # my send strip toward d must fit the receiver's opposite halo
+            assert lay.send_region(d).shape == lay.halo_region(d.opposite).shape
+
+    def test_border_partition_tiles_border(self):
+        lay = TileLayout(6, 7, 2, 1)
+        cover = np.zeros(lay.padded_shape, dtype=int)
+        for d in ALL_DIRECTIONS:
+            r = lay.halo_region(d)
+            cover[
+                r.offsets[0] : r.offsets[0] + r.shape[0],
+                r.offsets[1] : r.offsets[1] + r.shape[1],
+            ] += 1
+        core = lay.core
+        cover[
+            core.offsets[0] : core.offsets[0] + core.shape[0],
+            core.offsets[1] : core.offsets[1] + core.shape[1],
+        ] += 1
+        np.testing.assert_array_equal(cover, np.ones_like(cover))
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TileLayout(0, 4, 1, 1)
+        with pytest.raises(ValueError):
+            TileLayout(4, 4, 5, 1)  # halo deeper than core
+
+
+def _exchange_on_mesh(neighbors=8, periodic=True, init_halo=-1.0):
+    """Run one live exchange on a 2x4 CPU mesh, tiles = rank ids."""
+    mesh = make_mesh_2d((2, 4))
+    topo = CartTopology((2, 4), (periodic, periodic))
+    lay = TileLayout.for_stencil(4, 4, 3, 3)  # halo 1
+    spec = HaloSpec(layout=lay, topology=topo, neighbors=neighbors)
+
+    def body(x):
+        tile = x[0, 0]
+        return halo_exchange(tile, spec)[None, None]
+
+    f = run_spmd(
+        mesh, body, P("row", "col", None, None), P("row", "col", None, None)
+    )
+    tiles = np.full((2, 4) + lay.padded_shape, init_halo, dtype=np.float32)
+    for r in range(2):
+        for c in range(4):
+            tiles[r, c, 1:-1, 1:-1] = r * 4 + c
+    return np.asarray(f(jnp.asarray(tiles))), topo, lay, spec
+
+
+class TestHaloExchangeLive:
+    def test_golden_semantics_periodic(self):
+        # the sample-output oracle on a 2x4 grid: every halo piece holds
+        # the periodic neighbor's rank id
+        out, topo, lay, spec = _exchange_on_mesh()
+        for rank in topo.ranks():
+            r, c = topo.coords(rank)
+            tile = out[r, c]
+            for d in ALL_DIRECTIONS:
+                region = lay.halo_region(d)
+                block = tile[
+                    region.offsets[0] : region.offsets[0] + region.shape[0],
+                    region.offsets[1] : region.offsets[1] + region.shape[1],
+                ]
+                expected = topo.neighbor(rank, d)
+                assert (block == expected).all(), (rank, d, block)
+
+    def test_core_untouched(self):
+        out, topo, lay, _ = _exchange_on_mesh()
+        for rank in topo.ranks():
+            r, c = topo.coords(rank)
+            core = out[r, c, 1:-1, 1:-1]
+            assert (core == rank).all()
+
+    def test_open_boundary_keeps_initial_halo(self):
+        out, topo, lay, _ = _exchange_on_mesh(periodic=False, init_halo=-1.0)
+        # rank 0 sits in the top-left corner: TOP/LEFT/diagonal halos have
+        # no sender and must keep the -1 fill (MPI_PROC_NULL semantics)
+        tile = out[0, 0]
+        for d in (Direction.TOP, Direction.LEFT, Direction.TOP_LEFT,
+                  Direction.TOP_RIGHT, Direction.BOTTOM_LEFT):
+            region = lay.halo_region(d)
+            block = tile[
+                region.offsets[0] : region.offsets[0] + region.shape[0],
+                region.offsets[1] : region.offsets[1] + region.shape[1],
+            ]
+            assert (block == -1.0).all(), d
+        # while the interior-facing halos are filled
+        right = lay.halo_region(Direction.RIGHT)
+        assert (
+            tile[right.offsets[0] : right.offsets[0] + right.shape[0],
+                 right.offsets[1] : right.offsets[1] + right.shape[1]] == 1
+        ).all()
+
+    def test_four_neighbor_mode(self):
+        out, topo, lay, _ = _exchange_on_mesh(neighbors=4)
+        tile = out[0, 0]
+        top = lay.halo_region(Direction.TOP)
+        assert (
+            tile[top.offsets[0] : top.offsets[0] + top.shape[0],
+                 top.offsets[1] : top.offsets[1] + top.shape[1]]
+            == topo.neighbor(0, Direction.TOP)
+        ).all()
+        # corners not exchanged in 4-neighbor mode
+        tl = lay.halo_region(Direction.TOP_LEFT)
+        assert (
+            tile[tl.offsets[0] : tl.offsets[0] + tl.shape[0],
+                 tl.offsets[1] : tl.offsets[1] + tl.shape[1]] == -1.0
+        ).all()
+
+    def test_miswiring_ablation_caught(self):
+        # NO_SYNC-style negative test: wire the plan with the direction
+        # tables NOT mirrored (send toward d landing in halo d) — the
+        # golden oracle must reject it. Proves the oracle detects
+        # topology miswiring, the class of bug the reference demos.
+        mesh = make_mesh_2d((2, 4))
+        topo = CartTopology((2, 4), (True, True))
+        lay = TileLayout.for_stencil(4, 4, 3, 3)
+        spec = HaloSpec(layout=lay, topology=topo)
+
+        def miswired(tile):
+            from jax import lax as _lax
+            out = tile
+            for t in spec.plan():
+                payload = t.send.region(tile)
+                # BUG under test: permutation for d instead of opposite(d)
+                wrong = tuple(topo.send_permutation(t.direction))
+                arrived = _lax.ppermute(payload, spec.axes, list(wrong))
+                out = _lax.dynamic_update_slice(out, arrived, t.recv.offsets)
+            return out
+
+        f = run_spmd(
+            mesh,
+            lambda x: miswired(x[0, 0])[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        tiles = np.full((2, 4) + lay.padded_shape, -1.0, dtype=np.float32)
+        for r in range(2):
+            for c in range(4):
+                tiles[r, c, 1:-1, 1:-1] = r * 4 + c
+        out = np.asarray(f(jnp.asarray(tiles)))
+        # check LEFT: on 4 columns, +1 and -1 shifts differ (on the 2-row
+        # axis the miswiring is invisible — shift ±1 mod 2 coincide)
+        left = lay.halo_region(Direction.LEFT)
+        block = out[0, 0][
+            left.offsets[0] : left.offsets[0] + left.shape[0],
+            left.offsets[1] : left.offsets[1] + left.shape[1],
+        ]
+        assert not (block == topo.neighbor(0, Direction.LEFT)).all()
+
+
+@pytest.mark.skipif(not REF_SAMPLES.exists(), reason="reference not mounted")
+class TestGoldenFiles:
+    """Cross-check against the reference's checked-in 3x3 golden dumps:
+    parse each rank's post-exchange 20x20 array and assert every halo piece
+    equals the neighbor id OUR topology + region geometry predict. Pure
+    host logic — validates the same math the live 2x4 test runs, against
+    the reference's actual recorded output."""
+
+    LAYOUT = TileLayout.for_stencil(16, 16, 5, 5)
+    TOPO = CartTopology((3, 3), (True, True))
+
+    @staticmethod
+    def _parse(path):
+        text = path.read_text()
+        rank = int(re.search(r"Rank:\s+(\d+)", text).group(1))
+        after = text.split("Array after exchange")[1]
+        rows = []
+        for line in after.strip().splitlines():
+            vals = line.split()
+            if len(vals) == 20:
+                rows.append([int(v) for v in vals])
+        assert len(rows) == 20, path
+        return rank, np.array(rows)
+
+    def test_all_nine_ranks(self):
+        files = [p for p in REF_SAMPLES.iterdir() if re.fullmatch(r"\d_\d", p.name)]
+        assert len(files) == 9
+        for path in files:
+            rank, arr = self._parse(path)
+            core = self.LAYOUT.core
+            assert (
+                arr[core.offsets[0] : core.offsets[0] + core.shape[0],
+                    core.offsets[1] : core.offsets[1] + core.shape[1]] == rank
+            ).all()
+            for d in ALL_DIRECTIONS:
+                region = self.LAYOUT.halo_region(d)
+                block = arr[
+                    region.offsets[0] : region.offsets[0] + region.shape[0],
+                    region.offsets[1] : region.offsets[1] + region.shape[1],
+                ]
+                expected = self.TOPO.neighbor(rank, d)
+                assert (block == expected).all(), (path.name, d)
+
+
+class TestStencilCompute:
+    def test_five_point_matches_numpy(self):
+        lay = TileLayout(4, 4, 1, 1)
+        rng = np.random.default_rng(2)
+        tile = rng.standard_normal(lay.padded_shape).astype(np.float32)
+        out = np.asarray(five_point(jnp.asarray(tile), lay))
+        expect = tile.copy()
+        expect[1:-1, 1:-1] = 0.25 * (
+            tile[:-2, 1:-1] + tile[2:, 1:-1] + tile[1:-1, :-2] + tile[1:-1, 2:]
+        )
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_distributed_matches_global_oracle(self):
+        # Dual-backend oracle at distributed scale: K steps on a 2x4
+        # decomposition == K steps on the undecomposed periodic grid.
+        R, C, TH, TW, K = 2, 4, 4, 4, 3
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), (True, True))
+        lay = TileLayout(TH, TW, 1, 1)
+        spec = HaloSpec(layout=lay, topology=topo)
+
+        rng = np.random.default_rng(3)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+
+        tiles = np.zeros((R, C) + lay.padded_shape, dtype=np.float32)
+        for r in range(R):
+            for c in range(C):
+                tiles[r, c, 1:-1, 1:-1] = world[
+                    r * TH : (r + 1) * TH, c * TW : (c + 1) * TW
+                ]
+
+        f = run_spmd(
+            mesh,
+            lambda x: run_stencil(x[0, 0], spec, steps=K)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        out = np.asarray(f(jnp.asarray(tiles)))
+
+        expect = world
+        for _ in range(K):
+            expect = 0.25 * (
+                np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
+                + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
+            )
+
+        got = np.zeros_like(world)
+        for r in range(R):
+            for c in range(C):
+                got[r * TH : (r + 1) * TH, c * TW : (c + 1) * TW] = out[
+                    r, c, 1:-1, 1:-1
+                ]
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,201 @@
+"""Tests for the Pallas kernels + the distributed dot-product slice.
+
+The reference's dual-backend oracle pattern (SURVEY.md §4.2): the same
+kernel code runs interpreted on CPU here and compiled on TPU in benchmarks;
+a plain-numpy oracle checks the math (ref_parallel-dot-product-atomics.cu's
+CPU `dot` loop, :36-42).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.bench.timing import (
+    BenchResult,
+    percentile,
+    span_max_min,
+    time_device,
+)
+from tpuscratch.comm import run_spmd
+from tpuscratch.ops import dot, fill, iota2d
+from tpuscratch.ops.common import to_lanes
+from tpuscratch.ops.reduction import local_dot_psum
+from tpuscratch.runtime.mesh import make_mesh_1d
+
+
+class TestToLanes:
+    def test_exact(self):
+        x = jnp.arange(8 * 128.0)
+        assert to_lanes(x).shape == (8, 128)
+
+    def test_padding_neutral(self):
+        x = jnp.ones(1000)
+        x2 = to_lanes(x)
+        assert x2.shape == (8, 128)
+        assert float(x2.sum()) == 1000.0
+
+
+class TestDotKernels:
+    @pytest.mark.parametrize("method", ["full", "partials", "xla"])
+    def test_oracle_small(self, method):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal(4096).astype(np.float32)
+        y = rng.standard_normal(4096).astype(np.float32)
+        got = float(dot(jnp.asarray(x), jnp.asarray(y), method, block_rows=8))
+        np.testing.assert_allclose(got, float(np.dot(x, y)), rtol=1e-4)
+
+    @pytest.mark.parametrize("method", ["full", "partials"])
+    def test_ragged_length_padded(self, method):
+        # length not a multiple of 128*block: zero padding must be neutral
+        x = jnp.ones(3000)
+        got = float(dot(x, x, method, block_rows=8))
+        assert got == 3000.0
+
+    def test_bf16_accumulates_f32(self):
+        # fp32-only atomics limitation does NOT carry over (mpicuda2.cu:52)
+        x = jnp.ones(8192, dtype=jnp.bfloat16)
+        out = dot(x, x, "full", block_rows=8)
+        assert out.dtype == jnp.float32
+        assert float(out) == 8192.0
+
+    def test_multiblock_accumulation(self):
+        # several grid steps must accumulate, not overwrite
+        x = jnp.ones(8 * 128 * 4)
+        assert float(dot(x, x, "full", block_rows=8)) == 8 * 128 * 4
+
+    def test_unknown_method(self):
+        with pytest.raises(ValueError):
+            dot(jnp.ones(8), jnp.ones(8), "atomic")
+
+    def test_shape_mismatch(self):
+        with pytest.raises(ValueError):
+            dot(jnp.ones(8), jnp.ones(9), "full")
+
+
+class TestDistributedDot:
+    def test_end_to_end_psum(self):
+        # mpicuda2-4 parity: shard two vectors over 8 ranks, per-shard
+        # kernel reduction, one psum; every rank sees the global dot
+        mesh = make_mesh_1d("x")
+        n = 8 * 2048
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal(n).astype(np.float32)
+        y = rng.standard_normal(n).astype(np.float32)
+
+        f = run_spmd(
+            mesh,
+            lambda a, b: local_dot_psum(a, b, "x", method="partials", block_rows=2),
+            (P("x"), P("x")),
+            P(),
+        )
+        got = float(f(jnp.asarray(x), jnp.asarray(y)))
+        np.testing.assert_allclose(got, float(np.dot(x, y)), rtol=1e-4)
+
+
+class TestFillKernels:
+    def test_fill(self):
+        out = fill((8, 128), 2.5)
+        assert out.shape == (8, 128)
+        assert float(out.sum()) == 2.5 * 8 * 128
+
+    def test_iota2d(self):
+        out = np.asarray(iota2d((8, 128)))
+        np.testing.assert_array_equal(
+            out, np.arange(8 * 128, dtype=np.float32).reshape(8, 128)
+        )
+
+
+class TestTiming:
+    def test_percentile_and_span(self):
+        assert percentile([3.0, 1.0, 2.0], 50) == 2.0
+        # mpicuda3 convention: span covers earliest begin to latest end
+        assert span_max_min([1.0, 1.5, 0.9], [2.0, 2.2, 1.8]) == pytest.approx(1.3)
+        with pytest.raises(ValueError):
+            span_max_min([], [])
+
+    def test_time_device_runs(self):
+        x = jnp.ones(1024)
+        res = time_device(
+            lambda a: dot(a, a, "xla"), x, iters=3, warmup=1,
+            name="dot", items=1024,
+        )
+        assert isinstance(res, BenchResult)
+        assert len(res.times_s) == 3
+        assert res.items_per_s > 0
+        assert "dot" in res.summary()
+
+
+class TestStencilKernels:
+    def _oracle(self, tile, hy, hx):
+        out = tile.copy()
+        out[hy:-hy, hx:-hx] = 0.25 * (
+            tile[hy - 1 : -hy - 1, hx:-hx]
+            + tile[hy + 1 : -hy + 1 if hy > 1 else None, hx:-hx][: tile.shape[0] - 2 * hy]
+            + tile[hy:-hy, hx - 1 : -hx - 1]
+            + tile[hy:-hy, hx + 1 : -hx + 1 if hx > 1 else None][:, : tile.shape[1] - 2 * hx]
+        )
+        return out
+
+    def test_whole_tile_matches_xla(self):
+        from tpuscratch.halo import TileLayout
+        from tpuscratch.halo.stencil import five_point
+        from tpuscratch.ops import five_point_pallas
+
+        lay = TileLayout(16, 128, 1, 1)
+        rng = np.random.default_rng(5)
+        tile = jnp.asarray(rng.standard_normal(lay.padded_shape).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(five_point_pallas(tile, lay)),
+            np.asarray(five_point(tile, lay)),
+            rtol=1e-6,
+        )
+
+    def test_blocked_matches_whole(self):
+        from tpuscratch.halo import TileLayout
+        from tpuscratch.ops import five_point_blocked, five_point_pallas
+
+        lay = TileLayout(32, 128, 2, 2)
+        rng = np.random.default_rng(6)
+        tile = jnp.asarray(rng.standard_normal(lay.padded_shape).astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(five_point_blocked(tile, lay, band=8)),
+            np.asarray(five_point_pallas(tile, lay)),
+            rtol=1e-6,
+        )
+
+    def test_zero_halo_rejected(self):
+        from tpuscratch.halo import TileLayout
+        from tpuscratch.ops import five_point_pallas
+
+        with pytest.raises(ValueError):
+            five_point_pallas(jnp.ones((4, 4)), TileLayout(4, 4, 0, 0))
+
+    def test_step_impl_dispatch(self):
+        from tpuscratch.halo import HaloSpec, TileLayout
+        from tpuscratch.halo.stencil import run_stencil
+        from tpuscratch.comm import run_spmd
+        from tpuscratch.runtime.mesh import make_mesh_2d
+        from tpuscratch.runtime.topology import CartTopology
+
+        mesh = make_mesh_2d((2, 4))
+        lay = TileLayout(8, 8, 1, 1)
+        spec = HaloSpec(layout=lay, topology=CartTopology((2, 4), (True, True)))
+        rng = np.random.default_rng(8)
+        tiles = jnp.asarray(
+            rng.standard_normal((2, 4) + lay.padded_shape).astype(np.float32)
+        )
+        outs = {}
+        for impl in ("xla", "pallas"):
+            f = run_spmd(
+                mesh,
+                lambda x, impl=impl: run_stencil(x[0, 0], spec, 2, impl=impl)[None, None],
+                P("row", "col", None, None),
+                P("row", "col", None, None),
+            )
+            outs[impl] = np.asarray(f(tiles))
+        np.testing.assert_allclose(outs["xla"], outs["pallas"], rtol=1e-6)
+
+        with pytest.raises(ValueError):
+            from tpuscratch.halo.stencil import stencil_step
+            stencil_step(tiles[0, 0], spec, impl="cuda")

@@ -85,6 +85,10 @@ def scatter_from_root(x, axis: str, root: int = 0):
     buffers are ignored.
     """
     n = lax.axis_size(axis)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"scatter: leading dim {x.shape[0]} not divisible by axis size {n}"
+        )
     rooted = broadcast(x, axis, root)  # ensure all ranks agree on root data
     piece = x.shape[0] // n
     start = _axis_index(axis) * piece

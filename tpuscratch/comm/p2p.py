@@ -43,22 +43,24 @@ def ring_perm(n: int, disp: int = 1, periodic: bool = True) -> list[tuple[int, i
     return pairs
 
 
-def ring_shift(x, axis: str, n: int, disp: int = 1, periodic: bool = True):
+def ring_shift(x, axis: str, disp: int = 1, periodic: bool = True):
     """Every rank receives the value of its neighbor ``disp`` behind it.
 
-    Ranks with no sender (open boundary) receive zeros.
+    Ranks with no sender (open boundary) receive zeros. The ring size is
+    the axis size — a static trace-time constant, so callers cannot
+    mis-state it.
     """
-    return lax.ppermute(x, axis, ring_perm(n, disp, periodic))
+    return lax.ppermute(x, axis, ring_perm(lax.axis_size(axis), disp, periodic))
 
 
-def neighbor_exchange(x, axis: str, n: int, periodic: bool = False):
+def neighbor_exchange(x, axis: str, periodic: bool = False):
     """(from_left, from_right) — each rank's value shared with both sides.
 
     mpi5 parity: every rank Isends its id to rank±1 and Irecvs theirs;
     boundaries receive zeros where MPI would skip the transfer.
     """
-    from_left = ring_shift(x, axis, n, disp=+1, periodic=periodic)
-    from_right = ring_shift(x, axis, n, disp=-1, periodic=periodic)
+    from_left = ring_shift(x, axis, disp=+1, periodic=periodic)
+    from_right = ring_shift(x, axis, disp=-1, periodic=periodic)
     return from_left, from_right
 
 
@@ -83,7 +85,7 @@ def pingpong(x, axis: str, a: int = 0, b: int = 1, rounds: int = 1):
     return y
 
 
-def token_ring(x, axis: str, n: int, hops: int, increment=1):
+def token_ring(x, axis: str, hops: int, increment=1):
     """Lock-step token circulation: the token makes ``hops`` hops around the
     ring, incremented at each hop — mpi4's counter passing generalized from
     2 ranks to the full ring. Uses a scan (static trip count) so the
@@ -92,7 +94,7 @@ def token_ring(x, axis: str, n: int, hops: int, increment=1):
     Every rank receives the circulating token each hop; after ``hops`` hops
     rank (hops % n) holds the token that started at rank 0.
     """
-    perm = ring_perm(n, 1, periodic=True)
+    perm = ring_perm(lax.axis_size(axis), 1, periodic=True)
 
     def hop(tok, _):
         tok = lax.ppermute(tok, axis, perm) + increment

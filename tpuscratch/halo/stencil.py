@@ -1,0 +1,75 @@
+"""Stencil compute + the exchange-compute iteration loop.
+
+The reference driver's loop body is ``do {Exchange; Compute} while
+(!TerminateCondition)`` with a **no-op** Compute and a single iteration
+(/root/reference/stencil2d/mpi-2d-stencil-subarray.cpp:27-31,92-95). Here
+Compute is a real 5-point update (so benchmarks measure something), the
+loop is a ``lax.scan`` (one compiled program for N steps, no per-step
+dispatch), and the whole iteration is differentiable/jittable like any jax
+code. A Pallas fused kernel variant lives in ops/stencil_kernel.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuscratch.halo.exchange import HaloSpec, halo_exchange
+from tpuscratch.halo.layout import TileLayout
+
+
+def five_point(tile: jax.Array, layout: TileLayout, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0)) -> jax.Array:
+    """One Jacobi-style 5-point update of the core; halo is read, not
+    written. ``coeffs`` = (north, south, west, east, center).
+
+    Defaults to the Laplace/Jacobi average — the canonical workload for a
+    halo benchmark.
+    """
+    hy, hx = layout.halo_y, layout.halo_x
+    if hy < 1 or hx < 1:
+        # dynamic_slice clamps out-of-range starts, so a 0-halo layout
+        # would silently read the core in place of the shifted planes
+        raise ValueError(f"five_point needs halo >= 1, got ({hy},{hx})")
+    h, w = layout.core_h, layout.core_w
+    cn, cs, cw, ce, cc = coeffs
+    core = lax.dynamic_slice(tile, (hy, hx), (h, w))
+    north = lax.dynamic_slice(tile, (hy - 1, hx), (h, w))
+    south = lax.dynamic_slice(tile, (hy + 1, hx), (h, w))
+    west = lax.dynamic_slice(tile, (hy, hx - 1), (h, w))
+    east = lax.dynamic_slice(tile, (hy, hx + 1), (h, w))
+    new_core = cn * north + cs * south + cw * west + ce * east + cc * core
+    return lax.dynamic_update_slice(tile, new_core, (hy, hx))
+
+
+def _compute(tile: jax.Array, layout: TileLayout, coeffs, impl: str) -> jax.Array:
+    if impl == "xla":
+        return five_point(tile, layout, coeffs)
+    if impl == "pallas":
+        from tpuscratch.ops.stencil_kernel import five_point_pallas
+
+        return five_point_pallas(tile, layout, tuple(coeffs))
+    raise ValueError(f"unknown stencil impl {impl!r}")
+
+
+def stencil_step(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla") -> jax.Array:
+    """Exchange then compute — one iteration of the flagship loop.
+
+    ``impl`` selects the compute path: 'xla' (fused by the compiler) or
+    'pallas' (explicit VMEM kernel, ops/stencil_kernel.py) — the runtime
+    analogue of the reference's compile-time GPU/CPU switch.
+    """
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown stencil impl {impl!r}")
+    tile = halo_exchange(tile, spec)
+    return _compute(tile, spec.layout, coeffs, impl)
+
+
+def run_stencil(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla") -> jax.Array:
+    """N iterations as one compiled scan (SPMD: call inside shard_map)."""
+
+    def body(t, _):
+        return stencil_step(t, spec, coeffs, impl), ()
+
+    out, _ = lax.scan(body, tile, None, length=steps)
+    return out

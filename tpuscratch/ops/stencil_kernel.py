@@ -1,0 +1,127 @@
+"""Pallas 5-point stencil kernel — the real Compute the reference stubs out.
+
+The reference's stencil drivers ship a no-op ``Compute`` placeholder
+(/root/reference/stencil2d/mpi-2d-stencil-subarray.cpp:27); its only real
+device kernel is the 1-thread-per-block ``InitKernel``
+(-cuda.cu:17-28). This module supplies what a benchmarkable stencil needs:
+a fused VPU kernel computing the 4-neighbor Jacobi update of the core in
+one pass over VMEM.
+
+Two variants:
+- ``five_point_pallas``: whole padded tile as one VMEM block — right for
+  per-chip tiles up to a few MB (the distributed regime, where each rank's
+  tile is modest and the interesting cost is the halo exchange).
+- ``five_point_blocked``: 1D grid over row bands with one-row overlap
+  (via an index_map that steps by the band height while the block is two
+  rows taller) — right for single-chip grids too big for VMEM.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+
+try:  # Element block dims: element-indexed (overlapping) blocks
+    from jax.experimental.pallas import Element  # type: ignore[attr-defined]
+except ImportError:  # not re-exported in this jax version
+    from jax._src.pallas.core import Element
+
+from tpuscratch.halo.layout import TileLayout
+from tpuscratch.ops.common import use_interpret
+
+Coeffs = tuple[float, float, float, float, float]
+JACOBI: Coeffs = (0.25, 0.25, 0.25, 0.25, 0.0)
+
+
+def _tile_kernel(t_ref, o_ref, *, layout: TileLayout, coeffs: Coeffs):
+    hy, hx = layout.halo_y, layout.halo_x
+    h, w = layout.core_h, layout.core_w
+    cn, cs, cw, ce, cc = coeffs
+    t = t_ref[:]
+    new_core = (
+        cn * t[hy - 1 : hy - 1 + h, hx : hx + w]
+        + cs * t[hy + 1 : hy + 1 + h, hx : hx + w]
+        + cw * t[hy : hy + h, hx - 1 : hx - 1 + w]
+        + ce * t[hy : hy + h, hx + 1 : hx + 1 + w]
+        + cc * t[hy : hy + h, hx : hx + w]
+    )
+    o_ref[:] = t
+    o_ref[hy : hy + h, hx : hx + w] = new_core
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "coeffs"))
+def five_point_pallas(tile: jax.Array, layout: TileLayout, coeffs: Coeffs = JACOBI) -> jax.Array:
+    """One Jacobi step over the whole padded tile in one VMEM block."""
+    if layout.halo_y < 1 or layout.halo_x < 1:
+        raise ValueError("five_point needs halo >= 1 on both axes")
+    if tuple(tile.shape) != layout.padded_shape:
+        raise ValueError(f"tile {tile.shape} != padded {layout.padded_shape}")
+    return pl.pallas_call(
+        functools.partial(_tile_kernel, layout=layout, coeffs=coeffs),
+        out_shape=jax.ShapeDtypeStruct(tile.shape, tile.dtype),
+        interpret=use_interpret(),
+    )(tile)
+
+
+def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
+    cn, cs, cw, ce, cc = coeffs
+    t = t_ref[:]  # (band + 2, 2*halo_x + width): one overlap row each side
+    w = width
+    hx = halo_x
+    new = (
+        cn * t[0:band, hx : hx + w]
+        + cs * t[2 : band + 2, hx : hx + w]
+        + cw * t[1 : band + 1, hx - 1 : hx - 1 + w]
+        + ce * t[1 : band + 1, hx + 1 : hx + 1 + w]
+        + cc * t[1 : band + 1, hx : hx + w]
+    )
+    o_ref[:] = new
+
+
+@functools.partial(jax.jit, static_argnames=("layout", "coeffs", "band"))
+def five_point_blocked(
+    tile: jax.Array,
+    layout: TileLayout,
+    coeffs: Coeffs = JACOBI,
+    band: int = 256,
+) -> jax.Array:
+    """Jacobi step for cores too large for one VMEM block.
+
+    The grid walks row bands of the core; each input block is the band plus
+    one row above and below — overlapping reads expressed with
+    Element-indexed block dims (the index_map steps by ``band`` elements
+    while the block spans ``band + 2`` rows). Only the new core is
+    produced; the caller's padded tile is re-wrapped around it. Requires
+    halo >= 1 and core_h % band == 0.
+    """
+    if layout.halo_y < 1 or layout.halo_x < 1:
+        raise ValueError("five_point needs halo >= 1 on both axes")
+    if tuple(tile.shape) != layout.padded_shape:
+        raise ValueError(f"tile {tile.shape} != padded {layout.padded_shape}")
+    h, w = layout.core_h, layout.core_w
+    band = min(band, h)
+    if h % band:
+        raise ValueError(f"core_h {h} not divisible by band {band}")
+    hy, hx = layout.halo_y, layout.halo_x
+    grid = h // band
+    pw = layout.padded_shape[1]
+
+    new_core = pl.pallas_call(
+        functools.partial(
+            _band_kernel, band=band, halo_x=hx, width=w, coeffs=coeffs
+        ),
+        grid=(grid,),
+        in_specs=[
+            # band i reads rows [hy-1 + i*band, hy+1 + i*band + band)
+            pl.BlockSpec(
+                (Element(band + 2), Element(pw)),
+                lambda i: (hy - 1 + i * band, 0),
+            )
+        ],
+        out_specs=pl.BlockSpec((band, w), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((h, w), tile.dtype),
+        interpret=use_interpret(),
+    )(tile)
+    return jax.lax.dynamic_update_slice(tile, new_core, (hy, hx))
