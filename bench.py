@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Headline benchmark: 2D 5-point stencil, 1024^2, on the available device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+BASELINE.md config 1 (the reference publishes no numbers — this repo
+establishes the baseline; see SURVEY.md §6). On a TPU this runs the full
+framework path — halo exchange (self-wrap on a 1x1 mesh) + 5-point
+update, scanned — with both the XLA and Pallas compute paths, reporting
+the faster. ``vs_baseline`` compares against BENCH_BASELINE.json (the
+first recorded run) when present, else 1.0.
+"""
+
+import json
+import pathlib
+import sys
+
+BASELINE_FILE = pathlib.Path(__file__).parent / "BENCH_BASELINE.json"
+GRID = (1024, 1024)
+STEPS = 10
+
+
+def main() -> int:
+    import jax
+
+    from tpuscratch.bench.stencil_bench import bench_stencil
+    from tpuscratch.runtime.mesh import make_mesh_2d
+
+    n_dev = len(jax.devices())
+    if n_dev == 1:
+        mesh = make_mesh_2d((1, 1))
+    else:
+        from tpuscratch.runtime.topology import factor2d
+
+        rows, cols = factor2d(n_dev)
+        if GRID[0] % rows or GRID[1] % cols:
+            rows, cols = 1, 1  # indivisible factorization: single device
+        mesh = make_mesh_2d((rows, cols))
+
+    best = None
+    for impl in ("xla", "pallas"):
+        try:
+            res = bench_stencil(GRID, STEPS, mesh=mesh, impl=impl, iters=5)
+        except Exception as e:  # an impl failing shouldn't kill the bench
+            print(f"# impl {impl} failed: {e}", file=sys.stderr)
+            continue
+        if best is None or res.items_per_s > best.items_per_s:
+            best = res
+    if best is None:
+        raise SystemExit("all stencil impls failed")
+
+    value = best.items_per_s
+    vs = 1.0
+    if BASELINE_FILE.exists():
+        base = json.loads(BASELINE_FILE.read_text()).get("value")
+        if base:
+            vs = value / base
+    print(
+        json.dumps(
+            {
+                "metric": "stencil2d_1024x1024_cell_updates_per_s",
+                "value": round(value, 1),
+                "unit": "cells/s",
+                "vs_baseline": round(vs, 4),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

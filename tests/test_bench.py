@@ -1,0 +1,74 @@
+"""Tests for the benchmark harnesses (correctness, not performance)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpuscratch.bench.dot_bench import bench_dot
+from tpuscratch.bench.pingpong import host_staging_roundtrip, sweep, verify_echo
+from tpuscratch.bench.stencil_bench import bench_stencil
+from tpuscratch.halo.driver import assemble, decompose, distributed_stencil
+from tpuscratch.halo.layout import TileLayout
+from tpuscratch.runtime.mesh import make_mesh_1d, make_mesh_2d
+from tpuscratch.runtime.topology import CartTopology
+
+
+class TestDriver:
+    def test_decompose_assemble_roundtrip(self):
+        topo = CartTopology((2, 4), (True, True))
+        lay = TileLayout(4, 8, 1, 1)
+        world = np.arange(8 * 32, dtype=np.float32).reshape(8, 32)
+        tiles = decompose(world, topo, lay)
+        assert tiles.shape == (2, 4, 6, 10)
+        np.testing.assert_array_equal(assemble(tiles, topo, lay), world)
+
+    def test_distributed_stencil_matches_roll(self):
+        rng = np.random.default_rng(4)
+        world = rng.standard_normal((16, 16)).astype(np.float32)
+        got = distributed_stencil(world, steps=2)
+        expect = world
+        for _ in range(2):
+            expect = 0.25 * (
+                np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
+                + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
+            )
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_single_device_mesh_self_wrap(self):
+        # 1x1 mesh: periodic halo wraps to self — single-chip path of bench.py
+        rng = np.random.default_rng(9)
+        world = rng.standard_normal((8, 8)).astype(np.float32)
+        got = distributed_stencil(world, steps=1, mesh=make_mesh_2d((1, 1)))
+        expect = 0.25 * (
+            np.roll(world, 1, 0) + np.roll(world, -1, 0)
+            + np.roll(world, 1, 1) + np.roll(world, -1, 1)
+        )
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+class TestPingpong:
+    def test_echo_verifies(self):
+        mesh = make_mesh_1d("x")
+        assert verify_echo(mesh, "x", 256)
+
+    def test_sweep_small(self):
+        mesh = make_mesh_1d("x")
+        results = sweep(mesh, sizes_bytes=(8, 128), iters=2)
+        assert len(results) == 2
+        assert all(r.p50 > 0 for r in results)
+        assert results[1].bytes_moved == 2 * 32 * 4
+
+    def test_host_staging(self):
+        res = host_staging_roundtrip(1024, iters=2)
+        assert res.p50 > 0
+
+
+class TestBenchPrograms:
+    def test_dot_bench_self_check(self):
+        mesh = make_mesh_1d("x")
+        res = bench_dot(mesh, n_elems=8 * 4096, iters=2, check=True)
+        assert res.items == 8 * 4096
+
+    def test_stencil_bench_runs(self):
+        res = bench_stencil(grid=(32, 32), steps=2, iters=2)
+        assert res.items == 32 * 32 * 2
+        assert res.items_per_s > 0

@@ -187,14 +187,27 @@ class TestStencilKernels:
         )
         outs = {}
         for impl in ("xla", "pallas"):
+            # steps=1 deliberately: the single-step program is where the
+            # XLA:CPU in-place-update miscompile hid (steps>=2 masked it)
             f = run_spmd(
                 mesh,
-                lambda x, impl=impl: run_stencil(x[0, 0], spec, 2, impl=impl)[None, None],
+                lambda x, impl=impl: run_stencil(x[0, 0], spec, 1, impl=impl)[None, None],
                 P("row", "col", None, None),
                 P("row", "col", None, None),
             )
             outs[impl] = np.asarray(f(tiles))
         np.testing.assert_allclose(outs["xla"], outs["pallas"], rtol=1e-6)
+        # and against the global periodic oracle, not just each other
+        from tpuscratch.halo.driver import assemble
+
+        topo = CartTopology((2, 4), (True, True))
+        world = assemble(np.asarray(tiles), topo, lay)
+        expect = 0.25 * (
+            np.roll(world, 1, 0) + np.roll(world, -1, 0)
+            + np.roll(world, 1, 1) + np.roll(world, -1, 1)
+        )
+        got = assemble(outs["pallas"], topo, lay)
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
 
         with pytest.raises(ValueError):
             from tpuscratch.halo.stencil import stencil_step

@@ -1,0 +1,54 @@
+"""Distributed dot-product benchmark (mpicuda3/4 timing parity).
+
+End-to-end: shard two vectors over the mesh, per-shard Pallas reduction,
+one psum, report elements/s. The reference's wall-time convention —
+every rank stamps begin/end, span = max(end)-min(begin) across ranks
+(mpicuda3.cu:315-325) — collapses in a single-process mesh to a
+block_until_ready bracket (all shards complete before the bracket closes);
+on multi-process slices use ``timing.span_max_min`` over per-process
+stamps. The NO_GPU_MALLOC_TIME carve-out is the warmup exclusion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.ops.reduction import local_dot_psum
+
+
+def dot_program(mesh: Mesh, axis: str = "x", method: str = "full", block_rows: int = 512):
+    return run_spmd(
+        mesh,
+        lambda a, b: local_dot_psum(a, b, axis, method=method, block_rows=block_rows),
+        (P(axis), P(axis)),
+        P(),
+    )
+
+
+def bench_dot(
+    mesh: Mesh,
+    n_elems: int = 100_000_000,
+    axis: str = "x",
+    method: str = "full",
+    iters: int = 5,
+    check: bool = True,
+) -> BenchResult:
+    """Time the distributed dot of ``n_elems`` f32 (BASELINE config 2)."""
+    n_dev = mesh.devices.size
+    n_elems = (n_elems // n_dev) * n_dev  # even shards
+    x = jnp.ones(n_elems, dtype=jnp.float32)
+    f = dot_program(mesh, axis, method)
+    if check:
+        got = float(f(x, x))
+        if abs(got - n_elems) > 1e-3 * n_elems:
+            raise AssertionError(f"dot self-check FAILED: {got} != {n_elems}")
+    return time_device(
+        f, x, x,
+        iters=iters, warmup=2,
+        name=f"dot {n_elems:.0e} f32 ({method})", items=n_elems,
+        bytes_moved=2 * 4 * n_elems,
+    )

@@ -1,0 +1,96 @@
+"""Pingpong latency/bandwidth probe over mesh links.
+
+The reference's probe sends one round trip of N doubles GPU-to-GPU and
+times it with MPI_Wtime, separately timing the D2H copy, verifying the
+echo, and printing PASSED/FAILED with sizes and times
+(/root/reference/test-benchmark/mpi-pingpong-gpu.cpp:24-87; async variant
+with host-staging ablations at mpi-pingpong-gpu-async.cpp:43-106). Here the
+round trip is a pair of ppermutes between two mesh ranks (ICI on TPU); the
+device-direct property is free (jax.Arrays live on device), and the
+HOST_COPY ablation becomes an explicit device->host->device staging timing
+so the comparison the reference makes is still measurable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.comm.p2p import pingpong
+
+DEFAULT_SIZES = tuple(8 * 4**i for i in range(13))  # 8 B ... 128 MiB (f32)
+
+
+def pingpong_program(mesh: Mesh, axis: str, n_elems: int, a: int = 0, b: int = 1, rounds: int = 1):
+    """Compiled SPMD pingpong: rank a's shard bounces to b and back."""
+    return run_spmd(
+        mesh,
+        lambda x: pingpong(x, axis, a=a, b=b, rounds=rounds),
+        P(axis),
+        P(axis),
+    )
+
+
+def verify_echo(mesh: Mesh, axis: str, n_elems: int) -> bool:
+    """PASSED/FAILED self-check: the echoed payload equals the original
+    (mpi-pingpong-gpu.cpp:58-61)."""
+    n = mesh.devices.size
+    payload = np.zeros((n, n_elems), dtype=np.float32)
+    payload[0] = np.random.default_rng(0).standard_normal(n_elems)
+    f = pingpong_program(mesh, axis, n_elems)
+    out = np.asarray(f(jnp.asarray(payload.reshape(-1)))).reshape(n, n_elems)
+    return bool((out[0] == payload[0]).all())
+
+
+def sweep(
+    mesh: Mesh,
+    axis: str = "x",
+    sizes_bytes: Sequence[int] = DEFAULT_SIZES,
+    rounds: int = 1,
+    iters: int = 10,
+) -> list[BenchResult]:
+    """Latency/BW sweep over message sizes (8 B - 128 MB by default).
+
+    One round trip moves the payload twice, so bytes_moved = 2 * size *
+    rounds. p50 over ``iters`` timed repetitions after warmup.
+    """
+    n = mesh.devices.size
+    results = []
+    for size in sizes_bytes:
+        n_elems = max(1, size // 4)  # f32 payload
+        f = pingpong_program(mesh, axis, n_elems, rounds=rounds)
+        x = jnp.zeros(n * n_elems, dtype=jnp.float32)
+        results.append(
+            time_device(
+                f,
+                x,
+                iters=iters,
+                warmup=2,
+                name=f"pingpong {size}B",
+                bytes_moved=2 * n_elems * 4 * rounds,
+            )
+        )
+    return results
+
+
+def host_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
+    """The HOST_COPY ablation: device -> host -> device staging, timed —
+    what GPU-direct (device-resident arrays) saves
+    (mpi-pingpong-gpu-async.cpp:59-70)."""
+    x = jnp.zeros(n_elems, dtype=jnp.float32)
+    jax.block_until_ready(x)
+
+    def stage(v):
+        host = np.asarray(v)          # D2H
+        return jax.device_put(host)   # H2D
+
+    return time_device(
+        stage, x, iters=iters, warmup=1,
+        name=f"host staging {n_elems * 4}B", bytes_moved=2 * n_elems * 4,
+    )

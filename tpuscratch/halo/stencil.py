@@ -28,18 +28,34 @@ def five_point(tile: jax.Array, layout: TileLayout, coeffs=(0.25, 0.25, 0.25, 0.
     """
     hy, hx = layout.halo_y, layout.halo_x
     if hy < 1 or hx < 1:
-        # dynamic_slice clamps out-of-range starts, so a 0-halo layout
-        # would silently read the core in place of the shifted planes
         raise ValueError(f"five_point needs halo >= 1, got ({hy},{hx})")
     h, w = layout.core_h, layout.core_w
     cn, cs, cw, ce, cc = coeffs
-    core = lax.dynamic_slice(tile, (hy, hx), (h, w))
-    north = lax.dynamic_slice(tile, (hy - 1, hx), (h, w))
-    south = lax.dynamic_slice(tile, (hy + 1, hx), (h, w))
-    west = lax.dynamic_slice(tile, (hy, hx - 1), (h, w))
-    east = lax.dynamic_slice(tile, (hy, hx + 1), (h, w))
+    core = tile[hy : hy + h, hx : hx + w]
+    north = tile[hy - 1 : hy - 1 + h, hx : hx + w]
+    south = tile[hy + 1 : hy + 1 + h, hx : hx + w]
+    west = tile[hy : hy + h, hx - 1 : hx - 1 + w]
+    east = tile[hy : hy + h, hx + 1 : hx + 1 + w]
     new_core = cn * north + cs * south + cw * west + ce * east + cc * core
-    return lax.dynamic_update_slice(tile, new_core, (hy, hx))
+    return rebuild(tile, new_core, layout)
+
+
+def rebuild(tile: jax.Array, new_core: jax.Array, layout: TileLayout) -> jax.Array:
+    """Wrap a freshly-computed core back into the padded tile's border.
+
+    By concatenation, NOT dynamic_update_slice: an in-place core update
+    fused with overlapping shifted reads of the same buffer miscompiles on
+    XLA:CPU under shard_map (Gauss-Seidel-like partial reads; even
+    optimization_barrier does not prevent it — found by the steps=1 oracle
+    test). Concat allocates a fresh buffer by construction and fuses just
+    as well.
+    """
+    hy, hx = layout.halo_y, layout.halo_x
+    h, w = layout.core_h, layout.core_w
+    mid = jnp.concatenate(
+        [tile[hy : hy + h, :hx], new_core, tile[hy : hy + h, hx + w :]], axis=1
+    )
+    return jnp.concatenate([tile[:hy], mid, tile[hy + h :]], axis=0)
 
 
 def _compute(tile: jax.Array, layout: TileLayout, coeffs, impl: str) -> jax.Array:

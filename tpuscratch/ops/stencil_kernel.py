@@ -29,6 +29,7 @@ except ImportError:  # not re-exported in this jax version
     from jax._src.pallas.core import Element
 
 from tpuscratch.halo.layout import TileLayout
+from tpuscratch.halo.stencil import rebuild
 from tpuscratch.ops.common import use_interpret
 
 Coeffs = tuple[float, float, float, float, float]
@@ -40,29 +41,36 @@ def _tile_kernel(t_ref, o_ref, *, layout: TileLayout, coeffs: Coeffs):
     h, w = layout.core_h, layout.core_w
     cn, cs, cw, ce, cc = coeffs
     t = t_ref[:]
-    new_core = (
+    o_ref[:] = (
         cn * t[hy - 1 : hy - 1 + h, hx : hx + w]
         + cs * t[hy + 1 : hy + 1 + h, hx : hx + w]
         + cw * t[hy : hy + h, hx - 1 : hx - 1 + w]
         + ce * t[hy : hy + h, hx + 1 : hx + 1 + w]
         + cc * t[hy : hy + h, hx : hx + w]
     )
-    o_ref[:] = t
-    o_ref[hy : hy + h, hx : hx + w] = new_core
 
 
 @functools.partial(jax.jit, static_argnames=("layout", "coeffs"))
 def five_point_pallas(tile: jax.Array, layout: TileLayout, coeffs: Coeffs = JACOBI) -> jax.Array:
-    """One Jacobi step over the whole padded tile in one VMEM block."""
+    """One Jacobi step over the whole padded tile in one VMEM block.
+
+    The kernel emits ONLY the new core (a fresh buffer); the halo border is
+    re-wrapped by concatenation. Emitting the full tile (copy + core
+    overwrite) invites the same in-place aliasing hazard the XLA path hit
+    in interpret mode — see halo.stencil.rebuild.
+    """
     if layout.halo_y < 1 or layout.halo_x < 1:
         raise ValueError("five_point needs halo >= 1 on both axes")
     if tuple(tile.shape) != layout.padded_shape:
         raise ValueError(f"tile {tile.shape} != padded {layout.padded_shape}")
-    return pl.pallas_call(
+    new_core = pl.pallas_call(
         functools.partial(_tile_kernel, layout=layout, coeffs=coeffs),
-        out_shape=jax.ShapeDtypeStruct(tile.shape, tile.dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (layout.core_h, layout.core_w), tile.dtype
+        ),
         interpret=use_interpret(),
     )(tile)
+    return rebuild(tile, new_core, layout)
 
 
 def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
@@ -124,4 +132,4 @@ def five_point_blocked(
         out_shape=jax.ShapeDtypeStruct((h, w), tile.dtype),
         interpret=use_interpret(),
     )(tile)
-    return jax.lax.dynamic_update_slice(tile, new_core, (hy, hx))
+    return rebuild(tile, new_core, layout)
