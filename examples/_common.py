@@ -1,0 +1,36 @@
+"""Shared example plumbing: device bring-up and pretty printing.
+
+Every example mirrors one reference program (the mpi1..mpi10 progression,
+the CUDA dot products, the stencil drivers, the pingpong benchmarks —
+SURVEY.md §2). Most need several devices; by default each example runs on
+a virtual CPU mesh of 8 devices — the same single-box testing trick the
+reference uses by running many MPI ranks on one node (mpicuda2.cu:31-32).
+Set TPUSCRATCH_ON_DEVICE=1 on a real multi-chip host to use the hardware
+mesh instead.
+"""
+
+from __future__ import annotations
+
+import os
+
+N_DEVICES = 8
+
+
+def ensure_devices(n: int = N_DEVICES):
+    """Return jax with >= n devices (virtual CPU mesh unless opted out)."""
+    if os.environ.get("TPUSCRATCH_ON_DEVICE", "") not in ("1", "true"):
+        from tpuscratch.runtime.hostenv import force_cpu_devices
+
+        force_cpu_devices(n)
+    import jax
+
+    if len(jax.devices()) < n:
+        raise SystemExit(
+            f"{len(jax.devices())} device(s) available but {n} needed — "
+            "unset TPUSCRATCH_ON_DEVICE to use a virtual CPU mesh"
+        )
+    return jax
+
+
+def banner(title: str) -> None:
+    print(f"== {title} ==")

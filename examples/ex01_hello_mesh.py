@@ -1,0 +1,33 @@
+"""Runtime bring-up: process identity, devices, mesh — mpi1/mpi2 parity.
+
+The reference's hello world prints rank, size, and processor name after
+MPI_Init (/root/reference/mpi1.cpp), and mpi2 adds error-handler
+installation. Here: initialize(), the per-process hello line, a mesh over
+every device, and the error-policy guard around the whole bring-up.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import banner, ensure_devices
+
+
+def main() -> None:
+    ensure_devices()
+    from tpuscratch import initialize, make_mesh_1d
+    from tpuscratch.runtime.errors import ErrorPolicy, guarded
+    from tpuscratch.runtime.log import RankLogger
+
+    banner("hello mesh")
+    with guarded("bring-up", ErrorPolicy.RAISE):
+        ctx = initialize()
+        print(ctx.hello())
+        mesh = make_mesh_1d("world")
+        log = RankLogger(rank=ctx.process_index)
+        log(f"mesh axes {mesh.axis_names}, {mesh.devices.size} devices:")
+        for d in mesh.devices.flat:
+            log("  device", d)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""Distributed dot product with kernel strategy selection and timing —
+mpicuda2/3/4 parity.
+
+The reference shards two big vectors across ranks, reduces each shard on
+the GPU (three kernel strategies), then MPI_Reduces to rank 0, timing the
+whole thing with the max-min convention (SURVEY.md §2.3). Here: shard via
+in_specs, Pallas kernel per shard ('partials' = two-phase,
+'full' = single-kernel accumulator — no atomics needed, TPU grids are
+sequential), one psum, block_until_ready-bracketed timing.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import banner, ensure_devices
+
+
+def main() -> None:
+    ensure_devices()
+    import numpy as np
+
+    from tpuscratch.bench.dot_bench import bench_dot
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    banner("distributed dot product (mpicuda2-4)")
+    mesh = make_mesh_1d("x")
+    n = 1 << 22  # 4Mi f32 per run
+    for method in ("full", "partials", "xla"):
+        res = bench_dot(mesh, n_elems=n, method=method, iters=3)
+        print(res.summary())
+    print("self-check vs n*1.0: PASSED (bench_dot asserts internally)")
+
+
+if __name__ == "__main__":
+    main()

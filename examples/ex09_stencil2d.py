@@ -1,0 +1,78 @@
+"""The flagship: 2D stencil with periodic halo exchange — stencil2d parity.
+
+Mirrors the reference drivers (/root/reference/stencil2d/
+mpi-2d-stencil-subarray{.cpp,-cuda.cu}): a periodic process grid, per-rank
+tiles with ghost borders initialized to the rank id (halo = -1), one
+exchange, and per-rank dumps named by grid coordinates — then goes beyond
+the reference's no-op Compute: several real 5-point iterations, checked
+against the undecomposed-grid oracle.
+"""
+
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, ".")
+from examples._common import banner, ensure_devices
+
+
+def main() -> None:
+    ensure_devices()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpuscratch.comm import run_spmd
+    from tpuscratch.halo import HaloSpec, TileLayout, halo_exchange
+    from tpuscratch.halo.driver import distributed_stencil
+    from tpuscratch.runtime.log import coord_filename
+    from tpuscratch.runtime.mesh import make_mesh_2d, topology_of
+
+    banner("stencil2d halo exchange (flagship)")
+    mesh = make_mesh_2d((2, 4))
+    topo = topology_of(mesh, periodic=True)
+    lay = TileLayout.for_stencil(8, 8, 5, 5)  # 5x5 stencil -> halo 2
+    spec = HaloSpec(layout=lay, topology=topo, axes=tuple(mesh.axis_names))
+
+    tiles = np.full((2, 4) + lay.padded_shape, -1.0, dtype=np.float32)
+    for r in topo.ranks():
+        rr, cc = topo.coords(r)
+        tiles[rr, cc, 2:-2, 2:-2] = r
+
+    f = run_spmd(
+        mesh,
+        lambda x: halo_exchange(x[0, 0], spec)[None, None],
+        P("row", "col", None, None),
+        P("row", "col", None, None),
+    )
+    out = np.asarray(f(jnp.asarray(tiles)))
+
+    outdir = pathlib.Path(tempfile.mkdtemp(prefix="stencil2d_"))
+    for r in topo.ranks():
+        rr, cc = topo.coords(r)
+        path = outdir / coord_filename((rr, cc))
+        with path.open("w") as fh:
+            fh.write(f"Rank: {r}\nCoord: {rr}, {cc}\n\nArray after exchange\n")
+            for row in out[rr, cc]:
+                fh.write(" ".join(f"{v:.0f}" for v in row) + "\n")
+    print(f"per-rank dumps written to {outdir} (cf. stencil2d/sample-output)")
+    print("rank 0 tile after exchange (core=0, halo=neighbor ids):")
+    print(np.array2string(out[0, 0], precision=0))
+
+    banner("real compute: 5 Jacobi iterations vs global oracle")
+    rng = np.random.default_rng(0)
+    world = rng.standard_normal((64, 64)).astype(np.float32)
+    got = distributed_stencil(world, steps=5, mesh=mesh)
+    expect = world
+    for _ in range(5):
+        expect = 0.25 * (
+            np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
+            + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
+        )
+    err = np.abs(got - expect).max()
+    print(f"max |distributed - global| after 5 steps: {err:.2e} "
+          f"({'PASSED' if err < 1e-5 else 'FAILED'})")
+
+
+if __name__ == "__main__":
+    main()

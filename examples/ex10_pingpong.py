@@ -1,0 +1,31 @@
+"""Pingpong latency/bandwidth probe — test-benchmark parity.
+
+The reference times one round trip of N doubles between two GPUs through
+GPU-direct MPI, verifies the echo, and prints PASSED with times
+(/root/reference/test-benchmark/mpi-pingpong-gpu.cpp). Here the round trip
+is a ppermute pair over the mesh interconnect (ICI on TPU); the host
+staging ablation shows what device-resident arrays save.
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+from examples._common import banner, ensure_devices
+
+
+def main() -> None:
+    ensure_devices()
+    from tpuscratch.bench.pingpong import host_staging_roundtrip, sweep, verify_echo
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    banner("pingpong (test-benchmark)")
+    mesh = make_mesh_1d("x")
+    ok = verify_echo(mesh, "x", 4096)
+    print(f"echo self-check: {'PASSED' if ok else 'FAILED'}")
+    for res in sweep(mesh, sizes_bytes=(8, 1024, 65536, 1 << 20), iters=5):
+        print(" ", res.summary())
+    print(" ", host_staging_roundtrip(1 << 18, iters=5).summary(), "(ablation)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+"""Smoke test: every example's main() runs clean on the CPU mesh.
+
+The examples are the L5' program catalog (SURVEY.md §2.2/§7.6); running
+them end-to-end is the closest analogue of the reference's self-checking
+mains.
+"""
+
+import importlib
+import pathlib
+import sys
+
+import pytest
+
+EXAMPLES_DIR = pathlib.Path(__file__).parent.parent / "examples"
+sys.path.insert(0, str(EXAMPLES_DIR.parent))
+
+EXAMPLES = sorted(p.stem for p in EXAMPLES_DIR.glob("ex*.py"))
+
+
+@pytest.mark.parametrize("name", EXAMPLES)
+def test_example_runs(name, capsys):
+    mod = importlib.import_module(f"examples.{name}")
+    mod.main()
+    out = capsys.readouterr().out
+    assert "==" in out  # banner printed
+    assert "FAILED" not in out
