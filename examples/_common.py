@@ -18,7 +18,9 @@ N_DEVICES = 8
 
 def ensure_devices(n: int = N_DEVICES):
     """Return jax with >= n devices (virtual CPU mesh unless opted out)."""
-    if os.environ.get("TPUSCRATCH_ON_DEVICE", "") not in ("1", "true"):
+    if os.environ.get("TPUSCRATCH_ON_DEVICE", "").strip().lower() not in (
+        "1", "true", "yes", "on",
+    ):
         from tpuscratch.runtime.hostenv import force_cpu_devices
 
         force_cpu_devices(n)

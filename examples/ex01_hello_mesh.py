@@ -6,9 +6,10 @@ installation. Here: initialize(), the per-process hello line, a mesh over
 every device, and the error-policy guard around the whole bring-up.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

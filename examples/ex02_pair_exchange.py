@@ -7,9 +7,10 @@ of the traced payload. The exchange itself is one ppermute with the pair
 table [(0,1),(1,0)].
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

@@ -5,9 +5,10 @@ rounds (/root/reference/mpi4.cpp:24-44). Here the token circulates the
 whole ring inside one compiled lax.scan — no per-hop dispatch.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

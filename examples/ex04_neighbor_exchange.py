@@ -8,9 +8,10 @@ does both: the neighbor ppermutes and the gather are a single compiled
 collective schedule — the Waitall is implicit in dataflow.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
@@ -18,7 +19,6 @@ def main() -> None:
     ensure_devices()
     import jax.numpy as jnp
     import numpy as np
-    from jax import lax
     from jax.sharding import PartitionSpec as P
 
     from tpuscratch.comm import gather_to_root, neighbor_exchange, run_spmd
@@ -29,15 +29,16 @@ def main() -> None:
     n = mesh.devices.size
 
     def body(x):
-        left, right = neighbor_exchange(x, "x", periodic=False)
-        triple = jnp.stack([left, x, right])       # (3,) per rank
-        return gather_to_root(triple, "x")         # (n, 3) on root, 0 else
+        # exchange rank+1 so ppermute's zero fill decodes to -1 ("no
+        # neighbor") and is never confused with rank 0's real id
+        left, right = neighbor_exchange(x + 1.0, "x", periodic=False)
+        triple = jnp.stack([left - 1.0, x, right - 1.0])  # (3, 1) per rank
+        return gather_to_root(triple, "x")                # (n, 3, 1) on root
 
     f = run_spmd(mesh, body, P("x"), P("x", None))
-    # local x is a (1,)-shard, so the gathered block is (n, 3, 1) per rank
     out = np.asarray(f(jnp.arange(n, dtype=jnp.float32)))
     root_view = out[:n, :, 0]  # root rank's gathered block
-    print("rank: (from-left, self, from-right)  [0 = open boundary]")
+    print("rank: (from-left, self, from-right)  [-1 = open boundary]")
     for r, (left, me, right) in enumerate(root_view):
         print(f"  {r}: ({left:.0f}, {me:.0f}, {right:.0f})")
 

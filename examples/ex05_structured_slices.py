@@ -9,9 +9,10 @@ of a committed MPI datatype:
   (mpi-complex-types) — pointer displacements become list indices.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

@@ -8,9 +8,10 @@ the per-half reduce, psum over both axes is the world reduce, and the
 "renumbered rank" is just lax.axis_index('local').
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

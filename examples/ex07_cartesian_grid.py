@@ -6,9 +6,10 @@ nonblocking ops + waitall (/root/reference/mpi10.cpp:27-54). Here the
 topology is a value object whose shift tables compile into four ppermutes.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
@@ -33,7 +34,8 @@ def main() -> None:
         received = []
         for d in (Direction.TOP, Direction.BOTTOM, Direction.LEFT, Direction.RIGHT):
             perm = topo.send_permutation(d.opposite)  # receive from d
-            received.append(lax.ppermute(x, ("row", "col"), perm))
+            # send rank+1: the zero fill decodes to -1, distinct from rank 0
+            received.append(lax.ppermute(x + 1.0, ("row", "col"), perm) - 1.0)
         return tuple(received)
 
     ids = jnp.arange(topo.size, dtype=jnp.float32).reshape(topo.dims)
@@ -45,7 +47,7 @@ def main() -> None:
         rr, cc = topo.coords(r)
         print(
             f"rank {r} ({rr},{cc}): top={top[rr, cc]:.0f} bottom={bottom[rr, cc]:.0f} "
-            f"left={left[rr, cc]:.0f} right={right[rr, cc]:.0f}  [0 = none]"
+            f"left={left[rr, cc]:.0f} right={right[rr, cc]:.0f}  [-1 = none]"
         )
 
 

@@ -9,9 +9,10 @@ in_specs, Pallas kernel per shard ('partials' = two-phase,
 sequential), one psum, block_until_ready-bracketed timing.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

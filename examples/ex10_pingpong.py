@@ -7,9 +7,10 @@ is a ppermute pair over the mesh interconnect (ICI on TPU); the host
 staging ablation shows what device-resident arrays save.
 """
 
+import pathlib
 import sys
 
-sys.path.insert(0, ".")
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 

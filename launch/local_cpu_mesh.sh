@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Single-box dev path: run any workload on a virtual N-device CPU mesh.
+#
+# The reference validates multi-node code by running many MPI ranks on one
+# node (mpicuda2.cu:31-32); this is the same loop for the XLA backend.
+#
+# Usage: ./launch/local_cpu_mesh.sh [-n devices] script.py [args...]
+set -euo pipefail
+
+N=8
+if [ "${1:-}" = "-n" ]; then N="$2"; shift 2; fi
+WORKLOAD="${1:?usage: local_cpu_mesh.sh [-n devices] <script.py> [args...]}"
+shift || true
+
+exec env -u PYTHONPATH \
+  JAX_PLATFORMS=cpu \
+  XLA_FLAGS="--xla_force_host_platform_device_count=${N}" \
+  python "$WORKLOAD" "$@"

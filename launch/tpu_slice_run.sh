@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Run a tpuscratch workload on every worker of a TPU-VM slice.
+#
+# Replaces the reference's PBS/SLURM + mpiexec.hydra job scripts
+# (mpi_pbs_sample.sh, job_9_1_1_cuda-2d-stencil-subarray.slurm): the slice
+# plays the scheduler's role, --worker=all plays mpiexec's.
+#
+# Usage:
+#   TPU_NAME=my-slice ZONE=us-central1-a ./launch/tpu_slice_run.sh \
+#       examples/ex09_stencil2d.py
+set -euo pipefail
+
+TPU_NAME="${TPU_NAME:?set TPU_NAME to the slice name}"
+ZONE="${ZONE:?set ZONE}"
+PROJECT="${PROJECT:-}"
+WORKLOAD="${1:?usage: tpu_slice_run.sh <script.py> [args...]}"
+shift || true
+
+PROJ_FLAG=()
+[ -n "$PROJECT" ] && PROJ_FLAG=(--project "$PROJECT")
+
+# One process per host; jax's TPU auto-detection performs the rendezvous
+# (the MPI_Init equivalent) across workers.
+exec gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+  --zone "$ZONE" "${PROJ_FLAG[@]}" \
+  --worker=all \
+  --command "cd ~/tpuscratch && TPUSCRATCH_ON_DEVICE=1 python $WORKLOAD $*"
