@@ -1,0 +1,111 @@
+// Native halo-exchange planner: topology + region geometry + plan builder.
+//
+// The reference's one true library is native C++ (the header-only templated
+// stencil2D.h: cartesian neighbor math at :232-299, 13-case region geometry
+// at :107-201, transfer-plan construction at :319-437). This is its
+// counterpart for the XLA backend: the same trace-time planning work —
+// neighbor tables, send/recv rectangles, ppermute permutations — done in
+// compiled code and handed to Python over a flat C ABI (ctypes). The hot
+// DATA path stays in XLA; this is the hot PLANNING path for large meshes,
+// where building 8 permutation tables for thousands of ranks in Python
+// is measurable at trace time.
+//
+// Conventions (must match tpuscratch/runtime/topology.py and
+// tpuscratch/halo/layout.py exactly; tests cross-check):
+//   - row-major ranks over (rows, cols); coords (r, c); row 0 = top
+//   - direction = (dr, dc) in {-1,0,1}^2 \ {(0,0)}
+//   - rect = {oy, ox, h, w} in padded-tile coordinates
+//   - missing neighbor (open boundary) = -1
+
+#include <cstdint>
+
+extern "C" {
+
+// Rank at coords + (dr,dc), honoring per-axis periodicity; -1 if off-grid.
+int32_t ts_neighbor(int32_t rows, int32_t cols, int32_t per_r, int32_t per_c,
+                    int32_t rank, int32_t dr, int32_t dc) {
+  if (rows <= 0 || cols <= 0 || rank < 0 || rank >= rows * cols) return -1;
+  int32_t r = rank / cols + dr;
+  int32_t c = rank % cols + dc;
+  if (r < 0 || r >= rows) {
+    if (!per_r) return -1;
+    r = ((r % rows) + rows) % rows;
+  }
+  if (c < 0 || c >= cols) {
+    if (!per_c) return -1;
+    c = ((c % cols) + cols) % cols;
+  }
+  return r * cols + c;
+}
+
+// (src, dst) pairs where every rank sends toward (dr,dc). Returns the pair
+// count; src/dst must hold rows*cols entries.
+int32_t ts_send_permutation(int32_t rows, int32_t cols, int32_t per_r,
+                            int32_t per_c, int32_t dr, int32_t dc,
+                            int32_t* src, int32_t* dst) {
+  int32_t n = 0;
+  for (int32_t rank = 0; rank < rows * cols; ++rank) {
+    int32_t nb = ts_neighbor(rows, cols, per_r, per_c, rank, dr, dc);
+    if (nb >= 0) {
+      src[n] = rank;
+      dst[n] = nb;
+      ++n;
+    }
+  }
+  return n;
+}
+
+// The ghost-border piece in direction (dr,dc) — the receive landing zone.
+void ts_halo_rect(int32_t core_h, int32_t core_w, int32_t hy, int32_t hx,
+                  int32_t dr, int32_t dc, int32_t* rect) {
+  rect[0] = dr < 0 ? 0 : (dr > 0 ? hy + core_h : hy);  // oy
+  rect[2] = dr == 0 ? core_h : hy;                     // h
+  rect[1] = dc < 0 ? 0 : (dc > 0 ? hx + core_w : hx);  // ox
+  rect[3] = dc == 0 ? core_w : hx;                     // w
+}
+
+// The core strip adjacent to edge (dr,dc) — what travels to that neighbor.
+void ts_send_rect(int32_t core_h, int32_t core_w, int32_t hy, int32_t hx,
+                  int32_t dr, int32_t dc, int32_t* rect) {
+  rect[0] = dr > 0 ? core_h : hy;   // oy (dr>0: bottom strip starts at
+                                    //     hy + core_h - hy == core_h)
+  rect[2] = dr == 0 ? core_h : hy;  // h
+  rect[1] = dc > 0 ? core_w : hx;   // ox
+  rect[3] = dc == 0 ? core_w : hx;  // w
+}
+
+// Full plan: for each direction d of the 8 (or 4 edge-only), the data
+// arriving in my d-halo flows toward opposite(d). Outputs, per direction i:
+//   dirs[2i..] = (dr, dc) of the halo piece
+//   send_rects[4i..] / recv_rects[4i..]
+//   perm pairs at perm_src/dst[i*rows*cols ..], count in perm_counts[i]
+// Returns the direction count, or -1 on invalid input.
+int32_t ts_build_plan(int32_t rows, int32_t cols, int32_t per_r, int32_t per_c,
+                      int32_t core_h, int32_t core_w, int32_t hy, int32_t hx,
+                      int32_t neighbors, int32_t* dirs, int32_t* send_rects,
+                      int32_t* recv_rects, int32_t* perm_src, int32_t* perm_dst,
+                      int32_t* perm_counts) {
+  if (rows <= 0 || cols <= 0 || core_h <= 0 || core_w <= 0 || hy < 0 ||
+      hx < 0 || hy > core_h || hx > core_w)
+    return -1;
+  if (neighbors != 4 && neighbors != 8) return -1;
+  // Same stable order as topology.ALL_DIRECTIONS: edges then corners.
+  static const int32_t kDirs[8][2] = {{-1, 0}, {1, 0},  {0, -1}, {0, 1},
+                                      {-1, -1}, {-1, 1}, {1, -1}, {1, 1}};
+  const int32_t ndirs = neighbors == 8 ? 8 : 4;
+  const int32_t stride = rows * cols;
+  for (int32_t i = 0; i < ndirs; ++i) {
+    const int32_t dr = kDirs[i][0], dc = kDirs[i][1];
+    dirs[2 * i] = dr;
+    dirs[2 * i + 1] = dc;
+    // flow direction is opposite(d): my d-neighbor sends toward -d
+    ts_send_rect(core_h, core_w, hy, hx, -dr, -dc, send_rects + 4 * i);
+    ts_halo_rect(core_h, core_w, hy, hx, dr, dc, recv_rects + 4 * i);
+    perm_counts[i] =
+        ts_send_permutation(rows, cols, per_r, per_c, -dr, -dc,
+                            perm_src + i * stride, perm_dst + i * stride);
+  }
+  return ndirs;
+}
+
+}  // extern "C"

@@ -1,0 +1,82 @@
+"""Cross-check the native C++ planner against the pure-Python geometry.
+
+The native library mirrors topology.py + layout.py one-for-one; these
+tests are the contract. Builds the library on demand (g++ is baked into
+the image); skips only if the toolchain is genuinely absent.
+"""
+
+import itertools
+
+import pytest
+
+from tpuscratch import native
+from tpuscratch.halo.exchange import HaloSpec
+from tpuscratch.halo.layout import TileLayout
+from tpuscratch.runtime.topology import ALL_DIRECTIONS, CartTopology
+
+pytestmark = pytest.mark.skipif(
+    not (native.available() or native.build()), reason="native toolchain absent"
+)
+
+CONFIGS = [
+    ((2, 4), (True, True)),
+    ((3, 3), (True, True)),
+    ((3, 3), (False, False)),
+    ((1, 1), (True, True)),
+    ((4, 2), (True, False)),
+    ((1, 5), (False, True)),
+]
+
+
+@pytest.mark.parametrize("dims,periodic", CONFIGS)
+def test_neighbor_matches_python(dims, periodic):
+    topo = CartTopology(dims, periodic)
+    for rank in topo.ranks():
+        for d in ALL_DIRECTIONS:
+            assert native.neighbor(dims, periodic, rank, d.offset) == topo.neighbor(
+                rank, d
+            ), (dims, periodic, rank, d)
+
+
+@pytest.mark.parametrize("dims,periodic", CONFIGS)
+def test_permutation_matches_python(dims, periodic):
+    topo = CartTopology(dims, periodic)
+    for d in ALL_DIRECTIONS:
+        assert native.send_permutation(dims, periodic, d.offset) == list(
+            topo.send_permutation(d)
+        )
+
+
+@pytest.mark.parametrize("core,halo", [((16, 16), (2, 2)), ((8, 12), (1, 3)), ((6, 7), (2, 1))])
+def test_rects_match_python(core, halo):
+    lay = TileLayout(core[0], core[1], halo[0], halo[1])
+    for d in ALL_DIRECTIONS:
+        hr = native.halo_rect(core[0], core[1], halo[0], halo[1], d.offset)
+        r = lay.halo_region(d)
+        assert hr == (*r.offsets, *r.shape), ("halo", d)
+        sr = native.send_rect(core[0], core[1], halo[0], halo[1], d.offset)
+        s = lay.send_region(d)
+        assert sr == (*s.offsets, *s.shape), ("send", d)
+
+
+@pytest.mark.parametrize("dims,periodic", CONFIGS[:4])
+@pytest.mark.parametrize("neighbors", [4, 8])
+def test_full_plan_matches_python(dims, periodic, neighbors):
+    topo = CartTopology(dims, periodic)
+    lay = TileLayout(8, 8, 2, 2)
+    spec = HaloSpec(layout=lay, topology=topo, neighbors=neighbors)
+    py_plan = spec.plan()
+    native_plan = native.build_plan(dims, periodic, 8, 8, 2, 2, neighbors)
+    assert len(native_plan) == len(py_plan)
+    for nat, py in zip(native_plan, py_plan):
+        assert nat["direction"] == py.direction.offset
+        assert nat["send_rect"] == (*py.send.offsets, *py.send.shape)
+        assert nat["recv_rect"] == (*py.recv.offsets, *py.recv.shape)
+        assert nat["perm"] == list(py.perm)
+
+
+def test_native_rejects_bad_config():
+    with pytest.raises(ValueError):
+        native.build_plan((2, 4), (True, True), 8, 8, 9, 2)  # halo > core
+    with pytest.raises(ValueError):
+        native.build_plan((2, 4), (True, True), 8, 8, 1, 1, neighbors=5)
