@@ -1,0 +1,63 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+Alternative to the ring scheme: instead of rotating KV blocks, one
+``all_to_all`` re-shards the activations from sequence-sharded to
+head-sharded, each rank runs exact attention for its head subset over the
+FULL sequence, and a second all_to_all restores sequence sharding.
+Two collectives total (vs n-1 ring hops) at the cost of requiring
+``n_heads % axis_size == 0`` and O(seq) memory for the gathered K/V of the
+local heads.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuscratch.comm.collectives import all_to_all
+
+
+def _attn(q, k, v, causal: bool) -> jax.Array:
+    """Exact attention: q,k,v (S, H, D) -> (S, H, D), fp32 accumulation."""
+    d = q.shape[-1]
+    s = jnp.einsum("shd,thd->hst", q.astype(jnp.float32), k.astype(jnp.float32))
+    s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if causal:
+        S, T = s.shape[1], s.shape[2]
+        mask = jnp.arange(S)[:, None] >= jnp.arange(T)[None, :]
+        s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hst,thd->shd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Exact attention, sequence sharded over ``axis`` via all-to-all.
+
+    q, k, v: (S, H, D) blocks of a global (n*S, H, D) sequence with
+    n_heads H divisible by the axis size. Returns the (S, H, D) output
+    block. Call inside shard_map.
+    """
+    if q.ndim != 3 or q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"expected equal (S,H,D) blocks, got {q.shape}/{k.shape}/{v.shape}")
+    S, H, D = q.shape
+    n = lax.axis_size(axis)
+    if H % n:
+        raise ValueError(f"n_heads {H} not divisible by axis size {n}")
+
+    def seq_to_heads(x):
+        # (S, H, D) seq-sharded -> (n*S, H/n, D) head-sharded
+        return all_to_all(x, axis, split_axis=1, concat_axis=0, tiled=True)
+
+    def heads_to_seq(x):
+        return all_to_all(x, axis, split_axis=0, concat_axis=1, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _attn(qh, kh, vh, causal)
+    return heads_to_seq(out)
