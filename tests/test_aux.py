@@ -1,0 +1,88 @@
+"""Tests for auxiliary subsystems: profiling spans + checkpoint/resume."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuscratch.runtime import checkpoint
+from tpuscratch.runtime.profiling import Timeline, cross_rank_span
+
+
+class TestTimeline:
+    def test_span_records(self):
+        tl = Timeline()
+        with tl.span("work"):
+            time.sleep(0.01)
+        assert tl.seconds("work") >= 0.01
+        assert "work" in tl.report()
+
+    def test_span_blocks_on_sync_values(self):
+        import jax
+
+        tl = Timeline()
+        x = jnp.ones(1 << 16)
+        y = jax.jit(lambda a: a * 2)(x)  # async dispatch in flight
+        with tl.span("sync", y):
+            pass
+        assert tl.seconds("sync") >= 0.0
+
+    def test_missing_name(self):
+        with pytest.raises(KeyError):
+            Timeline().seconds("nope")
+
+    def test_cross_rank_max_min(self):
+        # mpicuda3 convention over synthetic per-rank timelines
+        from tpuscratch.runtime.profiling import Span
+
+        a, b = Timeline(), Timeline()
+        a.spans.append(Span("step", 1.0, 2.0))
+        b.spans.append(Span("step", 1.2, 2.5))
+        assert cross_rank_span([a, b], "step") == pytest.approx(1.5)
+
+
+class TestCheckpoint:
+    def _tree(self, scale=1.0):
+        return {
+            "grid": jnp.arange(12.0).reshape(3, 4) * scale,
+            "opt": {"count": jnp.asarray(7, dtype=jnp.int32)},
+        }
+
+    def test_roundtrip(self, tmp_path):
+        tree = self._tree()
+        checkpoint.save(tmp_path, 5, tree, metadata={"note": "hi"})
+        got, step, meta = checkpoint.restore(tmp_path, tree)
+        assert step == 5 and meta == {"note": "hi"}
+        np.testing.assert_array_equal(got["grid"], np.asarray(tree["grid"]))
+        assert int(got["opt"]["count"]) == 7
+
+    def test_latest_and_prune(self, tmp_path):
+        for s in (1, 3, 2):
+            checkpoint.save(tmp_path, s, self._tree(s))
+        assert checkpoint.latest_step(tmp_path) == 3
+        got, step, _ = checkpoint.restore(tmp_path, self._tree())
+        assert step == 3
+        np.testing.assert_array_equal(
+            got["grid"], np.arange(12.0).reshape(3, 4) * 3
+        )
+        checkpoint.prune(tmp_path, keep=1)
+        assert checkpoint.steps(tmp_path) == [3]
+
+    def test_structure_drift_rejected(self, tmp_path):
+        checkpoint.save(tmp_path, 1, self._tree())
+        with pytest.raises(ValueError):
+            checkpoint.restore(tmp_path, {"only": jnp.zeros(2)})
+
+    def test_empty_dir(self, tmp_path):
+        assert checkpoint.latest_step(tmp_path) is None
+        with pytest.raises(FileNotFoundError):
+            checkpoint.restore(tmp_path, self._tree())
+
+    def test_overwrite_same_step(self, tmp_path):
+        checkpoint.save(tmp_path, 1, self._tree(1.0))
+        checkpoint.save(tmp_path, 1, self._tree(2.0))
+        got, _, _ = checkpoint.restore(tmp_path, self._tree())
+        np.testing.assert_array_equal(
+            got["grid"], np.arange(12.0).reshape(3, 4) * 2
+        )

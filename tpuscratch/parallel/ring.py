@@ -31,13 +31,18 @@ def ring_scan(
     payload,
     axis: str,
     reverse: bool = False,
+    return_payload: bool = True,
 ):
     """Run the rotate-and-combine pipeline over ``axis``.
 
     ``combine(carry, block, hop) -> carry`` sees, at hop i, the payload
     that started on rank ``(me - i) % n`` (or ``(me + i) % n`` when
-    ``reverse``). ``payload`` may be any pytree; it returns to its origin
-    after the final hop. Returns (final_carry, payload).
+    ``reverse``). ``payload`` may be any pytree. Returns
+    (final_carry, payload): with ``return_payload`` the payload makes the
+    full n hops and arrives back home; without it the final (homeward)
+    rotation is skipped — one less block transfer per call, the right
+    choice when the caller discards the payload — and None is returned in
+    its place.
     """
     n = lax.axis_size(axis)
     perm = ring_perm(n, -1 if reverse else 1, periodic=True)
@@ -48,7 +53,14 @@ def ring_scan(
         block = jax.tree.map(lambda b: lax.ppermute(b, axis, perm), block)
         return (carry, block), ()
 
-    (carry, payload), _ = lax.scan(
-        hop, (init_carry, payload), jax.numpy.arange(n)
-    )
-    return carry, payload
+    if return_payload:
+        (carry, payload), _ = lax.scan(
+            hop, (init_carry, payload), jax.numpy.arange(n)
+        )
+        return carry, payload
+    if n > 1:
+        (init_carry, payload), _ = lax.scan(
+            hop, (init_carry, payload), jax.numpy.arange(n - 1)
+        )
+    carry = combine(init_carry, payload, jax.numpy.asarray(n - 1))
+    return carry, None

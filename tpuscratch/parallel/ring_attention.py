@@ -22,18 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpuscratch.parallel.ring import ring_scan
-
-NEG_INF = -1e30
-
-
-def _block_scores(q, k, mask):
-    """Masked scaled scores (H, S, T) for one (Q block, K block) pair.
-
-    q: (S, H, D), k: (T, H, D), mask: (S, T) boolean (True = attend).
-    """
-    d = q.shape[-1]
-    s = jnp.einsum("shd,thd->hst", q, k) / jnp.sqrt(jnp.asarray(d, q.dtype))
-    return jnp.where(mask[None, :, :], s, NEG_INF)
+from tpuscratch.parallel.scores import NEG_INF, masked_scores
 
 
 def ring_attention(
@@ -76,7 +65,7 @@ def ring_attention(
             mask = rows[:, None] >= cols[None, :]
         else:
             mask = jnp.ones((S, S), dtype=bool)
-        s = _block_scores(q32, kb.astype(jnp.float32), mask)
+        s = masked_scores(q32, kb, mask)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, :, None])          # (H, S, T)
         # guard: when every score so far is masked, s - m_new == 0 for
@@ -89,6 +78,8 @@ def ring_attention(
         o = o * corr.T[:, :, None] + pv
         return (m_new, l, o)
 
-    (m, l, o), _ = ring_scan(combine, init, (k, v), axis)
+    # return_payload=False: the KV pair is discarded after the last hop, so
+    # the homeward rotation (one extra 2*S*H*D transfer) is skipped
+    (m, l, o), _ = ring_scan(combine, init, (k, v), axis, return_payload=False)
     out = o / l.T[:, :, None]
     return out.astype(q.dtype)

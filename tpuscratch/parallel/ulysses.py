@@ -16,18 +16,17 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpuscratch.comm.collectives import all_to_all
+from tpuscratch.parallel.scores import masked_scores
 
 
 def _attn(q, k, v, causal: bool) -> jax.Array:
     """Exact attention: q,k,v (S, H, D) -> (S, H, D), fp32 accumulation."""
-    d = q.shape[-1]
-    s = jnp.einsum("shd,thd->hst", q.astype(jnp.float32), k.astype(jnp.float32))
-    s = s / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    S, T = q.shape[0], k.shape[0]
     if causal:
-        S, T = s.shape[1], s.shape[2]
         mask = jnp.arange(S)[:, None] >= jnp.arange(T)[None, :]
-        s = jnp.where(mask[None], s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
+    else:
+        mask = jnp.ones((S, T), dtype=bool)
+    p = jax.nn.softmax(masked_scores(q, k, mask), axis=-1)
     return jnp.einsum("hst,thd->shd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
