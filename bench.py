@@ -38,7 +38,7 @@ def main() -> int:
         mesh = make_mesh_2d((rows, cols))
 
     best = None
-    for impl in ("xla", "pallas"):
+    for impl in ("xla", "pallas", "overlap"):
         try:
             res = bench_stencil(GRID, STEPS, mesh=mesh, impl=impl, iters=5)
         except Exception as e:  # an impl failing shouldn't kill the bench

@@ -93,31 +93,33 @@ class HaloSpec:
 from tpuscratch.comm.collectives import _axis_index as _flat_rank  # shared row-major flat-rank helper
 
 
-def halo_exchange(tile: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
-    """Fill ``tile``'s ghost border from its 8 (or 4) mesh neighbors.
-
-    SPMD: call inside shard_map over ``spec.axes``; ``tile`` is the local
-    padded tile. Returns the tile with refreshed halo; the core is
-    untouched. The reference's hot loop (ExchangeData, stencil2D.h:363-377).
-    """
+def halo_arrivals(tile: jnp.ndarray, spec: HaloSpec) -> list[jnp.ndarray]:
+    """Phase 1: launch the transfers. Every payload packs from the
+    PRE-exchange tile; the 8 ppermutes are mutually independent, so XLA is
+    free to overlap them — and to overlap them with any compute that does
+    not consume the arrivals (see stencil.stencil_step's 'overlap' impl)."""
     if tuple(tile.shape) != spec.layout.padded_shape:
         raise ValueError(
             f"tile {tile.shape} != padded {spec.layout.padded_shape} "
             "(batched tiles are not supported; vmap over the exchange instead)"
         )
+    return [
+        lax.ppermute(t.send.region(tile), spec.axes, list(t.perm))
+        for t in spec.plan()
+    ]
+
+
+def halo_scatter(
+    tile: jnp.ndarray, spec: HaloSpec, arrivals: list[jnp.ndarray]
+) -> jnp.ndarray:
+    """Phase 2: land the arrivals in the (disjoint) border pieces.
+
+    Open boundary = no sender: keep the existing ghost values
+    (MPI_PROC_NULL semantics), selected by a static per-rank table indexed
+    with the runtime rank.
+    """
     plan = spec.plan()
     me = _flat_rank(tuple(spec.axes))
-
-    # Phase 1: every payload packs from the PRE-exchange tile; the 8
-    # ppermutes are mutually independent, so XLA is free to overlap them.
-    arrivals = []
-    for t in plan:
-        payload = t.send.region(tile)
-        arrivals.append(lax.ppermute(payload, spec.axes, list(t.perm)))
-
-    # Phase 2: scatter into the (disjoint) border pieces. Open boundary =
-    # no sender: keep the existing ghost values (MPI_PROC_NULL semantics),
-    # selected by a static per-rank table indexed with the runtime rank.
     out = tile
     for t, arrived in zip(plan, arrivals):
         if all(t.has_sender):
@@ -127,3 +129,13 @@ def halo_exchange(tile: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
             update = jnp.where(mask, arrived, t.recv.region(out))
         out = lax.dynamic_update_slice(out, update, t.recv.offsets)
     return out
+
+
+def halo_exchange(tile: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
+    """Fill ``tile``'s ghost border from its 8 (or 4) mesh neighbors.
+
+    SPMD: call inside shard_map over ``spec.axes``; ``tile`` is the local
+    padded tile. Returns the tile with refreshed halo; the core is
+    untouched. The reference's hot loop (ExchangeData, stencil2D.h:363-377).
+    """
+    return halo_scatter(tile, spec, halo_arrivals(tile, spec))

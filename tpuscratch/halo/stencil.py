@@ -15,7 +15,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpuscratch.halo.exchange import HaloSpec, halo_exchange
+from tpuscratch.halo.exchange import (
+    HaloSpec,
+    halo_arrivals,
+    halo_exchange,
+    halo_scatter,
+)
 from tpuscratch.halo.layout import TileLayout
 
 
@@ -26,17 +31,13 @@ def five_point(tile: jax.Array, layout: TileLayout, coeffs=(0.25, 0.25, 0.25, 0.
     Defaults to the Laplace/Jacobi average — the canonical workload for a
     halo benchmark.
     """
-    hy, hx = layout.halo_y, layout.halo_x
-    if hy < 1 or hx < 1:
-        raise ValueError(f"five_point needs halo >= 1, got ({hy},{hx})")
-    h, w = layout.core_h, layout.core_w
-    cn, cs, cw, ce, cc = coeffs
-    core = tile[hy : hy + h, hx : hx + w]
-    north = tile[hy - 1 : hy - 1 + h, hx : hx + w]
-    south = tile[hy + 1 : hy + 1 + h, hx : hx + w]
-    west = tile[hy : hy + h, hx - 1 : hx - 1 + w]
-    east = tile[hy : hy + h, hx + 1 : hx + 1 + w]
-    new_core = cn * north + cs * south + cw * west + ce * east + cc * core
+    if layout.halo_y < 1 or layout.halo_x < 1:
+        raise ValueError(
+            f"five_point needs halo >= 1, got ({layout.halo_y},{layout.halo_x})"
+        )
+    new_core = _new_values(
+        tile, 0, layout.core_h, 0, layout.core_w, layout, coeffs
+    )
     return rebuild(tile, new_core, layout)
 
 
@@ -68,15 +69,68 @@ def _compute(tile: jax.Array, layout: TileLayout, coeffs, impl: str) -> jax.Arra
     raise ValueError(f"unknown stencil impl {impl!r}")
 
 
+def _new_values(t: jax.Array, r0: int, r1: int, c0: int, c1: int, layout, coeffs) -> jax.Array:
+    """Updated values for core cells rows [r0,r1) x cols [c0,c1), read from
+    the (padded-coordinate) tile ``t``."""
+    hy, hx = layout.halo_y, layout.halo_x
+    cn, cs, cw, ce, cc = coeffs
+    ry, rx = hy + r0, hx + c0
+    h, w = r1 - r0, c1 - c0
+    return (
+        cn * t[ry - 1 : ry - 1 + h, rx : rx + w]
+        + cs * t[ry + 1 : ry + 1 + h, rx : rx + w]
+        + cw * t[ry : ry + h, rx - 1 : rx - 1 + w]
+        + ce * t[ry : ry + h, rx + 1 : rx + 1 + w]
+        + cc * t[ry : ry + h, rx : rx + w]
+    )
+
+
+def stencil_step_overlap(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0)) -> jax.Array:
+    """Exchange overlapped with interior compute — the async-halo variant.
+
+    The interior of the core (every cell at least one stencil reach away
+    from the core edge) reads only core cells, so its update is computed
+    from the PRE-exchange tile with no data dependency on the transfers:
+    XLA is free to run the 8 ppermutes concurrently with the bulk of the
+    FLOPs. Only the 1-cell boundary ring of the core waits for the
+    arrivals. The reference analogue is the Isend-all/compute/Waitall
+    overlap pattern its plan-executor design enables (SURVEY.md §7.5).
+    """
+    lay = spec.layout
+    if lay.halo_y < 1 or lay.halo_x < 1:
+        raise ValueError("five_point needs halo >= 1 on both axes")
+    h, w = lay.core_h, lay.core_w
+    if h < 3 or w < 3:
+        # no interior to overlap; fall back to the plain step
+        return five_point(halo_exchange(tile, spec), lay, coeffs)
+
+    arrivals = halo_arrivals(tile, spec)                  # transfers launch
+    interior = _new_values(tile, 1, h - 1, 1, w - 1, lay, coeffs)  # overlaps
+    t2 = halo_scatter(tile, spec, arrivals)               # halo lands
+
+    top = _new_values(t2, 0, 1, 0, w, lay, coeffs)
+    bottom = _new_values(t2, h - 1, h, 0, w, lay, coeffs)
+    left = _new_values(t2, 1, h - 1, 0, 1, lay, coeffs)
+    right = _new_values(t2, 1, h - 1, w - 1, w, lay, coeffs)
+
+    mid = jnp.concatenate([left, interior, right], axis=1)
+    new_core = jnp.concatenate([top, mid, bottom], axis=0)
+    return rebuild(t2, new_core, lay)
+
+
 def stencil_step(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla") -> jax.Array:
     """Exchange then compute — one iteration of the flagship loop.
 
-    ``impl`` selects the compute path: 'xla' (fused by the compiler) or
-    'pallas' (explicit VMEM kernel, ops/stencil_kernel.py) — the runtime
-    analogue of the reference's compile-time GPU/CPU switch.
+    ``impl`` selects the compute path — the runtime analogue of the
+    reference's compile-time GPU/CPU switch: 'xla' (compiler-fused),
+    'pallas' (explicit VMEM kernel, ops/stencil_kernel.py), or 'overlap'
+    (interior compute overlapped with the halo transfers,
+    ``stencil_step_overlap``).
     """
-    if impl not in ("xla", "pallas"):
+    if impl not in ("xla", "pallas", "overlap"):
         raise ValueError(f"unknown stencil impl {impl!r}")
+    if impl == "overlap":
+        return stencil_step_overlap(tile, spec, coeffs)
     tile = halo_exchange(tile, spec)
     return _compute(tile, spec.layout, coeffs, impl)
 
