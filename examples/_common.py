@@ -11,27 +11,17 @@ mesh instead.
 
 from __future__ import annotations
 
-import os
-
 N_DEVICES = 8
 
 
 def ensure_devices(n: int = N_DEVICES):
     """Return jax with >= n devices (virtual CPU mesh unless opted out)."""
-    if os.environ.get("TPUSCRATCH_ON_DEVICE", "").strip().lower() not in (
-        "1", "true", "yes", "on",
-    ):
-        from tpuscratch.runtime.hostenv import force_cpu_devices
+    from tpuscratch.runtime import hostenv
 
-        force_cpu_devices(n)
-    import jax
-
-    if len(jax.devices()) < n:
-        raise SystemExit(
-            f"{len(jax.devices())} device(s) available but {n} needed — "
-            "unset TPUSCRATCH_ON_DEVICE to use a virtual CPU mesh"
-        )
-    return jax
+    try:
+        return hostenv.ensure_devices(n)
+    except RuntimeError as e:
+        raise SystemExit(str(e)) from None
 
 
 def banner(title: str) -> None:

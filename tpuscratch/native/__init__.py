@@ -10,18 +10,47 @@ meshes, never a semantic fork.
 from __future__ import annotations
 
 import ctypes
+import os
 import pathlib
+import shutil
 import subprocess
 from typing import Optional
 
-_NATIVE_DIR = pathlib.Path(__file__).resolve().parents[2] / "native"
-_LIB_PATH = _NATIVE_DIR / "libtpuscratch_native.so"
+_LIB_NAME = "libtpuscratch_native.so"
+_PKG_DIR = pathlib.Path(__file__).resolve().parent
+_NATIVE_DIR = _PKG_DIR.parents[1] / "native"
+
+
+def _lib_path() -> Optional[pathlib.Path]:
+    """Resolve the library: explicit env override (must exist), else the
+    newest of the dev-tree build and the wheel-shipped package copy."""
+    env = os.environ.get("TPUSCRATCH_NATIVE_LIB")
+    if env:
+        path = pathlib.Path(env)
+        if not path.exists():
+            raise FileNotFoundError(
+                f"TPUSCRATCH_NATIVE_LIB={env} does not exist"
+            )
+        return path
+    existing = [
+        p
+        for p in (_NATIVE_DIR / _LIB_NAME, _PKG_DIR / _LIB_NAME)
+        if p.exists()
+    ]
+    if not existing:
+        return None
+    return max(existing, key=lambda p: p.stat().st_mtime)
+
 
 _lib: Optional[ctypes.CDLL] = None
 
 
 def build(quiet: bool = True) -> bool:
-    """Compile the native library (requires g++/make). True on success."""
+    """Compile the native library (requires g++/make). True on success.
+
+    Also copies the built .so into the package directory so that wheels
+    built afterwards ship it (pyproject package-data picks it up).
+    """
     try:
         subprocess.run(
             ["make", "-C", str(_NATIVE_DIR)],
@@ -30,20 +59,30 @@ def build(quiet: bool = True) -> bool:
         )
     except (subprocess.CalledProcessError, FileNotFoundError):
         return False
+    try:
+        shutil.copy2(_NATIVE_DIR / _LIB_NAME, _PKG_DIR / _LIB_NAME)
+    except OSError:
+        pass  # dev tree copy still loadable from native/
     global _lib
     _lib = None  # force reload
     return load() is not None
 
 
 def load() -> Optional[ctypes.CDLL]:
-    """The loaded library, or None when unbuilt/unloadable."""
+    """The loaded library, or None when unbuilt/unloadable.
+
+    Exception: an explicit TPUSCRATCH_NATIVE_LIB override pointing at a
+    missing file raises FileNotFoundError — a deliberate misconfiguration
+    should fail loudly, not silently fall back to another copy.
+    """
     global _lib
     if _lib is not None:
         return _lib
-    if not _LIB_PATH.exists():
+    path = _lib_path()
+    if path is None:
         return None
     try:
-        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib = ctypes.CDLL(str(path))
     except OSError:
         return None
     i32 = ctypes.c_int32

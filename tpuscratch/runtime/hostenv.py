@@ -58,6 +58,46 @@ def force_cpu_devices(n: int = 8) -> None:
         )
 
 
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def on_device_requested() -> bool:
+    """True when TPUSCRATCH_ON_DEVICE asks for the real hardware mesh."""
+    return os.environ.get("TPUSCRATCH_ON_DEVICE", "").strip().lower() in _TRUTHY
+
+
+def ensure_devices(n: int = 8):
+    """Return jax with >= n visible devices (virtual CPU mesh unless opted out).
+
+    The single bring-up helper shared by examples and driver entry points:
+    unless TPUSCRATCH_ON_DEVICE requests real hardware, pins an n-device
+    virtual CPU mesh (only possible before jax's first backend init).
+    """
+    if not on_device_requested():
+        from jax._src import xla_bridge as xb
+
+        if xb._default_backend is None:  # noqa: SLF001
+            force_cpu_devices(n)
+        elif xb._default_backend.platform != "cpu":  # noqa: SLF001
+            raise RuntimeError(
+                "jax already initialized on platform "
+                f"'{xb._default_backend.platform}' without "  # noqa: SLF001
+                "TPUSCRATCH_ON_DEVICE=1 — refusing to run the CPU dev/test "
+                "path on real hardware; set TPUSCRATCH_ON_DEVICE=1 to opt "
+                "in, or call ensure_devices() before any jax use"
+            )
+    import jax
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"{len(jax.devices())} device(s) visible but {n} needed — jax "
+            "was already initialized (or TPUSCRATCH_ON_DEVICE is set) on a "
+            "smaller platform; call force_cpu_devices(n) before any jax "
+            "use, or run on a larger host"
+        )
+    return jax
+
+
 def on_tpu() -> bool:
     """True when the default jax backend is a TPU (initializes backends)."""
     import jax
