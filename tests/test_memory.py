@@ -1,0 +1,116 @@
+"""Tests for the memory placement/staging layer (host_allocator parity).
+
+Parity targets: host_allocator.h (page-locked staging memory), the
+PAGE_LOCKED/HOST_COPY pingpong ablations
+(test-benchmark/mpi-pingpong-gpu-async.cpp:43-49,59-70), and the
+capacity-probe spirit of mpicuda2.cu:44-47.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.runtime import memory
+from tpuscratch.runtime.mesh import make_mesh_1d, shard_along
+
+
+class TestKinds:
+    def test_device_kind_reported(self):
+        kinds = memory.memory_kinds()
+        assert memory.DEVICE in kinds
+
+    def test_supports_kind(self):
+        assert memory.supports_kind(memory.DEVICE)
+        assert not memory.supports_kind("no_such_space")
+
+
+needs_host_spaces = pytest.mark.skipif(
+    not (
+        memory.supports_kind(memory.PINNED_HOST)
+        and memory.supports_kind(memory.UNPINNED_HOST)
+    ),
+    reason="backend lacks host memory spaces",
+)
+
+
+class TestPlacement:
+    @needs_host_spaces
+    def test_pin_to_host_and_back(self):
+        x = jnp.arange(1024, dtype=jnp.float32)
+        pinned = memory.pin_to_host(x)
+        assert pinned.sharding.memory_kind == memory.PINNED_HOST
+        back = memory.to_device(pinned)
+        assert back.sharding.memory_kind == memory.DEVICE
+        np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+
+    @needs_host_spaces
+    def test_host_roundtrip_both_ablations(self):
+        x = jnp.full((256,), 3.0)
+        for pinned in (True, False):
+            out = memory.host_roundtrip(x, pinned=pinned)
+            assert out.sharding.memory_kind == memory.DEVICE
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    @needs_host_spaces
+    def test_sharded_placement_preserves_layout(self):
+        mesh = make_mesh_1d("x")
+        x = jax.device_put(
+            jnp.arange(64, dtype=jnp.float32), shard_along(mesh, "x")
+        )
+        pinned = memory.pin_to_host(x)
+        assert pinned.sharding.memory_kind == memory.PINNED_HOST
+        assert pinned.sharding.device_set == x.sharding.device_set
+        back = memory.to_device(pinned)
+        np.testing.assert_array_equal(np.asarray(back), np.arange(64))
+
+    def test_put_accepts_numpy(self):
+        out = memory.put(np.ones((8,), dtype=np.float32))
+        assert out.sharding.memory_kind in (None, memory.DEVICE)
+        np.testing.assert_array_equal(np.asarray(out), np.ones(8))
+
+
+class TestDonate:
+    def test_donated_step_matches_undonated(self):
+        def step(x):
+            return x * 2.0 + 1.0
+
+        donated = memory.donate(step)
+        x = jnp.arange(16, dtype=jnp.float32)
+        expected = np.asarray(step(x))
+        got = np.asarray(donated(jnp.arange(16, dtype=jnp.float32)))
+        np.testing.assert_array_equal(got, expected)
+
+    def test_donation_invalidates_input(self):
+        donated = memory.donate(lambda x: x + 1.0)
+        x = jnp.zeros((4096,), dtype=jnp.float32)
+        out = donated(x)
+        jax.block_until_ready(out)
+        # donated buffer must be treated as dead; jax marks it deleted
+        assert x.is_deleted()
+
+
+class TestIntrospection:
+    def test_live_bytes_sees_new_array(self):
+        before = memory.live_bytes()
+        keep = jnp.zeros((1 << 18,), dtype=jnp.float32)  # 1 MiB
+        jax.block_until_ready(keep)
+        after = memory.live_bytes()
+        assert after >= before + keep.nbytes
+
+    def test_memory_stats_reports_bytes(self):
+        stats = memory.memory_stats()
+        assert "bytes_in_use" in stats
+        assert stats["bytes_in_use"] >= 0
+
+
+class TestPinnedStagingBench:
+    @needs_host_spaces
+    def test_pinned_staging_roundtrip_runs(self):
+        from tpuscratch.bench.pingpong import pinned_staging_roundtrip
+
+        res = pinned_staging_roundtrip(1024, pinned=True, iters=2)
+        assert res.p50 > 0
+        res2 = pinned_staging_roundtrip(1024, pinned=False, iters=2)
+        assert res2.p50 > 0

@@ -94,3 +94,21 @@ def host_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
         stage, x, iters=iters, warmup=1,
         name=f"host staging {n_elems * 4}B", bytes_moved=2 * n_elems * 4,
     )
+
+
+def pinned_staging_roundtrip(
+    n_elems: int, pinned: bool = True, iters: int = 10
+) -> BenchResult:
+    """The PAGE_LOCKED ablation: stage through page-locked vs pageable
+    host memory spaces (mpi-pingpong-gpu-async.cpp:43-49) — here XLA
+    memory kinds ``pinned_host`` vs ``unpinned_host``."""
+    from tpuscratch.runtime import memory
+
+    x = jnp.zeros(n_elems, dtype=jnp.float32)
+    jax.block_until_ready(x)
+    label = "pinned" if pinned else "pageable"
+    return time_device(
+        lambda v: memory.host_roundtrip(v, pinned=pinned),
+        x, iters=iters, warmup=1,
+        name=f"{label} staging {n_elems * 4}B", bytes_moved=2 * n_elems * 4,
+    )

@@ -11,3 +11,10 @@ from tpuscratch.runtime.config import Config  # noqa: F401
 from tpuscratch.runtime.errors import CommError, ErrorPolicy, guarded  # noqa: F401
 from tpuscratch.runtime.context import RuntimeContext, initialize  # noqa: F401
 from tpuscratch.runtime.log import RankLogger  # noqa: F401
+from tpuscratch.runtime.memory import (  # noqa: F401
+    donate,
+    live_bytes,
+    memory_stats,
+    pin_to_host,
+    to_device,
+)
