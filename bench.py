@@ -4,20 +4,29 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 BASELINE.md config 1 (the reference publishes no numbers — this repo
-establishes the baseline; see SURVEY.md §6). On a TPU this runs the full
-framework path — halo exchange (self-wrap on a 1x1 mesh) + 5-point
-update, scanned — with both the XLA and Pallas compute paths, reporting
-the faster. ``vs_baseline`` compares against BENCH_BASELINE.json (the
-first recorded run) when present, else 1.0.
+establishes the baseline; see SURVEY.md §6). Runs the full framework
+path — halo exchange (self-wrap on a 1x1 mesh) + 5-point Jacobi update,
+folded into one compiled scan — for each impl in the ``impls`` tuple
+below (XLA-fused, deep-halo trapezoid, VMEM-resident Pallas trapezoid)
+and reports the fastest.
+
+Methodology notes (measured on the single-chip axon tunnel this repo
+develops against):
+- fence="readback": block_until_ready alone is NOT a reliable fence on
+  remote-tunnel PJRT transports — programs whose device time is provably
+  milliseconds "complete" in ~20us. A 4-byte readback is the fence.
+- many steps per invocation: the tunnel costs ~80 ms fixed per fenced
+  program call; thousands of scanned steps amortize it so the number
+  reflects the chip, not the transport.
 """
 
 import json
+import os
 import pathlib
 import sys
 
 BASELINE_FILE = pathlib.Path(__file__).parent / "BENCH_BASELINE.json"
 GRID = (1024, 1024)
-STEPS = 10
 
 
 def main() -> int:
@@ -25,6 +34,12 @@ def main() -> int:
 
     from tpuscratch.bench.stencil_bench import bench_stencil
     from tpuscratch.runtime.mesh import make_mesh_2d
+
+    on_tpu = jax.default_backend() == "tpu"
+    steps = int(
+        os.environ.get("TPUSCRATCH_BENCH_STEPS", "100000" if on_tpu else "50")
+    )
+    iters = int(os.environ.get("TPUSCRATCH_BENCH_ITERS", "3"))
 
     n_dev = len(jax.devices())
     if n_dev == 1:
@@ -37,13 +52,17 @@ def main() -> int:
             rows, cols = 1, 1  # indivisible factorization: single device
         mesh = make_mesh_2d((rows, cols))
 
+    impls = ("xla", "deep:16", "deep-pallas:16", "deep-pallas:32")
     best = None
-    for impl in ("xla", "pallas", "overlap"):
+    for impl in impls:
         try:
-            res = bench_stencil(GRID, STEPS, mesh=mesh, impl=impl, iters=5)
+            res = bench_stencil(
+                GRID, steps, mesh=mesh, impl=impl, iters=iters, fence="readback"
+            )
         except Exception as e:  # an impl failing shouldn't kill the bench
             print(f"# impl {impl} failed: {e}", file=sys.stderr)
             continue
+        print(f"# {res.summary()}", file=sys.stderr)
         if best is None or res.items_per_s > best.items_per_s:
             best = res
     if best is None:

@@ -72,3 +72,28 @@ class TestBenchPrograms:
         res = bench_stencil(grid=(32, 32), steps=2, iters=2)
         assert res.items == 32 * 32 * 2
         assert res.items_per_s > 0
+
+
+class TestImplStrings:
+    def test_deep_impl_string(self):
+        res = bench_stencil(grid=(32, 32), steps=4, impl="deep:2", iters=2)
+        assert "deep:2" in res.name
+        assert res.items == 32 * 32 * 4
+
+    def test_unroll_impl_string(self):
+        res = bench_stencil(grid=(32, 32), steps=2, impl="xla+unroll", iters=2)
+        assert res.items_per_s > 0
+
+
+class TestWeakScaling:
+    def test_efficiency_and_report(self):
+        from tpuscratch.bench.weak_scaling import bench_weak_scaling, efficiency, report
+
+        pts = bench_weak_scaling(
+            per_chip=(8, 8), steps=2, device_counts=(1, 4), iters=2
+        )
+        assert [p.n_devices for p in pts] == [1, 4]
+        assert pts[1].grid == (16, 16)  # 2x2 mesh of 8x8 tiles
+        eff = efficiency(pts)
+        assert eff[1] == 1.0 and eff[4] > 0
+        assert "eff" in report(pts)

@@ -378,3 +378,146 @@ class TestOverlapImpl:
             )
             outs[impl] = np.asarray(f(tiles))
         np.testing.assert_allclose(outs["xla"], outs["overlap"], rtol=1e-6)
+
+
+class TestDeepImpl:
+    """The communication-avoiding trapezoid scheme must compute the exact
+    same Jacobi trajectory as the one-exchange-per-step path — the core
+    after K steps is identical; only the exchange cadence differs."""
+
+    @pytest.mark.parametrize("depth,steps", [(2, 4), (2, 5), (3, 3), (3, 7)])
+    @pytest.mark.parametrize("deep_impl", ["xla", "pallas"])
+    def test_deep_matches_plain_core(self, depth, steps, deep_impl):
+        from tpuscratch.halo.stencil import run_stencil_deep
+
+        from tpuscratch.halo.driver import decompose
+
+        R, C, TH, TW = 2, 4, 6, 5
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), (True, True))
+        rng = np.random.default_rng(21)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+
+        def tiles_for(lay):
+            return jnp.asarray(decompose(world, topo, lay))
+
+        lay1 = TileLayout(TH, TW, 1, 1)
+        spec1 = HaloSpec(layout=lay1, topology=topo)
+        plain = run_spmd(
+            mesh,
+            lambda x: run_stencil(x[0, 0], spec1, steps)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        out_plain = np.asarray(plain(tiles_for(lay1)))[:, :, 1:-1, 1:-1]
+
+        layk = TileLayout(TH, TW, depth, depth)
+        speck = HaloSpec(layout=layk, topology=topo)
+        deep = run_spmd(
+            mesh,
+            lambda x: run_stencil_deep(x[0, 0], speck, steps, impl=deep_impl)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        k = depth
+        out_deep = np.asarray(deep(tiles_for(layk)))[:, :, k:-k, k:-k]
+        np.testing.assert_allclose(out_deep, out_plain, rtol=1e-5, atol=1e-6)
+
+    def test_deep_rejects_open_boundary(self):
+        from tpuscratch.halo.stencil import run_stencil_deep
+
+        topo = CartTopology((2, 4), (True, False))
+        lay = TileLayout(4, 4, 2, 2)
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="periodic"):
+            run_stencil_deep(jnp.zeros(lay.padded_shape), spec, 4)
+
+    def test_deep_rejects_asymmetric_halo(self):
+        from tpuscratch.halo.stencil import run_stencil_deep
+
+        topo = CartTopology((2, 4), (True, True))
+        lay = TileLayout(4, 4, 2, 1)
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="square"):
+            run_stencil_deep(jnp.zeros(lay.padded_shape), spec, 4)
+
+    def test_single_device_deep_matches_roll_oracle(self):
+        # 1x1 periodic mesh: deep == plain == numpy roll stencil.
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(22)
+        world = rng.standard_normal((16, 16)).astype(np.float32)
+        mesh = make_mesh_2d((1, 1))
+        got = distributed_stencil(world, steps=4, mesh=mesh, halo=(4, 4), impl="deep")
+        expect = world
+        for _ in range(4):
+            expect = 0.25 * (
+                np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
+                + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
+            )
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_banded_kernel_matches_single_block(self):
+        # force the Element-indexed band grid (the path the 1024^2 bench
+        # exercises) with a tiny VMEM budget and compare against the
+        # single-block kernel and the pure-jnp pyramid.
+        from tpuscratch.halo.stencil import shrink_step
+        from tpuscratch.ops.stencil_kernel import deep_trapezoid_pallas
+
+        lay = TileLayout(32, 24, 3, 3)
+        rng = np.random.default_rng(31)
+        t = jnp.asarray(rng.standard_normal(lay.padded_shape).astype(np.float32))
+        one_block = deep_trapezoid_pallas(t, lay, 3)
+        banded = deep_trapezoid_pallas(t, lay, 3, budget_bytes=(8 + 6) * 30 * 4)
+        a = t
+        for _ in range(3):
+            a = shrink_step(a, (0.25, 0.25, 0.25, 0.25, 0.0))
+        np.testing.assert_allclose(np.asarray(banded), np.asarray(a), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(banded), np.asarray(one_block), rtol=1e-6)
+
+    def test_banded_kernel_partial_substeps(self):
+        # banded + substeps < halo: crop must recover exactly the core
+        from tpuscratch.halo.stencil import shrink_step
+        from tpuscratch.ops.stencil_kernel import deep_trapezoid_pallas
+
+        lay = TileLayout(32, 24, 4, 4)
+        rng = np.random.default_rng(32)
+        t = jnp.asarray(rng.standard_normal(lay.padded_shape).astype(np.float32))
+        got = deep_trapezoid_pallas(t, lay, 2, budget_bytes=(8 + 8) * 32 * 4)
+        a = t
+        for _ in range(2):
+            a = shrink_step(a, (0.25, 0.25, 0.25, 0.25, 0.0))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(a)[2:-2, 2:-2], rtol=1e-6
+        )
+
+    @pytest.mark.parametrize("deep_impl", ["xla", "pallas"])
+    def test_depth_below_halo(self, deep_impl):
+        # depth < halo is documented as valid: a halo-4 layout stepping
+        # 2 steps per exchange must match the plain path too.
+        from tpuscratch.halo.driver import decompose, distributed_stencil
+
+        rng = np.random.default_rng(33)
+        world = rng.standard_normal((16, 16)).astype(np.float32)
+        mesh = make_mesh_2d((1, 1))
+        topo = CartTopology((1, 1), (True, True))
+        lay = TileLayout(16, 16, 4, 4)
+        spec = HaloSpec(layout=lay, topology=topo, axes=tuple(mesh.axis_names))
+        from tpuscratch.halo.stencil import run_stencil_deep
+
+        f = run_spmd(
+            mesh,
+            lambda x: run_stencil_deep(
+                x[0, 0], spec, 6, depth=2, impl=deep_impl
+            )[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        out = np.asarray(f(jnp.asarray(decompose(world, topo, lay))))[0, 0, 4:-4, 4:-4]
+        expect = world
+        for _ in range(6):
+            expect = 0.25 * (
+                np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
+                + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
+            )
+        np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)

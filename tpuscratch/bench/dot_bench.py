@@ -36,6 +36,7 @@ def bench_dot(
     method: str = "full",
     iters: int = 5,
     check: bool = True,
+    fence: str = "block",
 ) -> BenchResult:
     """Time the distributed dot of ``n_elems`` f32 (BASELINE config 2)."""
     n_dev = mesh.devices.size
@@ -48,7 +49,7 @@ def bench_dot(
             raise AssertionError(f"dot self-check FAILED: {got} != {n_elems}")
     return time_device(
         f, x, x,
-        iters=iters, warmup=2,
+        iters=iters, warmup=2, fence=fence,
         name=f"dot {n_elems:.0e} f32 ({method})", items=n_elems,
         bytes_moved=2 * 4 * n_elems,
     )

@@ -54,6 +54,7 @@ def sweep(
     sizes_bytes: Sequence[int] = DEFAULT_SIZES,
     rounds: int = 1,
     iters: int = 10,
+    fence: str = "block",
 ) -> list[BenchResult]:
     """Latency/BW sweep over message sizes (8 B - 128 MB by default).
 
@@ -72,6 +73,7 @@ def sweep(
                 x,
                 iters=iters,
                 warmup=2,
+                fence=fence,
                 name=f"pingpong {size}B",
                 bytes_moved=2 * n_elems * 4 * rounds,
             )

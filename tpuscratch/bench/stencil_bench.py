@@ -28,6 +28,7 @@ def bench_stencil(
     impl: str = "xla",
     iters: int = 5,
     dtype=jnp.float32,
+    fence: str = "block",
 ) -> BenchResult:
     """cell-updates/s for ``steps`` iterations of the full pipeline on a
     ``grid`` world decomposed over ``mesh`` (default: all devices)."""
@@ -36,9 +37,17 @@ def bench_stencil(
     rows, cols = topo.dims
     if grid[0] % rows or grid[1] % cols:
         raise ValueError(f"grid {grid} not divisible by mesh {topo.dims}")
-    layout = TileLayout(grid[0] // rows, grid[1] // cols, 1, 1)
+    halo, unroll, label = 1, 1, impl
+    if impl.startswith("deep"):
+        # "deep:K" / "deep-pallas:K" = trapezoid scheme, K-deep halo
+        # (K steps per exchange)
+        impl, _, depth = impl.partition(":")
+        halo = int(depth) if depth else min(steps, 8)
+    elif impl.endswith("+unroll"):
+        impl, unroll = impl.removesuffix("+unroll"), steps
+    layout = TileLayout(grid[0] // rows, grid[1] // cols, halo, halo)
     spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
-    program = make_stencil_program(mesh, spec, steps, impl=impl)
+    program = make_stencil_program(mesh, spec, steps, impl=impl, unroll=unroll)
 
     rng = np.random.default_rng(0)
     world = rng.standard_normal(grid).astype(np.dtype(dtype) if dtype != jnp.bfloat16 else np.float32)
@@ -46,7 +55,7 @@ def bench_stencil(
 
     return time_device(
         program, tiles,
-        iters=iters, warmup=2,
-        name=f"stencil {grid[0]}x{grid[1]} x{steps} on {rows}x{cols} ({impl})",
+        iters=iters, warmup=2, fence=fence,
+        name=f"stencil {grid[0]}x{grid[1]} x{steps} on {rows}x{cols} ({label})",
         items=grid[0] * grid[1] * steps,
     )

@@ -70,6 +70,30 @@ def span_max_min(begins: Sequence[float], ends: Sequence[float]) -> float:
     return max(ends) - min(begins)
 
 
+def _fence(out, mode: str):
+    """Wait until ``out`` is actually computed.
+
+    ``"block"`` trusts jax.block_until_ready. ``"readback"`` additionally
+    copies one element of the first output leaf to the host — the only
+    fence some remote-tunnel PJRT transports honor reliably (observed:
+    block_until_ready returning in ~20us for programs whose device time
+    is provably milliseconds). The 4-byte D2H costs one transport round
+    trip, so readback-fenced runs must amortize it with enough work per
+    iteration.
+    """
+    jax.block_until_ready(out)
+    if mode == "readback":
+        import numpy as np
+
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        # one-element slice, NOT ravel(): a reshape of a sharded array
+        # would dispatch a cross-device gather inside the timed region
+        np.asarray(leaf[(0,) * leaf.ndim])
+    elif mode != "block":
+        raise ValueError(f"unknown fence mode {mode!r}")
+    return out
+
+
 def time_device(
     fn: Callable,
     *args,
@@ -78,18 +102,20 @@ def time_device(
     name: str = "bench",
     bytes_moved: int = 0,
     items: int = 0,
+    fence: str = "block",
 ) -> BenchResult:
-    """block_until_ready-bracketed per-iteration timings.
+    """Fence-bracketed per-iteration timings.
 
     ``warmup`` runs (compile + cache effects) are excluded, the analogue of
-    NO_GPU_MALLOC_TIME excluding one-time setup from the window.
+    NO_GPU_MALLOC_TIME excluding one-time setup from the window. ``fence``
+    picks the completion barrier — see ``_fence``.
     """
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        _fence(fn(*args), fence)
     times = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        _fence(fn(*args), fence)
         times.append(time.perf_counter() - t0)
     return BenchResult(
         name=name, times_s=tuple(times), bytes_moved=bytes_moved, items=items

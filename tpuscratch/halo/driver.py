@@ -20,7 +20,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuscratch.comm import run_spmd
 from tpuscratch.halo.exchange import HaloSpec
 from tpuscratch.halo.layout import TileLayout
-from tpuscratch.halo.stencil import run_stencil
+from tpuscratch.halo.stencil import run_stencil, run_stencil_deep
 from tpuscratch.runtime.mesh import make_mesh_2d, topology_of
 from tpuscratch.runtime.topology import CartTopology
 
@@ -65,12 +65,20 @@ def make_stencil_program(
     steps: int,
     coeffs=(0.25, 0.25, 0.25, 0.25, 0.0),
     impl: str = "xla",
+    unroll: int = 1,
 ):
     """The compiled SPMD program: (rows, cols, ph, pw) tiles -> same, after
-    ``steps`` exchange+compute iterations."""
+    ``steps`` exchange+compute iterations. ``impl='deep'`` selects the
+    communication-avoiding trapezoid scheme (depth = the layout halo
+    width); other impls take an optional scan ``unroll`` factor."""
+    if impl in ("deep", "deep-pallas"):
+        sub = "pallas" if impl == "deep-pallas" else "xla"
+        step_fn = lambda t: run_stencil_deep(t[0, 0], spec, steps, coeffs, impl=sub)[None, None]  # noqa: E731
+    else:
+        step_fn = lambda t: run_stencil(t[0, 0], spec, steps, coeffs, impl, unroll)[None, None]  # noqa: E731
     return run_spmd(
         mesh,
-        lambda t: run_stencil(t[0, 0], spec, steps, coeffs, impl)[None, None],
+        step_fn,
         P(*mesh.axis_names, None, None),
         P(*mesh.axis_names, None, None),
     )

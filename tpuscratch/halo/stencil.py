@@ -135,11 +135,93 @@ def stencil_step(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25
     return _compute(tile, spec.layout, coeffs, impl)
 
 
-def run_stencil(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla") -> jax.Array:
+def run_stencil(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla", unroll: int = 1) -> jax.Array:
     """N iterations as one compiled scan (SPMD: call inside shard_map)."""
 
     def body(t, _):
         return stencil_step(t, spec, coeffs, impl), ()
 
-    out, _ = lax.scan(body, tile, None, length=steps)
+    out, _ = lax.scan(body, tile, None, length=steps, unroll=unroll)
+    return out
+
+
+def shrink_step(a: jax.Array, coeffs) -> jax.Array:
+    """One valid-region Jacobi step: (H, W) -> (H-2, W-2), every output
+    cell computed from fully-valid neighbors. The building block of the
+    trapezoid scheme — no border bookkeeping, the shape IS the validity."""
+    H, W = a.shape
+    h, w = H - 2, W - 2
+    cn, cs, cw, ce, cc = coeffs
+    return (
+        cn * a[0:h, 1 : 1 + w]
+        + cs * a[2 : 2 + h, 1 : 1 + w]
+        + cw * a[1 : 1 + h, 0:w]
+        + ce * a[1 : 1 + h, 2 : 2 + w]
+        + cc * a[1 : 1 + h, 1 : 1 + w]
+    )
+
+
+def run_stencil_deep(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), depth: int | None = None, impl: str = "xla") -> jax.Array:
+    """Communication-avoiding iteration: one ``depth``-wide halo exchange
+    buys ``depth`` update substeps (trapezoid/ghost-zone scheme).
+
+    Each exchange fills a halo ``depth`` cells deep; substep j then updates
+    every cell at least j rings in from the padded border, so after
+    ``depth`` substeps the core has advanced ``depth`` true Jacobi steps —
+    the redundant ring computation is the price for ``depth``x fewer
+    exchanges (and a ``depth``x shorter scan). The distributed win is
+    fewer, larger ICI messages; single-chip, it drops the per-step
+    pack/permute/scatter entirely. This is the natural TPU extension of
+    the reference's ghost-cell machinery, whose halo width is already
+    ``stencil/2`` cells (stencil2D.h:116-117) — here the width is an
+    optimization knob rather than a stencil property.
+
+    Requires a periodic topology: with open boundaries the scheme would
+    evolve boundary ghost rings that MPI_PROC_NULL semantics keep fixed.
+    ``depth`` defaults to the layout halo width; steps need not divide
+    evenly (the remainder runs as a shallower trailing trapezoid).
+
+    ``impl='xla'`` runs the substep pyramid as compiler-scheduled ops
+    (about one HBM pass per substep); ``impl='pallas'`` runs the whole
+    pyramid inside one VMEM-resident kernel (one HBM read + one write per
+    ``depth`` substeps — ops/stencil_kernel.deep_trapezoid_pallas), the
+    memory-bound regime's win.
+    """
+    lay = spec.layout
+    k = lay.halo_y if depth is None else depth
+    if lay.halo_y != lay.halo_x:
+        raise ValueError("deep stencil needs a square halo (halo_y == halo_x)")
+    if not (1 <= k <= lay.halo_y):
+        raise ValueError(f"depth {k} must be in [1, halo {lay.halo_y}]")
+    if not all(spec.topology.periodic):
+        raise ValueError("deep stencil requires a periodic topology")
+    if min(lay.core_h, lay.core_w) < k:
+        raise ValueError(
+            f"core {lay.core_h}x{lay.core_w} smaller than depth {k}"
+        )
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown deep stencil impl {impl!r}")
+
+    def trapezoid(t, substeps):
+        t = halo_exchange(t, spec)
+        if impl == "pallas":
+            from tpuscratch.ops.stencil_kernel import deep_trapezoid_pallas
+
+            core = deep_trapezoid_pallas(t, lay, substeps, tuple(coeffs))
+        else:
+            a = t
+            for _ in range(substeps):
+                a = shrink_step(a, coeffs)
+            crop = lay.halo_y - substeps
+            core = a[crop:-crop, crop:-crop] if crop else a
+        return rebuild(t, core, lay)
+
+    rounds, rem = divmod(steps, k)
+
+    def body(t, _):
+        return trapezoid(t, k), ()
+
+    out, _ = lax.scan(body, tile, None, length=rounds)
+    if rem:
+        out = trapezoid(out, rem)
     return out

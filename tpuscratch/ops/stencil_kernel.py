@@ -73,6 +73,97 @@ def five_point_pallas(tile: jax.Array, layout: TileLayout, coeffs: Coeffs = JACO
     return rebuild(tile, new_core, layout)
 
 
+def _trapezoid_kernel(t_ref, o_ref, *, substeps: int, crop: int, coeffs: Coeffs):
+    from tpuscratch.halo.stencil import shrink_step
+
+    a = t_ref[:]
+    for _ in range(substeps):
+        a = shrink_step(a, coeffs)
+    if crop:
+        a = a[crop:-crop, crop:-crop]
+    o_ref[:] = a
+
+
+def _trapezoid_band(layout: TileLayout, itemsize: int, budget_bytes: int) -> int:
+    """Largest divisor band of core_h whose input block fits the VMEM
+    budget (block is (band + 2*halo) x padded_w; the pyramid's temporaries
+    are about two more blocks, handled by the margin in ``budget_bytes``)."""
+    ph, pw = layout.padded_shape
+    if ph * pw * itemsize <= budget_bytes:  # whole tile in one block
+        return layout.core_h
+    band = layout.core_h
+    while band > 1 and (band + 2 * layout.halo_y) * pw * itemsize > budget_bytes:
+        # walk down through divisors of core_h
+        band = next(
+            (d for d in range(band - 1, 0, -1) if layout.core_h % d == 0), 1
+        )
+    return band
+
+
+@functools.partial(
+    jax.jit, static_argnames=("layout", "substeps", "coeffs", "budget_bytes")
+)
+def deep_trapezoid_pallas(
+    tile: jax.Array,
+    layout: TileLayout,
+    substeps: int,
+    coeffs: Coeffs = JACOBI,
+    budget_bytes: int = 2 << 20,
+) -> jax.Array:
+    """``substeps`` Jacobi steps of the padded tile in one VMEM residency
+    per row band: read each band from HBM once, run the shrinking
+    valid-region pyramid entirely in VMEM, write its advanced core rows
+    once.
+
+    The deep-halo (trapezoid) scheme's compute side: where the XLA deep
+    path costs ~one HBM pass per substep, this costs one read + one write
+    per ``substeps`` — the difference between HBM-roofline and
+    VMEM-roofline stepping. Small tiles run as a single block; tiles too
+    big for VMEM (~16 MB/core) run as a 1D grid over row bands whose
+    input blocks overlap by 2*halo rows (Element-indexed BlockSpec), at
+    the price of ~2*halo/band redundant rows per band.
+
+    Requires halo_y == halo_x >= substeps (the caller's exchange must have
+    filled a halo at least ``substeps`` deep).
+    """
+    k = layout.halo_y
+    if layout.halo_y != layout.halo_x:
+        raise ValueError("square halo required")
+    if not (1 <= substeps <= k):
+        raise ValueError(f"substeps {substeps} must be in [1, halo {k}]")
+    if tuple(tile.shape) != layout.padded_shape:
+        raise ValueError(f"tile {tile.shape} != padded {layout.padded_shape}")
+    kern = functools.partial(
+        _trapezoid_kernel, substeps=substeps, crop=k - substeps, coeffs=coeffs
+    )
+    band = _trapezoid_band(layout, tile.dtype.itemsize, budget_bytes)
+    if band == layout.core_h:
+        return pl.pallas_call(
+            kern,
+            out_shape=jax.ShapeDtypeStruct(
+                (layout.core_h, layout.core_w), tile.dtype
+            ),
+            interpret=use_interpret(),
+        )(tile)
+    ph, pw = layout.padded_shape
+    return pl.pallas_call(
+        kern,
+        grid=(layout.core_h // band,),
+        in_specs=[
+            # band i reads padded rows [i*band, i*band + band + 2k)
+            pl.BlockSpec(
+                (Element(band + 2 * k), Element(pw)),
+                lambda i: (i * band, 0),
+            )
+        ],
+        out_specs=pl.BlockSpec((band, layout.core_w), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(
+            (layout.core_h, layout.core_w), tile.dtype
+        ),
+        interpret=use_interpret(),
+    )(tile)
+
+
 def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
     cn, cs, cw, ce, cc = coeffs
     t = t_ref[:]  # (band + 2, 2*halo_x + width): one overlap row each side
