@@ -48,10 +48,10 @@ def config1_stencil_single(out: list, iters: int = 3) -> None:
     from tpuscratch.bench.stencil_bench import bench_stencil
     from tpuscratch.runtime.mesh import make_mesh_2d
 
-    steps = 40000 if jax.default_backend() == "tpu" else 50
+    steps = 100000 if jax.default_backend() == "tpu" else 50
     mesh = make_mesh_2d((1, 1))
     best = None
-    for impl in ("xla", "overlap", "deep:16"):
+    for impl in ("xla", "deep:16", "deep-pallas:16"):
         try:
             r = bench_stencil((1024, 1024), steps, mesh=mesh, impl=impl,
                               iters=iters, fence="readback")
@@ -132,10 +132,16 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     mesh = make_mesh_2d((4, 4), devices=jax.devices()[:16])
     best = None
     for impl in ("xla", "overlap", "deep:4"):
-        r = bench_stencil((8192, 8192), 10, mesh=mesh, impl=impl, iters=iters,
-                          fence="readback")
+        try:
+            r = bench_stencil((8192, 8192), 10, mesh=mesh, impl=impl,
+                              iters=iters, fence="readback")
+        except Exception as e:  # one impl failing shouldn't kill the config
+            print(f"# config 4 impl {impl} failed: {e}", file=sys.stderr)
+            continue
         if best is None or r.items_per_s > best.items_per_s:
             best = r
+    if best is None:
+        raise RuntimeError("all config-4 impls failed")
     _emit(
         out,
         config=4,
@@ -152,6 +158,8 @@ def config5_weak_scaling(out: list, per_chip: int = 1024, iters: int = 3) -> Non
     from tpuscratch.bench.weak_scaling import bench_weak_scaling, efficiency
 
     counts = [n for n in (1, 2, 4, 8, 16) if n <= len(jax.devices())]
+    if len(counts) < 2:
+        raise Needs("weak scaling needs >= 2 devices")
     pts = bench_weak_scaling(
         per_chip=(per_chip, per_chip), steps=10, device_counts=counts,
         iters=iters, fence="readback"

@@ -37,7 +37,10 @@ from tpuscratch.ops.common import LANES, to_lanes, use_interpret
 
 
 def _partials_kernel(x_ref, y_ref, o_ref):
-    o_ref[0] = jnp.sum(
+    # o_ref is the whole partials vector in SMEM: scalar stores are an
+    # SMEM capability (VMEM wants >= (8,128) vector blocks), and the
+    # sequential grid makes the per-step slot write race-free
+    o_ref[pl.program_id(0)] = jnp.sum(
         x_ref[:].astype(jnp.float32) * y_ref[:].astype(jnp.float32)
     )
 
@@ -47,11 +50,11 @@ def _full_kernel(x_ref, y_ref, o_ref):
 
     @pl.when(step == 0)
     def _init():
-        o_ref[0, 0] = 0.0
+        o_ref[...] = jnp.zeros_like(o_ref)
 
-    o_ref[0, 0] += jnp.sum(
+    o_ref[...] += jnp.sum(
         x_ref[:].astype(jnp.float32) * y_ref[:].astype(jnp.float32)
-    )
+    )[None, None]
 
 
 def _blocked(x: jax.Array, y: jax.Array, block_rows: int):
@@ -92,7 +95,7 @@ def dot_partials(x: jax.Array, y: jax.Array, block_rows: int = 512) -> jax.Array
             pl.BlockSpec((block, LANES), lambda i: (i, 0)),
             pl.BlockSpec((block, LANES), lambda i: (i, 0)),
         ],
-        out_specs=pl.BlockSpec((1,), lambda i: (i,)),
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((grid,), jnp.float32),
         interpret=use_interpret(),
     )(x2, y2)
