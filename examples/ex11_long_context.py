@@ -1,0 +1,73 @@
+"""Long-context sequence parallelism: ring attention and Ulysses.
+
+Beyond-parity capability (the reference has no attention anywhere —
+SURVEY.md §2.7 maps its ring/neighbor exchange and blockwise reduction as
+the structural ancestors): the sequence dimension is sharded over the
+mesh, KV blocks rotate by ppermute (ring) or heads swap by all_to_all
+(Ulysses), and both must agree with single-device attention on the
+gathered sequence.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main() -> None:
+    jax = ensure_devices()
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpuscratch.comm import run_spmd
+    from tpuscratch.parallel.ring_attention import ring_attention
+    from tpuscratch.parallel.ulysses import ulysses_attention
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    banner("long-context sequence parallelism (ring + Ulysses)")
+    mesh = make_mesh_1d("seq")
+    n = mesh.devices.size
+    S, H, D = 16, 8, 32  # per-rank block: global sequence = n*S
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((n * S, H, D)).astype(np.float32))
+        for _ in range(3)
+    )
+
+    # single-device oracle on the gathered sequence
+    def oracle(q, k, v, causal):
+        s = jnp.einsum("shd,thd->hst", q, k) / np.sqrt(D)
+        if causal:
+            mask = jnp.tril(jnp.ones((n * S, n * S), dtype=bool))
+            s = jnp.where(mask[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("hst,thd->shd", p, v)
+
+    for causal in (False, True):
+        want = oracle(q, k, v, causal)
+        ring = run_spmd(
+            mesh,
+            lambda q, k, v, c=causal: ring_attention(q, k, v, "seq", causal=c),
+            (P("seq"), P("seq"), P("seq")),
+            P("seq"),
+        )(q, k, v)
+        err_r = float(jnp.max(jnp.abs(ring - want)))
+        uly = run_spmd(
+            mesh,
+            lambda q, k, v, c=causal: ulysses_attention(q, k, v, "seq", causal=c),
+            (P("seq"), P("seq"), P("seq")),
+            P("seq"),
+        )(q, k, v)
+        err_u = float(jnp.max(jnp.abs(uly - want)))
+        tag = "causal" if causal else "full"
+        ok = "PASSED" if max(err_r, err_u) < 1e-4 else "FAILED"
+        print(
+            f"{tag:7s} seq={n * S} over {n} ranks: ring err {err_r:.2e}, "
+            f"ulysses err {err_u:.2e} -> {ok}"
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Weak-scaling stencil sweep: fixed per-chip tile, growing mesh.
+
+BASELINE config 5's harness as a runnable driver (the reference's scaling
+story is the capacity anecdote at mpicuda2.cu:44-47; this measures what it
+eyeballs). On one box the mesh is virtual CPU devices, so the efficiency
+numbers measure host-core contention, not ICI — run on a real slice for
+chip numbers (BASELINE.md).
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main() -> None:
+    ensure_devices()
+    from tpuscratch.bench.weak_scaling import bench_weak_scaling, report
+
+    banner("weak-scaling stencil (BASELINE config 5)")
+    pts = bench_weak_scaling(
+        per_chip=(128, 128), steps=10, device_counts=(1, 2, 4, 8), iters=3,
+        fence="readback",
+    )
+    print(report(pts))
+
+
+if __name__ == "__main__":
+    main()

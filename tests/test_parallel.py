@@ -168,3 +168,53 @@ class TestUlysses:
         x = jnp.ones((N * 2, 3, 4))  # 3 heads % 8 != 0
         with pytest.raises(ValueError):
             f(x, x, x)
+
+
+class TestPipeline:
+    """GPipe-style staged pipeline vs sequential stage application."""
+
+    def _run(self, mesh, M, feature=6):
+        from tpuscratch.parallel import pipeline_apply
+
+        n = mesh.devices.size
+        rng = np.random.default_rng(7)
+        # stage s: x -> tanh(x @ W_s + b_s), stacked over the stage axis
+        Ws = rng.standard_normal((n, feature, feature)).astype(np.float32) * 0.3
+        bs = rng.standard_normal((n, feature)).astype(np.float32) * 0.1
+        micro = rng.standard_normal((M, feature)).astype(np.float32)
+
+        def stage(params, x):
+            W, b = params
+            return jnp.tanh(x @ W[0] + b[0])
+
+        f = run_spmd(
+            mesh,
+            lambda W, b, m: pipeline_apply(stage, (W, b), m, "sp"),
+            (P("sp"), P("sp"), P()),
+            P(),
+        )
+        got = np.asarray(f(jnp.asarray(Ws), jnp.asarray(bs), jnp.asarray(micro)))
+
+        expect = micro.copy()
+        for s in range(n):
+            expect = np.tanh(expect @ Ws[s] + bs[s])
+        return got, expect
+
+    @pytest.mark.parametrize("M", [1, 3, 8])
+    def test_matches_sequential(self, mesh, M):
+        got, expect = self._run(mesh, M)
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_single_stage_mesh(self):
+        mesh1 = make_mesh_1d("sp", n=1)
+        got, expect = self._run(mesh1, 4)
+        np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+    def test_bubble_fraction(self):
+        from tpuscratch.parallel import bubble_fraction
+
+        assert bubble_fraction(1, 4) == 0.0
+        assert bubble_fraction(4, 1) == 0.75
+        assert abs(bubble_fraction(8, 56) - 7 / 63) < 1e-12
+        with pytest.raises(ValueError):
+            bubble_fraction(0, 4)

@@ -42,26 +42,34 @@ def _emit(out: list, **kv) -> None:
     print(json.dumps(kv), flush=True)
 
 
-def config1_stencil_single(out: list, iters: int = 3) -> None:
-    import jax
-
+def _best_stencil(impls, config_no, grid, steps, mesh, iters):
+    """Best cells/s over impls; a failing impl is reported and skipped."""
     from tpuscratch.bench.stencil_bench import bench_stencil
-    from tpuscratch.runtime.mesh import make_mesh_2d
 
-    steps = 100000 if jax.default_backend() == "tpu" else 50
-    mesh = make_mesh_2d((1, 1))
     best = None
-    for impl in ("xla", "deep:16", "deep-pallas:16"):
+    for impl in impls:
         try:
-            r = bench_stencil((1024, 1024), steps, mesh=mesh, impl=impl,
+            r = bench_stencil(grid, steps, mesh=mesh, impl=impl,
                               iters=iters, fence="readback")
         except Exception as e:  # one impl failing shouldn't kill the config
-            print(f"# config 1 impl {impl} failed: {e}", file=sys.stderr)
+            print(f"# config {config_no} impl {impl} failed: {e}",
+                  file=sys.stderr)
             continue
         if best is None or r.items_per_s > best.items_per_s:
             best = r
     if best is None:
-        raise RuntimeError("all config-1 impls failed")
+        raise RuntimeError(f"all config-{config_no} impls failed")
+    return best
+
+
+def config1_stencil_single(out: list, iters: int = 3) -> None:
+    import jax
+
+    from tpuscratch.runtime.mesh import make_mesh_2d
+
+    steps = 100000 if jax.default_backend() == "tpu" else 50
+    best = _best_stencil(("xla", "deep:16", "deep-pallas:16"), 1,
+                         (1024, 1024), steps, make_mesh_2d((1, 1)), iters)
     _emit(
         out,
         config=1,
@@ -124,24 +132,13 @@ def config3_pingpong(out: list, iters: int = 10) -> None:
 def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     import jax
 
-    from tpuscratch.bench.stencil_bench import bench_stencil
     from tpuscratch.runtime.mesh import make_mesh_2d
 
     if len(jax.devices()) < 16:
         raise Needs("config 4 needs a 4x4 mesh (16 devices)")
     mesh = make_mesh_2d((4, 4), devices=jax.devices()[:16])
-    best = None
-    for impl in ("xla", "overlap", "deep:4"):
-        try:
-            r = bench_stencil((8192, 8192), 10, mesh=mesh, impl=impl,
-                              iters=iters, fence="readback")
-        except Exception as e:  # one impl failing shouldn't kill the config
-            print(f"# config 4 impl {impl} failed: {e}", file=sys.stderr)
-            continue
-        if best is None or r.items_per_s > best.items_per_s:
-            best = r
-    if best is None:
-        raise RuntimeError("all config-4 impls failed")
+    best = _best_stencil(("xla", "overlap", "deep:4"), 4,
+                         (8192, 8192), 10, mesh, iters)
     _emit(
         out,
         config=4,

@@ -16,8 +16,11 @@ sequence-parallel attention schemes:
 - ``ulysses``: all-to-all sequence parallelism — switch from
   sequence-sharded to head-sharded with one all_to_all, run exact local
   attention, switch back.
+- ``pipeline``: staged (GPipe-style) pipeline parallelism — one stage per
+  rank, microbatches streaming through an open ppermute chain.
 """
 
+from tpuscratch.parallel.pipeline import bubble_fraction, pipeline_apply  # noqa: F401
 from tpuscratch.parallel.ring import ring_scan  # noqa: F401
 from tpuscratch.parallel.ring_attention import ring_attention  # noqa: F401
 from tpuscratch.parallel.ulysses import ulysses_attention  # noqa: F401
