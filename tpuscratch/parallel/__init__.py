@@ -18,8 +18,11 @@ sequence-parallel attention schemes:
   attention, switch back.
 - ``pipeline``: staged (GPipe-style) pipeline parallelism — one stage per
   rank, microbatches streaming through an open ppermute chain.
+- ``expert``: expert parallelism — capacity-routed MoE dispatch/combine
+  via all_to_all over an expert axis.
 """
 
+from tpuscratch.parallel.expert import expert_parallel_ffn, topk_routing  # noqa: F401
 from tpuscratch.parallel.pipeline import bubble_fraction, pipeline_apply  # noqa: F401
 from tpuscratch.parallel.ring import ring_scan  # noqa: F401
 from tpuscratch.parallel.ring_attention import ring_attention  # noqa: F401
