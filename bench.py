@@ -52,7 +52,7 @@ def main() -> int:
             rows, cols = 1, 1  # indivisible factorization: single device
         mesh = make_mesh_2d((rows, cols))
 
-    impls = ("xla", "deep:16", "deep-pallas:16", "deep-pallas:32")
+    impls = ("xla", "deep:16", "deep-pallas:16", "deep-pallas:32", "resident:8")
     best = None
     for impl in impls:
         try:

@@ -521,3 +521,35 @@ class TestDeepImpl:
                 + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
             )
         np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+class TestResidentStencil:
+    """run_stencil_resident: the 1x1-mesh VMEM-resident fast path."""
+
+    def test_matches_plain_path(self):
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(50)
+        world = rng.standard_normal((32, 128)).astype(np.float32)
+        mesh = make_mesh_2d((1, 1))
+        got = distributed_stencil(world, steps=5, mesh=mesh, impl="resident")
+        plain = distributed_stencil(world, steps=5, mesh=mesh, impl="xla")
+        np.testing.assert_allclose(got, plain, rtol=1e-5, atol=1e-6)
+
+    def test_rejects_multi_device_topology(self):
+        from tpuscratch.halo.stencil import run_stencil_resident
+
+        lay = TileLayout(8, 8, 1, 1)
+        topo = CartTopology((2, 4), (True, True))
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="single-device"):
+            run_stencil_resident(jnp.zeros(lay.padded_shape), spec, 2)
+
+    def test_rejects_open_boundary(self):
+        from tpuscratch.halo.stencil import run_stencil_resident
+
+        lay = TileLayout(8, 8, 1, 1)
+        topo = CartTopology((1, 1), (False, False))
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="periodic"):
+            run_stencil_resident(jnp.zeros(lay.padded_shape), spec, 2)

@@ -212,3 +212,59 @@ class TestStencilKernels:
         with pytest.raises(ValueError):
             from tpuscratch.halo.stencil import stencil_step
             stencil_step(tiles[0, 0], spec, impl="cuda")
+
+
+class TestResidentKernel:
+    """resident_periodic_pallas: whole grid in VMEM, roll-based torus wrap."""
+
+    def _oracle(self, world, steps, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0)):
+        cn, cs, cw, ce, cc = coeffs
+        for _ in range(steps):
+            world = (
+                cn * np.roll(world, 1, 0) + cs * np.roll(world, -1, 0)
+                + cw * np.roll(world, 1, 1) + ce * np.roll(world, -1, 1)
+                + cc * world
+            )
+        return world
+
+    @pytest.mark.parametrize("steps", [0, 1, 5, 6, 8])
+    def test_matches_roll_oracle(self, steps):
+        # unroll=3 with steps in {0,1,5,6,8} covers: empty loop, pure
+        # remainder, rounds+remainder, exact-multiple (6), and 2 rounds
+        # + remainder paths
+        from tpuscratch.ops.stencil_kernel import resident_periodic_pallas
+
+        rng = np.random.default_rng(40)
+        world = rng.standard_normal((16, 128)).astype(np.float32)
+        got = resident_periodic_pallas(jnp.asarray(world), steps, unroll=3)
+        np.testing.assert_allclose(
+            np.asarray(got), self._oracle(world, steps), rtol=1e-5, atol=1e-6
+        )
+
+    def test_asymmetric_coeffs(self):
+        # exercises the generic (non-factored) kernel body incl. center term
+        from tpuscratch.ops.stencil_kernel import resident_periodic_pallas
+
+        coeffs = (0.1, 0.2, 0.3, 0.15, 0.25)
+        rng = np.random.default_rng(41)
+        world = rng.standard_normal((8, 128)).astype(np.float32)
+        got = resident_periodic_pallas(jnp.asarray(world), 4, coeffs=coeffs)
+        np.testing.assert_allclose(
+            np.asarray(got), self._oracle(world, 4, coeffs), rtol=1e-5, atol=1e-6
+        )
+
+    def test_rejects_oversized_grid(self):
+        from tpuscratch.ops.stencil_kernel import resident_periodic_pallas
+
+        with pytest.raises(ValueError, match="VMEM"):
+            resident_periodic_pallas(
+                jnp.zeros((512, 512)), 1, vmem_limit_bytes=1 << 20
+            )
+
+    def test_rejects_bad_args(self):
+        from tpuscratch.ops.stencil_kernel import resident_periodic_pallas
+
+        with pytest.raises(ValueError, match="2D"):
+            resident_periodic_pallas(jnp.zeros((4, 4, 4)), 1)
+        with pytest.raises(ValueError, match="unroll"):
+            resident_periodic_pallas(jnp.zeros((8, 128)), 1, unroll=0)

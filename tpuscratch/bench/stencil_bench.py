@@ -37,12 +37,16 @@ def bench_stencil(
     rows, cols = topo.dims
     if grid[0] % rows or grid[1] % cols:
         raise ValueError(f"grid {grid} not divisible by mesh {topo.dims}")
-    halo, unroll, label = 1, 1, impl
+    halo, unroll, label = 1, None, impl
     if impl.startswith("deep"):
         # "deep:K" / "deep-pallas:K" = trapezoid scheme, K-deep halo
         # (K steps per exchange)
         impl, _, depth = impl.partition(":")
         halo = int(depth) if depth else min(steps, 8)
+    elif impl.startswith("resident"):
+        # "resident[:U]" = whole grid VMEM-resident, U-way inner unroll
+        impl, _, u = impl.partition(":")
+        unroll = int(u) if u else 8
     elif impl.endswith("+unroll"):
         impl, unroll = impl.removesuffix("+unroll"), steps
     layout = TileLayout(grid[0] // rows, grid[1] // cols, halo, halo)

@@ -20,7 +20,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from tpuscratch.comm import run_spmd
 from tpuscratch.halo.exchange import HaloSpec
 from tpuscratch.halo.layout import TileLayout
-from tpuscratch.halo.stencil import run_stencil, run_stencil_deep
+from tpuscratch.halo.stencil import (
+    run_stencil,
+    run_stencil_deep,
+    run_stencil_resident,
+)
 from tpuscratch.runtime.mesh import make_mesh_2d, topology_of
 from tpuscratch.runtime.topology import CartTopology
 
@@ -65,17 +69,21 @@ def make_stencil_program(
     steps: int,
     coeffs=(0.25, 0.25, 0.25, 0.25, 0.0),
     impl: str = "xla",
-    unroll: int = 1,
+    unroll: int | None = None,
 ):
     """The compiled SPMD program: (rows, cols, ph, pw) tiles -> same, after
     ``steps`` exchange+compute iterations. ``impl='deep'`` selects the
     communication-avoiding trapezoid scheme (depth = the layout halo
-    width); other impls take an optional scan ``unroll`` factor."""
-    if impl in ("deep", "deep-pallas"):
+    width); ``impl='resident'`` the single-device VMEM-resident kernel.
+    ``unroll`` is the scan unroll factor for the per-step impls and the
+    kernel's inner unroll for 'resident' (defaults 1 and 8)."""
+    if impl == "resident":
+        step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=unroll or 8)[None, None]  # noqa: E731
+    elif impl in ("deep", "deep-pallas"):
         sub = "pallas" if impl == "deep-pallas" else "xla"
         step_fn = lambda t: run_stencil_deep(t[0, 0], spec, steps, coeffs, impl=sub)[None, None]  # noqa: E731
     else:
-        step_fn = lambda t: run_stencil(t[0, 0], spec, steps, coeffs, impl, unroll)[None, None]  # noqa: E731
+        step_fn = lambda t: run_stencil(t[0, 0], spec, steps, coeffs, impl, unroll or 1)[None, None]  # noqa: E731
     return run_spmd(
         mesh,
         step_fn,
