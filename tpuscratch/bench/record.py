@@ -43,10 +43,11 @@ def _emit(out: list, **kv) -> None:
 
 
 def _best_stencil(impls, config_no, grid, steps, mesh, iters):
-    """Best cells/s over impls; a failing impl is reported and skipped."""
+    """(best result, winning impl) over impls; a failing impl is reported
+    and skipped."""
     from tpuscratch.bench.stencil_bench import bench_stencil
 
-    best = None
+    best, best_impl = None, None
     for impl in impls:
         try:
             r = bench_stencil(grid, steps, mesh=mesh, impl=impl,
@@ -55,11 +56,41 @@ def _best_stencil(impls, config_no, grid, steps, mesh, iters):
             print(f"# config {config_no} impl {impl} failed: {e}",
                   file=sys.stderr)
             continue
+        print(f"# {r.summary()}", file=sys.stderr)
         if best is None or r.items_per_s > best.items_per_s:
-            best = r
+            best, best_impl = r, impl
     if best is None:
         raise RuntimeError(f"all config-{config_no} impls failed")
-    return best
+    return best, best_impl
+
+
+def two_phase_stencil(impls, config_no, grid, mesh, iters,
+                      screen_steps, final_steps):
+    """Screen ``impls`` at ``screen_steps``, then re-measure the winner at
+    ``final_steps`` so the transport's fixed per-invocation cost (~150-200
+    ms on the axon tunnel) amortizes to noise. Returns (best, impl,
+    final_ok): ``final_ok`` False means every re-measure failed and
+    ``best`` is the screen-phase number, whose fixed-cost share
+    understates the chip rate."""
+    from tpuscratch.bench.stencil_bench import bench_stencil
+
+    best, best_impl = _best_stencil(impls, config_no, grid, screen_steps,
+                                    mesh, iters)
+    if not isinstance(final_steps, tuple):
+        final_steps = (final_steps,)
+    attempts = [s for s in final_steps if s > screen_steps]
+    for steps in attempts:
+        try:
+            r = bench_stencil(grid, steps, mesh=mesh, impl=best_impl,
+                              iters=iters, fence="readback")
+            print(f"# final: {r.summary()}", file=sys.stderr)
+            return r, best_impl, True
+        except Exception as e:
+            print(f"# re-measure at {steps} steps failed: {e}",
+                  file=sys.stderr)
+    # no re-measure needed (screen already at/above target) => ok; every
+    # attempt failed => screen number stands but is flagged not-ok
+    return best, best_impl, not attempts
 
 
 def config1_stencil_single(out: list, iters: int = 3) -> None:
@@ -67,9 +98,12 @@ def config1_stencil_single(out: list, iters: int = 3) -> None:
 
     from tpuscratch.runtime.mesh import make_mesh_2d
 
-    steps = 100000 if jax.default_backend() == "tpu" else 50
-    best = _best_stencil(("xla", "deep:16", "deep-pallas:16"), 1,
-                         (1024, 1024), steps, make_mesh_2d((1, 1)), iters)
+    on_tpu = jax.default_backend() == "tpu"
+    best, _, _ = two_phase_stencil(
+        ("xla", "deep:16", "deep-pallas:16", "resident:8"), 1,
+        (1024, 1024), make_mesh_2d((1, 1)), iters,
+        screen_steps=20000 if on_tpu else 50,
+        final_steps=500000 if on_tpu else 50)
     _emit(
         out,
         config=1,
@@ -137,7 +171,7 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     if len(jax.devices()) < 16:
         raise Needs("config 4 needs a 4x4 mesh (16 devices)")
     mesh = make_mesh_2d((4, 4), devices=jax.devices()[:16])
-    best = _best_stencil(("xla", "overlap", "deep:4"), 4,
+    best, _ = _best_stencil(("xla", "overlap", "deep:4"), 4,
                          (8192, 8192), 10, mesh, iters)
     _emit(
         out,

@@ -78,7 +78,7 @@ def make_stencil_program(
     ``unroll`` is the scan unroll factor for the per-step impls and the
     kernel's inner unroll for 'resident' (defaults 1 and 8)."""
     if impl == "resident":
-        step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=unroll or 8)[None, None]  # noqa: E731
+        step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=8 if unroll is None else unroll)[None, None]  # noqa: E731
     elif impl in ("deep", "deep-pallas"):
         sub = "pallas" if impl == "deep-pallas" else "xla"
         step_fn = lambda t: run_stencil_deep(t[0, 0], spec, steps, coeffs, impl=sub)[None, None]  # noqa: E731
