@@ -1,0 +1,184 @@
+// Native pooled host-staging allocator.
+//
+// The reference's host_allocator.h is a std-compliant allocator over
+// cudaMallocHost/cudaFreeHost (host_allocator.h:72-83): page-locked host
+// memory so staged transfers DMA at full rate, exercised by the pingpong
+// PAGE_LOCKED ablation (test-benchmark/mpi-pingpong-gpu-async.cpp:43-49).
+// This is its TPU-host counterpart: page-aligned buffers, optional
+// mlock(2) page-locking with graceful fallback (RLIMIT_MEMLOCK is often
+// tiny in containers), power-of-two size-class free lists so repeated
+// staging reuses buffers instead of round-tripping the OS, and the
+// capacity accounting the reference probes by crashing into cudaMalloc
+// failures (mpicuda2.cu:44-47) — here an explicit stats surface.
+//
+// Flat C ABI over an opaque pool handle; bound from Python via ctypes
+// (tpuscratch/native/hostpool.py).
+
+#include <cstdint>
+#include <cstdlib>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#if defined(__unix__) || defined(__APPLE__)
+#include <sys/mman.h>
+#include <unistd.h>
+#define TS_HAVE_MLOCK 1
+#else
+#define TS_HAVE_MLOCK 0
+#endif
+
+namespace {
+
+constexpr size_t kMinClass = 4096;  // one page: also the alignment
+
+struct Pool {
+  std::mutex mu;
+  bool lock_pages = false;
+  // size-class -> free buffers of exactly that class size
+  std::unordered_map<size_t, std::vector<void*>> cache;
+  // outstanding ptr -> its class size
+  std::unordered_map<void*, size_t> live;
+  // ptrs that mlock succeeded on (must munlock before free)
+  std::unordered_map<void*, bool> locked;
+  uint64_t bytes_in_use = 0;
+  uint64_t bytes_cached = 0;
+  uint64_t high_water = 0;
+  uint64_t alloc_calls = 0;
+  uint64_t reuse_hits = 0;
+  uint64_t locked_bytes = 0;
+  uint64_t lock_failures = 0;
+};
+
+// 0 = unserviceable (so the alloc fails cleanly instead of the shift
+// wrapping past 2^63 and spinning forever)
+size_t size_class(uint64_t n) {
+  if (n > (uint64_t{1} << 62)) return 0;
+  size_t c = kMinClass;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+void release_buffer(Pool* p, void* ptr, size_t cls) {
+#if TS_HAVE_MLOCK
+  auto it = p->locked.find(ptr);
+  if (it != p->locked.end()) {
+    if (it->second) {
+      munlock(ptr, cls);
+      p->locked_bytes -= cls;
+    }
+    p->locked.erase(it);
+  }
+#else
+  (void)cls;
+  p->locked.erase(ptr);
+#endif
+  std::free(ptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ts_pool_create(int32_t lock_pages) {
+  Pool* p = new (std::nothrow) Pool;
+  if (p) p->lock_pages = lock_pages != 0;
+  return p;
+}
+
+// Page-aligned buffer of at least `size` bytes (rounded up to its
+// power-of-two size class). NULL on exhaustion or size 0.
+void* ts_pool_alloc(void* pool, uint64_t size) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!p || size == 0) return nullptr;
+  const size_t cls = size_class(size);
+  if (cls == 0) return nullptr;
+  std::lock_guard<std::mutex> g(p->mu);
+  p->alloc_calls++;
+  void* ptr = nullptr;
+  auto it = p->cache.find(cls);
+  if (it != p->cache.end() && !it->second.empty()) {
+    ptr = it->second.back();
+    it->second.pop_back();
+    p->bytes_cached -= cls;
+    p->reuse_hits++;
+  } else {
+    if (posix_memalign(&ptr, kMinClass, cls) != 0) return nullptr;
+    if (p->lock_pages) {
+#if TS_HAVE_MLOCK
+      if (mlock(ptr, cls) == 0) {
+        p->locked[ptr] = true;
+        p->locked_bytes += cls;
+      } else {
+        p->locked[ptr] = false;
+        p->lock_failures++;
+      }
+#else
+      p->lock_failures++;
+#endif
+    }
+  }
+  p->live[ptr] = cls;
+  p->bytes_in_use += cls;
+  if (p->bytes_in_use > p->high_water) p->high_water = p->bytes_in_use;
+  return ptr;
+}
+
+// Return a buffer to the free list. Unknown/double-freed pointers are
+// ignored (counted nowhere: the Python binding owns pointer discipline).
+void ts_pool_free(void* pool, void* ptr) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!p || !ptr) return;
+  std::lock_guard<std::mutex> g(p->mu);
+  auto it = p->live.find(ptr);
+  if (it == p->live.end()) return;
+  const size_t cls = it->second;
+  p->live.erase(it);
+  p->bytes_in_use -= cls;
+  p->cache[cls].push_back(ptr);
+  p->bytes_cached += cls;
+}
+
+// Release every cached (free-listed) buffer back to the OS.
+void ts_pool_trim(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!p) return;
+  std::lock_guard<std::mutex> g(p->mu);
+  for (auto& kv : p->cache)
+    for (void* ptr : kv.second) release_buffer(p, ptr, kv.first);
+  p->cache.clear();
+  p->bytes_cached = 0;
+}
+
+// out[8] = {bytes_in_use, bytes_cached, high_water, alloc_calls,
+//           reuse_hits, locked_bytes, lock_failures, page_class}
+void ts_pool_stats(void* pool, uint64_t* out) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!p || !out) return;
+  std::lock_guard<std::mutex> g(p->mu);
+  out[0] = p->bytes_in_use;
+  out[1] = p->bytes_cached;
+  out[2] = p->high_water;
+  out[3] = p->alloc_calls;
+  out[4] = p->reuse_hits;
+  out[5] = p->locked_bytes;
+  out[6] = p->lock_failures;
+  out[7] = kMinClass;
+}
+
+// Free everything — cached AND outstanding — then the pool itself.
+void ts_pool_destroy(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!p) return;
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    for (auto& kv : p->cache)
+      for (void* ptr : kv.second) release_buffer(p, ptr, kv.first);
+    p->cache.clear();
+    for (auto& kv : p->live) release_buffer(p, kv.first, kv.second);
+    p->live.clear();
+  }
+  delete p;
+}
+
+}  // extern "C"

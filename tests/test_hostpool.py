@@ -1,0 +1,149 @@
+"""Native pooled host-staging allocator (native/src/host_pool.cpp).
+
+The contract mirrors the reference's ``host_allocator`` semantics
+(host_allocator.h:58-93): aligned allocation, reuse, and clean release —
+plus the pooling/stats surface the TPU build adds. Builds the native
+library on demand like test_native.py.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from tpuscratch import native
+
+pytestmark = pytest.mark.skipif(
+    not (native.available() or native.build()), reason="native toolchain absent"
+)
+
+
+@pytest.fixture()
+def pool():
+    from tpuscratch.native import hostpool
+
+    with hostpool.HostPool(lock_pages=False) as p:
+        yield p
+
+
+def test_alloc_is_page_aligned(pool):
+    with pool.alloc(100) as buf:
+        assert buf.ptr % 4096 == 0
+        assert buf.nbytes == 100
+
+
+def test_data_roundtrip_through_view(pool):
+    rng = np.random.default_rng(0)
+    data = rng.standard_normal(1000).astype(np.float32)
+    with pool.alloc(data.nbytes) as buf:
+        view = buf.view(np.float32, (1000,))
+        np.copyto(view, data)
+        np.testing.assert_array_equal(buf.view(np.float32, (1000,)), data)
+
+
+def test_free_then_alloc_reuses_buffer(pool):
+    buf = pool.alloc(5000)
+    first_ptr = buf.ptr
+    buf.free()
+    buf2 = pool.alloc(6000)  # same 8192-byte size class
+    assert buf2.ptr == first_ptr
+    assert pool.stats()["reuse_hits"] == 1
+    buf2.free()
+
+
+def test_stats_accounting(pool):
+    assert pool.stats()["bytes_in_use"] == 0
+    a = pool.alloc(4096)
+    b = pool.alloc(4096)
+    s = pool.stats()
+    assert s["bytes_in_use"] == 8192
+    assert s["high_water"] == 8192
+    assert s["alloc_calls"] == 2
+    a.free()
+    s = pool.stats()
+    assert s["bytes_in_use"] == 4096
+    assert s["bytes_cached"] == 4096
+    assert s["high_water"] == 8192
+    b.free()
+    pool.trim()
+    s = pool.stats()
+    assert s["bytes_in_use"] == 0
+    assert s["bytes_cached"] == 0
+    assert s["page_class"] == 4096
+
+
+def test_size_class_rounding(pool):
+    with pool.alloc(4097) as buf:
+        assert buf.nbytes == 4097  # logical size preserved
+    assert pool.stats()["bytes_cached"] == 8192  # physical class size
+
+
+def test_double_free_and_stale_view_guard(pool):
+    buf = pool.alloc(64)
+    buf.free()
+    buf.free()  # idempotent
+    with pytest.raises(ValueError):
+        buf.view(np.uint8)
+    with pytest.raises(ValueError):
+        _ = buf.ptr
+
+
+def test_oversized_view_rejected(pool):
+    with pool.alloc(100) as buf:
+        with pytest.raises(ValueError):
+            buf.view(np.float32, (1000,))
+
+
+def test_bad_alloc_size_rejected(pool):
+    with pytest.raises(ValueError):
+        pool.alloc(0)
+
+
+def test_absurd_alloc_size_fails_cleanly(pool):
+    with pytest.raises(MemoryError):
+        pool.alloc(2**63 + 1)
+
+
+def test_lock_pages_graceful_fallback():
+    """mlock either succeeds (locked_bytes > 0) or falls back
+    (lock_failures > 0) — never crashes."""
+    from tpuscratch.native import hostpool
+
+    with hostpool.HostPool(lock_pages=True) as p:
+        with p.alloc(4096):
+            s = p.stats()
+            assert s["locked_bytes"] > 0 or s["lock_failures"] > 0
+
+
+def test_concurrent_alloc_free(pool):
+    errors = []
+
+    def churn():
+        try:
+            for _ in range(200):
+                with pool.alloc(2048) as buf:
+                    buf.view(np.uint8)[0] = 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert pool.stats()["bytes_in_use"] == 0
+
+
+def test_default_pool_singleton_and_staging_bench():
+    from tpuscratch.bench.pingpong import (
+        native_pool_staging_roundtrip,
+        pageable_buffer_staging_roundtrip,
+    )
+    from tpuscratch.native import hostpool
+
+    assert hostpool.default_pool() is hostpool.default_pool()
+    res = native_pool_staging_roundtrip(1024, iters=2)
+    control = pageable_buffer_staging_roundtrip(1024, iters=2)
+    assert res.p50 > 0 and control.p50 > 0
+    assert res.bytes_moved == control.bytes_moved == 2 * 1024 * 4

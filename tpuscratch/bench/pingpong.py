@@ -98,6 +98,53 @@ def host_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
     )
 
 
+def _buffer_staging(view: np.ndarray, n_elems: int, iters: int, label: str) -> BenchResult:
+    """device -> host -> persistent staging buffer -> device, with the
+    buffer's allocator as the only variable."""
+    x = jnp.zeros(n_elems, dtype=jnp.float32)
+    jax.block_until_ready(x)
+
+    def stage(v):
+        np.copyto(view, np.asarray(v))   # D2H then memcpy into the buffer
+        return jax.device_put(view)      # H2D out of it
+
+    return time_device(
+        stage, x, iters=iters, warmup=1,
+        name=f"{label} staging {n_elems * 4}B",
+        bytes_moved=2 * n_elems * 4,
+    )
+
+
+def native_pool_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
+    """The reference's ``host_allocator`` ablation: stage through the
+    native pooled page-aligned (mlocked where permitted) buffer
+    (native/src/host_pool.cpp; host_allocator.h:58-93 is the CUDA
+    counterpart, exercised the same way by
+    mpi-pingpong-gpu-async.cpp:43-49).
+
+    Compare against ``pageable_buffer_staging_roundtrip`` — identical
+    copy structure, only the buffer's allocator differs. (jax offers no
+    D2H-into-caller-buffer API, so unlike the reference's
+    cudaMemcpy-into-pinned path both variants pay an extra host memcpy;
+    the A/B isolates the allocator, which is what the PAGE_LOCKED switch
+    ablates in the reference.)"""
+    from tpuscratch.native import hostpool
+
+    buf = hostpool.default_pool().alloc(n_elems * 4)
+    try:
+        view = buf.view(np.float32, (n_elems,))
+        return _buffer_staging(view, n_elems, iters, "native-pool")
+    finally:
+        buf.free()
+
+
+def pageable_buffer_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
+    """Control for the native-pool ablation: same persistent-staging-buffer
+    copy structure through a plain pageable numpy allocation."""
+    view = np.empty(n_elems, dtype=np.float32)
+    return _buffer_staging(view, n_elems, iters, "pageable-buffer")
+
+
 def pinned_staging_roundtrip(
     n_elems: int, pinned: bool = True, iters: int = 10
 ) -> BenchResult:
