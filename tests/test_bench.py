@@ -1,5 +1,6 @@
 """Tests for the benchmark harnesses (correctness, not performance)."""
 
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -67,6 +68,33 @@ class TestBenchPrograms:
         mesh = make_mesh_1d("x")
         res = bench_dot(mesh, n_elems=8 * 4096, iters=2, check=True)
         assert res.items == 8 * 4096
+
+    def test_dot_bench_scanned_rounds(self):
+        # the rounds>1 scan path: self-check still exact (the
+        # anti-hoisting perturbation is below f32 resolution), and
+        # items/bytes scale by rounds
+        mesh = make_mesh_1d("x")
+        n = 8 * 4096
+        res = bench_dot(mesh, n_elems=n, iters=2, check=True, rounds=3)
+        assert res.items == n * 3
+        assert res.bytes_moved == 2 * 4 * n * 3
+
+    def test_dot_bench_scanned_rounds_xla_method(self):
+        mesh = make_mesh_1d("x")
+        res = bench_dot(
+            mesh, n_elems=8 * 4096, iters=2, check=True, rounds=2,
+            method="xla",
+        )
+        assert res.items == 8 * 4096 * 2
+
+    def test_dot_bench_implausible_rate_rejected(self):
+        # tiny problem + absurdly low bound => the roofline guard trips
+        mesh = make_mesh_1d("x")
+        with pytest.raises(AssertionError, match="implausible"):
+            bench_dot(
+                mesh, n_elems=8 * 4096, iters=2, check=False, rounds=2,
+                max_gbps=1e-12,
+            )
 
     def test_stencil_bench_runs(self):
         res = bench_stencil(grid=(32, 32), steps=2, iters=2)

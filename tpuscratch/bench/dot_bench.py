@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpuscratch.bench.timing import BenchResult, time_device
@@ -20,13 +21,37 @@ from tpuscratch.comm import run_spmd
 from tpuscratch.ops.reduction import local_dot_psum
 
 
-def dot_program(mesh: Mesh, axis: str = "x", method: str = "full", block_rows: int = 512):
-    return run_spmd(
-        mesh,
-        lambda a, b: local_dot_psum(a, b, axis, method=method, block_rows=block_rows),
-        (P(axis), P(axis)),
-        P(),
-    )
+def dot_program(
+    mesh: Mesh,
+    axis: str = "x",
+    method: str = "full",
+    block_rows: int = 512,
+    rounds: int = 1,
+):
+    """Compiled distributed dot; ``rounds`` > 1 folds that many dots into
+    one ``lax.scan`` so a fenced invocation amortizes fixed dispatch/
+    transport cost (the same treatment the stencil bench applies).
+
+    Each round perturbs the input by ``1e-30 * acc`` (loop-carried, so
+    XLA cannot hoist the otherwise loop-invariant dot out of the scan)
+    — far below f32 resolution for O(1) data, so the result is
+    unchanged while every round honestly re-reads both vectors from HBM.
+    """
+
+    def one(a, b):
+        return local_dot_psum(a, b, axis, method=method, block_rows=block_rows)
+
+    if rounds == 1:
+        return run_spmd(mesh, one, (P(axis), P(axis)), P())
+
+    def repeated(a, b):
+        def step(acc, _):
+            return one(a + acc * jnp.float32(1e-30), b), None
+
+        acc, _ = lax.scan(step, jnp.float32(0.0), None, length=rounds)
+        return acc
+
+    return run_spmd(mesh, repeated, (P(axis), P(axis)), P())
 
 
 def bench_dot(
@@ -37,19 +62,37 @@ def bench_dot(
     iters: int = 5,
     check: bool = True,
     fence: str = "block",
+    rounds: int = 1,
+    max_gbps: float = 2000.0,
 ) -> BenchResult:
-    """Time the distributed dot of ``n_elems`` f32 (BASELINE config 2)."""
+    """Time ``rounds`` distributed dots of ``n_elems`` f32 (BASELINE
+    config 2). ``rounds=1`` measures single-invocation latency; large
+    ``rounds`` measures HBM-roofline throughput.
+
+    ``max_gbps`` is a physical-plausibility bound (no current chip
+    streams HBM anywhere near 2 TB/s/core for f32): if a multi-round
+    measurement beats it, the anti-hoisting perturbation has stopped
+    working (a compiler rewrite distributed the dot over the add and
+    hoisted it) and the number is rejected rather than recorded."""
     n_dev = mesh.devices.size
     n_elems = (n_elems // n_dev) * n_dev  # even shards
     x = jnp.ones(n_elems, dtype=jnp.float32)
-    f = dot_program(mesh, axis, method)
+    f = dot_program(mesh, axis, method, rounds=rounds)
     if check:
         got = float(f(x, x))
         if abs(got - n_elems) > 1e-3 * n_elems:
             raise AssertionError(f"dot self-check FAILED: {got} != {n_elems}")
-    return time_device(
+    res = time_device(
         f, x, x,
         iters=iters, warmup=2, fence=fence,
-        name=f"dot {n_elems:.0e} f32 ({method})", items=n_elems,
-        bytes_moved=2 * 4 * n_elems,
+        name=f"dot {n_elems:.0e} f32 ({method}) x{rounds}",
+        items=n_elems * rounds,
+        bytes_moved=2 * 4 * n_elems * rounds,
     )
+    if rounds > 1 and res.gbps > max_gbps:
+        raise AssertionError(
+            f"implausible {res.gbps:.0f} GB/s (> {max_gbps:.0f}): the scanned "
+            "dot was likely hoisted out of the loop; fix dot_program's "
+            "perturbation before trusting this benchmark"
+        )
+    return res

@@ -121,15 +121,35 @@ def config2_dot(out: list, iters: int = 10) -> None:
     from tpuscratch.runtime.mesh import make_mesh_1d
 
     mesh = make_mesh_1d("x", devices=jax.devices())
-    r = bench_dot(mesh, n_elems=100_000_000, iters=iters, check=True,
-                  fence="readback")
+    on_tpu = jax.default_backend() == "tpu"
+    # latency: one fenced invocation (the reference's per-call number);
+    # throughput: enough scanned rounds to amortize the fixed transport
+    # cost down to the HBM roofline
+    lat = bench_dot(mesh, n_elems=100_000_000, iters=iters, check=True,
+                    fence="readback")
+    _emit(
+        out,
+        config=2,
+        metric="dot_1e8_f32_call_latency_s",
+        value=lat.p50,
+        detail=lat.name,
+        n_devices=mesh.devices.size,
+    )
+    # method="xla" for throughput: the fused native reduction reaches the
+    # HBM roofline (~1 ms/round for 2x400 MB reads on v5e); the Pallas
+    # kernels (the CUDA-parity demonstration, measured above) plateau ~4x
+    # off it, and hand-scheduling what XLA already schedules well is
+    # exactly what this framework's design principles say not to do
+    thr = bench_dot(mesh, n_elems=100_000_000, iters=max(2, iters // 3),
+                    check=True, fence="readback", method="xla",
+                    rounds=2000 if on_tpu else 2)
     _emit(
         out,
         config=2,
         metric="dot_1e8_f32_elements_per_s",
-        value=r.items_per_s,
-        p50_s=r.p50,
-        detail=r.name,
+        value=thr.items_per_s,
+        p50_s=thr.p50,
+        detail=thr.name,
         n_devices=mesh.devices.size,
     )
 
