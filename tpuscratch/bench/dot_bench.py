@@ -36,17 +36,40 @@ def dot_program(
     XLA cannot hoist the otherwise loop-invariant dot out of the scan)
     — far below f32 resolution for O(1) data, so the result is
     unchanged while every round honestly re-reads both vectors from HBM.
+    The perturbation rides the kernels' in-kernel ``offset`` scalar
+    (ops.reduction._offset_arg): adding it to a materialized ``a + eps``
+    instead would cost every round an extra read+write of the whole
+    vector outside the opaque pallas_call (~3x measured slowdown).
     """
 
-    def one(a, b):
-        return local_dot_psum(a, b, axis, method=method, block_rows=block_rows)
+    from tpuscratch.ops import reduction
+
+    def one(a, b, offset=None):
+        return local_dot_psum(
+            a, b, axis, method=method, block_rows=block_rows, offset=offset
+        )
 
     if rounds == 1:
         return run_spmd(mesh, one, (P(axis), P(axis)), P())
 
     def repeated(a, b):
-        def step(acc, _):
-            return one(a + acc * jnp.float32(1e-30), b), None
+        # Prep (pad/reshape to lane blocks) ONCE outside the scan for the
+        # Pallas methods: XLA does not hoist it out of the loop body, and
+        # paying it per round triples the measured traffic.
+        if method == "xla":
+            def step(acc, _):
+                return one(a, b, offset=acc * jnp.float32(1e-30)), None
+        else:
+            x2, y2, _, block = reduction.prep(a, b, block_rows)
+            prepped = (
+                reduction.dot_full_prepped
+                if method == "full"
+                else reduction.dot_partials_prepped
+            )
+
+            def step(acc, _):
+                s = prepped(x2, y2, block, offset=acc * jnp.float32(1e-30))
+                return lax.psum(s, axis), None
 
         acc, _ = lax.scan(step, jnp.float32(0.0), None, length=rounds)
         return acc
@@ -63,17 +86,21 @@ def bench_dot(
     check: bool = True,
     fence: str = "block",
     rounds: int = 1,
-    max_gbps: float = 2000.0,
+    max_gbps: float = 1000.0,
 ) -> BenchResult:
     """Time ``rounds`` distributed dots of ``n_elems`` f32 (BASELINE
     config 2). ``rounds=1`` measures single-invocation latency; large
     ``rounds`` measures HBM-roofline throughput.
 
-    ``max_gbps`` is a physical-plausibility bound (no current chip
-    streams HBM anywhere near 2 TB/s/core for f32): if a multi-round
+    ``max_gbps`` is a physical-plausibility bound: if a multi-round
     measurement beats it, the anti-hoisting perturbation has stopped
-    working (a compiler rewrite distributed the dot over the add and
-    hoisted it) and the number is rejected rather than recorded."""
+    working (e.g. a compiler rewrite distributed ``dot(x+o, y)`` into
+    ``dot(x,y) + o*sum(y)`` and hoisted the invariant parts) and the
+    number is rejected rather than recorded. The default is tuned just
+    above v5e-class HBM (~820 GB/s) so even PARTIAL hoisting (one of the
+    two operand streams skipped → apparent 2x) trips it; on parts with
+    faster HBM per core (e.g. v5p ~2.7 TB/s) callers must raise it to
+    ~1.3x that part's roofline to keep the same sensitivity."""
     n_dev = mesh.devices.size
     n_elems = (n_elems // n_dev) * n_dev  # even shards
     x = jnp.ones(n_elems, dtype=jnp.float32)

@@ -135,14 +135,32 @@ def config2_dot(out: list, iters: int = 10) -> None:
         detail=lat.name,
         n_devices=mesh.devices.size,
     )
-    # method="xla" for throughput: the fused native reduction reaches the
-    # HBM roofline (~1 ms/round for 2x400 MB reads on v5e); the Pallas
-    # kernels (the CUDA-parity demonstration, measured above) plateau ~4x
-    # off it, and hand-scheduling what XLA already schedules well is
-    # exactly what this framework's design principles say not to do
-    thr = bench_dot(mesh, n_elems=100_000_000, iters=max(2, iters // 3),
-                    check=True, fence="readback", method="xla",
-                    rounds=2000 if on_tpu else 2)
+    # throughput: screen the three reduction strategies (Pallas full /
+    # Pallas partials / fused XLA — all within ~5% of the HBM roofline
+    # once the benchmark preps lane blocks outside the scan), then
+    # re-measure the winner with enough rounds to amortize the fixed
+    # transport cost
+    screen_rounds, final_rounds = (200, 2000) if on_tpu else (2, 2)
+    it = max(2, iters // 3)
+    best = None
+    for m in ("full", "partials", "xla"):
+        try:
+            r = bench_dot(mesh, n_elems=100_000_000, iters=it, check=True,
+                          fence="readback", method=m, rounds=screen_rounds)
+        except Exception as e:
+            print(f"# config 2 method {m} failed: {e}", file=sys.stderr)
+            continue
+        print(f"# {r.summary()}", file=sys.stderr)
+        if best is None or r.items_per_s > best[0].items_per_s:
+            best = (r, m)
+    if best is None:
+        raise RuntimeError("all config-2 methods failed")
+    thr = best[0]
+    if final_rounds > screen_rounds:
+        thr = bench_dot(mesh, n_elems=100_000_000, iters=it, check=True,
+                        fence="readback", method=best[1],
+                        rounds=final_rounds)
+        print(f"# final: {thr.summary()}", file=sys.stderr)
     _emit(
         out,
         config=2,
