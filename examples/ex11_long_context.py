@@ -61,11 +61,22 @@ def main() -> None:
             P("seq"),
         )(q, k, v)
         err_u = float(jnp.max(jnp.abs(uly - want)))
+        # same all_to_all scheme with the Pallas flash kernel as the
+        # local attention (the long-sequence memory-bounded path)
+        flash = run_spmd(
+            mesh,
+            lambda q, k, v, c=causal: ulysses_attention(
+                q, k, v, "seq", causal=c, impl="pallas"
+            ),
+            (P("seq"), P("seq"), P("seq")),
+            P("seq"),
+        )(q, k, v)
+        err_f = float(jnp.max(jnp.abs(flash - want)))
         tag = "causal" if causal else "full"
-        ok = "PASSED" if max(err_r, err_u) < 1e-4 else "FAILED"
+        ok = "PASSED" if max(err_r, err_u, err_f) < 1e-4 else "FAILED"
         print(
             f"{tag:7s} seq={n * S} over {n} ranks: ring err {err_r:.2e}, "
-            f"ulysses err {err_u:.2e} -> {ok}"
+            f"ulysses err {err_u:.2e}, ulysses+flash err {err_f:.2e} -> {ok}"
         )
 
 

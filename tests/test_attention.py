@@ -1,0 +1,125 @@
+"""Flash-attention kernel (ops/attention.py) vs a dense oracle.
+
+Runs in Pallas interpret mode on the CPU mesh (conftest forces CPU
+devices); the same kernel source runs compiled on real TPUs, where it
+was probed at S=4096, H=8, D=128 (~99 TFLOP/s non-causal, ~69 causal).
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from tpuscratch.ops.attention import flash_attention
+from tpuscratch.parallel.scores import masked_scores
+
+
+def dense_oracle(q, k, v, causal, q_offset=0, kv_offset=0):
+    S, H, D = q.shape
+    T = k.shape[0]
+    rows = q_offset + np.arange(S)
+    cols = kv_offset + np.arange(T)
+    mask = (
+        rows[:, None] >= cols[None, :]
+        if causal
+        else np.ones((S, T), bool)
+    )
+    s = np.asarray(
+        masked_scores(jnp.asarray(q), jnp.asarray(k), jnp.asarray(mask))
+    )
+    m = s.max(-1, keepdims=True)
+    p = np.exp(s - m) * (s > -1e29)
+    l = p.sum(-1, keepdims=True)
+    l[l == 0] = 1.0
+    return np.einsum("hst,thd->shd", p / l, v)
+
+
+def rand_qkv(rng, S, T, H, D):
+    return (
+        rng.standard_normal((S, H, D)).astype(np.float32),
+        rng.standard_normal((T, H, D)).astype(np.float32),
+        rng.standard_normal((T, H, D)).astype(np.float32),
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("S,T,H,D", [(16, 16, 2, 8), (32, 16, 1, 8), (8, 24, 3, 16)])
+    def test_matches_dense(self, causal, S, T, H, D):
+        rng = np.random.default_rng(0)
+        q, k, v = rand_qkv(rng, S, T, H, D)
+        got = np.asarray(
+            flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=causal, block_q=8, block_k=8,
+            )
+        )
+        np.testing.assert_allclose(
+            got, dense_oracle(q, k, v, causal), rtol=1e-5, atol=1e-6
+        )
+
+    def test_global_offsets_for_ring_style_blocks(self):
+        # a Q block at rows [16,48) attending a K block at cols [0,16):
+        # fully visible under causal; and the mirrored case fully masked
+        rng = np.random.default_rng(1)
+        q, k, v = rand_qkv(rng, 32, 16, 2, 8)
+        got = np.asarray(
+            flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=True, q_offset=16, kv_offset=0, block_q=8, block_k=8,
+            )
+        )
+        np.testing.assert_allclose(
+            got, dense_oracle(q, k, v, True, 16, 0), rtol=1e-5, atol=1e-6
+        )
+
+    def test_fully_masked_rows_are_zero_not_nan(self):
+        # kv strictly in the future of every query row
+        rng = np.random.default_rng(2)
+        q, k, v = rand_qkv(rng, 8, 16, 1, 8)
+        got = np.asarray(
+            flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=True, q_offset=0, kv_offset=100, block_q=8, block_k=8,
+            )
+        )
+        assert np.isfinite(got).all()
+        np.testing.assert_array_equal(got, np.zeros_like(got))
+
+    def test_uneven_block_shrink(self):
+        # S=T=24 with requested blocks 128 -> shrinks to a divisor
+        rng = np.random.default_rng(3)
+        q, k, v = rand_qkv(rng, 24, 24, 2, 8)
+        got = np.asarray(
+            flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        )
+        np.testing.assert_allclose(
+            got, dense_oracle(q, k, v, False), rtol=1e-5, atol=1e-6
+        )
+
+    def test_bad_shapes_rejected(self):
+        q = jnp.ones((8, 2, 4), jnp.float32)
+        k = jnp.ones((8, 2, 6), jnp.float32)
+        with pytest.raises(ValueError, match="bad attention shapes"):
+            flash_attention(q, k, k)
+
+    def test_unblockable_length_rejected(self):
+        # 17 has no power-of-two divisor >= 8: refuse rather than
+        # silently degrade to per-row grid steps
+        q = jnp.ones((17, 2, 8), jnp.float32)
+        with pytest.raises(ValueError, match="power-of-two block divisor"):
+            flash_attention(q, q, q)
+
+    def test_bf16_inputs(self):
+        rng = np.random.default_rng(4)
+        q, k, v = rand_qkv(rng, 16, 16, 2, 8)
+        got = np.asarray(
+            flash_attention(
+                jnp.asarray(q, jnp.bfloat16),
+                jnp.asarray(k, jnp.bfloat16),
+                jnp.asarray(v, jnp.bfloat16),
+                block_q=8, block_k=8,
+            ).astype(jnp.float32)
+        )
+        np.testing.assert_allclose(
+            got, dense_oracle(q, k, v, False), rtol=0.05, atol=0.05
+        )

@@ -138,6 +138,39 @@ class TestUlysses:
         expect = _oracle_attention(q, k, v, causal)
         np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_pallas_impl_matches_oracle(self, mesh, causal):
+        # the flash-attention local step (ops.attention) behind the same
+        # all_to_all re-sharding — interpret mode on the CPU mesh
+        S, H, D = 4, 8, 8
+        rng = np.random.default_rng(6)
+        q = rng.standard_normal((N * S, H, D)).astype(np.float32)
+        k = rng.standard_normal((N * S, H, D)).astype(np.float32)
+        v = rng.standard_normal((N * S, H, D)).astype(np.float32)
+        f = run_spmd(
+            mesh,
+            lambda a, b, c: ulysses_attention(
+                a, b, c, "sp", causal=causal, impl="pallas"
+            ),
+            (P("sp"), P("sp"), P("sp")),
+            P("sp"),
+        )
+        got = np.asarray(f(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
+        expect = _oracle_attention(q, k, v, causal)
+        np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-5)
+
+    def test_unknown_impl_rejected(self, mesh):
+        S, H, D = 2, 8, 4
+        x = jnp.ones((N * S, H, D), jnp.float32)
+        f = run_spmd(
+            mesh,
+            lambda a, b, c: ulysses_attention(a, b, c, "sp", impl="nope"),
+            (P("sp"), P("sp"), P("sp")),
+            P("sp"),
+        )
+        with pytest.raises(ValueError, match="unknown ulysses impl"):
+            f(x, x, x)
+
     def test_ring_and_ulysses_agree(self, mesh):
         S, H, D = 2, 8, 4
         rng = np.random.default_rng(3)

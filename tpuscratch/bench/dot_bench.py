@@ -61,14 +61,11 @@ def dot_program(
                 return one(a, b, offset=acc * jnp.float32(1e-30)), None
         else:
             x2, y2, _, block = reduction.prep(a, b, block_rows)
-            prepped = (
-                reduction.dot_full_prepped
-                if method == "full"
-                else reduction.dot_partials_prepped
-            )
 
             def step(acc, _):
-                s = prepped(x2, y2, block, offset=acc * jnp.float32(1e-30))
+                s = reduction.dot_prepped(
+                    x2, y2, block, method, offset=acc * jnp.float32(1e-30)
+                )
                 return lax.psum(s, axis), None
 
         acc, _ = lax.scan(step, jnp.float32(0.0), None, length=rounds)

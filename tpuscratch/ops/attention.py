@@ -1,0 +1,198 @@
+"""Pallas flash-attention kernel — blockwise exact attention, MXU path.
+
+The reference has no attention anywhere (SURVEY.md §2.7: no sequence
+dimension exists); this kernel is part of the framework's long-context
+surface, beyond reference parity. The sequence-parallel schemes in
+``tpuscratch.parallel`` bound *cross-chip* memory by sharding the
+sequence; this kernel bounds *on-chip* memory for the local attention
+those schemes still compute — most importantly the Ulysses path, whose
+all-to-all hands every rank the FULL global sequence for its head slice
+(parallel/ulysses.py), where a naive (S, S) score materialization is
+exactly the memory blowup flash attention exists to avoid.
+
+Shape contract matches ``parallel.scores.masked_scores`` semantics:
+q (S, H, D), k/v (T, H, D), fp32 online-softmax accumulation, causal
+masking on global positions via ``q_offset``/``kv_offset`` (scalars, so
+ring-attention hops can reuse the kernel with rotated K origins).
+
+Kernel structure (the canonical TPU flash schedule):
+- grid (H, S/block_q, T/block_k); the KV axis is the innermost,
+  sequential ("arbitrary") dimension — the VMEM scratch carrying the
+  online-softmax state (running max, normalizer, fp32 accumulator) is
+  revisited across KV steps, initialized at the first step, and the
+  normalized output is emitted at the last.
+- both matmuls (scores = q @ k^T, update = p @ v) hit the MXU with
+  ``preferred_element_type=float32``; the VPU handles the softmax
+  bookkeeping in between.
+- the running max / normalizer live in (block_q, 128) VMEM scratch with
+  values broadcast across lanes: Mosaic wants lane-complete vector
+  stores, and a broadcast store + column-0 read is free compared to the
+  relayouts a (block_q, 1) slice store would trigger.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuscratch.ops.common import use_interpret
+from tpuscratch.parallel.scores import NEG_INF
+
+_LANE = 128
+
+
+def _flash_kernel(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # block-level causal skip: a KV block strictly above this Q
+        # block's last row contributes nothing — skip its MXU/VPU work
+        # entirely (~2x for long sequences; the DMA still happens, which
+        # is what keeps the skip correct under Mosaic's static pipeline)
+        first_masked_col = qoff_ref[0] + (i + 1) * block_q
+        block_needed = koff_ref[0] + j * block_k < first_masked_col
+    else:
+        block_needed = True
+
+    @pl.when(block_needed)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, D)
+        s = (
+            lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+        )  # (block_q, block_k)
+
+        if causal:
+            rows = qoff_ref[0] + i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = koff_ref[0] + j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_scr[:, 0]                       # (block_q,)
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        # fully-masked rows keep m_new == NEG_INF, making s - m_new == 0
+        # for masked entries; zero them so correctness is hop-order
+        # independent (same guard as parallel/ring_attention.py)
+        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=1)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + lax.dot(
+            p, v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        l_fin = l_scr[:, 0]
+        safe = jnp.where(l_fin > 0.0, l_fin, 1.0)  # fully-masked row -> 0
+        o_ref[0] = (acc_scr[...] / safe[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, want: int, name: str) -> int:
+    """Largest power-of-two block <= want that divides n.
+
+    Refuses blocks below the 8-row sublane quantum (unless the dimension
+    itself is smaller): a sequence length with no power-of-two divisor
+    would silently degrade to per-row grid steps, orders of magnitude
+    slower than the dense fallback — pad the sequence instead."""
+    b = want
+    while b > 1 and n % b:
+        b //= 2
+    if b < 8 and n >= 8:
+        raise ValueError(
+            f"{name}={n} has no power-of-two block divisor >= 8; pad the "
+            "sequence to a multiple of 8 (or use the dense xla path)"
+        )
+    return max(b, 1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k"),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 512,
+    block_k: int = 1024,
+) -> jax.Array:
+    """Exact attention with O(S·D) memory per head: q (S, H, D),
+    k/v (T, H, D) -> (S, H, D). Offsets place the blocks in global
+    coordinates for causal masking (both default 0: a self-contained
+    sequence)."""
+    if q.ndim != 3 or k.shape != v.shape or q.shape[1:] != k.shape[1:]:
+        raise ValueError(f"bad attention shapes {q.shape}/{k.shape}/{v.shape}")
+    S, H, D = q.shape
+    T = k.shape[0]
+    bq = _pick_block(S, block_q, "S")
+    bk = _pick_block(T, block_k, "T")
+    nq, nk = S // bq, T // bk
+    scale = 1.0 / float(D) ** 0.5
+
+    qh = jnp.swapaxes(q, 0, 1)  # (H, S, D)
+    kh = jnp.swapaxes(k, 0, 1)
+    vh = jnp.swapaxes(v, 0, 1)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+
+    kern = functools.partial(
+        _flash_kernel,
+        scale=scale, causal=causal, block_q=bq, block_k=bk, nk=nk,
+    )
+    interpret = use_interpret()
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    out = pl.pallas_call(
+        kern,
+        grid=(H, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **params,
+    )(qoff, koff, qh, kh, vh)
+    return jnp.swapaxes(out, 0, 1)

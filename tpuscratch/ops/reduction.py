@@ -178,6 +178,17 @@ def dot_full_prepped(x2: jax.Array, y2: jax.Array, block: int, offset=None) -> j
     return out[0, 0]
 
 
+def dot_prepped(x2: jax.Array, y2: jax.Array, block: int, method: str = "full", offset=None) -> jax.Array:
+    """Strategy dispatch over pre-blocked operands (see ``prep``) — the
+    one method-string table, shared with iterating callers like
+    dot_bench so the benchmark cannot silently diverge from the library."""
+    if method == "full":
+        return dot_full_prepped(x2, y2, block, offset=offset)
+    if method == "partials":
+        return dot_partials_prepped(x2, y2, block, offset=offset)
+    raise ValueError(f"unknown prepped dot method {method!r}")
+
+
 def dot(x: jax.Array, y: jax.Array, method: str = "full", block_rows: int = 512, offset=None) -> jax.Array:
     """Dot product with strategy selection (REDUCE_GPU/REDUCE_CPU parity,
     mpicuda4.cu:347-355, as a runtime argument instead of a #define).
