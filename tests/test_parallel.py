@@ -70,9 +70,11 @@ class TestRingScan:
 
 
 class TestRingAttention:
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_oracle(self, mesh, causal):
-        S, H, D = 4, 2, 8  # global seq = 32
+    def test_matches_oracle(self, mesh, causal, impl):
+        # S=8 so the interpret-mode flash kernel gets full sublane blocks
+        S, H, D = 8, 2, 8  # global seq = 64
         rng = np.random.default_rng(0)
         q = rng.standard_normal((N * S, H, D)).astype(np.float32)
         k = rng.standard_normal((N * S, H, D)).astype(np.float32)
@@ -80,13 +82,26 @@ class TestRingAttention:
 
         f = run_spmd(
             mesh,
-            lambda a, b, c: ring_attention(a, b, c, "sp", causal=causal),
+            lambda a, b, c: ring_attention(
+                a, b, c, "sp", causal=causal, impl=impl
+            ),
             (P("sp"), P("sp"), P("sp")),
             P("sp"),
         )
         got = np.asarray(f(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)))
         expect = _oracle_attention(q, k, v, causal)
         np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-5)
+
+    def test_unknown_impl_rejected(self, mesh):
+        x = jnp.ones((N * 2, 1, 4), jnp.float32)
+        f = run_spmd(
+            mesh,
+            lambda a, b, c: ring_attention(a, b, c, "sp", impl="cuda"),
+            (P("sp"), P("sp"), P("sp")),
+            P("sp"),
+        )
+        with pytest.raises(ValueError, match="unknown ring attention impl"):
+            f(x, x, x)
 
     def test_bf16_inputs(self, mesh):
         S, H, D = 2, 1, 4

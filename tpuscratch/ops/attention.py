@@ -49,6 +49,7 @@ _LANE = 128
 def _flash_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
+    m_ref=None, l_ref=None,
 ):
     i = pl.program_id(1)
     j = pl.program_id(2)
@@ -109,9 +110,35 @@ def _flash_kernel(
 
     @pl.when(j == nk - 1)
     def _emit():
-        l_fin = l_scr[:, 0]
-        safe = jnp.where(l_fin > 0.0, l_fin, 1.0)  # fully-masked row -> 0
-        o_ref[0] = (acc_scr[...] / safe[:, None]).astype(o_ref.dtype)
+        if m_ref is None:
+            l_fin = l_scr[:, 0]
+            safe = jnp.where(l_fin > 0.0, l_fin, 1.0)  # fully-masked row->0
+            o_ref[0] = (acc_scr[...] / safe[:, None]).astype(o_ref.dtype)
+        else:
+            # state mode: emit the RAW fp32 accumulator (no divide, no
+            # dtype cast — the caller's softmax-merge stays exact) plus
+            # the running max / normalizer broadcast over an 8-lane
+            # plane. Mosaic requires lane-complete block stores and a
+            # sublane-divisible block shape, which rules out both a bare
+            # (1, block_q) state row and the full 128-lane broadcast;
+            # 8 lanes is the narrowest legal layout (column 0 is read
+            # back outside).
+            o_ref[0] = acc_scr[...]
+            m_ref[0] = m_scr[:, :8]
+            l_ref[0] = l_scr[:, :8]
+
+
+def _flash_kernel_state(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+    m_scr, l_scr, acc_scr, **kw,
+):
+    """Positional reordering for the three-output variant: pallas passes
+    (inputs..., outputs..., scratch...); the base kernel wants the state
+    outputs as keywords."""
+    _flash_kernel(
+        qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
+        m_scr, l_scr, acc_scr, m_ref=m_ref, l_ref=l_ref, **kw,
+    )
 
 
 def _pick_block(n: int, want: int, name: str) -> int:
@@ -134,7 +161,7 @@ def _pick_block(n: int, want: int, name: str) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "block_q", "block_k"),
+    static_argnames=("causal", "block_q", "block_k", "return_state"),
 )
 def flash_attention(
     q: jax.Array,
@@ -145,11 +172,20 @@ def flash_attention(
     kv_offset=0,
     block_q: int = 512,
     block_k: int = 1024,
-) -> jax.Array:
+    return_state: bool = False,
+):
     """Exact attention with O(S·D) memory per head: q (S, H, D),
     k/v (T, H, D) -> (S, H, D). Offsets place the blocks in global
     coordinates for causal masking (both default 0: a self-contained
-    sequence)."""
+    sequence).
+
+    ``return_state=True`` changes the contract for cross-block merging
+    (ring attention's hops): returns ``(acc, m, l)`` where ``acc`` is the
+    UNNORMALIZED fp32 weighted sum (S, H, D) and ``m``/``l`` are the
+    running max / normalizer, each (H, S) fp32. The caller merges blocks
+    with ``acc*exp(m-m')`` algebra and divides by the merged ``l`` once
+    at the end — exact, with no per-hop normalize/un-normalize round
+    trip through the input dtype."""
     if q.ndim != 3 or k.shape != v.shape or q.shape[1:] != k.shape[1:]:
         raise ValueError(f"bad attention shapes {q.shape}/{k.shape}/{v.shape}")
     S, H, D = q.shape
@@ -166,7 +202,7 @@ def flash_attention(
     koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
 
     kern = functools.partial(
-        _flash_kernel,
+        _flash_kernel_state if return_state else _flash_kernel,
         scale=scale, causal=causal, block_q=bq, block_k=bk, nk=nk,
     )
     interpret = use_interpret()
@@ -175,7 +211,15 @@ def flash_attention(
         params["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         )
-    out = pl.pallas_call(
+    out_specs = [pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((H, S, D), q.dtype)]
+    if return_state:
+        # raw fp32 accumulator + 8-lane state planes (column 0 = value)
+        out_specs[0] = pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0))
+        out_shape[0] = jax.ShapeDtypeStruct((H, S, D), jnp.float32)
+        out_specs += [pl.BlockSpec((1, bq, 8), lambda h, i, j: (h, i, 0))] * 2
+        out_shape += [jax.ShapeDtypeStruct((H, S, 8), jnp.float32)] * 2
+    res = pl.pallas_call(
         kern,
         grid=(H, nq, nk),
         in_specs=[
@@ -185,8 +229,8 @@ def flash_attention(
             pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
             pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((H, S, D), q.dtype),
+        out_specs=out_specs if return_state else out_specs[0],
+        out_shape=out_shape if return_state else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANE), jnp.float32),
             pltpu.VMEM((bq, _LANE), jnp.float32),
@@ -195,4 +239,7 @@ def flash_attention(
         interpret=interpret,
         **params,
     )(qoff, koff, qh, kh, vh)
-    return jnp.swapaxes(out, 0, 1)
+    if return_state:
+        acc, m, l = res
+        return jnp.swapaxes(acc, 0, 1), m[..., 0], l[..., 0]
+    return jnp.swapaxes(res, 0, 1)

@@ -31,6 +31,7 @@ def ring_attention(
     v: jax.Array,
     axis: str,
     causal: bool = False,
+    impl: str = "xla",
 ) -> jax.Array:
     """Exact multi-head attention, sequence sharded over ``axis``.
 
@@ -39,9 +40,17 @@ def ring_attention(
     bit-equivalent (up to fp assoc.) to attention on the gathered sequence.
     Call inside shard_map with the sequence dimension sharded over
     ``axis``.
+
+    ``impl``: 'xla' computes each hop's block scores densely; 'pallas'
+    runs the flash-attention kernel (ops.attention) per hop with
+    ``return_state=True`` and softmax-merges the per-hop (out, m, l) —
+    same math, MXU-scheduled, and the per-hop (H, S, S) score block never
+    materializes (the long-block regime).
     """
     if q.ndim != 3 or q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"expected equal (S,H,D) blocks, got {q.shape}/{k.shape}/{v.shape}")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
     S, H, D = q.shape
     n = lax.axis_size(axis)
     me = lax.axis_index(axis)
@@ -56,7 +65,7 @@ def ring_attention(
         jnp.zeros((S, H, D), dtype=jnp.float32),
     )
 
-    def combine(state, kv_block, hop):
+    def combine_xla(state, kv_block, hop):
         m, l, o = state
         kb, vb = kv_block
         src = (me - hop) % n  # origin rank of this KV block
@@ -77,6 +86,28 @@ def ring_attention(
         pv = jnp.einsum("hst,thd->shd", p, vb.astype(jnp.float32))
         o = o * corr.T[:, :, None] + pv
         return (m_new, l, o)
+
+    def combine_pallas(state, kv_block, hop):
+        from tpuscratch.ops.attention import flash_attention
+
+        m, l, o = state
+        kb, vb = kv_block
+        src = (me - hop) % n
+        # per-hop flash over this KV block, in global coordinates;
+        # acc_i is the hop's raw fp32 weighted sum (no normalization)
+        acc_i, m_i, l_i = flash_attention(
+            q, kb, vb, causal=causal,
+            q_offset=me * S, kv_offset=src * S, return_state=True,
+        )
+        # exact softmax-merge: rescale both sides to the new running max
+        m_new = jnp.maximum(m, m_i)
+        c_old = jnp.exp(m - m_new)                   # (H, S)
+        c_new = jnp.exp(m_i - m_new)
+        l_new = l * c_old + l_i * c_new
+        o_new = o * c_old.T[:, :, None] + acc_i * c_new.T[:, :, None]
+        return (m_new, l_new, o_new)
+
+    combine = combine_pallas if impl == "pallas" else combine_xla
 
     # return_payload=False: the KV pair is discarded after the last hop, so
     # the homeward rotation (one extra 2*S*H*D transfer) is skipped
