@@ -45,7 +45,7 @@ def main() -> int:
     )
     final_steps = int(
         os.environ.get(
-            "TPUSCRATCH_BENCH_STEPS_FINAL", "500000" if on_tpu else "50"
+            "TPUSCRATCH_BENCH_STEPS_FINAL", "2000000" if on_tpu else "50"
         )
     )
     iters = int(os.environ.get("TPUSCRATCH_BENCH_ITERS", "3"))

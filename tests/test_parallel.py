@@ -103,13 +103,16 @@ class TestRingAttention:
         with pytest.raises(ValueError, match="unknown ring attention impl"):
             f(x, x, x)
 
-    def test_bf16_inputs(self, mesh):
-        S, H, D = 2, 1, 4
+    @pytest.mark.parametrize("impl", ["xla", "pallas"])
+    def test_bf16_inputs(self, mesh, impl):
+        # bf16 is the motivating case for the pallas path's raw-fp32
+        # accumulator state (no per-hop round trip through the dtype)
+        S, H, D = 8, 1, 8
         rng = np.random.default_rng(1)
         q = rng.standard_normal((N * S, H, D)).astype(np.float32)
         f = run_spmd(
             mesh,
-            lambda a, b, c: ring_attention(a, b, c, "sp"),
+            lambda a, b, c: ring_attention(a, b, c, "sp", impl=impl),
             (P("sp"), P("sp"), P("sp")),
             P("sp"),
         )

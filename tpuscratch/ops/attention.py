@@ -215,7 +215,6 @@ def flash_attention(
     out_shape = [jax.ShapeDtypeStruct((H, S, D), q.dtype)]
     if return_state:
         # raw fp32 accumulator + 8-lane state planes (column 0 = value)
-        out_specs[0] = pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0))
         out_shape[0] = jax.ShapeDtypeStruct((H, S, D), jnp.float32)
         out_specs += [pl.BlockSpec((1, bq, 8), lambda h, i, j: (h, i, 0))] * 2
         out_shape += [jax.ShapeDtypeStruct((H, S, 8), jnp.float32)] * 2
