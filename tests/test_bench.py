@@ -97,6 +97,24 @@ class TestBenchPrograms:
         assert res.items == 32 * 32 * 2
         assert res.items_per_s > 0
 
+    def test_attention_bench_runs(self):
+        from tpuscratch.bench.attention_bench import bench_attention
+
+        res = bench_attention(
+            S=16, H=2, D=8, causal=True, rounds=2, iters=2, fence="block"
+        )
+        assert res.items == 2 * int(4 * 16 * 16 * 2 * 8 * 0.5)
+        assert res.items_per_s > 0
+
+    def test_attention_bench_implausible_rate_rejected(self):
+        from tpuscratch.bench.attention_bench import bench_attention
+
+        with pytest.raises(AssertionError, match="implausible"):
+            bench_attention(
+                S=16, H=2, D=8, causal=True, rounds=2, iters=2,
+                fence="block", max_tflops=1e-12,
+            )
+
 
 class TestImplStrings:
     def test_deep_impl_string(self):

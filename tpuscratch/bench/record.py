@@ -103,7 +103,7 @@ def config1_stencil_single(out: list, iters: int = 3) -> None:
         ("xla", "deep:16", "deep-pallas:16", "resident:8"), 1,
         (1024, 1024), make_mesh_2d((1, 1)), iters,
         screen_steps=20000 if on_tpu else 50,
-        final_steps=500000 if on_tpu else 50)
+        final_steps=2000000 if on_tpu else 50)
     _emit(
         out,
         config=1,
@@ -256,18 +256,50 @@ def config5_weak_scaling(out: list, per_chip: int = 1024, iters: int = 3) -> Non
     )
 
 
+def config6_flash_attention(out: list, iters: int = 3) -> None:
+    """Beyond-reference: flash-attention TFLOP/s (ops/attention.py).
+
+    The reference has no attention; this records the framework's
+    long-context MXU kernel so the number is reproducible rather than a
+    one-off probe."""
+    import jax
+
+    from tpuscratch.bench.attention_bench import bench_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    for causal in (True, False):
+        r = bench_attention(
+            S=4096 if on_tpu else 64,
+            H=8 if on_tpu else 2,
+            D=128 if on_tpu else 16,
+            causal=causal,
+            rounds=2000 if on_tpu else 2,
+            iters=iters,
+        )
+        print(f"# {r.summary()}", file=sys.stderr)
+        _emit(
+            out,
+            config=6,
+            metric=f"flash_attention_{'causal' if causal else 'full'}_tflops",
+            value=r.items_per_s / 1e12,  # items = FLOPs
+            p50_s=r.p50,
+            detail=r.name,
+        )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
     3: config3_pingpong,
     4: config4_stencil_mesh,
     5: config5_weak_scaling,
+    6: config6_flash_attention,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--configs", default="1,2,3,4,5")
+    ap.add_argument("--configs", default="1,2,3,4,5,6")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh first (dev path)")
