@@ -1,0 +1,133 @@
+"""Composed multi-axis training step (models/transformer.py).
+
+The correctness anchors:
+- sharding invariance: the same global batch gives the same loss on a
+  1x1 mesh (no communication at all) and on a dp x sp mesh (ring
+  attention hops + expert all_to_all + grad psums), up to fp reordering
+  — capacity_factor is set so no token is ever dropped, making the math
+  sharding-independent;
+- optimization sanity: the jitted step actually descends;
+- impl equivalence: flash-kernel attention hops match the dense path.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tpuscratch.models import TransformerConfig, init_params, train_step
+from tpuscratch.models.transformer import param_spec
+from tpuscratch.runtime.mesh import make_mesh
+
+B, S, D = 4, 16, 32
+
+
+def cfg_for(n_experts=4, **kw):
+    # capacity_factor=n_experts => per-expert capacity == local token
+    # count: nothing is ever dropped, so loss is sharding-invariant
+    kw.setdefault("capacity_factor", float(n_experts))
+    return TransformerConfig(
+        d_model=D, n_heads=2, n_experts=n_experts, d_ff=48, **kw
+    )
+
+
+def data(seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((B, S, D)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((B, S, D)).astype(np.float32))
+    return x, y
+
+
+class TestComposedTrainStep:
+    @pytest.mark.parametrize("dims", [(2, 1), (1, 4), (2, 4)])
+    def test_sharding_invariance(self, dims):
+        # the degenerate axes matter independently: (2,1) = pure dp
+        # (multi-expert-shard, no ring hops), (1,4) = pure sp (ring hops,
+        # single expert shard), (2,4) = both
+        cfg = cfg_for()
+        x, y = data()
+        params = init_params(1, cfg)
+
+        single = train_step(
+            make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1]), cfg
+        )
+        n = dims[0] * dims[1]
+        multi = train_step(
+            make_mesh(dims, ("dp", "sp"), jax.devices()[:n]), cfg
+        )
+        p1, l1 = single(params, x, y)
+        pn, ln = multi(params, x, y)
+        assert abs(float(l1) - float(ln)) < 1e-4
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pn)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+            )
+
+    def test_loss_decreases(self):
+        cfg = cfg_for()
+        x, y = data(3)
+        params = init_params(2, cfg)
+        step = train_step(
+            make_mesh((2, 4), ("dp", "sp"), jax.devices()[:8]), cfg, lr=0.05
+        )
+        losses = []
+        for _ in range(5):
+            params, loss = step(params, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
+
+    def test_flash_hops_match_dense_forward(self):
+        # flash hops are a forward/inference path (no backward kernel
+        # yet); the composed FORWARD must agree across impls. sp=2 keeps
+        # the local sequence block >= the kernel's 8-row quantum.
+        from jax.sharding import PartitionSpec as P
+
+        from tpuscratch.comm import run_spmd
+        from tpuscratch.models import model_apply
+
+        x, _ = data(5)
+        params = init_params(4, cfg_for())
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        outs = {}
+        for impl in ("xla", "pallas"):
+            cfg = cfg_for(attn_impl=impl)
+            f = run_spmd(
+                mesh,
+                lambda p, v, c=cfg: model_apply(p, v, c)[0],
+                (param_spec(cfg), P("dp", "sp")),
+                P("dp", "sp"),
+            )
+            outs[impl] = np.asarray(f(params, x))
+        np.testing.assert_allclose(
+            outs["xla"], outs["pallas"], rtol=1e-4, atol=1e-5
+        )
+
+    def test_flash_training_rejected_clearly(self):
+        mesh = make_mesh((2, 4), ("dp", "sp"), jax.devices()[:8])
+        with pytest.raises(NotImplementedError, match="no backward"):
+            train_step(mesh, cfg_for(attn_impl="pallas"))
+
+    def test_expert_divisibility_enforced(self):
+        mesh = make_mesh((2, 4), ("dp", "sp"), jax.devices()[:8])
+        with pytest.raises(ValueError, match="not divisible by dp"):
+            train_step(mesh, cfg_for(n_experts=3))
+
+    def test_param_spec_marks_expert_leaves(self):
+        cfg = cfg_for()
+        spec = param_spec(cfg)
+        layer = spec["layers"][0]
+        assert layer["w_in"] == jax.sharding.PartitionSpec("dp")
+        assert layer["w_out"] == jax.sharding.PartitionSpec("dp")
+        assert layer["wq"] == jax.sharding.PartitionSpec()
+
+    def test_n_layers_stack(self):
+        cfg = cfg_for(n_layers=2)
+        x, y = data(7)
+        params = init_params(6, cfg)
+        assert len(params["layers"]) == 2
+        step = train_step(
+            make_mesh((2, 4), ("dp", "sp"), jax.devices()[:8]), cfg
+        )
+        _, loss = step(params, x, y)
+        assert np.isfinite(float(loss))
