@@ -50,7 +50,12 @@ class TransformerConfig:
     causal: bool = True
     capacity_factor: float = 2.0
     aux_coef: float = 0.01
-    attn_impl: str = "xla"      # 'xla' | 'pallas' (flash kernel hops)
+    # 'xla': ring attention, dense hop blocks (trainable)
+    # 'pallas': ring attention, flash-kernel hops (forward-only: the
+    #   state-mode kernel the hop merge needs has no backward)
+    # 'ulysses-pallas': Ulysses all_to_all + differentiable flash kernel
+    #   (trainable; needs n_heads % sp_size == 0)
+    attn_impl: str = "xla"
 
     @property
     def d_head(self) -> int:
@@ -91,10 +96,11 @@ def init_params(seed: int, cfg: TransformerConfig) -> dict:
     return {"layers": layers}
 
 
+EXPERT_LEAVES = ("w_in", "w_out")  # the leaves sharded over "dp"
+
+
 def _is_expert_leaf(path) -> bool:
-    return any(
-        getattr(k, "key", None) in ("w_in", "w_out") for k in path
-    )
+    return any(getattr(k, "key", None) in EXPERT_LEAVES for k in path)
 
 
 def param_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
@@ -103,7 +109,7 @@ def param_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
     config (materializing a throwaway parameter set just for its tree
     shape would cost RNG time and device memory)."""
     layer = {
-        name: P(dp) if name in ("w_in", "w_out") else P()
+        name: P(dp) if name in EXPERT_LEAVES else P()
         for name in ("wq", "wk", "wv", "wo", "ln1", "ln2",
                      "gate", "w_in", "w_out")
     }
@@ -125,11 +131,17 @@ def _block(p, x, cfg: TransformerConfig, sp: str, dp: str):
     q = (h @ p["wq"]).reshape(B, S, H, Dh)
     k = (h @ p["wk"]).reshape(B, S, H, Dh)
     v = (h @ p["wv"]).reshape(B, S, H, Dh)
-    attn = jax.vmap(
-        lambda qb, kb, vb: ring_attention(
+    if cfg.attn_impl == "ulysses-pallas":
+        from tpuscratch.parallel.ulysses import ulysses_attention
+
+        seq_attn = lambda qb, kb, vb: ulysses_attention(  # noqa: E731
+            qb, kb, vb, sp, causal=cfg.causal, impl="pallas"
+        )
+    else:
+        seq_attn = lambda qb, kb, vb: ring_attention(  # noqa: E731
             qb, kb, vb, sp, causal=cfg.causal, impl=cfg.attn_impl
         )
-    )(q, k, v)
+    attn = jax.vmap(seq_attn)(q, k, v)
     x = x + attn.reshape(B, S, d) @ p["wo"]
 
     h = _rms_norm(x, p["ln2"])
@@ -216,9 +228,20 @@ def train_step(
         )
     if cfg.attn_impl == "pallas":
         raise NotImplementedError(
-            "the flash kernel has no backward pass yet — train with "
-            "attn_impl='xla' (forward/inference composes with 'pallas' "
-            "via model_apply)"
+            "ring flash hops have no backward (the state-mode kernel is "
+            "forward-only) — train with attn_impl='xla' (dense ring "
+            "hops) or 'ulysses-pallas' (all_to_all + differentiable "
+            "flash kernel); 'pallas' composes forward via model_apply"
+        )
+    if cfg.attn_impl not in ("xla", "ulysses-pallas"):
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r}: "
+            "'xla' | 'pallas' | 'ulysses-pallas'"
+        )
+    if cfg.attn_impl == "ulysses-pallas" and cfg.n_heads % mesh.shape[sp]:
+        raise ValueError(
+            f"ulysses-pallas needs n_heads {cfg.n_heads} divisible by "
+            f"sp size {mesh.shape[sp]}"
         )
     pspec = param_spec(cfg, dp)
     return run_spmd(
