@@ -26,8 +26,6 @@ idiomatic JAX shape, not a port of any framework's Module system.
 from __future__ import annotations
 
 import dataclasses
-import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

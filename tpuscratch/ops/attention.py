@@ -40,7 +40,9 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpuscratch.ops.common import use_interpret
+import numpy as np
+
+from tpuscratch.ops.common import mosaic_params, use_interpret
 from tpuscratch.parallel.scores import NEG_INF
 
 _LANE = 128
@@ -74,6 +76,18 @@ def _score_block(
     return s, s > NEG_INF * 0.5
 
 
+def _block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k):
+    """Block-level causal skip predicate (shared by all three kernels):
+    a KV block strictly above the Q block's last row contributes
+    nothing — its MXU/VPU work is skipped (~2x on long causal
+    sequences; the DMA still happens, which is what keeps the skip
+    correct under Mosaic's static pipeline)."""
+    if not causal:
+        return True
+    first_masked_col = qoff_ref[0] + (i + 1) * block_q
+    return koff_ref[0] + j * block_k < first_masked_col
+
+
 def _flash_kernel(
     qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     *, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
@@ -88,17 +102,7 @@ def _flash_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    if causal:
-        # block-level causal skip: a KV block strictly above this Q
-        # block's last row contributes nothing — skip its MXU/VPU work
-        # entirely (~2x for long sequences; the DMA still happens, which
-        # is what keeps the skip correct under Mosaic's static pipeline)
-        first_masked_col = qoff_ref[0] + (i + 1) * block_q
-        block_needed = koff_ref[0] + j * block_k < first_masked_col
-    else:
-        block_needed = True
-
-    @pl.when(block_needed)
+    @pl.when(_block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k))
     def _compute():
         s, guard = _score_block(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
@@ -184,13 +188,7 @@ def _dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    if causal:
-        first_masked_col = qoff_ref[0] + (i + 1) * block_q
-        block_needed = koff_ref[0] + j * block_k < first_masked_col
-    else:
-        block_needed = True
-
-    @pl.when(block_needed)
+    @pl.when(_block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k))
     def _compute():
         s, guard = _score_block(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
@@ -229,13 +227,7 @@ def _dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    if causal:
-        first_masked_col = qoff_ref[0] + (i + 1) * block_q
-        block_needed = koff_ref[0] + j * block_k < first_masked_col
-    else:
-        block_needed = True
-
-    @pl.when(block_needed)
+    @pl.when(_block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k))
     def _compute():
         s, guard = _score_block(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
@@ -280,11 +272,9 @@ def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk):
     nq, nk = S // bq, T // bk
     scale = 1.0 / float(D) ** 0.5
     interpret = use_interpret()
-    params = {}
-    if not interpret:
-        params["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        )
+    params = mosaic_params(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
     lse_p, delta_p = _plane(lse), _plane(delta)
     qspec = pl.BlockSpec((1, bq, D), lambda h, a, b: (h, a, 0))
     kspec = pl.BlockSpec((1, bk, D), lambda h, a, b: (h, b, 0))
@@ -343,11 +333,9 @@ def _flash_fwd_call(qh, kh, vh, qoff, koff, causal, bq, bk, return_state):
         scale=scale, causal=causal, block_q=bq, block_k=bk, nk=nk,
     )
     interpret = use_interpret()
-    params = {}
-    if not interpret:
-        params["compiler_params"] = pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        )
+    params = mosaic_params(
+        dimension_semantics=("parallel", "parallel", "arbitrary")
+    )
     out_specs = [pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((H, S, D), qh.dtype)]
     if return_state:
@@ -399,8 +387,6 @@ def _flash_diff_fwd(qh, kh, vh, qoff, koff, causal, bq, bk):
 
 
 def _flash_diff_bwd(causal, bq, bk, res, do):
-    import numpy as np
-
     qh, kh, vh, qoff, koff, o, lse = res
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1

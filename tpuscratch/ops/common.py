@@ -30,3 +30,14 @@ def to_lanes(x: jax.Array, sublanes_multiple: int = 8) -> jax.Array:
     if padded != n:
         x = jnp.pad(x, (0, padded - n))
     return x.reshape(-1, LANES)
+
+
+def mosaic_params(**kw) -> dict:
+    """``{"compiler_params": CompilerParams(**kw)}`` on TPU, ``{}`` in
+    interpret mode (where Mosaic compiler knobs don't exist). Spread into
+    ``pl.pallas_call(..., **mosaic_params(...))``."""
+    if use_interpret():
+        return {}
+    from jax.experimental.pallas import tpu as pltpu
+
+    return {"compiler_params": pltpu.CompilerParams(**kw)}

@@ -31,7 +31,7 @@ except ImportError:  # not re-exported in this jax version
 
 from tpuscratch.halo.layout import TileLayout
 from tpuscratch.halo.stencil import rebuild
-from tpuscratch.ops.common import use_interpret
+from tpuscratch.ops.common import mosaic_params, use_interpret
 
 Coeffs = tuple[float, float, float, float, float]
 JACOBI: Coeffs = (0.25, 0.25, 0.25, 0.25, 0.0)
@@ -243,13 +243,7 @@ def resident_periodic_pallas(
             "deep_trapezoid_pallas path for grids that don't fit"
         )
     interpret = use_interpret()
-    params = {}
-    if not interpret:
-        from jax.experimental.pallas import tpu as pltpu
-
-        params["compiler_params"] = pltpu.CompilerParams(
-            vmem_limit_bytes=vmem_limit_bytes
-        )
+    params = mosaic_params(vmem_limit_bytes=vmem_limit_bytes)
     return pl.pallas_call(
         functools.partial(
             _resident_kernel, steps=steps, unroll=unroll, coeffs=coeffs
