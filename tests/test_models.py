@@ -78,9 +78,8 @@ class TestComposedTrainStep:
         assert losses[-1] < losses[0], losses
 
     def test_flash_hops_match_dense_forward(self):
-        # flash hops are a forward/inference path (no backward kernel
-        # yet); the composed FORWARD must agree across impls. sp=2 keeps
-        # the local sequence block >= the kernel's 8-row quantum.
+        # the composed FORWARD must agree across impls. sp=2 keeps the
+        # local sequence block >= the kernel's 8-row quantum.
         from jax.sharding import PartitionSpec as P
 
         from tpuscratch.comm import run_spmd
@@ -103,23 +102,18 @@ class TestComposedTrainStep:
             outs["xla"], outs["pallas"], rtol=1e-4, atol=1e-5
         )
 
-    def test_ring_flash_training_rejected_clearly(self):
-        mesh = make_mesh((2, 4), ("dp", "sp"), jax.devices()[:8])
-        with pytest.raises(NotImplementedError, match="no backward"):
-            train_step(mesh, cfg_for(attn_impl="pallas"))
-
-    def test_ulysses_flash_training_matches_xla(self):
-        # the differentiable flash kernel behind Ulysses: a full train
-        # step must agree with the dense ring path (same math). sp=2
-        # keeps local seq blocks >= the kernel's 8-row quantum and
-        # n_heads=2 divisible by sp.
+    @pytest.mark.parametrize("impl", ["pallas", "ulysses-pallas"])
+    def test_flash_training_matches_xla(self, impl):
+        # both flash training paths - ring hops with the custom-VJP ring
+        # backward, and Ulysses with the differentiable kernel - must
+        # produce the same train step as the dense ring path. sp=2 keeps
+        # local seq blocks >= the kernel's 8-row quantum and n_heads=2
+        # divisible by sp.
         x, y = data(9)
         params = init_params(8, cfg_for())
         mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
         p_x, l_x = train_step(mesh, cfg_for(attn_impl="xla"))(params, x, y)
-        p_f, l_f = train_step(
-            mesh, cfg_for(attn_impl="ulysses-pallas")
-        )(params, x, y)
+        p_f, l_f = train_step(mesh, cfg_for(attn_impl=impl))(params, x, y)
         assert abs(float(l_x) - float(l_f)) < 1e-4
         for a, b in zip(jax.tree.leaves(p_x), jax.tree.leaves(p_f)):
             np.testing.assert_allclose(

@@ -48,11 +48,12 @@ class TransformerConfig:
     causal: bool = True
     capacity_factor: float = 2.0
     aux_coef: float = 0.01
-    # 'xla': ring attention, dense hop blocks (trainable)
-    # 'pallas': ring attention, flash-kernel hops (forward-only: the
-    #   state-mode kernel the hop merge needs has no backward)
+    # 'xla': ring attention, dense hop blocks
+    # 'pallas': ring attention, flash-kernel hops (custom-VJP ring
+    #   backward: a second KV rotation accumulating dk/dv)
     # 'ulysses-pallas': Ulysses all_to_all + differentiable flash kernel
-    #   (trainable; needs n_heads % sp_size == 0)
+    #   (needs n_heads % sp_size == 0)
+    # all three are trainable
     attn_impl: str = "xla"
 
     @property
@@ -224,14 +225,7 @@ def train_step(
         raise ValueError(
             f"n_experts {cfg.n_experts} not divisible by dp size {n_dp}"
         )
-    if cfg.attn_impl == "pallas":
-        raise NotImplementedError(
-            "ring flash hops have no backward (the state-mode kernel is "
-            "forward-only) — train with attn_impl='xla' (dense ring "
-            "hops) or 'ulysses-pallas' (all_to_all + differentiable "
-            "flash kernel); 'pallas' composes forward via model_apply"
-        )
-    if cfg.attn_impl not in ("xla", "ulysses-pallas"):
+    if cfg.attn_impl not in ("xla", "pallas", "ulysses-pallas"):
         raise ValueError(
             f"unknown attn_impl {cfg.attn_impl!r}: "
             "'xla' | 'pallas' | 'ulysses-pallas'"

@@ -264,9 +264,13 @@ def _plane(x):  # (H, S) -> (H, S, 8) lane-broadcast input plane
     return jnp.broadcast_to(x[:, :, None], (*x.shape, 8))
 
 
-def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk):
+def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk,
+                    out_dtype=None):
     """dq/dk/dv via the two backward kernels. All of q/k/v/do are
-    (H, SorT, D) head-major; lse/delta are (H, S)."""
+    (H, SorT, D) head-major; lse/delta are (H, S). ``out_dtype``
+    overrides the gradient dtype (callers accumulating across several
+    calls — the ring backward — want fp32 partials, casting once at the
+    end instead of quantizing every contribution)."""
     H, S, D = q.shape
     T = k.shape[1]
     nq, nk = S // bq, T // bk
@@ -288,7 +292,7 @@ def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk):
         grid=(H, nq, nk),
         in_specs=[smem, smem, qspec, kspec, kspec, qspec, rowspec, rowspec],
         out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct((H, S, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((H, S, D), out_dtype or q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
         **params,
@@ -308,8 +312,8 @@ def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk):
                   rowspec2, rowspec2],
         out_specs=[kspec2, kspec2],
         out_shape=[
-            jax.ShapeDtypeStruct((H, T, D), k.dtype),
-            jax.ShapeDtypeStruct((H, T, D), v.dtype),
+            jax.ShapeDtypeStruct((H, T, D), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((H, T, D), out_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, D), jnp.float32),

@@ -44,6 +44,11 @@ def ring_scan(
     choice when the caller discards the payload — and None is returned in
     its place.
     """
+    if return_payload:
+        return ring_scan_rw(
+            lambda c, b, i: (combine(c, b, i), b),
+            init_carry, payload, axis, reverse,
+        )
     n = lax.axis_size(axis)
     perm = ring_perm(n, -1 if reverse else 1, periodic=True)
 
@@ -53,14 +58,40 @@ def ring_scan(
         block = jax.tree.map(lambda b: lax.ppermute(b, axis, perm), block)
         return (carry, block), ()
 
-    if return_payload:
-        (carry, payload), _ = lax.scan(
-            hop, (init_carry, payload), jax.numpy.arange(n)
-        )
-        return carry, payload
     if n > 1:
         (init_carry, payload), _ = lax.scan(
             hop, (init_carry, payload), jax.numpy.arange(n - 1)
         )
     carry = combine(init_carry, payload, jax.numpy.asarray(n - 1))
     return carry, None
+
+
+def ring_scan_rw(
+    combine: Callable[[Carry, Any, Any], tuple[Carry, Any]],
+    init_carry: Carry,
+    payload,
+    axis: str,
+    reverse: bool = False,
+):
+    """Rotate-and-combine where the combine also UPDATES the visiting
+    payload: ``combine(carry, block, hop) -> (carry, block)``.
+
+    The shape of ring backward passes: each hop accumulates gradient
+    contributions onto the visiting KV block, and after the full n hops
+    the block arrives back home carrying every rank's contribution —
+    the transpose of the forward rotation, expressed as a second
+    forward rotation. Returns (final_carry, homeward_payload).
+    ``ring_scan(return_payload=True)`` is the read-only special case."""
+    n = lax.axis_size(axis)
+    perm = ring_perm(n, -1 if reverse else 1, periodic=True)
+
+    def hop(state, i):
+        carry, block = state
+        carry, block = combine(carry, block, i)
+        block = jax.tree.map(lambda b: lax.ppermute(b, axis, perm), block)
+        return (carry, block), ()
+
+    (carry, payload), _ = lax.scan(
+        hop, (init_carry, payload), jax.numpy.arange(n)
+    )
+    return carry, payload

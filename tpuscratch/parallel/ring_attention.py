@@ -17,11 +17,13 @@ exp(-inf)=0 via the running max, so no special-casing per hop.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpuscratch.parallel.ring import ring_scan
+from tpuscratch.parallel.ring import ring_scan, ring_scan_rw
 from tpuscratch.parallel.scores import NEG_INF, masked_scores
 
 
@@ -45,11 +47,17 @@ def ring_attention(
     runs the flash-attention kernel (ops.attention) per hop with
     ``return_state=True`` and softmax-merges the per-hop (out, m, l) —
     same math, MXU-scheduled, and the per-hop (H, S, S) score block never
-    materializes (the long-block regime).
+    materializes (the long-block regime). The pallas path is trainable:
+    its custom VJP runs the standard ring backward — a second KV
+    rotation where each hop applies the flash backward kernels against
+    the GLOBAL log-sum-exp and the visiting block accumulates its dk/dv
+    on the way home (ring_scan_rw).
     """
     if q.ndim != 3 or q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"expected equal (S,H,D) blocks, got {q.shape}/{k.shape}/{v.shape}")
-    if impl not in ("xla", "pallas"):
+    if impl == "pallas":
+        return _ring_flash(q, k, v, axis, causal)
+    if impl != "xla":
         raise ValueError(f"unknown ring attention impl {impl!r}")
     S, H, D = q.shape
     n = lax.axis_size(axis)
@@ -87,9 +95,30 @@ def ring_attention(
         o = o * corr.T[:, :, None] + pv
         return (m_new, l, o)
 
-    def combine_pallas(state, kv_block, hop):
-        from tpuscratch.ops.attention import flash_attention
+    # return_payload=False: the KV pair is discarded after the last hop, so
+    # the homeward rotation (one extra 2*S*H*D transfer) is skipped
+    (m, l, o), _ = ring_scan(
+        combine_xla, init, (k, v), axis, return_payload=False
+    )
+    out = o / l.T[:, :, None]
+    return out.astype(q.dtype)
 
+
+def _ring_flash_forward(q, k, v, axis, causal):
+    """Flash-kernel hops + exact softmax-merge. Returns
+    (out (S,H,D), m (H,S), l (H,S))."""
+    from tpuscratch.ops.attention import flash_attention
+
+    S, H, D = q.shape
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    init = (
+        jnp.full((H, S), NEG_INF, dtype=jnp.float32),
+        jnp.zeros((H, S), dtype=jnp.float32),
+        jnp.zeros((S, H, D), dtype=jnp.float32),
+    )
+
+    def combine(state, kv_block, hop):
         m, l, o = state
         kb, vb = kv_block
         src = (me - hop) % n
@@ -107,10 +136,69 @@ def ring_attention(
         o_new = o * c_old.T[:, :, None] + acc_i * c_new.T[:, :, None]
         return (m_new, l_new, o_new)
 
-    combine = combine_pallas if impl == "pallas" else combine_xla
-
-    # return_payload=False: the KV pair is discarded after the last hop, so
-    # the homeward rotation (one extra 2*S*H*D transfer) is skipped
     (m, l, o), _ = ring_scan(combine, init, (k, v), axis, return_payload=False)
-    out = o / l.T[:, :, None]
-    return out.astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe.T[:, :, None]).astype(q.dtype)
+    return out, m, l_safe
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ring_flash(q, k, v, axis, causal):
+    return _ring_flash_forward(q, k, v, axis, causal)[0]
+
+
+def _ring_flash_fwd(q, k, v, axis, causal):
+    out, m, l = _ring_flash_forward(q, k, v, axis, causal)
+    lse = m + jnp.log(l)  # global log-sum-exp rows, (H, S)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis, causal, res, do):
+    """The standard ring-attention backward: rotate (kb, vb, dkb, dvb)
+    the full cycle; every hop runs the flash backward kernels against
+    the saved GLOBAL lse, adds dq locally, and accumulates dk/dv onto
+    the visiting block, which arrives home after n hops carrying every
+    rank's contribution."""
+    from tpuscratch.ops.attention import _flash_bwd_call, _pick_block
+
+    q, k, v, out, lse = res
+    S, H, D = q.shape
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    bq = _pick_block(S, 512, "S")
+    bk = _pick_block(S, 1024, "T")
+    qh = jnp.swapaxes(q, 0, 1)
+    doh = jnp.swapaxes(do.astype(jnp.float32), 0, 1)
+    delta = jnp.sum(
+        doh * jnp.swapaxes(out, 0, 1).astype(jnp.float32), axis=-1
+    )  # (H, S)
+
+    # rotate head-major (ppermute is layout-agnostic): one transpose per
+    # tensor total instead of one per hop, and fp32 gradient partials
+    # throughout — a single cast at the end, not one per contribution
+    def combine(dq_acc, payload, hop):
+        kbh, vbh, dkh, dvh = payload
+        src = (me - hop) % n
+        dq_c, dk_c, dv_c = _flash_bwd_call(
+            qh, kbh, vbh, doh, lse, delta,
+            jnp.asarray(me * S, jnp.int32).reshape(1),
+            jnp.asarray(src * S, jnp.int32).reshape(1),
+            causal, bq, bk, out_dtype=jnp.float32,
+        )
+        return dq_acc + dq_c, (kbh, vbh, dkh + dk_c, dvh + dv_c)
+
+    zero_h = jnp.zeros((H, S, D), jnp.float32)
+    dq, (_, _, dkh, dvh) = ring_scan_rw(
+        combine,
+        zero_h,
+        (jnp.swapaxes(k, 0, 1), jnp.swapaxes(v, 0, 1), zero_h, zero_h),
+        axis,
+    )
+    return (
+        jnp.swapaxes(dq, 0, 1).astype(q.dtype),
+        jnp.swapaxes(dkh, 0, 1).astype(k.dtype),
+        jnp.swapaxes(dvh, 0, 1).astype(v.dtype),
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
