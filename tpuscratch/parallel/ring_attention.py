@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpuscratch.parallel.ring import ring_scan, ring_scan_rw
+from tpuscratch.comm.p2p import ring_perm
+from tpuscratch.parallel.ring import ring_scan
 from tpuscratch.parallel.scores import NEG_INF, masked_scores
 
 
@@ -51,7 +52,7 @@ def ring_attention(
     its custom VJP runs the standard ring backward — a second KV
     rotation where each hop applies the flash backward kernels against
     the GLOBAL log-sum-exp and the visiting block accumulates its dk/dv
-    on the way home (ring_scan_rw).
+    on the way home (the k/v blocks themselves stop one hop early).
     """
     if q.ndim != 3 or q.shape != k.shape or q.shape != v.shape:
         raise ValueError(f"expected equal (S,H,D) blocks, got {q.shape}/{k.shape}/{v.shape}")
@@ -154,11 +155,12 @@ def _ring_flash_fwd(q, k, v, axis, causal):
 
 
 def _ring_flash_bwd(axis, causal, res, do):
-    """The standard ring-attention backward: rotate (kb, vb, dkb, dvb)
-    the full cycle; every hop runs the flash backward kernels against
-    the saved GLOBAL lse, adds dq locally, and accumulates dk/dv onto
-    the visiting block, which arrives home after n hops carrying every
-    rank's contribution."""
+    """The standard ring-attention backward: rotate the KV blocks with
+    their gradient accumulators; every hop runs the flash backward
+    kernels against the saved GLOBAL lse, adds dq locally, and
+    accumulates dk/dv onto the visiting block. dk/dv make the full n
+    hops home; the spent k/v blocks stop one hop early (the same
+    homeward transfer the forward's return_payload=False skips)."""
     from tpuscratch.ops.attention import _flash_bwd_call, _pick_block
 
     q, k, v, out, lse = res
@@ -176,8 +178,9 @@ def _ring_flash_bwd(axis, causal, res, do):
     # rotate head-major (ppermute is layout-agnostic): one transpose per
     # tensor total instead of one per hop, and fp32 gradient partials
     # throughout — a single cast at the end, not one per contribution
-    def combine(dq_acc, payload, hop):
-        kbh, vbh, dkh, dvh = payload
+    perm = ring_perm(n, 1, periodic=True)
+
+    def contrib(dq_acc, kbh, vbh, dkh, dvh, hop):
         src = (me - hop) % n
         dq_c, dk_c, dv_c = _flash_bwd_call(
             qh, kbh, vbh, doh, lse, delta,
@@ -185,14 +188,28 @@ def _ring_flash_bwd(axis, causal, res, do):
             jnp.asarray(src * S, jnp.int32).reshape(1),
             causal, bq, bk, out_dtype=jnp.float32,
         )
-        return dq_acc + dq_c, (kbh, vbh, dkh + dk_c, dvh + dv_c)
+        return dq_acc + dq_c, dkh + dk_c, dvh + dv_c
+
+    def hop(state, i):
+        dq_acc, kbh, vbh, dkh, dvh = state
+        dq_acc, dkh, dvh = contrib(dq_acc, kbh, vbh, dkh, dvh, i)
+        kbh, vbh, dkh, dvh = jax.tree.map(
+            lambda b: lax.ppermute(b, axis, perm), (kbh, vbh, dkh, dvh)
+        )
+        return (dq_acc, kbh, vbh, dkh, dvh), ()
 
     zero_h = jnp.zeros((H, S, D), jnp.float32)
-    dq, (_, _, dkh, dvh) = ring_scan_rw(
-        combine,
-        zero_h,
-        (jnp.swapaxes(k, 0, 1), jnp.swapaxes(v, 0, 1), zero_h, zero_h),
-        axis,
+    state = (zero_h, jnp.swapaxes(k, 0, 1), jnp.swapaxes(v, 0, 1),
+             zero_h, zero_h)
+    if n > 1:
+        state, _ = lax.scan(hop, state, jnp.arange(n - 1))
+    dq, kbh, vbh, dkh, dvh = state
+    # final combine, then send ONLY dk/dv home — the k/v blocks are
+    # spent, so their homeward rotation (the 2*S*H*D transfer the
+    # forward's return_payload=False also skips) is dropped
+    dq, dkh, dvh = contrib(dq, kbh, vbh, dkh, dvh, jnp.asarray(n - 1))
+    dkh, dvh = jax.tree.map(
+        lambda b: lax.ppermute(b, axis, perm), (dkh, dvh)
     )
     return (
         jnp.swapaxes(dq, 0, 1).astype(q.dtype),
