@@ -1,0 +1,29 @@
+"""tpuscratch — a TPU-native distributed-computing framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the CUDA+MPI
+scratchpad ``ugovaretto-accel/cuda-mpi-scratch`` (surveyed in ``SURVEY.md``):
+
+- **runtime**  — mesh/topology bring-up, typed config, error policies,
+  rank-prefixed logging (replaces ``MPI_Init``/``mpierr.h``/cartesian setup).
+- **comm**     — named collectives and point-to-point patterns over mesh axes
+  (replaces the raw ``MPI_*`` call surface: psum/ppermute/all_gather/...).
+- **dtypes**   — structured slice specs, the functional equivalent of MPI
+  derived datatypes (indexed / struct / subarray / hindexed).
+- **halo**     — the flagship: a generic 2D domain-decomposition library with
+  8-neighbor periodic ghost-cell exchange (replaces ``stencil2D.h``).
+- **ops**      — Pallas TPU kernels: reductions, stencil compute, fills
+  (replaces the CUDA ``__global__`` kernels).
+- **bench**    — timing harnesses: pingpong latency/BW, distributed dot,
+  stencil throughput (replaces ``test-benchmark/``).
+
+Everything is runnable on a single host via a CPU device mesh
+(``XLA_FLAGS=--xla_force_host_platform_device_count=N``), mirroring how the
+reference validates multi-node behavior with many ranks on one box.
+"""
+
+__version__ = "0.1.0"
+
+from tpuscratch.runtime.topology import CartTopology, Direction  # noqa: F401
+from tpuscratch.runtime.mesh import make_mesh, make_mesh_1d, make_mesh_2d  # noqa: F401
+from tpuscratch.runtime.config import Config  # noqa: F401
+from tpuscratch.runtime.context import RuntimeContext, initialize  # noqa: F401

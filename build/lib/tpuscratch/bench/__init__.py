@@ -1,0 +1,8 @@
+"""Benchmark harnesses: timing conventions, pingpong, dot, stencil."""
+
+from tpuscratch.bench.timing import (  # noqa: F401
+    BenchResult,
+    percentile,
+    span_max_min,
+    time_device,
+)

@@ -1,0 +1,92 @@
+"""Flash-attention throughput benchmark (beyond-reference config).
+
+The reference has no attention (SURVEY.md §2.7); this measures the
+framework's long-context MXU kernel (ops/attention.py) with the same
+methodology as the stencil/dot benches: many calls folded into one
+compiled scan so the transport's fixed per-invocation cost amortizes
+away, a loop-carried zero-valued offset defeating loop-invariant
+hoisting, and readback fencing.
+
+Reported metric: attention TFLOP/s at (S, H, D), counting the standard
+4*S*T*H*D multiply-accumulate FLOPs (halved for causal via the kernel's
+block skip).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.ops.attention import flash_attention
+
+
+def attention_program(
+    causal: bool, rounds: int, block_q: int = 512, block_k: int = 1024,
+):
+    """jit'd fn(q, k, v) running ``rounds`` flash calls in one scan.
+
+    The loop-carried q_offset is always 0 in value (derived from the
+    previous output times zero) but the compiler cannot prove it, so no
+    round is hoisted."""
+
+    @jax.jit
+    def run(q, k, v):
+        def step(carry, _):
+            off, _prev = carry
+            out = flash_attention(
+                q, k, v, causal=causal, q_offset=off,
+                block_q=block_q, block_k=block_k,
+            )
+            # carry (not stack) the output: stacked scan ys would
+            # materialize rounds * S*H*D*4 bytes of HBM
+            return ((out[0, 0, 0] * 0).astype(jnp.int32), out), None
+
+        init = (jnp.int32(0), jnp.zeros(q.shape, q.dtype))
+        (_, last), _ = lax.scan(step, init, None, length=rounds)
+        return last
+
+    return run
+
+
+def bench_attention(
+    S: int = 4096,
+    H: int = 8,
+    D: int = 128,
+    causal: bool = True,
+    rounds: int = 50,
+    iters: int = 3,
+    fence: str = "readback",
+    dtype=jnp.float32,
+    block_q: int = 512,
+    block_k: int = 1024,
+    max_tflops: float = 250.0,
+) -> BenchResult:
+    """``max_tflops`` is the same implausibility defense as dot_bench's
+    ``max_gbps``: the anti-hoisting chain hangs on XLA never constant-
+    folding the f32 ``out * 0`` into the loop-carried offset — if a
+    future simplifier does, the scanned calls collapse to one and the
+    rate explodes past any physical MXU roofline (~197 bf16 TFLOP/s on
+    v5e). Raise the bound for faster parts rather than deleting it."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((S, H, D)), dtype=dtype)
+    k = jnp.asarray(rng.standard_normal((S, H, D)), dtype=dtype)
+    v = jnp.asarray(rng.standard_normal((S, H, D)), dtype=dtype)
+    f = attention_program(causal, rounds, block_q=block_q, block_k=block_k)
+    flops_per_call = 4 * S * S * H * D * (0.5 if causal else 1.0)
+    res = time_device(
+        f, q, k, v,
+        iters=iters, warmup=2, fence=fence,
+        name=f"flash S={S} H={H} D={D} causal={causal} x{rounds}",
+        items=int(flops_per_call) * rounds,  # items = FLOPs
+    )
+    if rounds > 1 and res.items_per_s / 1e12 > max_tflops:
+        raise AssertionError(
+            f"implausible {res.items_per_s / 1e12:.0f} TFLOP/s "
+            f"(> {max_tflops:.0f}): the scanned attention was likely "
+            "hoisted out of the loop; fix attention_program's "
+            "loop-carried offset before trusting this benchmark"
+        )
+    return res

@@ -1,0 +1,122 @@
+"""Distributed dot-product benchmark (mpicuda3/4 timing parity).
+
+End-to-end: shard two vectors over the mesh, per-shard Pallas reduction,
+one psum, report elements/s. The reference's wall-time convention —
+every rank stamps begin/end, span = max(end)-min(begin) across ranks
+(mpicuda3.cu:315-325) — collapses in a single-process mesh to a
+block_until_ready bracket (all shards complete before the bracket closes);
+on multi-process slices use ``timing.span_max_min`` over per-process
+stamps. The NO_GPU_MALLOC_TIME carve-out is the warmup exclusion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.ops.reduction import local_dot_psum
+
+
+def dot_program(
+    mesh: Mesh,
+    axis: str = "x",
+    method: str = "full",
+    block_rows: int = 512,
+    rounds: int = 1,
+):
+    """Compiled distributed dot; ``rounds`` > 1 folds that many dots into
+    one ``lax.scan`` so a fenced invocation amortizes fixed dispatch/
+    transport cost (the same treatment the stencil bench applies).
+
+    Each round perturbs the input by ``1e-30 * acc`` (loop-carried, so
+    XLA cannot hoist the otherwise loop-invariant dot out of the scan)
+    — far below f32 resolution for O(1) data, so the result is
+    unchanged while every round honestly re-reads both vectors from HBM.
+    The perturbation rides the kernels' in-kernel ``offset`` scalar
+    (ops.reduction._offset_arg): adding it to a materialized ``a + eps``
+    instead would cost every round an extra read+write of the whole
+    vector outside the opaque pallas_call (~3x measured slowdown).
+    """
+
+    from tpuscratch.ops import reduction
+
+    def one(a, b, offset=None):
+        return local_dot_psum(
+            a, b, axis, method=method, block_rows=block_rows, offset=offset
+        )
+
+    if rounds == 1:
+        return run_spmd(mesh, one, (P(axis), P(axis)), P())
+
+    def repeated(a, b):
+        # Prep (pad/reshape to lane blocks) ONCE outside the scan for the
+        # Pallas methods: XLA does not hoist it out of the loop body, and
+        # paying it per round triples the measured traffic.
+        if method == "xla":
+            def step(acc, _):
+                return one(a, b, offset=acc * jnp.float32(1e-30)), None
+        else:
+            x2, y2, _, block = reduction.prep(a, b, block_rows)
+
+            def step(acc, _):
+                s = reduction.dot_prepped(
+                    x2, y2, block, method, offset=acc * jnp.float32(1e-30)
+                )
+                return lax.psum(s, axis), None
+
+        acc, _ = lax.scan(step, jnp.float32(0.0), None, length=rounds)
+        return acc
+
+    return run_spmd(mesh, repeated, (P(axis), P(axis)), P())
+
+
+def bench_dot(
+    mesh: Mesh,
+    n_elems: int = 100_000_000,
+    axis: str = "x",
+    method: str = "full",
+    iters: int = 5,
+    check: bool = True,
+    fence: str = "block",
+    rounds: int = 1,
+    max_gbps: float = 1000.0,
+) -> BenchResult:
+    """Time ``rounds`` distributed dots of ``n_elems`` f32 (BASELINE
+    config 2). ``rounds=1`` measures single-invocation latency; large
+    ``rounds`` measures HBM-roofline throughput.
+
+    ``max_gbps`` is a physical-plausibility bound: if a multi-round
+    measurement beats it, the anti-hoisting perturbation has stopped
+    working (e.g. a compiler rewrite distributed ``dot(x+o, y)`` into
+    ``dot(x,y) + o*sum(y)`` and hoisted the invariant parts) and the
+    number is rejected rather than recorded. The default is tuned just
+    above v5e-class HBM (~820 GB/s) so even PARTIAL hoisting (one of the
+    two operand streams skipped → apparent 2x) trips it; on parts with
+    faster HBM per core (e.g. v5p ~2.7 TB/s) callers must raise it to
+    ~1.3x that part's roofline to keep the same sensitivity."""
+    n_dev = mesh.devices.size
+    n_elems = (n_elems // n_dev) * n_dev  # even shards
+    x = jnp.ones(n_elems, dtype=jnp.float32)
+    f = dot_program(mesh, axis, method, rounds=rounds)
+    if check:
+        got = float(f(x, x))
+        if abs(got - n_elems) > 1e-3 * n_elems:
+            raise AssertionError(f"dot self-check FAILED: {got} != {n_elems}")
+    res = time_device(
+        f, x, x,
+        iters=iters, warmup=2, fence=fence,
+        name=f"dot {n_elems:.0e} f32 ({method}) x{rounds}",
+        items=n_elems * rounds,
+        bytes_moved=2 * 4 * n_elems * rounds,
+    )
+    if rounds > 1 and res.gbps > max_gbps:
+        raise AssertionError(
+            f"implausible {res.gbps:.0f} GB/s (> {max_gbps:.0f}): the scanned "
+            "dot was likely hoisted out of the loop; fix dot_program's "
+            "perturbation before trusting this benchmark"
+        )
+    return res

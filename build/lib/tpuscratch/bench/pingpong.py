@@ -1,0 +1,163 @@
+"""Pingpong latency/bandwidth probe over mesh links.
+
+The reference's probe sends one round trip of N doubles GPU-to-GPU and
+times it with MPI_Wtime, separately timing the D2H copy, verifying the
+echo, and printing PASSED/FAILED with sizes and times
+(/root/reference/test-benchmark/mpi-pingpong-gpu.cpp:24-87; async variant
+with host-staging ablations at mpi-pingpong-gpu-async.cpp:43-106). Here the
+round trip is a pair of ppermutes between two mesh ranks (ICI on TPU); the
+device-direct property is free (jax.Arrays live on device), and the
+HOST_COPY ablation becomes an explicit device->host->device staging timing
+so the comparison the reference makes is still measurable.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.comm.p2p import pingpong
+
+DEFAULT_SIZES = tuple(8 * 4**i for i in range(13))  # 8 B ... 128 MiB (f32)
+
+
+def pingpong_program(mesh: Mesh, axis: str, n_elems: int, a: int = 0, b: int = 1, rounds: int = 1):
+    """Compiled SPMD pingpong: rank a's shard bounces to b and back."""
+    return run_spmd(
+        mesh,
+        lambda x: pingpong(x, axis, a=a, b=b, rounds=rounds),
+        P(axis),
+        P(axis),
+    )
+
+
+def verify_echo(mesh: Mesh, axis: str, n_elems: int) -> bool:
+    """PASSED/FAILED self-check: the echoed payload equals the original
+    (mpi-pingpong-gpu.cpp:58-61)."""
+    n = mesh.devices.size
+    payload = np.zeros((n, n_elems), dtype=np.float32)
+    payload[0] = np.random.default_rng(0).standard_normal(n_elems)
+    f = pingpong_program(mesh, axis, n_elems)
+    out = np.asarray(f(jnp.asarray(payload.reshape(-1)))).reshape(n, n_elems)
+    return bool((out[0] == payload[0]).all())
+
+
+def sweep(
+    mesh: Mesh,
+    axis: str = "x",
+    sizes_bytes: Sequence[int] = DEFAULT_SIZES,
+    rounds: int = 1,
+    iters: int = 10,
+    fence: str = "block",
+) -> list[BenchResult]:
+    """Latency/BW sweep over message sizes (8 B - 128 MB by default).
+
+    One round trip moves the payload twice, so bytes_moved = 2 * size *
+    rounds. p50 over ``iters`` timed repetitions after warmup.
+    """
+    n = mesh.devices.size
+    results = []
+    for size in sizes_bytes:
+        n_elems = max(1, size // 4)  # f32 payload
+        f = pingpong_program(mesh, axis, n_elems, rounds=rounds)
+        x = jnp.zeros(n * n_elems, dtype=jnp.float32)
+        results.append(
+            time_device(
+                f,
+                x,
+                iters=iters,
+                warmup=2,
+                fence=fence,
+                name=f"pingpong {size}B",
+                bytes_moved=2 * n_elems * 4 * rounds,
+            )
+        )
+    return results
+
+
+def host_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
+    """The HOST_COPY ablation: device -> host -> device staging, timed —
+    what GPU-direct (device-resident arrays) saves
+    (mpi-pingpong-gpu-async.cpp:59-70)."""
+    x = jnp.zeros(n_elems, dtype=jnp.float32)
+    jax.block_until_ready(x)
+
+    def stage(v):
+        host = np.asarray(v)          # D2H
+        return jax.device_put(host)   # H2D
+
+    return time_device(
+        stage, x, iters=iters, warmup=1,
+        name=f"host staging {n_elems * 4}B", bytes_moved=2 * n_elems * 4,
+    )
+
+
+def _buffer_staging(view: np.ndarray, n_elems: int, iters: int, label: str) -> BenchResult:
+    """device -> host -> persistent staging buffer -> device, with the
+    buffer's allocator as the only variable."""
+    x = jnp.zeros(n_elems, dtype=jnp.float32)
+    jax.block_until_ready(x)
+
+    def stage(v):
+        np.copyto(view, np.asarray(v))   # D2H then memcpy into the buffer
+        return jax.device_put(view)      # H2D out of it
+
+    return time_device(
+        stage, x, iters=iters, warmup=1,
+        name=f"{label} staging {n_elems * 4}B",
+        bytes_moved=2 * n_elems * 4,
+    )
+
+
+def native_pool_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
+    """The reference's ``host_allocator`` ablation: stage through the
+    native pooled page-aligned (mlocked where permitted) buffer
+    (native/src/host_pool.cpp; host_allocator.h:58-93 is the CUDA
+    counterpart, exercised the same way by
+    mpi-pingpong-gpu-async.cpp:43-49).
+
+    Compare against ``pageable_buffer_staging_roundtrip`` — identical
+    copy structure, only the buffer's allocator differs. (jax offers no
+    D2H-into-caller-buffer API, so unlike the reference's
+    cudaMemcpy-into-pinned path both variants pay an extra host memcpy;
+    the A/B isolates the allocator, which is what the PAGE_LOCKED switch
+    ablates in the reference.)"""
+    from tpuscratch.native import hostpool
+
+    buf = hostpool.default_pool().alloc(n_elems * 4)
+    try:
+        view = buf.view(np.float32, (n_elems,))
+        return _buffer_staging(view, n_elems, iters, "native-pool")
+    finally:
+        buf.free()
+
+
+def pageable_buffer_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
+    """Control for the native-pool ablation: same persistent-staging-buffer
+    copy structure through a plain pageable numpy allocation."""
+    view = np.empty(n_elems, dtype=np.float32)
+    return _buffer_staging(view, n_elems, iters, "pageable-buffer")
+
+
+def pinned_staging_roundtrip(
+    n_elems: int, pinned: bool = True, iters: int = 10
+) -> BenchResult:
+    """The PAGE_LOCKED ablation: stage through page-locked vs pageable
+    host memory spaces (mpi-pingpong-gpu-async.cpp:43-49) — here XLA
+    memory kinds ``pinned_host`` vs ``unpinned_host``."""
+    from tpuscratch.runtime import memory
+
+    x = jnp.zeros(n_elems, dtype=jnp.float32)
+    jax.block_until_ready(x)
+    label = "pinned" if pinned else "pageable"
+    return time_device(
+        lambda v: memory.host_roundtrip(v, pinned=pinned),
+        x, iters=iters, warmup=1,
+        name=f"{label} staging {n_elems * 4}B", bytes_moved=2 * n_elems * 4,
+    )

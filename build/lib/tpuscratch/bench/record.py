@@ -1,0 +1,329 @@
+"""Record the BASELINE.md measurement configs on whatever is available.
+
+``python -m tpuscratch.bench.record [--configs 1,2] [--json PATH]``
+
+The reference publishes no numbers (SURVEY.md §6) — this harness produces
+the ones this repo establishes. Configs follow BASELINE.md:
+
+1. 2D 5-point stencil, 1024^2, single device     (real chip when present)
+2. distributed dot-product psum, 1e8 f32         (real chip when present)
+3. pingpong sweep 8 B - 128 MB                   (needs >= 2 devices; on a
+   single-chip session this runs on a virtual CPU mesh — a methodology
+   proxy, NOT an ICI number, and is labeled as such)
+4. 8192^2 stencil on a 4x4 mesh                  (16 devices; CPU proxy
+   on single-chip sessions)
+5. weak-scaling stencil, fixed per-chip tile     (ditto)
+
+Each config prints one JSON line with the platform recorded, so CPU-proxy
+numbers can never masquerade as chip numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+class Needs(RuntimeError):
+    """A config's hardware prerequisite is absent — an expected skip, not
+    a failure (exit code stays 0)."""
+
+
+def _platform():
+    import jax
+
+    return jax.default_backend()
+
+
+def _emit(out: list, **kv) -> None:
+    kv.setdefault("platform", _platform())
+    out.append(kv)
+    print(json.dumps(kv), flush=True)
+
+
+def _best_stencil(impls, config_no, grid, steps, mesh, iters):
+    """(best result, winning impl) over impls; a failing impl is reported
+    and skipped."""
+    from tpuscratch.bench.stencil_bench import bench_stencil
+
+    best, best_impl = None, None
+    for impl in impls:
+        try:
+            r = bench_stencil(grid, steps, mesh=mesh, impl=impl,
+                              iters=iters, fence="readback")
+        except Exception as e:  # one impl failing shouldn't kill the config
+            print(f"# config {config_no} impl {impl} failed: {e}",
+                  file=sys.stderr)
+            continue
+        print(f"# {r.summary()}", file=sys.stderr)
+        if best is None or r.items_per_s > best.items_per_s:
+            best, best_impl = r, impl
+    if best is None:
+        raise RuntimeError(f"all config-{config_no} impls failed")
+    return best, best_impl
+
+
+def two_phase_stencil(impls, config_no, grid, mesh, iters,
+                      screen_steps, final_steps):
+    """Screen ``impls`` at ``screen_steps``, then re-measure the winner at
+    ``final_steps`` so the transport's fixed per-invocation cost (~150-200
+    ms on the axon tunnel) amortizes to noise. Returns (best, impl,
+    final_ok): ``final_ok`` False means every re-measure failed and
+    ``best`` is the screen-phase number, whose fixed-cost share
+    understates the chip rate."""
+    from tpuscratch.bench.stencil_bench import bench_stencil
+
+    best, best_impl = _best_stencil(impls, config_no, grid, screen_steps,
+                                    mesh, iters)
+    if not isinstance(final_steps, tuple):
+        final_steps = (final_steps,)
+    attempts = [s for s in final_steps if s > screen_steps]
+    for steps in attempts:
+        try:
+            r = bench_stencil(grid, steps, mesh=mesh, impl=best_impl,
+                              iters=iters, fence="readback")
+            print(f"# final: {r.summary()}", file=sys.stderr)
+            return r, best_impl, True
+        except Exception as e:
+            print(f"# re-measure at {steps} steps failed: {e}",
+                  file=sys.stderr)
+    # no re-measure needed (screen already at/above target) => ok; every
+    # attempt failed => screen number stands but is flagged not-ok
+    return best, best_impl, not attempts
+
+
+def config1_stencil_single(out: list, iters: int = 3) -> None:
+    import jax
+
+    from tpuscratch.runtime.mesh import make_mesh_2d
+
+    on_tpu = jax.default_backend() == "tpu"
+    best, _, _ = two_phase_stencil(
+        ("xla", "deep:16", "deep-pallas:16", "resident:8"), 1,
+        (1024, 1024), make_mesh_2d((1, 1)), iters,
+        screen_steps=20000 if on_tpu else 50,
+        final_steps=2000000 if on_tpu else 50)
+    _emit(
+        out,
+        config=1,
+        metric="stencil2d_1024x1024_cell_updates_per_s",
+        value=best.items_per_s,
+        p50_s=best.p50,
+        detail=best.name,
+    )
+
+
+def config2_dot(out: list, iters: int = 10) -> None:
+    import jax
+
+    from tpuscratch.bench.dot_bench import bench_dot
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    mesh = make_mesh_1d("x", devices=jax.devices())
+    on_tpu = jax.default_backend() == "tpu"
+    # latency: one fenced invocation (the reference's per-call number);
+    # throughput: enough scanned rounds to amortize the fixed transport
+    # cost down to the HBM roofline
+    lat = bench_dot(mesh, n_elems=100_000_000, iters=iters, check=True,
+                    fence="readback")
+    _emit(
+        out,
+        config=2,
+        metric="dot_1e8_f32_call_latency_s",
+        value=lat.p50,
+        detail=lat.name,
+        n_devices=mesh.devices.size,
+    )
+    # throughput: screen the three reduction strategies (Pallas full /
+    # Pallas partials / fused XLA — all within ~5% of the HBM roofline
+    # once the benchmark preps lane blocks outside the scan), then
+    # re-measure the winner with enough rounds to amortize the fixed
+    # transport cost
+    screen_rounds, final_rounds = (200, 2000) if on_tpu else (2, 2)
+    it = max(2, iters // 3)
+    # plausibility bound: default is tuned to v5e-class HBM (dot_bench
+    # docstring); on faster-HBM parts set TPUSCRATCH_DOT_MAX_GBPS to
+    # ~1.3x that part's per-core roofline
+    import os
+
+    max_gbps = float(os.environ.get("TPUSCRATCH_DOT_MAX_GBPS", "1000"))
+    best = None
+    for m in ("full", "partials", "xla"):
+        try:
+            r = bench_dot(mesh, n_elems=100_000_000, iters=it, check=True,
+                          fence="readback", method=m, rounds=screen_rounds,
+                          max_gbps=max_gbps)
+        except Exception as e:
+            print(f"# config 2 method {m} failed: {e}", file=sys.stderr)
+            continue
+        print(f"# {r.summary()}", file=sys.stderr)
+        if best is None or r.items_per_s > best[0].items_per_s:
+            best = (r, m)
+    if best is None:
+        raise RuntimeError("all config-2 methods failed")
+    thr = best[0]
+    if final_rounds > screen_rounds:
+        try:
+            thr = bench_dot(mesh, n_elems=100_000_000, iters=it, check=True,
+                            fence="readback", method=best[1],
+                            rounds=final_rounds, max_gbps=max_gbps)
+            print(f"# final: {thr.summary()}", file=sys.stderr)
+        except Exception as e:  # keep the valid screen number
+            print(f"# config 2 final re-measure failed, using screen: {e}",
+                  file=sys.stderr)
+    _emit(
+        out,
+        config=2,
+        metric="dot_1e8_f32_elements_per_s",
+        value=thr.items_per_s,
+        p50_s=thr.p50,
+        detail=thr.name,
+        n_devices=mesh.devices.size,
+    )
+
+
+def config3_pingpong(out: list, iters: int = 10) -> None:
+    import jax
+
+    from tpuscratch.bench.pingpong import DEFAULT_SIZES, sweep, verify_echo
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    if len(jax.devices()) < 2:
+        raise Needs("pingpong needs >= 2 devices")
+    mesh = make_mesh_1d("x", devices=jax.devices()[:2])
+    if not verify_echo(mesh, "x", 1024):
+        raise AssertionError("pingpong echo self-check FAILED")
+    results = sweep(mesh, sizes_bytes=DEFAULT_SIZES, iters=iters,
+                    fence="readback")
+    peak = max(results, key=lambda r: r.gbps)
+    small = results[0]
+    _emit(
+        out,
+        config=3,
+        metric="pingpong_peak_GBps",
+        value=peak.gbps,
+        p50_latency_s_smallest=small.p50,
+        detail=f"peak at {peak.name}; echo PASSED",
+        sweep=[
+            {"bytes": r.bytes_moved // 2, "p50_s": r.p50, "gbps": r.gbps}
+            for r in results
+        ],
+    )
+
+
+def config4_stencil_mesh(out: list, iters: int = 5) -> None:
+    import jax
+
+    from tpuscratch.runtime.mesh import make_mesh_2d
+
+    if len(jax.devices()) < 16:
+        raise Needs("config 4 needs a 4x4 mesh (16 devices)")
+    mesh = make_mesh_2d((4, 4), devices=jax.devices()[:16])
+    best, _ = _best_stencil(("xla", "overlap", "deep:4"), 4,
+                         (8192, 8192), 10, mesh, iters)
+    _emit(
+        out,
+        config=4,
+        metric="stencil2d_8192x8192_4x4_cell_updates_per_s_per_chip",
+        value=best.items_per_s / 16,
+        p50_s=best.p50,
+        detail=best.name,
+    )
+
+
+def config5_weak_scaling(out: list, per_chip: int = 1024, iters: int = 3) -> None:
+    import jax
+
+    from tpuscratch.bench.weak_scaling import bench_weak_scaling, efficiency
+
+    counts = [n for n in (1, 2, 4, 8, 16) if n <= len(jax.devices())]
+    if len(counts) < 2:
+        raise Needs("weak scaling needs >= 2 devices")
+    pts = bench_weak_scaling(
+        per_chip=(per_chip, per_chip), steps=10, device_counts=counts,
+        iters=iters, fence="readback"
+    )
+    eff = efficiency(pts)
+    _emit(
+        out,
+        config=5,
+        metric="weak_scaling_efficiency",
+        value=eff[counts[-1]],
+        per_chip_tile=per_chip,
+        points={str(n): e for n, e in eff.items()},
+        detail=f"per-chip rate at N vs N=1, tile {per_chip}^2 x10 steps",
+    )
+
+
+def config6_flash_attention(out: list, iters: int = 3) -> None:
+    """Beyond-reference: flash-attention TFLOP/s (ops/attention.py).
+
+    The reference has no attention; this records the framework's
+    long-context MXU kernel so the number is reproducible rather than a
+    one-off probe."""
+    import jax
+
+    from tpuscratch.bench.attention_bench import bench_attention
+
+    on_tpu = jax.default_backend() == "tpu"
+    for causal in (True, False):
+        r = bench_attention(
+            S=4096 if on_tpu else 64,
+            H=8 if on_tpu else 2,
+            D=128 if on_tpu else 16,
+            causal=causal,
+            rounds=2000 if on_tpu else 2,
+            iters=iters,
+        )
+        print(f"# {r.summary()}", file=sys.stderr)
+        _emit(
+            out,
+            config=6,
+            metric=f"flash_attention_{'causal' if causal else 'full'}_tflops",
+            value=r.items_per_s / 1e12,  # items = FLOPs
+            p50_s=r.p50,
+            detail=r.name,
+        )
+
+
+CONFIGS = {
+    1: config1_stencil_single,
+    2: config2_dot,
+    3: config3_pingpong,
+    4: config4_stencil_mesh,
+    5: config5_weak_scaling,
+    6: config6_flash_attention,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--configs", default="1,2,3,4,5,6")
+    ap.add_argument("--json", default=None, help="append results to this file")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force an N-device virtual CPU mesh first (dev path)")
+    args = ap.parse_args(argv)
+
+    if args.cpu_devices:
+        from tpuscratch.runtime.hostenv import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+
+    out: list = []
+    rc = 0
+    for c in (int(x) for x in args.configs.split(",")):
+        try:
+            CONFIGS[c](out)
+        except Exception as e:  # keep going; report what failed
+            print(f"# config {c} skipped: {e}", file=sys.stderr)
+            rc = rc or (0 if isinstance(e, Needs) else 1)
+    if args.json:
+        with open(args.json, "a") as f:
+            for row in out:
+                f.write(json.dumps(row) + "\n")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Stencil throughput benchmark: cell-updates/sec (the headline metric).
+
+BASELINE configs 1 (1024^2 single device) and 4/5 (multi-chip meshes,
+weak scaling). A measured iteration = one halo exchange + one 5-point
+update of every core cell; steps are folded into one compiled scan so
+per-step dispatch cost doesn't pollute the number.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.halo.driver import decompose, make_stencil_program
+from tpuscratch.halo.exchange import HaloSpec
+from tpuscratch.halo.layout import TileLayout
+from tpuscratch.runtime.mesh import make_mesh_2d, topology_of
+
+
+def bench_stencil(
+    grid: tuple[int, int] = (1024, 1024),
+    steps: int = 10,
+    mesh: Optional[Mesh] = None,
+    impl: str = "xla",
+    iters: int = 5,
+    dtype=jnp.float32,
+    fence: str = "block",
+) -> BenchResult:
+    """cell-updates/s for ``steps`` iterations of the full pipeline on a
+    ``grid`` world decomposed over ``mesh`` (default: all devices)."""
+    mesh = mesh if mesh is not None else make_mesh_2d()
+    topo = topology_of(mesh, periodic=True)
+    rows, cols = topo.dims
+    if grid[0] % rows or grid[1] % cols:
+        raise ValueError(f"grid {grid} not divisible by mesh {topo.dims}")
+    halo, unroll, label = 1, None, impl
+    if impl.startswith("deep"):
+        # "deep:K" / "deep-pallas:K" = trapezoid scheme, K-deep halo
+        # (K steps per exchange)
+        impl, _, depth = impl.partition(":")
+        halo = int(depth) if depth else min(steps, 8)
+    elif impl.startswith("resident"):
+        # "resident[:U]" = whole grid VMEM-resident, U-way inner unroll
+        impl, _, u = impl.partition(":")
+        unroll = int(u) if u else 8
+    elif impl.endswith("+unroll"):
+        impl, unroll = impl.removesuffix("+unroll"), steps
+    layout = TileLayout(grid[0] // rows, grid[1] // cols, halo, halo)
+    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    program = make_stencil_program(mesh, spec, steps, impl=impl, unroll=unroll)
+
+    rng = np.random.default_rng(0)
+    world = rng.standard_normal(grid).astype(np.dtype(dtype) if dtype != jnp.bfloat16 else np.float32)
+    tiles = jnp.asarray(decompose(world, topo, layout), dtype=dtype)
+
+    return time_device(
+        program, tiles,
+        iters=iters, warmup=2, fence=fence,
+        name=f"stencil {grid[0]}x{grid[1]} x{steps} on {rows}x{cols} ({label})",
+        items=grid[0] * grid[1] * steps,
+    )

@@ -1,0 +1,122 @@
+"""Timing conventions for device benchmarks.
+
+Keeps the reference's two instrumentation idioms (SURVEY.md §5):
+- **max-min span**: every rank stamps begin/end; the reported wall time is
+  ``max(ends) - min(begins)`` across ranks (mpicuda3.cu:315-325). Kept as a
+  pure function over per-process timestamp lists.
+- **segmented timing**: bracket exactly the phase being measured —
+  MPI_Wtime around the transfer, separate from the D2H copy
+  (mpi-pingpong-gpu.cpp:51-57); the NO_GPU_MALLOC_TIME carve-out excluding
+  allocation (mpicuda3.cu:221-240). Under jax the equivalent discipline is
+  ``block_until_ready`` brackets with compile (warmup) excluded — dispatch
+  is async exactly like CUDA launches, so un-bracketed timers measure
+  nothing, the same pitfall the reference's clock() placement dodges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Sequence
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class BenchResult:
+    name: str
+    times_s: tuple[float, ...]
+    bytes_moved: int = 0
+    items: int = 0
+
+    @property
+    def p50(self) -> float:
+        return percentile(self.times_s, 50)
+
+    @property
+    def best(self) -> float:
+        return min(self.times_s)
+
+    @property
+    def gbps(self) -> float:
+        """GB/s at the median."""
+        return self.bytes_moved / self.p50 / 1e9 if self.bytes_moved else 0.0
+
+    @property
+    def items_per_s(self) -> float:
+        return self.items / self.p50 if self.items else 0.0
+
+    def summary(self) -> str:
+        parts = [f"{self.name}: p50 {self.p50 * 1e3:.3f} ms"]
+        if self.bytes_moved:
+            parts.append(f"{self.gbps:.2f} GB/s")
+        if self.items:
+            parts.append(f"{self.items_per_s:.3e} items/s")
+        return ", ".join(parts)
+
+
+def percentile(xs: Sequence[float], q: float) -> float:
+    ys = sorted(xs)
+    if not ys:
+        raise ValueError("empty sample")
+    idx = min(len(ys) - 1, max(0, round(q / 100 * (len(ys) - 1))))
+    return ys[idx]
+
+
+def span_max_min(begins: Sequence[float], ends: Sequence[float]) -> float:
+    """Cross-rank wall time: max(end) - min(begin) (mpicuda3 convention)."""
+    if not begins or not ends:
+        raise ValueError("empty timestamp lists")
+    return max(ends) - min(begins)
+
+
+def _fence(out, mode: str):
+    """Wait until ``out`` is actually computed.
+
+    ``"block"`` trusts jax.block_until_ready. ``"readback"`` additionally
+    copies one element of the first output leaf to the host — the only
+    fence some remote-tunnel PJRT transports honor reliably (observed:
+    block_until_ready returning in ~20us for programs whose device time
+    is provably milliseconds). The 4-byte D2H costs one transport round
+    trip, so readback-fenced runs must amortize it with enough work per
+    iteration.
+    """
+    jax.block_until_ready(out)
+    if mode == "readback":
+        import numpy as np
+
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        # one-element slice, NOT ravel(): a reshape of a sharded array
+        # would dispatch a cross-device gather inside the timed region
+        np.asarray(leaf[(0,) * leaf.ndim])
+    elif mode != "block":
+        raise ValueError(f"unknown fence mode {mode!r}")
+    return out
+
+
+def time_device(
+    fn: Callable,
+    *args,
+    iters: int = 10,
+    warmup: int = 2,
+    name: str = "bench",
+    bytes_moved: int = 0,
+    items: int = 0,
+    fence: str = "block",
+) -> BenchResult:
+    """Fence-bracketed per-iteration timings.
+
+    ``warmup`` runs (compile + cache effects) are excluded, the analogue of
+    NO_GPU_MALLOC_TIME excluding one-time setup from the window. ``fence``
+    picks the completion barrier — see ``_fence``.
+    """
+    for _ in range(warmup):
+        _fence(fn(*args), fence)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        _fence(fn(*args), fence)
+        times.append(time.perf_counter() - t0)
+    return BenchResult(
+        name=name, times_s=tuple(times), bytes_moved=bytes_moved, items=items
+    )

@@ -1,0 +1,88 @@
+"""Weak-scaling stencil benchmark (BASELINE config 5).
+
+Fixed per-chip tile, growing device mesh: ideal scaling keeps per-chip
+cell-updates/s constant, so efficiency(N) = rate_per_chip(N) /
+rate_per_chip(1). The reference has no weak-scaling harness — its scaling
+story is the qualitative capacity note at
+/root/reference/mpicuda2.cu:44-47 — so this establishes the methodology
+the reference lacks: same program, same per-rank work, mesh as the only
+variable. On one host the mesh is virtual CPU devices (the reference's
+N-ranks-on-one-box trick, mpicuda2.cu:31-32); on a slice it is the real
+chip grid.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+
+from tpuscratch.bench.stencil_bench import bench_stencil
+from tpuscratch.bench.timing import BenchResult
+from tpuscratch.runtime.mesh import make_mesh_2d
+from tpuscratch.runtime.topology import factor2d
+
+
+@dataclasses.dataclass(frozen=True)
+class WeakScalingPoint:
+    n_devices: int
+    dims: tuple[int, int]
+    grid: tuple[int, int]
+    result: BenchResult
+
+    @property
+    def per_chip_rate(self) -> float:
+        return self.result.items_per_s / self.n_devices
+
+
+def bench_weak_scaling(
+    per_chip: tuple[int, int] = (1024, 1024),
+    steps: int = 10,
+    device_counts: Optional[Sequence[int]] = None,
+    impl: str = "xla",
+    iters: int = 5,
+    fence: str = "block",
+) -> list[WeakScalingPoint]:
+    """One point per device count; global grid grows with the mesh."""
+    avail = len(jax.devices())
+    if device_counts is None:
+        device_counts = [n for n in (1, 2, 4, 8, 16) if n <= avail]
+    points = []
+    for n in sorted(device_counts):
+        if n > avail:
+            raise ValueError(f"{n} devices requested, {avail} visible")
+        rows, cols = factor2d(n)
+        grid = (rows * per_chip[0], cols * per_chip[1])
+        mesh = make_mesh_2d((rows, cols), devices=jax.devices()[:n])
+        points.append(
+            WeakScalingPoint(
+                n_devices=n,
+                dims=(rows, cols),
+                grid=grid,
+                result=bench_stencil(
+                    grid, steps, mesh=mesh, impl=impl, iters=iters, fence=fence
+                ),
+            )
+        )
+    return points
+
+
+def efficiency(points: Sequence[WeakScalingPoint]) -> dict[int, float]:
+    """Per-chip-rate ratio vs the smallest-mesh point."""
+    if not points:
+        raise ValueError("no points")
+    base = min(points, key=lambda p: p.n_devices).per_chip_rate
+    return {p.n_devices: p.per_chip_rate / base for p in points}
+
+
+def report(points: Sequence[WeakScalingPoint]) -> str:
+    eff = efficiency(points)
+    lines = []
+    for p in points:
+        lines.append(
+            f"{p.n_devices:3d} dev {p.dims[0]}x{p.dims[1]}  grid "
+            f"{p.grid[0]}x{p.grid[1]}  {p.per_chip_rate:.3e} cells/s/chip  "
+            f"eff {eff[p.n_devices] * 100:5.1f}%"
+        )
+    return "\n".join(lines)

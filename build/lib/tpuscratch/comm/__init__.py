@@ -1,0 +1,37 @@
+"""Communication layer: named collectives and point-to-point patterns.
+
+The framework's NCCL/MPI-equivalent seam (SURVEY.md §2.8): every ``MPI_*``
+data-plane call the reference exercises maps to an XLA collective over a
+named mesh axis, riding ICI within a slice and DCN across slices.
+
+Mapping table (reference -> here):
+- MPI_Reduce/Allreduce  -> ``allreduce_*`` / ``reduce_to_root``
+- MPI_Gather/Allgather  -> ``gather_to_root`` / ``all_gather``
+- MPI_Bcast             -> ``broadcast``
+- MPI_Scatter           -> ``scatter_from_root``
+- MPI_Isend/Irecv rings -> ``ring_shift`` / ``neighbor_exchange`` (ppermute)
+- MPI_Send/Recv pairs   -> ``send_pairs`` / ``pingpong``
+- sub-communicators     -> collectives over one axis of a multi-axis mesh
+"""
+
+from tpuscratch.comm.collectives import (  # noqa: F401
+    all_gather,
+    all_to_all,
+    allreduce_max,
+    allreduce_min,
+    allreduce_sum,
+    broadcast,
+    gather_to_root,
+    reduce_scatter,
+    reduce_to_root,
+    scatter_from_root,
+)
+from tpuscratch.comm.p2p import (  # noqa: F401
+    neighbor_exchange,
+    pingpong,
+    ring_perm,
+    ring_shift,
+    send_pairs,
+    token_ring,
+)
+from tpuscratch.comm.spmd import run_spmd  # noqa: F401

@@ -1,0 +1,107 @@
+"""Named collectives over mesh axes — the MPI collective surface, XLA-native.
+
+These are SPMD primitives: call them INSIDE a ``shard_map``-traced function
+(see ``tpuscratch.comm.spmd.run_spmd``). Each wraps an XLA collective that
+compiles to ICI/DCN transfers on TPU; none of them allocates communicators,
+datatypes, or requests — the compiled program is the communication plan.
+
+Parity notes (reference -> here):
+- ``MPI_Allreduce`` within halves AND across the world
+  (/root/reference/mpi9.cpp:51-54) -> ``allreduce_sum(x, 'half')`` vs
+  ``allreduce_sum(x, ('half', 'local'))`` on a 2-axis mesh.
+- ``MPI_Reduce`` to rank 0 (/root/reference/mpicuda2.cu:293) ->
+  ``reduce_to_root``; non-roots get zeros, matching the undefined recv
+  buffer non-roots have under MPI (here defined, for determinism).
+- ``MPI_Gather`` root-collects triples (/root/reference/mpi6.cpp:89-100) ->
+  ``gather_to_root``.
+- ``MPI_Bcast`` of a node count (/root/reference/mpicuda2.cu:154) ->
+  ``broadcast``.
+- ``MPI_Scatter`` (sketched at /root/reference/mpicuda2.cu:145-152) ->
+  ``scatter_from_root``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _axis_index(axis: AxisName):
+    """Flat index along one axis or row-major across several axes."""
+    if isinstance(axis, str):
+        return lax.axis_index(axis)
+    idx = lax.axis_index(axis[0])
+    for name in axis[1:]:
+        idx = idx * lax.axis_size(name) + lax.axis_index(name)
+    return idx
+
+
+def allreduce_sum(x, axis: AxisName):
+    return lax.psum(x, axis)
+
+
+def allreduce_max(x, axis: AxisName):
+    return lax.pmax(x, axis)
+
+
+def allreduce_min(x, axis: AxisName):
+    return lax.pmin(x, axis)
+
+
+def reduce_to_root(x, axis: AxisName, root: int = 0):
+    """Sum-reduce; root rank holds the result, others hold zeros."""
+    total = lax.psum(x, axis)
+    return jnp.where(_axis_index(axis) == root, total, jnp.zeros_like(total))
+
+
+def broadcast(x, axis: AxisName, root: int = 0):
+    """Every rank receives root's value."""
+    masked = jnp.where(_axis_index(axis) == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def all_gather(x, axis: AxisName, tiled: bool = False):
+    """Concatenate every rank's shard along a new (or existing) leading dim."""
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def gather_to_root(x, axis: AxisName, root: int = 0, tiled: bool = False):
+    """Root holds the gathered array, others hold zeros (MPI_Gather shape)."""
+    gathered = lax.all_gather(x, axis, tiled=tiled)
+    keep = _axis_index(axis) == root
+    return jnp.where(keep, gathered, jnp.zeros_like(gathered))
+
+
+def scatter_from_root(x, axis: str, root: int = 0):
+    """Root's array is split evenly along dim 0; rank i receives piece i.
+
+    ``x`` is the full array on every rank's shard input (replicated in-spec);
+    only root's copy matters — parity with MPI_Scatter where non-root send
+    buffers are ignored.
+    """
+    n = lax.axis_size(axis)
+    if x.shape[0] % n != 0:
+        raise ValueError(
+            f"scatter: leading dim {x.shape[0]} not divisible by axis size {n}"
+        )
+    rooted = broadcast(x, axis, root)  # ensure all ranks agree on root data
+    piece = x.shape[0] // n
+    start = _axis_index(axis) * piece
+    return lax.dynamic_slice_in_dim(rooted, start, piece, axis=0)
+
+
+def reduce_scatter(x, axis: str, scatter_dimension: int = 0, tiled: bool = False):
+    return lax.psum_scatter(
+        x, axis, scatter_dimension=scatter_dimension, tiled=tiled
+    )
+
+
+def all_to_all(x, axis: str, split_axis: int = 0, concat_axis: int = 0, tiled: bool = False):
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
+    )

@@ -1,0 +1,104 @@
+"""Point-to-point patterns: rings, neighbor exchange, pairwise transfers.
+
+The reference's p2p catalog — blocking pair exchange with probe-sized
+buffers (/root/reference/mpi3.cpp:26-32), lock-step token passing
+(mpi4.cpp:24-44), and nonblocking neighbor exchange with waitall
+(mpi5.cpp:34-75) — all compile here to ``lax.ppermute`` with a static
+permutation table. Three MPI concepts dissolve on TPU:
+
+- **Probe/Get_count** (dynamic receive sizing): shapes are static under
+  XLA; the "probe" happens at trace time, so a receiver always knows its
+  buffer shape. There is deliberately no probe API.
+- **Tags**: each ppermute is its own op; there is no shared mailbox to
+  demultiplex, so direction tags (mpi5.cpp:47-52) have no equivalent.
+- **Waitall**: XLA's scheduler sequences/overlaps the transfers; a
+  program's data dependencies are the synchronization.
+
+Permutation tables come from ``CartTopology`` (tpuscratch.runtime.topology)
+or the helpers below; like every function in this module they must be
+static Python values at trace time.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_perm(n: int, disp: int = 1, periodic: bool = True) -> list[tuple[int, int]]:
+    """(src, dst) pairs shifting every rank by ``disp`` around a ring of n.
+
+    Non-periodic rings drop the wrap pair(s): ranks at the open boundary
+    simply have no partner (MPI_PROC_NULL semantics, mpi5.cpp:28-33).
+    """
+    pairs = []
+    for i in range(n):
+        j = i + disp
+        if periodic:
+            pairs.append((i, j % n))
+        elif 0 <= j < n:
+            pairs.append((i, j))
+    return pairs
+
+
+def ring_shift(x, axis: str, disp: int = 1, periodic: bool = True):
+    """Every rank receives the value of its neighbor ``disp`` behind it.
+
+    Ranks with no sender (open boundary) receive zeros. The ring size is
+    the axis size — a static trace-time constant, so callers cannot
+    mis-state it.
+    """
+    return lax.ppermute(x, axis, ring_perm(lax.axis_size(axis), disp, periodic))
+
+
+def neighbor_exchange(x, axis: str, periodic: bool = False):
+    """(from_left, from_right) — each rank's value shared with both sides.
+
+    mpi5 parity: every rank Isends its id to rank±1 and Irecvs theirs;
+    boundaries receive zeros where MPI would skip the transfer.
+    """
+    from_left = ring_shift(x, axis, disp=+1, periodic=periodic)
+    from_right = ring_shift(x, axis, disp=-1, periodic=periodic)
+    return from_left, from_right
+
+
+def send_pairs(x, axis: str, pairs: Sequence[tuple[int, int]]):
+    """Explicit pairwise transfers: value of src lands on dst, zeros
+    elsewhere (mpi3's two-rank exchange is ``pairs=[(0,1),(1,0)]``)."""
+    return lax.ppermute(x, axis, list(pairs))
+
+
+def pingpong(x, axis: str, a: int = 0, b: int = 1, rounds: int = 1):
+    """Bounce a value between ranks a and b ``rounds`` times (one round =
+    a->b->a). The latency-probe primitive (test-benchmark pingpong).
+
+    Returns the bounced value (on rank a after full rounds).
+    """
+    there = [(a, b)]
+    back = [(b, a)]
+    y = x
+    for _ in range(rounds):
+        y = lax.ppermute(y, axis, there)
+        y = lax.ppermute(y, axis, back)
+    return y
+
+
+def token_ring(x, axis: str, hops: int, increment=1):
+    """Lock-step token circulation: the token makes ``hops`` hops around the
+    ring, incremented at each hop — mpi4's counter passing generalized from
+    2 ranks to the full ring. Uses a scan (static trip count) so the
+    compiled program is one loop, not ``hops`` unrolled ppermutes.
+
+    Every rank receives the circulating token each hop; after ``hops`` hops
+    rank (hops % n) holds the token that started at rank 0.
+    """
+    perm = ring_perm(lax.axis_size(axis), 1, periodic=True)
+
+    def hop(tok, _):
+        tok = lax.ppermute(tok, axis, perm) + increment
+        return tok, ()
+
+    out, _ = lax.scan(hop, x, None, length=hops)
+    return out

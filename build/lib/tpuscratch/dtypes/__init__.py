@@ -1,0 +1,24 @@
+"""Structured slice specs — MPI derived datatypes, functionally.
+
+An MPI derived datatype describes which bytes of a buffer form a message
+(indexed / struct / subarray / hindexed, SURVEY.md §2.2). Under XLA there
+are no buffers-with-layouts to describe — but the same *selection algebra*
+is still needed: "these blocks of that array travel together". Here a spec
+is an immutable value with two pure functions:
+
+- ``pack(arrays)``   -> flat contiguous vector (the message payload)
+- ``unpack(flat, arrays)`` -> arrays with the payload scattered back in
+
+Both are jit-compatible with static shapes, so ``pack -> ppermute ->
+unpack`` inside ``shard_map`` is the exact analogue of committing a
+datatype and passing it to Isend/Irecv — except XLA fuses the gather into
+the transfer and there is nothing to commit or free.
+"""
+
+from tpuscratch.dtypes.specs import (  # noqa: F401
+    HIndexedSpec,
+    IndexedSpec,
+    StructSpec,
+    SubarraySpec,
+    exchange_packed,
+)

@@ -1,0 +1,184 @@
+"""The slice-spec algebra: indexed, subarray, struct, hindexed composition.
+
+Parity map (reference -> here):
+- ``MPI_Type_indexed`` 2 blocks (len 4 @ disp 5, len 2 @ disp 12) of a
+  16-float array (/root/reference/mpi7.cpp:36-41) -> ``IndexedSpec(((5, 4),
+  (12, 2)))``; the receiver's "6 plain floats" is exactly ``pack``'s output.
+- ``MPI_Type_create_subarray`` (/root/reference/stencil2D.h:210-228,
+  mpi-complex-types.cpp:35) -> ``SubarraySpec(offsets, shape)``; strided
+  2D slices travel without manual packing, as in the reference.
+- ``MPI_Type_create_struct`` over Particle {4 float; 2 int}
+  (/root/reference/mpi8.cpp:13-17,53) -> ``StructSpec``: a pytree of
+  same-leading-dim arrays; jax collectives already map over pytrees, so a
+  "struct type" only needs to validate and split/join records.
+- ``MPI_Type_create_hindexed`` over subarrays of *separately allocated*
+  arrays (/root/reference/mpi-complex-types.cpp:49,88) -> ``HIndexedSpec``:
+  a sequence of (array index, spec) pairs packed into one payload. Runtime
+  pointer-difference displacements (:38-40) become plain list indices —
+  addresses are not a concept the functional model needs.
+
+All extents/offsets are static Python ints: the trace-time equivalent of
+Type_commit. A spec is hashable and reusable across any number of
+exchanges, like a committed datatype, but needs no free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _check_payload(flat, size: int) -> None:
+    """Static shape check: jnp slicing clips out-of-range silently, so a
+    wrong-sized payload would otherwise scatter partially — the one failure
+    mode MPI's typed recv would catch that static shapes alone don't."""
+    if flat.ndim != 1 or flat.shape[0] != size:
+        raise ValueError(f"payload shape {flat.shape} != spec size ({size},)")
+
+
+@dataclasses.dataclass(frozen=True)
+class IndexedSpec:
+    """Blocks of a 1D array: ((start, length), ...) — MPI_Type_indexed."""
+
+    blocks: tuple[tuple[int, int], ...]
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "blocks", tuple((int(s), int(l)) for s, l in self.blocks)
+        )
+        for start, length in self.blocks:
+            if start < 0 or length <= 0:
+                raise ValueError(f"bad block ({start}, {length})")
+
+    @property
+    def size(self) -> int:
+        return sum(l for _, l in self.blocks)
+
+    def pack(self, x: jax.Array) -> jax.Array:
+        return jnp.concatenate([x[s : s + l] for s, l in self.blocks])
+
+    def unpack(self, flat: jax.Array, x: jax.Array) -> jax.Array:
+        _check_payload(flat, self.size)
+        out = x
+        pos = 0
+        for start, length in self.blocks:
+            out = lax.dynamic_update_slice(out, flat[pos : pos + length], (start,))
+            pos += length
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SubarraySpec:
+    """A rectangular region of an N-D array — MPI_Type_create_subarray."""
+
+    offsets: tuple[int, ...]
+    shape: tuple[int, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "offsets", tuple(int(o) for o in self.offsets))
+        object.__setattr__(self, "shape", tuple(int(s) for s in self.shape))
+        if len(self.offsets) != len(self.shape):
+            raise ValueError(f"rank mismatch {self.offsets} vs {self.shape}")
+        if any(o < 0 for o in self.offsets) or any(s <= 0 for s in self.shape):
+            raise ValueError(f"bad subarray {self.offsets}/{self.shape}")
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.shape)
+
+    def region(self, x: jax.Array) -> jax.Array:
+        """The subarray itself, in its N-D shape."""
+        idx = tuple(slice(o, o + s) for o, s in zip(self.offsets, self.shape))
+        return x[idx]
+
+    def pack(self, x: jax.Array) -> jax.Array:
+        return self.region(x).reshape(-1)
+
+    def unpack(self, flat: jax.Array, x: jax.Array) -> jax.Array:
+        _check_payload(flat, self.size)
+        return lax.dynamic_update_slice(
+            x, flat.reshape(self.shape), self.offsets
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class StructSpec:
+    """Records spread across a pytree of arrays (struct-of-arrays layout).
+
+    The reference's array-of-structs Particle buffer (mpi8.cpp:13-17) is a
+    layout forced by C memory; the TPU-native layout for the same records is
+    struct-of-arrays, which keeps each field contiguous for vector loads.
+    ``fields`` names the leaves; all leaves share leading dim = record count.
+    """
+
+    fields: tuple[str, ...]
+
+    def validate(self, tree: dict) -> int:
+        if set(tree.keys()) != set(self.fields):
+            raise ValueError(f"fields {sorted(tree)} != spec {sorted(self.fields)}")
+        counts = {k: tree[k].shape[0] for k in self.fields}
+        n = next(iter(counts.values()))
+        if any(c != n for c in counts.values()):
+            raise ValueError(f"ragged record counts {counts}")
+        return n
+
+    def records(self, tree: dict, start: int, count: int) -> dict:
+        """A contiguous run of records — e.g. one rank's scatter share."""
+        self.validate(tree)
+        return {k: lax.dynamic_slice_in_dim(tree[k], start, count, 0) for k in self.fields}
+
+    def concat(self, trees: Sequence[dict]) -> dict:
+        for t in trees:
+            self.validate(t)
+        return {
+            k: jnp.concatenate([t[k] for t in trees], axis=0) for k in self.fields
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class HIndexedSpec:
+    """Regions of several separately-allocated arrays in one message.
+
+    ``parts[i] = (array_index, spec)``: which input array, and which region
+    of it. mpi-complex-types parity: 3-element blocks of 3 separate arrays
+    sent as one payload.
+    """
+
+    parts: tuple[tuple[int, "IndexedSpec | SubarraySpec"], ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "parts", tuple(self.parts))
+
+    @property
+    def size(self) -> int:
+        return sum(spec.size for _, spec in self.parts)
+
+    def pack(self, arrays: Sequence[jax.Array]) -> jax.Array:
+        return jnp.concatenate(
+            [spec.pack(arrays[i]) for i, spec in self.parts]
+        )
+
+    def unpack(self, flat: jax.Array, arrays: Sequence[jax.Array]) -> list[jax.Array]:
+        _check_payload(flat, self.size)
+        out = list(arrays)
+        pos = 0
+        for i, spec in self.parts:
+            out[i] = spec.unpack(flat[pos : pos + spec.size], out[i])
+            pos += spec.size
+        return out
+
+
+def exchange_packed(spec, x, axis, perm, dest_spec=None):
+    """pack -> ppermute -> unpack: a structured region travels to the
+    permutation's destination and lands in ``dest_spec``'s region there
+    (defaults to the send region). The one-line equivalent of commit +
+    Isend/Irecv with a derived datatype on both sides.
+    """
+    payload = spec.pack(x)
+    arrived = lax.ppermute(payload, axis, list(perm))
+    return (dest_spec or spec).unpack(arrived, x)

@@ -1,0 +1,27 @@
+"""2D domain-decomposition with ghost-cell (halo) exchange — the flagship.
+
+TPU-native redesign of the reference's only true library, the header-only
+templated halo exchanger ``stencil2D.h`` (SURVEY.md §2.4). The shape of the
+design survives — separate layout description, pure region geometry, a
+precompiled per-direction transfer plan, one executor — but every piece is
+re-grounded in XLA:
+
+- ``Array2D``/``Array2DAccessor`` (layout over raw pointers) ->
+  ``TileLayout``: a value object describing core extent + halo widths; the
+  "accessor" is array slicing via ``SubarraySpec``.
+- ``MPI_Type_create_subarray`` per region -> ``SubarraySpec`` slices
+  (tpuscratch.dtypes); XLA fuses the gather/scatter into the transfer.
+- ``CreateSendRecvArrays`` (8 send + 8 recv descriptors)
+  -> ``HaloSpec.plan()``: 8 (send-region, recv-region, permutation)
+  triples; the mirrored region/direction/tag tables collapse because a
+  ppermute names source AND destination in one table.
+- ``ExchangeData`` (Irecv/Isend/Waitall) -> ``halo_exchange``: 8
+  ``ppermute``s whose scheduling/overlap is XLA's job.
+- periodic cartesian communicator -> ``CartTopology`` permutation tables;
+  corner (diagonal) neighbors are a single diagonal ppermute over the
+  tuple of mesh axes, not two composed axis shifts.
+"""
+
+from tpuscratch.halo.layout import Region, TileLayout, sub_region  # noqa: F401
+from tpuscratch.halo.exchange import HaloSpec, halo_exchange  # noqa: F401
+from tpuscratch.halo.stencil import five_point, stencil_step  # noqa: F401

@@ -1,0 +1,254 @@
+"""Stencil compute + the exchange-compute iteration loop.
+
+The reference driver's loop body is ``do {Exchange; Compute} while
+(!TerminateCondition)`` with a **no-op** Compute and a single iteration
+(/root/reference/stencil2d/mpi-2d-stencil-subarray.cpp:27-31,92-95). Here
+Compute is a real 5-point update (so benchmarks measure something), the
+loop is a ``lax.scan`` (one compiled program for N steps, no per-step
+dispatch), and the whole iteration is differentiable/jittable like any jax
+code. A Pallas fused kernel variant lives in ops/stencil_kernel.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuscratch.halo.exchange import (
+    HaloSpec,
+    halo_arrivals,
+    halo_exchange,
+    halo_scatter,
+)
+from tpuscratch.halo.layout import TileLayout
+
+
+def five_point(tile: jax.Array, layout: TileLayout, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0)) -> jax.Array:
+    """One Jacobi-style 5-point update of the core; halo is read, not
+    written. ``coeffs`` = (north, south, west, east, center).
+
+    Defaults to the Laplace/Jacobi average — the canonical workload for a
+    halo benchmark.
+    """
+    if layout.halo_y < 1 or layout.halo_x < 1:
+        raise ValueError(
+            f"five_point needs halo >= 1, got ({layout.halo_y},{layout.halo_x})"
+        )
+    new_core = _new_values(
+        tile, 0, layout.core_h, 0, layout.core_w, layout, coeffs
+    )
+    return rebuild(tile, new_core, layout)
+
+
+def rebuild(tile: jax.Array, new_core: jax.Array, layout: TileLayout) -> jax.Array:
+    """Wrap a freshly-computed core back into the padded tile's border.
+
+    By concatenation, NOT dynamic_update_slice: an in-place core update
+    fused with overlapping shifted reads of the same buffer miscompiles on
+    XLA:CPU under shard_map (Gauss-Seidel-like partial reads; even
+    optimization_barrier does not prevent it — found by the steps=1 oracle
+    test). Concat allocates a fresh buffer by construction and fuses just
+    as well.
+    """
+    hy, hx = layout.halo_y, layout.halo_x
+    h, w = layout.core_h, layout.core_w
+    mid = jnp.concatenate(
+        [tile[hy : hy + h, :hx], new_core, tile[hy : hy + h, hx + w :]], axis=1
+    )
+    return jnp.concatenate([tile[:hy], mid, tile[hy + h :]], axis=0)
+
+
+def _compute(tile: jax.Array, layout: TileLayout, coeffs, impl: str) -> jax.Array:
+    if impl == "xla":
+        return five_point(tile, layout, coeffs)
+    if impl == "pallas":
+        from tpuscratch.ops.stencil_kernel import five_point_pallas
+
+        return five_point_pallas(tile, layout, tuple(coeffs))
+    raise ValueError(f"unknown stencil impl {impl!r}")
+
+
+def _new_values(t: jax.Array, r0: int, r1: int, c0: int, c1: int, layout, coeffs) -> jax.Array:
+    """Updated values for core cells rows [r0,r1) x cols [c0,c1), read from
+    the (padded-coordinate) tile ``t``."""
+    hy, hx = layout.halo_y, layout.halo_x
+    cn, cs, cw, ce, cc = coeffs
+    ry, rx = hy + r0, hx + c0
+    h, w = r1 - r0, c1 - c0
+    return (
+        cn * t[ry - 1 : ry - 1 + h, rx : rx + w]
+        + cs * t[ry + 1 : ry + 1 + h, rx : rx + w]
+        + cw * t[ry : ry + h, rx - 1 : rx - 1 + w]
+        + ce * t[ry : ry + h, rx + 1 : rx + 1 + w]
+        + cc * t[ry : ry + h, rx : rx + w]
+    )
+
+
+def stencil_step_overlap(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0)) -> jax.Array:
+    """Exchange overlapped with interior compute — the async-halo variant.
+
+    The interior of the core (every cell at least one stencil reach away
+    from the core edge) reads only core cells, so its update is computed
+    from the PRE-exchange tile with no data dependency on the transfers:
+    XLA is free to run the 8 ppermutes concurrently with the bulk of the
+    FLOPs. Only the 1-cell boundary ring of the core waits for the
+    arrivals. The reference analogue is the Isend-all/compute/Waitall
+    overlap pattern its plan-executor design enables (SURVEY.md §7.5).
+    """
+    lay = spec.layout
+    if lay.halo_y < 1 or lay.halo_x < 1:
+        raise ValueError("five_point needs halo >= 1 on both axes")
+    h, w = lay.core_h, lay.core_w
+    if h < 3 or w < 3:
+        # no interior to overlap; fall back to the plain step
+        return five_point(halo_exchange(tile, spec), lay, coeffs)
+
+    arrivals = halo_arrivals(tile, spec)                  # transfers launch
+    interior = _new_values(tile, 1, h - 1, 1, w - 1, lay, coeffs)  # overlaps
+    t2 = halo_scatter(tile, spec, arrivals)               # halo lands
+
+    top = _new_values(t2, 0, 1, 0, w, lay, coeffs)
+    bottom = _new_values(t2, h - 1, h, 0, w, lay, coeffs)
+    left = _new_values(t2, 1, h - 1, 0, 1, lay, coeffs)
+    right = _new_values(t2, 1, h - 1, w - 1, w, lay, coeffs)
+
+    mid = jnp.concatenate([left, interior, right], axis=1)
+    new_core = jnp.concatenate([top, mid, bottom], axis=0)
+    return rebuild(t2, new_core, lay)
+
+
+def stencil_step(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla") -> jax.Array:
+    """Exchange then compute — one iteration of the flagship loop.
+
+    ``impl`` selects the compute path — the runtime analogue of the
+    reference's compile-time GPU/CPU switch: 'xla' (compiler-fused),
+    'pallas' (explicit VMEM kernel, ops/stencil_kernel.py), or 'overlap'
+    (interior compute overlapped with the halo transfers,
+    ``stencil_step_overlap``).
+    """
+    if impl not in ("xla", "pallas", "overlap"):
+        raise ValueError(f"unknown stencil impl {impl!r}")
+    if impl == "overlap":
+        return stencil_step_overlap(tile, spec, coeffs)
+    tile = halo_exchange(tile, spec)
+    return _compute(tile, spec.layout, coeffs, impl)
+
+
+def run_stencil(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), impl: str = "xla", unroll: int = 1) -> jax.Array:
+    """N iterations as one compiled scan (SPMD: call inside shard_map)."""
+
+    def body(t, _):
+        return stencil_step(t, spec, coeffs, impl), ()
+
+    out, _ = lax.scan(body, tile, None, length=steps, unroll=unroll)
+    return out
+
+
+def run_stencil_resident(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), unroll: int = 8) -> jax.Array:
+    """N iterations entirely in VMEM — the single-device fast path.
+
+    On a 1x1 periodic topology the halo exchange is a self-wrap: every
+    ghost strip comes from the tile's own opposite edge. That makes the
+    ghost cells redundant — periodic wrap is just modular indexing of the
+    core — so the whole loop collapses into one VMEM-resident Pallas
+    kernel (ops.stencil_kernel.resident_periodic_pallas) with zero HBM
+    traffic between steps. Returns a padded tile with the halo re-wrapped
+    (one trailing exchange), so the result is interchangeable with
+    ``run_stencil``'s.
+    """
+    lay = spec.layout
+    if spec.topology.dims != (1, 1):
+        raise ValueError(
+            f"resident stencil is single-device only, got mesh {spec.topology.dims}"
+        )
+    if not all(spec.topology.periodic):
+        raise ValueError("resident stencil requires a periodic topology")
+    from tpuscratch.ops.stencil_kernel import resident_periodic_pallas
+
+    hy, hx = lay.halo_y, lay.halo_x
+    core = tile[hy : hy + lay.core_h, hx : hx + lay.core_w]
+    new_core = resident_periodic_pallas(core, steps, tuple(coeffs), unroll)
+    return halo_exchange(rebuild(tile, new_core, lay), spec)
+
+
+def shrink_step(a: jax.Array, coeffs) -> jax.Array:
+    """One valid-region Jacobi step: (H, W) -> (H-2, W-2), every output
+    cell computed from fully-valid neighbors. The building block of the
+    trapezoid scheme — no border bookkeeping, the shape IS the validity."""
+    H, W = a.shape
+    h, w = H - 2, W - 2
+    cn, cs, cw, ce, cc = coeffs
+    return (
+        cn * a[0:h, 1 : 1 + w]
+        + cs * a[2 : 2 + h, 1 : 1 + w]
+        + cw * a[1 : 1 + h, 0:w]
+        + ce * a[1 : 1 + h, 2 : 2 + w]
+        + cc * a[1 : 1 + h, 1 : 1 + w]
+    )
+
+
+def run_stencil_deep(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 0.25, 0.25, 0.25, 0.0), depth: int | None = None, impl: str = "xla") -> jax.Array:
+    """Communication-avoiding iteration: one ``depth``-wide halo exchange
+    buys ``depth`` update substeps (trapezoid/ghost-zone scheme).
+
+    Each exchange fills a halo ``depth`` cells deep; substep j then updates
+    every cell at least j rings in from the padded border, so after
+    ``depth`` substeps the core has advanced ``depth`` true Jacobi steps —
+    the redundant ring computation is the price for ``depth``x fewer
+    exchanges (and a ``depth``x shorter scan). The distributed win is
+    fewer, larger ICI messages; single-chip, it drops the per-step
+    pack/permute/scatter entirely. This is the natural TPU extension of
+    the reference's ghost-cell machinery, whose halo width is already
+    ``stencil/2`` cells (stencil2D.h:116-117) — here the width is an
+    optimization knob rather than a stencil property.
+
+    Requires a periodic topology: with open boundaries the scheme would
+    evolve boundary ghost rings that MPI_PROC_NULL semantics keep fixed.
+    ``depth`` defaults to the layout halo width; steps need not divide
+    evenly (the remainder runs as a shallower trailing trapezoid).
+
+    ``impl='xla'`` runs the substep pyramid as compiler-scheduled ops
+    (about one HBM pass per substep); ``impl='pallas'`` runs the whole
+    pyramid inside one VMEM-resident kernel (one HBM read + one write per
+    ``depth`` substeps — ops/stencil_kernel.deep_trapezoid_pallas), the
+    memory-bound regime's win.
+    """
+    lay = spec.layout
+    k = lay.halo_y if depth is None else depth
+    if lay.halo_y != lay.halo_x:
+        raise ValueError("deep stencil needs a square halo (halo_y == halo_x)")
+    if not (1 <= k <= lay.halo_y):
+        raise ValueError(f"depth {k} must be in [1, halo {lay.halo_y}]")
+    if not all(spec.topology.periodic):
+        raise ValueError("deep stencil requires a periodic topology")
+    if min(lay.core_h, lay.core_w) < k:
+        raise ValueError(
+            f"core {lay.core_h}x{lay.core_w} smaller than depth {k}"
+        )
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown deep stencil impl {impl!r}")
+
+    def trapezoid(t, substeps):
+        t = halo_exchange(t, spec)
+        if impl == "pallas":
+            from tpuscratch.ops.stencil_kernel import deep_trapezoid_pallas
+
+            core = deep_trapezoid_pallas(t, lay, substeps, tuple(coeffs))
+        else:
+            a = t
+            for _ in range(substeps):
+                a = shrink_step(a, coeffs)
+            crop = lay.halo_y - substeps
+            core = a[crop:-crop, crop:-crop] if crop else a
+        return rebuild(t, core, lay)
+
+    rounds, rem = divmod(steps, k)
+
+    def body(t, _):
+        return trapezoid(t, k), ()
+
+    out, _ = lax.scan(body, tile, None, length=rounds)
+    if rem:
+        out = trapezoid(out, rem)
+    return out

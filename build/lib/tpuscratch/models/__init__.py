@@ -1,0 +1,16 @@
+"""Model compositions built from the parallel/ops layers.
+
+The reference is an MPI scratchpad with no model zoo (SURVEY.md §2) — its
+"models" are the numbered SPMD programs, mirrored one-for-one in
+examples/. This package holds the framework's composed demonstrations:
+multiple parallelism families sharded over one mesh in a single compiled
+training step (models.transformer), the thing the individual
+parallel/* modules exist to make possible.
+"""
+
+from tpuscratch.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    init_params,
+    model_apply,
+    train_step,
+)

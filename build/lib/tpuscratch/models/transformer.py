@@ -1,0 +1,252 @@
+"""Mini MoE transformer: one compiled training step composing dp+sp+ep.
+
+The composed demonstration the parallel/* modules build toward — and the
+thing the reference cannot express at all (it has no autodiff, no
+optimizer, no attention; its closest structure is the exchange-compute
+loop at /root/reference/stencil2d/mpi-2d-stencil-subarray.cpp:92-95).
+One ``shard_map``'d ``jax.grad`` step over a 2D mesh ("dp", "sp"):
+
+- batch sharded over "dp", sequence over "sp";
+- attention: ring attention over "sp" (parallel.ring_attention — KV
+  blocks rotate by ppermute, optionally flash-kernel hops);
+- MoE FFN: expert parallelism over the "dp" axis (the standard
+  EP-groups==DP-groups layout; parallel.expert all_to_all
+  dispatch/combine);
+- loss: pmean over both axes; gradients: collective transposes route
+  cross-rank cotangents (rotated KV, routed tokens) back to the owning
+  rank, then an explicit per-leaf psum totals the copies — expert leaves
+  over "sp" only (their copies live across "sp"; across "dp" they are
+  DIFFERENT experts), replicated leaves over both axes;
+- SGD update, all inside the same jit.
+
+Everything is a pure function over an explicit parameter pytree — the
+idiomatic JAX shape, not a port of any framework's Module system.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.parallel.expert import expert_parallel_ffn
+from tpuscratch.parallel.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    d_model: int = 32
+    n_heads: int = 2
+    n_experts: int = 4          # total; must divide by the dp axis size
+    d_ff: int = 64
+    n_layers: int = 1
+    causal: bool = True
+    capacity_factor: float = 2.0
+    aux_coef: float = 0.01
+    # 'xla': ring attention, dense hop blocks (trainable)
+    # 'pallas': ring attention, flash-kernel hops (forward-only: the
+    #   state-mode kernel the hop merge needs has no backward)
+    # 'ulysses-pallas': Ulysses all_to_all + differentiable flash kernel
+    #   (trainable; needs n_heads % sp_size == 0)
+    attn_impl: str = "xla"
+
+    @property
+    def d_head(self) -> int:
+        if self.d_model % self.n_heads:
+            raise ValueError(
+                f"d_model {self.d_model} not divisible by n_heads {self.n_heads}"
+            )
+        return self.d_model // self.n_heads
+
+
+def init_params(seed: int, cfg: TransformerConfig) -> dict:
+    """Parameter pytree for ``cfg``; expert leaves have a leading
+    (n_experts,) axis — the dimension sharded over "dp"."""
+    rng = np.random.default_rng(seed)
+    d, f, e = cfg.d_model, cfg.d_ff, cfg.n_experts
+
+    def dense(*shape, scale=None):
+        scale = scale if scale is not None else 1.0 / np.sqrt(shape[0])
+        return jnp.asarray(
+            rng.standard_normal(shape).astype(np.float32) * scale
+        )
+
+    layers = []
+    for _ in range(cfg.n_layers):
+        layers.append(
+            {
+                "wq": dense(d, d),
+                "wk": dense(d, d),
+                "wv": dense(d, d),
+                "wo": dense(d, d),
+                "ln1": jnp.ones((d,), jnp.float32),
+                "ln2": jnp.ones((d,), jnp.float32),
+                "gate": dense(d, e, scale=0.02),
+                "w_in": dense(e, d, f, scale=1.0 / np.sqrt(d)),
+                "w_out": dense(e, f, d, scale=1.0 / np.sqrt(f)),
+            }
+        )
+    return {"layers": layers}
+
+
+EXPERT_LEAVES = ("w_in", "w_out")  # the leaves sharded over "dp"
+
+
+def _is_expert_leaf(path) -> bool:
+    return any(getattr(k, "key", None) in EXPERT_LEAVES for k in path)
+
+
+def param_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
+    """PartitionSpec pytree: expert leaves sharded over ``dp`` on their
+    expert axis, everything else replicated. Built structurally from the
+    config (materializing a throwaway parameter set just for its tree
+    shape would cost RNG time and device memory)."""
+    layer = {
+        name: P(dp) if name in EXPERT_LEAVES else P()
+        for name in ("wq", "wk", "wv", "wo", "ln1", "ln2",
+                     "gate", "w_in", "w_out")
+    }
+    return {"layers": [dict(layer) for _ in range(cfg.n_layers)]}
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _block(p, x, cfg: TransformerConfig, sp: str, dp: str):
+    """One attention + MoE block on a local (B_loc, S_loc, d) shard.
+    Returns (new_x, aux_loss)."""
+    B, S, d = x.shape
+    H, Dh = cfg.n_heads, cfg.d_head
+
+    h = _rms_norm(x, p["ln1"])
+    q = (h @ p["wq"]).reshape(B, S, H, Dh)
+    k = (h @ p["wk"]).reshape(B, S, H, Dh)
+    v = (h @ p["wv"]).reshape(B, S, H, Dh)
+    if cfg.attn_impl == "ulysses-pallas":
+        from tpuscratch.parallel.ulysses import ulysses_attention
+
+        seq_attn = lambda qb, kb, vb: ulysses_attention(  # noqa: E731
+            qb, kb, vb, sp, causal=cfg.causal, impl="pallas"
+        )
+    else:
+        seq_attn = lambda qb, kb, vb: ring_attention(  # noqa: E731
+            qb, kb, vb, sp, causal=cfg.causal, impl=cfg.attn_impl
+        )
+    attn = jax.vmap(seq_attn)(q, k, v)
+    x = x + attn.reshape(B, S, d) @ p["wo"]
+
+    h = _rms_norm(x, p["ln2"])
+    tokens = h.reshape(B * S, d)
+    moe, aux = expert_parallel_ffn(
+        tokens, p["gate"], p["w_in"], p["w_out"], dp,
+        capacity_factor=cfg.capacity_factor,
+    )
+    return x + moe.reshape(B, S, d), aux
+
+
+def model_apply(params, x, cfg: TransformerConfig, sp: str = "sp", dp: str = "dp"):
+    """Forward over a local shard: x (B_loc, S_loc, d) -> (out, aux)."""
+    aux_total = jnp.float32(0.0)
+    for p in params["layers"]:
+        x, aux = _block(p, x, cfg, sp, dp)
+        aux_total = aux_total + aux
+    return x, aux_total
+
+
+def _loss(params, x, y, cfg: TransformerConfig, sp: str, dp: str):
+    out, aux = model_apply(params, x, cfg, sp, dp)
+    mse = jnp.mean(jnp.square(out - y))
+    # identical on every rank: the global objective, not a local one
+    return lax.pmean(mse + cfg.aux_coef * aux, (dp, sp))
+
+
+def _grad_reduce(grads, dp: str, sp: str):
+    """Combine the per-copy gradients into the logical gradient.
+
+    Every one of the n = |dp|*|sp| ranks seeds its own replica of the
+    pmean'd loss with cotangent 1, and the collective transposes (ring
+    ppermute, expert all_to_all) route each seed's cross-rank terms to
+    the copy that produced them — so summing a leaf's grads over its
+    copy axes counts every seed exactly once per copy-set, i.e. n times
+    the logical gradient. The rule is therefore uniform:
+    psum over the leaf's copy axes, divided by n. Expert leaves have
+    copies across "sp" only (across "dp" they are DIFFERENT experts —
+    their single copy still receives all n seeds via the all_to_all
+    transpose); everything else has copies across both axes. Validated
+    by the sharding-invariance test (1x1 == 2x1 == 1x4 == 2x4 meshes,
+    tests/test_models.py)."""
+    n = lax.axis_size(dp) * lax.axis_size(sp)
+
+    def reduce_leaf(path, g):
+        axes = (sp,) if _is_expert_leaf(path) else (dp, sp)
+        return lax.psum(g, axes) / n
+
+    return jax.tree_util.tree_map_with_path(reduce_leaf, grads)
+
+
+def train_step_fn(cfg: TransformerConfig, lr: float = 1e-2,
+                  sp: str = "sp", dp: str = "dp"):
+    """The shard_map body: (params, x, y) -> (new_params, loss)."""
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
+        grads = _grad_reduce(grads, dp, sp)
+        new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
+        return new_params, loss
+
+    return step
+
+
+def train_step(
+    mesh: Mesh,
+    cfg: TransformerConfig,
+    lr: float = 1e-2,
+    dp: str = "dp",
+    sp: str = "sp",
+):
+    """Compiled training step over ``mesh`` (axes ``dp`` x ``sp``).
+
+    Returns jit'd fn(params, x, y) -> (new_params, loss) with x, y
+    (batch, seq, d_model) sharded P(dp, sp) and params laid out by
+    ``param_spec``. The full composed surface — ring attention over sp,
+    expert all_to_all over dp, grad, psum totals, SGD — is ONE XLA
+    program.
+    """
+    n_dp = mesh.shape[dp]
+    if cfg.n_experts % n_dp:
+        raise ValueError(
+            f"n_experts {cfg.n_experts} not divisible by dp size {n_dp}"
+        )
+    if cfg.attn_impl == "pallas":
+        raise NotImplementedError(
+            "ring flash hops have no backward (the state-mode kernel is "
+            "forward-only) — train with attn_impl='xla' (dense ring "
+            "hops) or 'ulysses-pallas' (all_to_all + differentiable "
+            "flash kernel); 'pallas' composes forward via model_apply"
+        )
+    if cfg.attn_impl not in ("xla", "ulysses-pallas"):
+        raise ValueError(
+            f"unknown attn_impl {cfg.attn_impl!r}: "
+            "'xla' | 'pallas' | 'ulysses-pallas'"
+        )
+    if cfg.attn_impl == "ulysses-pallas" and cfg.n_heads % mesh.shape[sp]:
+        raise ValueError(
+            f"ulysses-pallas needs n_heads {cfg.n_heads} divisible by "
+            f"sp size {mesh.shape[sp]}"
+        )
+    pspec = param_spec(cfg, dp)
+    return run_spmd(
+        mesh,
+        train_step_fn(cfg, lr, sp=sp, dp=dp),
+        (pspec, P(dp, sp), P(dp, sp)),
+        (pspec, P()),
+    )

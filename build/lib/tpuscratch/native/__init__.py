@@ -1,0 +1,184 @@
+"""ctypes binding for the native halo planner (native/src/halo_geometry.cpp).
+
+Loads ``native/libtpuscratch_native.so`` if present (``make -C native``
+builds it; ``build()`` does so programmatically). All entry points mirror
+the pure-Python topology/layout math one-for-one — tests cross-check them —
+so the native path is an accelerator for trace-time planning on large
+meshes, never a semantic fork.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import shutil
+import subprocess
+from typing import Optional
+
+_LIB_NAME = "libtpuscratch_native.so"
+_PKG_DIR = pathlib.Path(__file__).resolve().parent
+_NATIVE_DIR = _PKG_DIR.parents[1] / "native"
+
+
+def _lib_path() -> Optional[pathlib.Path]:
+    """Resolve the library: explicit env override (must exist), else the
+    newest of the dev-tree build and the wheel-shipped package copy."""
+    env = os.environ.get("TPUSCRATCH_NATIVE_LIB")
+    if env:
+        path = pathlib.Path(env)
+        if not path.exists():
+            raise FileNotFoundError(
+                f"TPUSCRATCH_NATIVE_LIB={env} does not exist"
+            )
+        return path
+    existing = [
+        p
+        for p in (_NATIVE_DIR / _LIB_NAME, _PKG_DIR / _LIB_NAME)
+        if p.exists()
+    ]
+    if not existing:
+        return None
+    return max(existing, key=lambda p: p.stat().st_mtime)
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def build(quiet: bool = True) -> bool:
+    """Compile the native library (requires g++/make). True on success.
+
+    Also copies the built .so into the package directory so that wheels
+    built afterwards ship it (pyproject package-data picks it up).
+    """
+    try:
+        subprocess.run(
+            ["make", "-C", str(_NATIVE_DIR)],
+            check=True,
+            capture_output=quiet,
+        )
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+    try:
+        shutil.copy2(_NATIVE_DIR / _LIB_NAME, _PKG_DIR / _LIB_NAME)
+    except OSError:
+        pass  # dev tree copy still loadable from native/
+    global _lib
+    _lib = None  # force reload
+    return load() is not None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The loaded library, or None when unbuilt/unloadable.
+
+    Exception: an explicit TPUSCRATCH_NATIVE_LIB override pointing at a
+    missing file raises FileNotFoundError — a deliberate misconfiguration
+    should fail loudly, not silently fall back to another copy.
+    """
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = _lib_path()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError:
+        return None
+    i32 = ctypes.c_int32
+    p32 = ctypes.POINTER(ctypes.c_int32)
+    lib.ts_neighbor.restype = i32
+    lib.ts_neighbor.argtypes = [i32] * 7
+    lib.ts_send_permutation.restype = i32
+    lib.ts_send_permutation.argtypes = [i32] * 6 + [p32, p32]
+    lib.ts_halo_rect.restype = None
+    lib.ts_halo_rect.argtypes = [i32] * 6 + [p32]
+    lib.ts_send_rect.restype = None
+    lib.ts_send_rect.argtypes = [i32] * 6 + [p32]
+    lib.ts_build_plan.restype = i32
+    lib.ts_build_plan.argtypes = [i32] * 9 + [p32] * 6
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _rect(fn, core_h: int, core_w: int, hy: int, hx: int, dr: int, dc: int):
+    out = (ctypes.c_int32 * 4)()
+    fn(core_h, core_w, hy, hx, dr, dc, out)
+    return tuple(out)
+
+
+def neighbor(dims, periodic, rank: int, offset) -> Optional[int]:
+    lib = load()
+    assert lib is not None
+    got = lib.ts_neighbor(
+        dims[0], dims[1], int(periodic[0]), int(periodic[1]),
+        rank, offset[0], offset[1],
+    )
+    return None if got < 0 else got
+
+
+def send_permutation(dims, periodic, offset) -> list[tuple[int, int]]:
+    lib = load()
+    assert lib is not None
+    n = dims[0] * dims[1]
+    src = (ctypes.c_int32 * n)()
+    dst = (ctypes.c_int32 * n)()
+    count = lib.ts_send_permutation(
+        dims[0], dims[1], int(periodic[0]), int(periodic[1]),
+        offset[0], offset[1], src, dst,
+    )
+    return [(src[i], dst[i]) for i in range(count)]
+
+
+def halo_rect(core_h, core_w, hy, hx, offset):
+    lib = load()
+    assert lib is not None
+    return _rect(lib.ts_halo_rect, core_h, core_w, hy, hx, *offset)
+
+
+def send_rect(core_h, core_w, hy, hx, offset):
+    lib = load()
+    assert lib is not None
+    return _rect(lib.ts_send_rect, core_h, core_w, hy, hx, *offset)
+
+
+def build_plan(dims, periodic, core_h, core_w, hy, hx, neighbors=8):
+    """Full plan in one native call. Returns a list of dicts per direction:
+    {direction, send_rect, recv_rect, perm} in ALL_DIRECTIONS order."""
+    lib = load()
+    assert lib is not None
+    ndir_max, stride = 8, dims[0] * dims[1]
+    dirs = (ctypes.c_int32 * (2 * ndir_max))()
+    send_rects = (ctypes.c_int32 * (4 * ndir_max))()
+    recv_rects = (ctypes.c_int32 * (4 * ndir_max))()
+    perm_src = (ctypes.c_int32 * (ndir_max * stride))()
+    perm_dst = (ctypes.c_int32 * (ndir_max * stride))()
+    counts = (ctypes.c_int32 * ndir_max)()
+    ndirs = lib.ts_build_plan(
+        dims[0], dims[1], int(periodic[0]), int(periodic[1]),
+        core_h, core_w, hy, hx, neighbors,
+        dirs, send_rects, recv_rects, perm_src, perm_dst, counts,
+    )
+    if ndirs < 0:
+        raise ValueError(
+            f"native planner rejected dims={dims} core=({core_h},{core_w}) "
+            f"halo=({hy},{hx}) neighbors={neighbors}"
+        )
+    out = []
+    for i in range(ndirs):
+        out.append(
+            {
+                "direction": (dirs[2 * i], dirs[2 * i + 1]),
+                "send_rect": tuple(send_rects[4 * i : 4 * i + 4]),
+                "recv_rect": tuple(recv_rects[4 * i : 4 * i + 4]),
+                "perm": [
+                    (perm_src[i * stride + j], perm_dst[i * stride + j])
+                    for j in range(counts[i])
+                ],
+            }
+        )
+    return out

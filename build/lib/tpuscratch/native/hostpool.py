@@ -1,0 +1,160 @@
+"""ctypes binding for the native pooled host-staging allocator
+(native/src/host_pool.cpp) — the TPU-host counterpart of the reference's
+pinned ``host_allocator<T>`` (host_allocator.h:58-93).
+
+Page-aligned, size-class-pooled host buffers with optional mlock(2)
+page-locking. Used by the pingpong staging ablations (the role
+host_allocator plays in mpi-pingpong-gpu-async.cpp:43-49) and available
+to any host-staging path (checkpoint serialization, decompose/assemble).
+
+``HostBuffer.view()`` exposes the buffer as a zero-copy numpy array, so
+staging is ``view[:] = np.asarray(device_arr)`` in and
+``jax.device_put(view)`` out.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from tpuscratch import native
+
+_STATS_FIELDS = (
+    "bytes_in_use",
+    "bytes_cached",
+    "high_water",
+    "alloc_calls",
+    "reuse_hits",
+    "locked_bytes",
+    "lock_failures",
+    "page_class",
+)
+
+_configured = False
+
+
+def _lib():
+    lib = native.load()
+    if lib is None:
+        return None
+    global _configured
+    if not _configured:
+        u64 = ctypes.c_uint64
+        vp = ctypes.c_void_p
+        lib.ts_pool_create.restype = vp
+        lib.ts_pool_create.argtypes = [ctypes.c_int32]
+        lib.ts_pool_alloc.restype = vp
+        lib.ts_pool_alloc.argtypes = [vp, u64]
+        lib.ts_pool_free.restype = None
+        lib.ts_pool_free.argtypes = [vp, vp]
+        lib.ts_pool_trim.restype = None
+        lib.ts_pool_trim.argtypes = [vp]
+        lib.ts_pool_stats.restype = None
+        lib.ts_pool_stats.argtypes = [vp, ctypes.POINTER(u64)]
+        lib.ts_pool_destroy.restype = None
+        lib.ts_pool_destroy.argtypes = [vp]
+        _configured = True
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+class HostBuffer:
+    """One pooled buffer. Returns to the pool on ``free()``/``with`` exit;
+    views become invalid afterwards (the buffer may be reused)."""
+
+    def __init__(self, pool: "HostPool", ptr: int, nbytes: int):
+        self._pool = pool
+        self._ptr: Optional[int] = ptr
+        self.nbytes = nbytes
+
+    @property
+    def ptr(self) -> int:
+        if self._ptr is None:
+            raise ValueError("buffer already returned to the pool")
+        return self._ptr
+
+    def view(self, dtype=np.uint8, shape: Optional[tuple] = None) -> np.ndarray:
+        """Zero-copy numpy view of (a prefix of) the buffer."""
+        dtype = np.dtype(dtype)
+        if shape is None:
+            shape = (self.nbytes // dtype.itemsize,)
+        need = int(np.prod(shape)) * dtype.itemsize
+        if need > self.nbytes:
+            raise ValueError(f"view of {need} B exceeds buffer {self.nbytes} B")
+        raw = (ctypes.c_byte * need).from_address(self.ptr)
+        return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+    def free(self) -> None:
+        if self._ptr is not None:
+            self._pool._free(self._ptr)
+            self._ptr = None
+
+    def __enter__(self) -> "HostBuffer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.free()
+
+
+class HostPool:
+    """Pooled page-aligned (optionally page-locked) host buffers."""
+
+    def __init__(self, lock_pages: bool = True):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError(
+                "native library unavailable — tpuscratch.native.build() "
+                "or `make -C native` first"
+            )
+        self._handle = lib.ts_pool_create(1 if lock_pages else 0)
+        if not self._handle:
+            raise MemoryError("ts_pool_create failed")
+
+    def alloc(self, nbytes: int) -> HostBuffer:
+        if nbytes <= 0:
+            raise ValueError(f"alloc of {nbytes} bytes")
+        ptr = _lib().ts_pool_alloc(self._handle, nbytes)
+        if not ptr:
+            raise MemoryError(f"host pool exhausted allocating {nbytes} B")
+        return HostBuffer(self, ptr, nbytes)
+
+    def _free(self, ptr: int) -> None:
+        if self._handle:
+            _lib().ts_pool_free(self._handle, ptr)
+
+    def trim(self) -> None:
+        """Release cached (free-listed) buffers back to the OS."""
+        _lib().ts_pool_trim(self._handle)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * len(_STATS_FIELDS))()
+        _lib().ts_pool_stats(self._handle, out)
+        return dict(zip(_STATS_FIELDS, (int(v) for v in out)))
+
+    def close(self) -> None:
+        if self._handle:
+            _lib().ts_pool_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "HostPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+_default: Optional[HostPool] = None
+
+
+def default_pool() -> HostPool:
+    """Process-wide pool (page-locking on, falling back silently where
+    RLIMIT_MEMLOCK forbids — see ``stats()['lock_failures']``)."""
+    global _default
+    if _default is None or _default._handle is None:
+        _default = HostPool(lock_pages=True)
+    return _default

@@ -1,0 +1,23 @@
+"""Device compute kernels (Pallas) — the reference's CUDA kernel layer.
+
+Every ``__global__`` kernel in the reference maps to a Pallas TPU kernel
+here, designed for the VPU/MXU rather than translated from CUDA:
+
+- dot-product reductions (atomic / two-phase / single-kernel,
+  mpicuda2-4.cu) -> ``reduction.dot_partials`` / ``reduction.dot_full``
+- ``init_vector`` / ``InitKernel`` device-side fills
+  (ref_parallel-dot-product-atomics.cu:45-51,
+  mpi-2d-stencil-subarray-cuda.cu:17-28) -> ``fill.fill`` / ``fill.iota2d``
+- the stencil ``Compute`` placeholder (mpi-2d-stencil-subarray.cpp:27)
+  -> a real 5-point stencil kernel in ``stencil_kernel``
+
+All kernels run in Pallas interpreter mode off-TPU, so the same code path
+is exercised by CPU tests and TPU benchmarks.
+"""
+
+from tpuscratch.ops.reduction import dot, dot_full, dot_partials  # noqa: F401
+from tpuscratch.ops.fill import fill, iota2d  # noqa: F401
+from tpuscratch.ops.stencil_kernel import (  # noqa: F401
+    five_point_blocked,
+    five_point_pallas,
+)

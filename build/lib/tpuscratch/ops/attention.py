@@ -1,0 +1,470 @@
+"""Pallas flash-attention kernel — blockwise exact attention, MXU path.
+
+The reference has no attention anywhere (SURVEY.md §2.7: no sequence
+dimension exists); this kernel is part of the framework's long-context
+surface, beyond reference parity. The sequence-parallel schemes in
+``tpuscratch.parallel`` bound *cross-chip* memory by sharding the
+sequence; this kernel bounds *on-chip* memory for the local attention
+those schemes still compute — most importantly the Ulysses path, whose
+all-to-all hands every rank the FULL global sequence for its head slice
+(parallel/ulysses.py), where a naive (S, S) score materialization is
+exactly the memory blowup flash attention exists to avoid.
+
+Shape contract matches ``parallel.scores.masked_scores`` semantics:
+q (S, H, D), k/v (T, H, D), fp32 online-softmax accumulation, causal
+masking on global positions via ``q_offset``/``kv_offset`` (scalars, so
+ring-attention hops can reuse the kernel with rotated K origins).
+
+Kernel structure (the canonical TPU flash schedule):
+- grid (H, S/block_q, T/block_k); the KV axis is the innermost,
+  sequential ("arbitrary") dimension — the VMEM scratch carrying the
+  online-softmax state (running max, normalizer, fp32 accumulator) is
+  revisited across KV steps, initialized at the first step, and the
+  normalized output is emitted at the last.
+- both matmuls (scores = q @ k^T, update = p @ v) hit the MXU with
+  ``preferred_element_type=float32``; the VPU handles the softmax
+  bookkeeping in between.
+- the running max / normalizer live in (block_q, 128) VMEM scratch with
+  values broadcast across lanes: Mosaic wants lane-complete vector
+  stores, and a broadcast store + column-0 read is free compared to the
+  relayouts a (block_q, 1) slice store would trigger.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuscratch.ops.common import use_interpret
+from tpuscratch.parallel.scores import NEG_INF
+
+_LANE = 128
+
+
+def _score_block(
+    q_ref, k_ref, qoff_ref, koff_ref, i, j,
+    *, scale: float, causal: bool, block_q: int, block_k: int,
+):
+    """Scaled (and causally masked) score block + the masked-p guard.
+
+    THE one definition shared by the forward and both backward kernels —
+    a masking fix applied here cannot leave forward and gradient
+    inconsistent. Returns (s, guard) where ``p`` values must be passed
+    through ``jnp.where(guard, p, 0.0)`` after exponentiation (rows whose
+    every score is masked otherwise exponentiate s - m == 0)."""
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    s = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        rows = qoff_ref[0] + i * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        cols = koff_ref[0] + j * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    return s, s > NEG_INF * 0.5
+
+
+def _flash_kernel(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
+    m_ref=None, l_ref=None,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        # block-level causal skip: a KV block strictly above this Q
+        # block's last row contributes nothing — skip its MXU/VPU work
+        # entirely (~2x for long sequences; the DMA still happens, which
+        # is what keeps the skip correct under Mosaic's static pipeline)
+        first_masked_col = qoff_ref[0] + (i + 1) * block_q
+        block_needed = koff_ref[0] + j * block_k < first_masked_col
+    else:
+        block_needed = True
+
+    @pl.when(block_needed)
+    def _compute():
+        s, guard = _score_block(
+            q_ref, k_ref, qoff_ref, koff_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        m_prev = m_scr[:, 0]                       # (block_q,)
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=1))
+        p = jnp.exp(s - m_new[:, None])
+        # fully-masked rows keep m_new == NEG_INF, making s - m_new == 0
+        # for masked entries; zero them so correctness is hop-order
+        # independent (same guard as parallel/ring_attention.py)
+        p = jnp.where(guard, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + p.sum(axis=1)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + lax.dot(
+            p, v_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        if m_ref is None:
+            l_fin = l_scr[:, 0]
+            safe = jnp.where(l_fin > 0.0, l_fin, 1.0)  # fully-masked row->0
+            o_ref[0] = (acc_scr[...] / safe[:, None]).astype(o_ref.dtype)
+        else:
+            # state mode: emit the RAW fp32 accumulator (no divide, no
+            # dtype cast — the caller's softmax-merge stays exact) plus
+            # the running max / normalizer broadcast over an 8-lane
+            # plane. Mosaic requires lane-complete block stores and a
+            # sublane-divisible block shape, which rules out both a bare
+            # (1, block_q) state row and the full 128-lane broadcast;
+            # 8 lanes is the narrowest legal layout (column 0 is read
+            # back outside).
+            o_ref[0] = acc_scr[...]
+            m_ref[0] = m_scr[:, :8]
+            l_ref[0] = l_scr[:, :8]
+
+
+def _flash_kernel_state(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+    m_scr, l_scr, acc_scr, **kw,
+):
+    """Positional reordering for the three-output variant: pallas passes
+    (inputs..., outputs..., scratch...); the base kernel wants the state
+    outputs as keywords."""
+    _flash_kernel(
+        qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
+        m_scr, l_scr, acc_scr, m_ref=m_ref, l_ref=l_ref, **kw,
+    )
+
+
+def _pick_block(n: int, want: int, name: str) -> int:
+    """Largest power-of-two block <= want that divides n.
+
+    Refuses blocks below the 8-row sublane quantum (unless the dimension
+    itself is smaller): a sequence length with no power-of-two divisor
+    would silently degrade to per-row grid steps, orders of magnitude
+    slower than the dense fallback — pad the sequence instead."""
+    b = want
+    while b > 1 and n % b:
+        b //= 2
+    if b < 8 and n >= 8:
+        raise ValueError(
+            f"{name}={n} has no power-of-two block divisor >= 8; pad the "
+            "sequence to a multiple of 8 (or use the dense xla path)"
+        )
+    return max(b, 1)
+
+
+def _dq_kernel(
+    qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dq_ref, dq_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nk: int,
+):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        first_masked_col = qoff_ref[0] + (i + 1) * block_q
+        block_needed = koff_ref[0] + j * block_k < first_masked_col
+    else:
+        block_needed = True
+
+    @pl.when(block_needed)
+    def _compute():
+        s, guard = _score_block(
+            q_ref, k_ref, qoff_ref, koff_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(guard, p, 0.0)  # fully-masked-row guard
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0][:, None])
+        dq_scr[...] += scale * lax.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    qoff_ref, koff_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale: float, causal: bool, block_q: int, block_k: int, nq: int,
+):
+    j = pl.program_id(1)  # kv block
+    i = pl.program_id(2)  # q block (innermost, sequential)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    if causal:
+        first_masked_col = qoff_ref[0] + (i + 1) * block_q
+        block_needed = koff_ref[0] + j * block_k < first_masked_col
+    else:
+        block_needed = True
+
+    @pl.when(block_needed)
+    def _compute():
+        s, guard = _score_block(
+            q_ref, k_ref, qoff_ref, koff_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        q = q_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(guard, p, 0.0)
+        # dv += p^T @ do ; ds = p * (do v^T - delta) ; dk += ds^T @ q
+        dv_scr[...] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0][:, None])
+        dk_scr[...] += scale * lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(i == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _plane(x):  # (H, S) -> (H, S, 8) lane-broadcast input plane
+    return jnp.broadcast_to(x[:, :, None], (*x.shape, 8))
+
+
+def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk):
+    """dq/dk/dv via the two backward kernels. All of q/k/v/do are
+    (H, SorT, D) head-major; lse/delta are (H, S)."""
+    H, S, D = q.shape
+    T = k.shape[1]
+    nq, nk = S // bq, T // bk
+    scale = 1.0 / float(D) ** 0.5
+    interpret = use_interpret()
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    lse_p, delta_p = _plane(lse), _plane(delta)
+    qspec = pl.BlockSpec((1, bq, D), lambda h, a, b: (h, a, 0))
+    kspec = pl.BlockSpec((1, bk, D), lambda h, a, b: (h, b, 0))
+    rowspec = pl.BlockSpec((1, bq, 8), lambda h, a, b: (h, a, 0))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, nk=nk,
+        ),
+        grid=(H, nq, nk),
+        in_specs=[smem, smem, qspec, kspec, kspec, qspec, rowspec, rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+        **params,
+    )(qoff, koff, q, k, v, do, lse_p, delta_p)
+    # dkv grid: (h, kv block, q block); q-side specs index by the LAST
+    # grid axis now
+    qspec2 = pl.BlockSpec((1, bq, D), lambda h, b, a: (h, a, 0))
+    kspec2 = pl.BlockSpec((1, bk, D), lambda h, b, a: (h, b, 0))
+    rowspec2 = pl.BlockSpec((1, bq, 8), lambda h, b, a: (h, a, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            block_q=bq, block_k=bk, nq=nq,
+        ),
+        grid=(H, nk, nq),
+        in_specs=[smem, smem, kspec2, kspec2, qspec2, qspec2,
+                  rowspec2, rowspec2],
+        out_specs=[kspec2, kspec2],
+        out_shape=[
+            jax.ShapeDtypeStruct((H, T, D), k.dtype),
+            jax.ShapeDtypeStruct((H, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **params,
+    )(qoff, koff, k, v, q, do, lse_p, delta_p)
+    return dq, dk, dv
+
+
+def _flash_fwd_call(qh, kh, vh, qoff, koff, causal, bq, bk, return_state):
+    """The forward pallas_call, head-major: qh (H, S, D), kh/vh (H, T, D).
+    Plain: out (H, S, D). State: (acc (H, S, D) f32, m (H, S), l (H, S))."""
+    H, S, D = qh.shape
+    T = kh.shape[1]
+    nq, nk = S // bq, T // bk
+    scale = 1.0 / float(D) ** 0.5
+    kern = functools.partial(
+        _flash_kernel_state if return_state else _flash_kernel,
+        scale=scale, causal=causal, block_q=bq, block_k=bk, nk=nk,
+    )
+    interpret = use_interpret()
+    params = {}
+    if not interpret:
+        params["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    out_specs = [pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((H, S, D), qh.dtype)]
+    if return_state:
+        # raw fp32 accumulator + 8-lane state planes (column 0 = value)
+        out_shape[0] = jax.ShapeDtypeStruct((H, S, D), jnp.float32)
+        out_specs += [pl.BlockSpec((1, bq, 8), lambda h, i, j: (h, i, 0))] * 2
+        out_shape += [jax.ShapeDtypeStruct((H, S, 8), jnp.float32)] * 2
+    res = pl.pallas_call(
+        kern,
+        grid=(H, nq, nk),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
+        ],
+        out_specs=out_specs if return_state else out_specs[0],
+        out_shape=out_shape if return_state else out_shape[0],
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=interpret,
+        **params,
+    )(qoff, koff, qh, kh, vh)
+    if return_state:
+        acc, m, l = res
+        return acc, m[..., 0], l[..., 0]
+    return res
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_diff(qh, kh, vh, qoff, koff, causal, bq, bk):
+    """Differentiable head-major flash attention (the custom-vjp seam)."""
+    return _flash_fwd_call(qh, kh, vh, qoff, koff, causal, bq, bk, False)
+
+
+def _flash_diff_fwd(qh, kh, vh, qoff, koff, causal, bq, bk):
+    acc, m, l = _flash_fwd_call(qh, kh, vh, qoff, koff, causal, bq, bk, True)
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (acc / l_safe[:, :, None]).astype(qh.dtype)
+    lse = m + jnp.log(l_safe)  # log-sum-exp: all the backward needs
+    # o saved in the INPUT dtype (FlashAttention-2's choice): for bf16
+    # training the residual costs half the fp32 accumulator; delta still
+    # accumulates in fp32 from the casts
+    return o, (qh, kh, vh, qoff, koff, o, lse)
+
+
+def _flash_diff_bwd(causal, bq, bk, res, do):
+    import numpy as np
+
+    qh, kh, vh, qoff, koff, o, lse = res
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # (H, S)
+    dq, dk, dv = _flash_bwd_call(
+        qh, kh, vh, do, lse, delta, qoff, koff, causal, bq, bk
+    )
+    # integer offsets are non-differentiable: float0 cotangents
+    zero = np.zeros(qoff.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zero, zero
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "return_state"),
+)
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    q_offset=0,
+    kv_offset=0,
+    block_q: int = 512,
+    block_k: int = 1024,
+    return_state: bool = False,
+):
+    """Exact attention with O(S·D) memory per head: q (S, H, D),
+    k/v (T, H, D) -> (S, H, D). Offsets place the blocks in global
+    coordinates for causal masking (both default 0: a self-contained
+    sequence).
+
+    Differentiable: a custom VJP recomputes score blocks from the saved
+    log-sum-exp (the standard flash backward — two Pallas kernels
+    producing dq and dk/dv, never materializing the (S, T) score
+    matrix).
+
+    ``return_state=True`` changes the contract for cross-block merging
+    (ring attention's hops): returns ``(acc, m, l)`` where ``acc`` is the
+    UNNORMALIZED fp32 weighted sum (S, H, D) and ``m``/``l`` are the
+    running max / normalizer, each (H, S) fp32. The caller merges blocks
+    with ``acc*exp(m-m')`` algebra and divides by the merged ``l`` once
+    at the end — exact, with no per-hop normalize/un-normalize round
+    trip through the input dtype. The state mode is forward-only."""
+    if q.ndim != 3 or k.shape != v.shape or q.shape[1:] != k.shape[1:]:
+        raise ValueError(f"bad attention shapes {q.shape}/{k.shape}/{v.shape}")
+    S, H, D = q.shape
+    T = k.shape[0]
+    bq = _pick_block(S, block_q, "S")
+    bk = _pick_block(T, block_k, "T")
+
+    qh = jnp.swapaxes(q, 0, 1)  # (H, S, D)
+    kh = jnp.swapaxes(k, 0, 1)
+    vh = jnp.swapaxes(v, 0, 1)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+
+    if return_state:
+        acc, m, l = _flash_fwd_call(
+            qh, kh, vh, qoff, koff, causal, bq, bk, True
+        )
+        return jnp.swapaxes(acc, 0, 1), m, l
+    out = _flash_diff(qh, kh, vh, qoff, koff, causal, bq, bk)
+    return jnp.swapaxes(out, 0, 1)

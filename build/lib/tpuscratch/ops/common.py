@@ -1,0 +1,32 @@
+"""Shared kernel plumbing: interpret-mode selection and shape blocking."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128  # TPU lane width: last dim of every tile
+
+
+def use_interpret() -> bool:
+    """Pallas interpreter off-TPU — one kernel source, both backends.
+
+    The analogue of the reference's #ifdef GPU dual path (mpicuda2.cu:176),
+    but with no second implementation to keep in sync.
+    """
+    return jax.default_backend() != "tpu"
+
+
+def to_lanes(x: jax.Array, sublanes_multiple: int = 8) -> jax.Array:
+    """Reshape a vector to (rows, 128), zero-padding to full tiles.
+
+    TPU vector registers are (sublane, lane) tiles; 1D reductions are run
+    as 2D reductions over this layout. Zero padding is neutral for
+    sum-reductions.
+    """
+    n = x.shape[0]
+    row_quantum = LANES * sublanes_multiple
+    padded = (n + row_quantum - 1) // row_quantum * row_quantum
+    if padded != n:
+        x = jnp.pad(x, (0, padded - n))
+    return x.reshape(-1, LANES)

@@ -1,0 +1,52 @@
+"""Device-side initialization kernels.
+
+Parity: ``init_vector`` filling a vector on-device so no H2D copy is paid
+(ref_parallel-dot-product-atomics.cu:45-51) and ``InitKernel`` writing the
+rank id into a 2D tile's core (mpi-2d-stencil-subarray-cuda.cu:17-28 —
+launched there as w*h blocks of 1 thread; here one vectorized kernel).
+Under jax, constants are already materialized on-device, so these exist
+mainly to keep initialization inside a fused Pallas pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from tpuscratch.ops.common import use_interpret
+
+
+def _fill_kernel(val_ref, o_ref):
+    o_ref[:] = jnp.full_like(o_ref, val_ref[0])
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "dtype"))
+def fill(shape: tuple[int, ...], value, dtype=jnp.float32) -> jax.Array:
+    """Fill a (rows, cols) array with ``value`` on-device."""
+    val = jnp.asarray([value], dtype=dtype)
+    return pl.pallas_call(
+        _fill_kernel,
+        out_shape=jax.ShapeDtypeStruct(shape, dtype),
+        interpret=use_interpret(),
+    )(val)
+
+
+def _iota2d_kernel(o_ref):
+    h, w = o_ref.shape
+    o_ref[:] = (
+        jax.lax.broadcasted_iota(o_ref.dtype, (h, w), 0) * w
+        + jax.lax.broadcasted_iota(o_ref.dtype, (h, w), 1)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "dtype"))
+def iota2d(shape: tuple[int, int], dtype=jnp.float32) -> jax.Array:
+    """Row-major linear index per cell — the InitKernel test pattern."""
+    return pl.pallas_call(
+        _iota2d_kernel,
+        out_shape=jax.ShapeDtypeStruct(shape, dtype),
+        interpret=use_interpret(),
+    )()

@@ -1,0 +1,219 @@
+"""Dot-product reduction kernels — the reference's CUDA reductions, TPU-way.
+
+The reference ships three CUDA strategies (SURVEY.md §2.3):
+atomicAdd finish (dot_product_kernel, mpicuda2.cu:65-81), two-phase
+per-block partials + host accumulate (partial_dot_product_kernel,
+mpicuda2.cu:84-100), and single-kernel full reduction where the last block
+(detected via __threadfence + atomicInc) reduces the partials
+(dot_product_full_kernel, mpicuda4.cu:157-185).
+
+On TPU the whole concurrency problem those strategies manage does not
+exist: a Pallas grid executes its steps **sequentially** on a core, so a
+running accumulator needs no atomics, fences, or last-block detection —
+the idiom is "initialize on first grid step, accumulate every step".
+Both reference shapes survive:
+
+- ``dot_partials``: per-block partials (two-phase shape) — one grid step
+  writes one partial; the caller sums them (a cheap fused XLA reduce).
+- ``dot_full``: single-kernel running accumulation (full-kernel shape) —
+  the output block is revisited by every grid step.
+
+fp32 accumulation regardless of input dtype (the fp32-only atomics
+limitation at mpicuda2.cu:52-64 does not carry over: bf16/fp32 inputs both
+accumulate in fp32).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuscratch.ops.common import LANES, to_lanes, use_interpret
+
+
+def _partials_kernel(off_ref, x_ref, y_ref, o_ref):
+    # o_ref is the whole partials vector in SMEM: scalar stores are an
+    # SMEM capability (VMEM wants >= (8,128) vector blocks), and the
+    # sequential grid makes the per-step slot write race-free
+    o_ref[pl.program_id(0)] = jnp.sum(
+        (x_ref[:].astype(jnp.float32) + off_ref[0])
+        * y_ref[:].astype(jnp.float32)
+    )
+
+
+def _full_kernel(off_ref, x_ref, y_ref, o_ref):
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    o_ref[...] += jnp.sum(
+        (x_ref[:].astype(jnp.float32) + off_ref[0])
+        * y_ref[:].astype(jnp.float32)
+    )[None, None]
+
+
+def _blocked(x: jax.Array, y: jax.Array, block_rows: int):
+    """Block two vectors for a gridded reduction.
+
+    Pads only to the 8x128 tile quantum, then clamps the block to the data
+    (small inputs don't pay for a full 512x128 block) and pads the row count
+    to a whole number of blocks.
+    """
+    if x.shape != y.shape:
+        raise ValueError(f"shape mismatch {x.shape} vs {y.shape}")
+    x2 = to_lanes(x)
+    rows = x2.shape[0]
+    block = min(block_rows, rows)
+    grid = (rows + block - 1) // block
+    pad_rows = grid * block - rows
+    if pad_rows:
+        x2 = jnp.pad(x2, ((0, pad_rows), (0, 0)))
+    y2 = to_lanes(y)
+    if pad_rows:
+        y2 = jnp.pad(y2, ((0, pad_rows), (0, 0)))
+    return x2, y2, grid, block
+
+
+def _offset_arg(offset) -> jax.Array:
+    """Normalize the optional elementwise offset to a (1,) f32 SMEM input.
+
+    ``dot(x + o, y)`` without materializing ``x + o``: the add happens
+    inside the kernel, so a loop-carried ``o`` (benchmark anti-hoisting,
+    dot_bench.dot_program) costs zero extra HBM traffic — the blocked
+    operands stay loop-invariant and XLA hoists their layout prep out of
+    the scan.
+    """
+    if offset is None:
+        return jnp.zeros((1,), jnp.float32)
+    return jnp.asarray(offset, jnp.float32).reshape(1)
+
+
+def prep(x: jax.Array, y: jax.Array, block_rows: int = 512):
+    """Block two vectors once for repeated prepped-kernel calls.
+
+    XLA does not hoist the pad/reshape out of a scan body on its own, so
+    a loop that calls ``dot_full``/``dot_partials`` directly pays a full
+    extra read+write of both vectors every iteration. Callers that
+    iterate (dot_bench.dot_program) prep once and pass the blocked
+    operands to ``dot_full_prepped``/``dot_partials_prepped``."""
+    return _blocked(x, y, block_rows)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows",))
+def dot_partials(x: jax.Array, y: jax.Array, block_rows: int = 512, offset=None) -> jax.Array:
+    """Two-phase reduction: Pallas per-block partials, XLA final sum.
+
+    Returns a float32 scalar. Parity: partial_dot_product_kernel + the
+    host-side std::accumulate finish (mpicuda2.cu:277-279) — except the
+    finish is a fused on-device reduce, not a host loop.
+    """
+    x2, y2, grid, block = _blocked(x, y, block_rows)
+    return dot_partials_prepped(x2, y2, block, offset=offset)
+
+
+def _check_prepped(x2: jax.Array, y2: jax.Array, block: int) -> None:
+    if x2.shape != y2.shape:
+        raise ValueError(f"prepped shape mismatch {x2.shape} vs {y2.shape}")
+    if x2.ndim != 2 or x2.shape[1] != LANES or x2.shape[0] % block:
+        raise ValueError(
+            f"prepped operands must be (k*{block}, {LANES}), got {x2.shape} "
+            "— use prep() with the same block_rows"
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def dot_partials_prepped(x2: jax.Array, y2: jax.Array, block: int, offset=None) -> jax.Array:
+    _check_prepped(x2, y2, block)
+    grid = x2.shape[0] // block
+    partials = pl.pallas_call(
+        _partials_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((grid,), jnp.float32),
+        interpret=use_interpret(),
+    )(_offset_arg(offset), x2, y2)
+    return jnp.sum(partials)
+
+
+@functools.partial(jax.jit, static_argnames=("block_rows",))
+def dot_full(x: jax.Array, y: jax.Array, block_rows: int = 512, offset=None) -> jax.Array:
+    """Single-kernel full reduction via a running accumulator.
+
+    Parity: dot_product_full_kernel (mpicuda4.cu:157-185) minus its entire
+    synchronization apparatus — TPU grid steps are sequential, so the
+    revisited output block IS the accumulator.
+    """
+    x2, y2, grid, block = _blocked(x, y, block_rows)
+    return dot_full_prepped(x2, y2, block, offset=offset)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def dot_full_prepped(x2: jax.Array, y2: jax.Array, block: int, offset=None) -> jax.Array:
+    _check_prepped(x2, y2, block)
+    grid = x2.shape[0] // block
+    out = pl.pallas_call(
+        _full_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+            pl.BlockSpec((block, LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        interpret=use_interpret(),
+    )(_offset_arg(offset), x2, y2)
+    return out[0, 0]
+
+
+def dot_prepped(x2: jax.Array, y2: jax.Array, block: int, method: str = "full", offset=None) -> jax.Array:
+    """Strategy dispatch over pre-blocked operands (see ``prep``) — the
+    one method-string table, shared with iterating callers like
+    dot_bench so the benchmark cannot silently diverge from the library."""
+    if method == "full":
+        return dot_full_prepped(x2, y2, block, offset=offset)
+    if method == "partials":
+        return dot_partials_prepped(x2, y2, block, offset=offset)
+    raise ValueError(f"unknown prepped dot method {method!r}")
+
+
+def dot(x: jax.Array, y: jax.Array, method: str = "full", block_rows: int = 512, offset=None) -> jax.Array:
+    """Dot product with strategy selection (REDUCE_GPU/REDUCE_CPU parity,
+    mpicuda4.cu:347-355, as a runtime argument instead of a #define).
+
+    methods: 'full' (single kernel), 'partials' (two-phase), 'xla'
+    (jnp reference path — the CPU-oracle analogue).
+    """
+    if method == "full":
+        return dot_full(x, y, block_rows, offset=offset)
+    if method == "partials":
+        return dot_partials(x, y, block_rows, offset=offset)
+    if method == "xla":
+        xf = x.astype(jnp.float32)
+        if offset is not None:
+            xf = xf + _offset_arg(offset)[0]  # fuses into the reduce
+        return jnp.dot(xf, y.astype(jnp.float32))
+    raise ValueError(f"unknown dot method {method!r}")
+
+
+def local_dot_psum(x_shard: jax.Array, y_shard: jax.Array, axis, method: str = "full", block_rows: int = 512, offset=None):
+    """SPMD body: per-shard kernel reduction + psum over ``axis``.
+
+    The distributed dot product end-to-end (mpicuda2-4 parity): each rank
+    reduces its shard on-device, then one data-plane collective combines
+    them (MPI_Reduce at mpicuda2.cu:293 -> lax.psum). Call inside
+    shard_map; see examples/dot_product.py for the driver.
+    """
+    return lax.psum(dot(x_shard, y_shard, method, block_rows, offset=offset), axis)

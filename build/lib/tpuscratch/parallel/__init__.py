@@ -1,0 +1,29 @@
+"""Long-context parallelism: ring pipelines and sequence/context parallel
+attention.
+
+The reference has no attention or sequence dimension (SURVEY.md §2.7), but
+its communication skeletons are exactly what long-context parallelism is
+built from: the ring/neighbor exchange (mpi5) and blockwise-partitioned
+reduction (mpicuda4's per-block partials). This package composes those
+primitives — already present in tpuscratch.comm — into the two standard
+sequence-parallel attention schemes:
+
+- ``ring``: a generic rotate-and-combine pipeline over a mesh axis
+  (the load-bearing structure of ring attention, ring allreduce, etc.).
+- ``ring_attention``: blockwise attention with KV blocks rotating around
+  the ring and online-softmax accumulation — O(seq/n) memory per chip,
+  communication overlapped hop by hop over ICI.
+- ``ulysses``: all-to-all sequence parallelism — switch from
+  sequence-sharded to head-sharded with one all_to_all, run exact local
+  attention, switch back.
+- ``pipeline``: staged (GPipe-style) pipeline parallelism — one stage per
+  rank, microbatches streaming through an open ppermute chain.
+- ``expert``: expert parallelism — capacity-routed MoE dispatch/combine
+  via all_to_all over an expert axis.
+"""
+
+from tpuscratch.parallel.expert import expert_parallel_ffn, topk_routing  # noqa: F401
+from tpuscratch.parallel.pipeline import bubble_fraction, pipeline_apply  # noqa: F401
+from tpuscratch.parallel.ring import ring_scan  # noqa: F401
+from tpuscratch.parallel.ring_attention import ring_attention  # noqa: F401
+from tpuscratch.parallel.ulysses import ulysses_attention  # noqa: F401

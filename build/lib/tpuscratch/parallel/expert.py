@@ -1,0 +1,149 @@
+"""Expert parallelism: routed MoE feed-forward over an expert mesh axis.
+
+Beyond-parity capability (the reference has no expert routing anywhere —
+SURVEY.md §2.7 lists EP as absent), but its structural ancestors are the
+same ones the reference exercises: the scatter of typed records to ranks
+(/root/reference/mpi8.cpp:53 struct scatter) and sub-communicator
+reduction (/root/reference/mpi9.cpp:51-54). Here tokens are the records,
+experts the ranks, and the transport is one ``all_to_all`` over ICI in
+each direction — the TPU-native replacement for per-pair Isend/Irecv.
+
+Scheme (Switch-Transformer style, einsum dispatch/combine so everything
+is static-shaped for XLA):
+
+1. route: a linear gate scores every local token against all experts;
+   top-k selection with per-(rank, expert) capacity ``C`` — tokens past
+   capacity are dropped (their combine weight is zero), keeping shapes
+   static.
+2. dispatch: ``einsum('tec,td->ecd')`` packs tokens into per-expert
+   capacity slots; ``all_to_all`` over the expert axis hands each rank
+   the slots of ITS experts from every rank.
+3. expert compute: each rank applies its local experts' FFN to its
+   (E_local, n*C, D) batch — a large static matmul per expert, MXU-shaped.
+4. combine: reverse ``all_to_all``, then ``einsum('tec,ecd->td')``
+   weighted by the gate probability restores token order.
+
+The load-balance auxiliary loss (mean fraction-routed x mean gate mass,
+scaled by E) is returned alongside — it is what keeps routing from
+collapsing onto one expert/rank.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuscratch.comm.collectives import all_to_all
+
+
+class Routing(NamedTuple):
+    """Static-shaped routing plan for one rank's tokens.
+
+    dispatch: (T, E, C) 0/1 — token t occupies slot c of expert e.
+    combine:  (T, E, C) float — dispatch weighted by the gate probability.
+    aux_loss: scalar load-balance loss (1.0 == perfectly uniform top-1).
+    """
+
+    dispatch: jax.Array
+    combine: jax.Array
+    aux_loss: jax.Array
+
+
+def capacity(tokens: int, n_experts: int, factor: float = 1.25) -> int:
+    """Per-expert capacity slots for ``tokens`` local tokens: the expected
+    even share times ``factor``, at least 1."""
+    return max(1, int(tokens * factor / n_experts))
+
+
+def topk_routing(logits: jax.Array, cap: int, k: int = 1) -> Routing:
+    """Top-k capacity routing from gate ``logits`` (T, E).
+
+    Experts are chosen greedily (iterated masked top-1, the standard
+    static-shaped formulation); each choice claims the next free capacity
+    slot of its expert, and choices past slot ``cap`` are dropped —
+    dropped tokens simply contribute zero to the combine, mirroring how
+    the reference keeps buffers fixed-size and probe-sized rather than
+    reallocating (/root/reference/mpi3.cpp:28-32).
+    """
+    T, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    remaining = probs
+    dispatch = jnp.zeros((T, E, cap), dtype=jnp.float32)
+    combine = jnp.zeros((T, E, cap), dtype=jnp.float32)
+    # slots already claimed per expert accumulate across the k rounds
+    used = jnp.zeros((E,), dtype=jnp.int32)
+    top1_frac = None
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)  # (T,)
+        gate = jnp.take_along_axis(remaining, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)  # (T, E)
+        if top1_frac is None:
+            top1_frac = onehot.astype(jnp.float32).mean(axis=0)  # (E,)
+        # slot index = tokens for the same expert ahead of me + already used
+        ahead = jnp.cumsum(onehot, axis=0) - onehot  # (T, E)
+        slot = (ahead + used[None, :]) * onehot  # valid where onehot
+        kept = (slot < cap) & (onehot == 1)
+        slot_1h = jax.nn.one_hot(
+            jnp.sum(slot, axis=-1), cap, dtype=jnp.float32
+        )  # (T, C)
+        sel = kept.astype(jnp.float32)  # (T, E)
+        dispatch = dispatch + sel[:, :, None] * slot_1h[:, None, :]
+        combine = combine + (gate[:, None] * sel)[:, :, None] * slot_1h[:, None, :]
+        used = used + jnp.sum(kept.astype(jnp.int32), axis=0)
+        remaining = remaining * (1 - onehot)  # mask chosen expert, next round
+    # Switch load-balance loss: E * <frac routed to e> . <mean gate prob e>
+    aux = E * jnp.sum(top1_frac * probs.mean(axis=0))
+    return Routing(dispatch, combine, aux)
+
+
+def expert_ffn(x: jax.Array, w_in: jax.Array, w_out: jax.Array) -> jax.Array:
+    """The per-expert MLP: (E, C', D) x (E, D, F) -> relu -> (E, C', D).
+
+    One batched einsum per layer — E experts' matmuls fused into a single
+    MXU-shaped contraction (vs the reference's one-kernel-per-rank
+    compute, /root/reference/mpicuda2.cu:265-275)."""
+    h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", x, w_in))
+    return jnp.einsum("ecf,efd->ecd", h, w_out).astype(x.dtype)
+
+
+def expert_parallel_ffn(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_in: jax.Array,
+    w_out: jax.Array,
+    axis: str,
+    capacity_factor: float = 1.25,
+    k: int = 1,
+) -> tuple[jax.Array, jax.Array]:
+    """Routed MoE layer, experts sharded over mesh ``axis``. Call inside
+    shard_map.
+
+    x: (T, D) local tokens. gate_w: (D, E_total) replicated gate.
+    w_in/w_out: (E_local, D, F)/(E_local, F, D) THIS rank's experts.
+    Returns (out (T, D), aux_loss scalar). E_total = axis_size * E_local.
+    """
+    n = lax.axis_size(axis)
+    T, D = x.shape
+    e_local = w_in.shape[0]
+    e_total = n * e_local
+    if gate_w.shape != (D, e_total):
+        raise ValueError(
+            f"gate_w {gate_w.shape} != ({D}, {e_total}) for "
+            f"{e_local} local experts on a {n}-way axis"
+        )
+    cap = capacity(T, e_total, capacity_factor)
+    route = topk_routing(x @ gate_w, cap, k=k)
+    # pack: (T, E_total, C) x (T, D) -> (E_total, C, D)
+    packed = jnp.einsum("tec,td->ecd", route.dispatch, x.astype(jnp.float32))
+    # route out: split experts across ranks, gather every rank's slots for
+    # mine -> (E_local, n*C, D)
+    routed = all_to_all(packed, axis, split_axis=0, concat_axis=1, tiled=True)
+    y = expert_ffn(routed, w_in.astype(jnp.float32), w_out.astype(jnp.float32))
+    # route back: inverse all_to_all -> (E_total, C, D), slots back at the
+    # rank whose tokens filled them
+    back = all_to_all(y, axis, split_axis=1, concat_axis=0, tiled=True)
+    out = jnp.einsum("tec,ecd->td", route.combine, back)
+    return out.astype(x.dtype), route.aux_loss

@@ -1,0 +1,79 @@
+"""Staged pipeline parallelism: microbatches streaming through a stage chain.
+
+Beyond-parity capability (the reference's closest structure is the 2-rank
+lock-step token passing of mpi4, SURVEY.md §2.7): each mesh rank owns ONE
+stage of a layer chain; activations hop stage-to-stage over an open
+ppermute chain while microbatches stream in behind each other — the GPipe
+schedule. With M microbatches over n stages the schedule runs M + n - 1
+ticks, so bubble overhead is (n-1)/(M+n-1); every tick every stage
+computes on a different microbatch, which is what makes it pipeline (not
+sequential) parallelism.
+
+SPMD formulation: one `lax.scan` over ticks inside shard_map. Stage
+parameters arrive pre-sharded over the stage axis (in_specs P("stage")),
+the microbatch stack is replicated, and the output stack is returned
+replicated via a masked psum from the last stage. Stage shapes must be
+uniform (every stage maps (..., F) -> (..., F)) — the standard equal-width
+pipeline regime.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+StageFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def pipeline_apply(
+    stage_fn: StageFn,
+    params: Any,
+    micro: jax.Array,
+    axis: str,
+) -> jax.Array:
+    """Apply the full stage chain to every microbatch, pipelined.
+
+    ``stage_fn(params, x)``: this rank's stage; shape-preserving.
+    ``params``: this rank's stage parameters (shard the stacked (n, ...)
+    parameters over ``axis`` via in_specs).
+    ``micro``: (M, ...) microbatch stack, replicated across the axis.
+    Returns the (M, ...) outputs of stage_{n-1}(...stage_0(x)...),
+    replicated. Call inside shard_map over ``axis``.
+    """
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    M = micro.shape[0]
+    if n == 1:
+        return jax.vmap(lambda x: stage_fn(params, x))(micro)
+    ticks = M + n - 1
+    shift = [(i, i + 1) for i in range(n - 1)]  # open chain: stage i -> i+1
+
+    out_buf = jnp.zeros_like(micro)
+    act0 = jnp.zeros_like(micro[0])
+
+    def tick(state, t):
+        act, out = state
+        incoming = lax.ppermute(act, axis, shift)
+        inject = jnp.where(t < M, micro[jnp.clip(t, 0, M - 1)], 0.0)
+        x = jnp.where(me == 0, inject, incoming)
+        y = stage_fn(params, x)
+        emit = t - (n - 1)  # microbatch index leaving the last stage
+        upd = lax.dynamic_update_slice(
+            out, y[None], (jnp.clip(emit, 0, M - 1),) + (0,) * y.ndim
+        )
+        out = jnp.where((me == n - 1) & (emit >= 0), upd, out)
+        return (y, out), ()
+
+    (_, out_buf), _ = lax.scan(tick, (act0, out_buf), jnp.arange(ticks))
+    # only the last stage's buffer holds results; replicate it
+    return lax.psum(jnp.where(me == n - 1, out_buf, 0.0), axis)
+
+
+def bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """Idle fraction of the GPipe schedule: (n-1)/(M+n-1)."""
+    if n_stages < 1 or n_micro < 1:
+        raise ValueError("need at least one stage and one microbatch")
+    return (n_stages - 1) / (n_micro + n_stages - 1)

@@ -1,0 +1,66 @@
+"""The generic ring pipeline: rotate a payload, combine at every hop.
+
+Structure: ``carry_{i+1} = combine(carry_i, payload from rank (me - i))``,
+with the payload moving one ring hop between combines. After axis-size
+hops every rank has combined every rank's payload exactly once, while only
+ever holding one block — the O(1)-memory property ring attention and ring
+allreduce share. The reference's structural ancestor is the mpi5 neighbor
+ring + the blockwise reduction of mpicuda4 (SURVEY.md §2.7).
+
+Compiled as one ``lax.scan``: n hops, each a ppermute + combine, which XLA
+can overlap (hop i's transfer runs while hop i-1's combine computes —
+communication/computation overlap over ICI).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, TypeVar
+
+import jax
+from jax import lax
+
+from tpuscratch.comm.p2p import ring_perm
+
+Carry = TypeVar("Carry")
+Combine = Callable[[Carry, Any, Any], Carry]
+
+
+def ring_scan(
+    combine: Combine,
+    init_carry: Carry,
+    payload,
+    axis: str,
+    reverse: bool = False,
+    return_payload: bool = True,
+):
+    """Run the rotate-and-combine pipeline over ``axis``.
+
+    ``combine(carry, block, hop) -> carry`` sees, at hop i, the payload
+    that started on rank ``(me - i) % n`` (or ``(me + i) % n`` when
+    ``reverse``). ``payload`` may be any pytree. Returns
+    (final_carry, payload): with ``return_payload`` the payload makes the
+    full n hops and arrives back home; without it the final (homeward)
+    rotation is skipped — one less block transfer per call, the right
+    choice when the caller discards the payload — and None is returned in
+    its place.
+    """
+    n = lax.axis_size(axis)
+    perm = ring_perm(n, -1 if reverse else 1, periodic=True)
+
+    def hop(state, i):
+        carry, block = state
+        carry = combine(carry, block, i)
+        block = jax.tree.map(lambda b: lax.ppermute(b, axis, perm), block)
+        return (carry, block), ()
+
+    if return_payload:
+        (carry, payload), _ = lax.scan(
+            hop, (init_carry, payload), jax.numpy.arange(n)
+        )
+        return carry, payload
+    if n > 1:
+        (init_carry, payload), _ = lax.scan(
+            hop, (init_carry, payload), jax.numpy.arange(n - 1)
+        )
+    carry = combine(init_carry, payload, jax.numpy.asarray(n - 1))
+    return carry, None

@@ -1,0 +1,116 @@
+"""Ring attention: exact attention over a sequence sharded around a ring.
+
+Each rank holds one block of the sequence: Q stays put, the (K, V) block
+rotates around the mesh axis; every hop combines the incoming KV block
+into a running online-softmax state (max, normalizer, weighted sum), so
+the full (seq x seq) score matrix never materializes and per-chip memory
+stays O(seq/n). The rotation is the framework's ring primitive
+(parallel.ring.ring_scan -> lax.ppermute over ICI); the accumulation is
+the blockwise-reduction pattern of the reference's partial-sums kernels
+(SURVEY.md §2.7 maps both skeletons).
+
+Causal masking works on global positions: rank r's Q block covers rows
+[r*S, (r+1)*S); the block arriving at hop i originated on rank
+(r - i) mod n and covers the matching K rows. Fully-masked hops contribute
+exp(-inf)=0 via the running max, so no special-casing per hop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuscratch.parallel.ring import ring_scan
+from tpuscratch.parallel.scores import NEG_INF, masked_scores
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    causal: bool = False,
+    impl: str = "xla",
+) -> jax.Array:
+    """Exact multi-head attention, sequence sharded over ``axis``.
+
+    q, k, v: (S, H, D) — this rank's block of a global (n*S, H, D)
+    sequence. Returns this rank's (S, H, D) block of the attention output,
+    bit-equivalent (up to fp assoc.) to attention on the gathered sequence.
+    Call inside shard_map with the sequence dimension sharded over
+    ``axis``.
+
+    ``impl``: 'xla' computes each hop's block scores densely; 'pallas'
+    runs the flash-attention kernel (ops.attention) per hop with
+    ``return_state=True`` and softmax-merges the per-hop (out, m, l) —
+    same math, MXU-scheduled, and the per-hop (H, S, S) score block never
+    materializes (the long-block regime).
+    """
+    if q.ndim != 3 or q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"expected equal (S,H,D) blocks, got {q.shape}/{k.shape}/{v.shape}")
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+    S, H, D = q.shape
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    q32 = q.astype(jnp.float32)
+
+    rows = me * S + jnp.arange(S)  # global Q positions
+
+    # online-softmax state: running max m, normalizer l, weighted sum o
+    init = (
+        jnp.full((H, S), NEG_INF, dtype=jnp.float32),
+        jnp.zeros((H, S), dtype=jnp.float32),
+        jnp.zeros((S, H, D), dtype=jnp.float32),
+    )
+
+    def combine_xla(state, kv_block, hop):
+        m, l, o = state
+        kb, vb = kv_block
+        src = (me - hop) % n  # origin rank of this KV block
+        cols = src * S + jnp.arange(S)  # global K positions
+        if causal:
+            mask = rows[:, None] >= cols[None, :]
+        else:
+            mask = jnp.ones((S, S), dtype=bool)
+        s = masked_scores(q32, kb, mask)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, :, None])          # (H, S, T)
+        # guard: when every score so far is masked, s - m_new == 0 for
+        # masked entries and exp would count them; zero them explicitly so
+        # correctness doesn't depend on the self-block arriving first
+        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
+        corr = jnp.exp(m - m_new)                   # (H, S)
+        l = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("hst,thd->shd", p, vb.astype(jnp.float32))
+        o = o * corr.T[:, :, None] + pv
+        return (m_new, l, o)
+
+    def combine_pallas(state, kv_block, hop):
+        from tpuscratch.ops.attention import flash_attention
+
+        m, l, o = state
+        kb, vb = kv_block
+        src = (me - hop) % n
+        # per-hop flash over this KV block, in global coordinates;
+        # acc_i is the hop's raw fp32 weighted sum (no normalization)
+        acc_i, m_i, l_i = flash_attention(
+            q, kb, vb, causal=causal,
+            q_offset=me * S, kv_offset=src * S, return_state=True,
+        )
+        # exact softmax-merge: rescale both sides to the new running max
+        m_new = jnp.maximum(m, m_i)
+        c_old = jnp.exp(m - m_new)                   # (H, S)
+        c_new = jnp.exp(m_i - m_new)
+        l_new = l * c_old + l_i * c_new
+        o_new = o * c_old.T[:, :, None] + acc_i * c_new.T[:, :, None]
+        return (m_new, l_new, o_new)
+
+    combine = combine_pallas if impl == "pallas" else combine_xla
+
+    # return_payload=False: the KV pair is discarded after the last hop, so
+    # the homeward rotation (one extra 2*S*H*D transfer) is skipped
+    (m, l, o), _ = ring_scan(combine, init, (k, v), axis, return_payload=False)
+    out = o / l.T[:, :, None]
+    return out.astype(q.dtype)

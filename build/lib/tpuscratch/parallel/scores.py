@@ -1,0 +1,24 @@
+"""Shared attention-score math for the sequence-parallel schemes.
+
+One definition of the scale, the mask sentinel, and the fp32 einsum so the
+ring and Ulysses paths (which tests assert agree) cannot silently diverge.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def masked_scores(q: jax.Array, k: jax.Array, mask: jax.Array) -> jax.Array:
+    """Masked scaled scores (H, S, T) in fp32.
+
+    q: (S, H, D), k: (T, H, D), mask: (S, T) boolean (True = attend).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum(
+        "shd,thd->hst", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    return jnp.where(mask[None, :, :], s, NEG_INF)

@@ -1,0 +1,81 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+Alternative to the ring scheme: instead of rotating KV blocks, one
+``all_to_all`` re-shards the activations from sequence-sharded to
+head-sharded, each rank runs exact attention for its head subset over the
+FULL sequence, and a second all_to_all restores sequence sharding.
+Two collectives total (vs n-1 ring hops) at the cost of requiring
+``n_heads % axis_size == 0`` and O(seq) memory for the gathered K/V of the
+local heads.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpuscratch.comm.collectives import all_to_all
+from tpuscratch.parallel.scores import masked_scores
+
+
+def _attn(q, k, v, causal: bool) -> jax.Array:
+    """Exact attention: q,k,v (S, H, D) -> (S, H, D), fp32 accumulation.
+
+    Materializes the (H, S, T) score block — fine for short sequences and
+    the CPU-mesh tests; the ``impl='pallas'`` path below avoids it."""
+    S, T = q.shape[0], k.shape[0]
+    if causal:
+        mask = jnp.arange(S)[:, None] >= jnp.arange(T)[None, :]
+    else:
+        mask = jnp.ones((S, T), dtype=bool)
+    p = jax.nn.softmax(masked_scores(q, k, mask), axis=-1)
+    return jnp.einsum("hst,thd->shd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str,
+    causal: bool = False,
+    impl: str = "xla",
+) -> jax.Array:
+    """Exact attention, sequence sharded over ``axis`` via all-to-all.
+
+    q, k, v: (S, H, D) blocks of a global (n*S, H, D) sequence with
+    n_heads H divisible by the axis size. Returns the (S, H, D) output
+    block. Call inside shard_map.
+
+    ``impl``: 'xla' materializes the local score block (simple, fine for
+    modest sequences); 'pallas' runs the flash-attention kernel
+    (ops.attention) — the local attention here covers the FULL global
+    sequence for this rank's head slice, so it is exactly where the
+    O(S^2) score materialization stops fitting and the blockwise kernel
+    matters (measured ~99 TFLOP/s non-causal / ~69 causal on v5e at
+    S=4096, H=8, D=128).
+    """
+    if q.ndim != 3 or q.shape != k.shape or q.shape != v.shape:
+        raise ValueError(f"expected equal (S,H,D) blocks, got {q.shape}/{k.shape}/{v.shape}")
+    S, H, D = q.shape
+    n = lax.axis_size(axis)
+    if H % n:
+        raise ValueError(f"n_heads {H} not divisible by axis size {n}")
+
+    def seq_to_heads(x):
+        # (S, H, D) seq-sharded -> (n*S, H/n, D) head-sharded
+        return all_to_all(x, axis, split_axis=1, concat_axis=0, tiled=True)
+
+    def heads_to_seq(x):
+        return all_to_all(x, axis, split_axis=0, concat_axis=1, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if impl == "pallas":
+        from tpuscratch.ops.attention import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal)
+    elif impl == "xla":
+        out = _attn(qh, kh, vh, causal)
+    else:
+        raise ValueError(f"unknown ulysses impl {impl!r}")
+    return heads_to_seq(out)

@@ -1,0 +1,20 @@
+"""Runtime bring-up layer: topology, mesh, config, errors, logging.
+
+TPU-native replacement for the reference's L2 runtime layer
+(``mpierr.h``, ``cuda_error_handler.h``, device binding and cartesian
+communicator setup — see SURVEY.md §1).
+"""
+
+from tpuscratch.runtime.topology import CartTopology, Direction  # noqa: F401
+from tpuscratch.runtime.mesh import make_mesh, make_mesh_1d, make_mesh_2d  # noqa: F401
+from tpuscratch.runtime.config import Config  # noqa: F401
+from tpuscratch.runtime.errors import CommError, ErrorPolicy, guarded  # noqa: F401
+from tpuscratch.runtime.context import RuntimeContext, initialize  # noqa: F401
+from tpuscratch.runtime.log import RankLogger  # noqa: F401
+from tpuscratch.runtime.memory import (  # noqa: F401
+    donate,
+    live_bytes,
+    memory_stats,
+    pin_to_host,
+    to_device,
+)

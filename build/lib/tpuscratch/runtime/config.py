@@ -1,0 +1,113 @@
+"""One typed configuration object replacing the reference's three config tiers.
+
+The reference configures behavior through (a) compile-time ``#define``
+switches — GPU, NO_LOG, REDUCE_CPU/REDUCE_GPU, DOUBLE_, MPI_RROBIN_,
+NO_GPU_MALLOC_TIME, HOST_COPY, PAGE_LOCKED, MPI_ERR_USE_EXCEPTIONS
+(/root/reference/mpicuda3.cu:18-24, mpi-pingpong-gpu-async.cpp:43-49,
+mpierr.h:48) — (b) argv for sizes (mpi-pingpong-gpu.cpp:31,
+mpi-2d-stencil-subarray-cuda.cu:131-138), and (c) env vars for runtime
+discovery (MV2_COMM_WORLD_LOCAL_RANK etc., -cuda.cu:46-69). Here all of it
+is one frozen dataclass, parseable from argv and env, passed explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from tpuscratch.runtime.errors import ErrorPolicy
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float64": jnp.float64,  # requires jax_enable_x64; fp64 parity w/ DOUBLE_
+    "int32": jnp.int32,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    # -- compute path ----------------------------------------------------
+    dtype: str = "float32"           # DOUBLE_ switch parity, but runtime-typed
+    use_pallas: bool = True          # GPU vs host-loop switch parity: pallas
+    #                                  kernel vs plain jnp reference path
+    block_rows: int = 512            # kernel block shape (BLOCK_SIZE parity,
+    #                                  mpicuda3.cu:65 raised 256->512)
+    reduce_on_device: bool = True    # REDUCE_GPU vs host-accumulate parity
+    # -- mesh ------------------------------------------------------------
+    mesh_shape: Optional[tuple[int, ...]] = None  # None = auto (all devices)
+    periodic: bool = True
+    # -- problem sizes (argv tier) ---------------------------------------
+    tile_width: int = 16             # reference default tile (subarray.cpp:71)
+    tile_height: int = 16
+    stencil_width: int = 5           # reference default 5x5 stencil
+    stencil_height: int = 5
+    elements: int = 1 << 20          # message/vector size (argv parity)
+    # -- instrumentation -------------------------------------------------
+    log: bool = True                 # NO_LOG parity
+    include_setup_time: bool = True  # NO_GPU_MALLOC_TIME parity
+    error_policy: ErrorPolicy = ErrorPolicy.RAISE  # MPI_ERR_USE_EXCEPTIONS
+
+    # ---- derived -------------------------------------------------------
+
+    @property
+    def jnp_dtype(self):
+        try:
+            return _DTYPES[self.dtype]
+        except KeyError:
+            raise ValueError(
+                f"unknown dtype {self.dtype!r}; choose from {sorted(_DTYPES)}"
+            ) from None
+
+    @property
+    def halo_width(self) -> int:
+        # ghost depth = stencil//2, as in stencil2D.h:116-117
+        return self.stencil_width // 2
+
+    @property
+    def halo_height(self) -> int:
+        return self.stencil_height // 2
+
+    # ---- construction --------------------------------------------------
+
+    @classmethod
+    def from_argv(cls, argv: Sequence[str], **overrides) -> "Config":
+        """CLI parity with the reference drivers: positional
+        ``[tile_w tile_h [stencil_w stencil_h]]`` (-cuda.cu:131-138, including
+        fixing its stencilHeight self-assignment bug) or ``elements`` for the
+        benchmarks (mpi-pingpong-gpu.cpp:31)."""
+        fields = dict(overrides)
+        args = [a for a in argv if not a.startswith("-")]
+        if len(args) == 1:
+            fields.setdefault("elements", int(args[0]))
+        elif len(args) >= 2:
+            fields.setdefault("tile_width", int(args[0]))
+            fields.setdefault("tile_height", int(args[1]))
+            if len(args) >= 3:
+                fields.setdefault("stencil_width", int(args[2]))
+            if len(args) >= 4:
+                fields.setdefault("stencil_height", int(args[3]))
+        return cls(**fields)
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None, **overrides) -> "Config":
+        """Env tier: TPUSCRATCH_* variables (runtime discovery only)."""
+        env = dict(os.environ if env is None else env)
+        fields = dict(overrides)
+        if "TPUSCRATCH_DTYPE" in env:
+            fields.setdefault("dtype", env["TPUSCRATCH_DTYPE"])
+        if "TPUSCRATCH_NO_LOG" in env:
+            fields.setdefault("log", env["TPUSCRATCH_NO_LOG"] not in ("1", "true"))
+        if "TPUSCRATCH_MESH" in env:  # e.g. "2x4"
+            fields.setdefault(
+                "mesh_shape", tuple(int(x) for x in env["TPUSCRATCH_MESH"].split("x"))
+            )
+        if env.get("TPUSCRATCH_ABORT_ON_ERROR", "") in ("1", "true", "yes"):
+            fields.setdefault("error_policy", ErrorPolicy.ABORT)
+        return cls(**fields)
+
+    def replace(self, **kw) -> "Config":
+        return dataclasses.replace(self, **kw)

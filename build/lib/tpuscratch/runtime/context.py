@@ -1,0 +1,107 @@
+"""Runtime bring-up: the framework's MPI_Init / rank / size / hostname layer.
+
+Reference prologue (every program): MPI_Init, MPI_Comm_size, MPI_Comm_rank,
+MPI_Get_processor_name (/root/reference/mpi1.cpp:11-14), error-handler
+installation (mpi2.cpp:32), and — for GPU programs — binding the process to a
+device from launcher env vars BEFORE init
+(/root/reference/stencil2d/mpi-2d-stencil-subarray-cuda.cu:40-73).
+
+TPU-native version: a single ``initialize()`` that (a) on multi-host slices
+calls ``jax.distributed.initialize`` (the rendezvous MPI_Init performs),
+(b) introspects process index/count, local/global devices and hostname, and
+(c) returns an immutable RuntimeContext. Device binding needs no env-var
+gymnastics: each jax process owns its local devices by construction — the
+property the reference's BindDevice hand-rolls with
+MV2_COMM_WORLD_LOCAL_RANK % device_count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import Optional, Sequence
+
+import jax
+
+from tpuscratch.runtime.errors import ErrorPolicy, guarded
+
+_initialized_distributed = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeContext:
+    """Identity of this process within the job (MPI rank/size analogue)."""
+
+    process_index: int
+    process_count: int
+    hostname: str
+    backend: str
+    local_devices: tuple
+    global_devices: tuple
+
+    @property
+    def is_root(self) -> bool:
+        return self.process_index == 0
+
+    @property
+    def local_device_count(self) -> int:
+        return len(self.local_devices)
+
+    @property
+    def global_device_count(self) -> int:
+        return len(self.global_devices)
+
+    def hello(self) -> str:
+        """'task N of M on HOST' — the mpi1 hello line (mpi1.cpp:15-16),
+        extended with the device identity the GPU programs log at startup
+        (mpicuda2.cu:203-209)."""
+        return (
+            f"process {self.process_index} of {self.process_count} on "
+            f"{self.hostname}: {self.local_device_count} local / "
+            f"{self.global_device_count} global {self.backend} device(s)"
+        )
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    error_policy: ErrorPolicy = ErrorPolicy.RAISE,
+) -> RuntimeContext:
+    """Bring up the distributed runtime and return this process's identity.
+
+    Single-host (tests, one TPU VM): pure introspection, no rendezvous.
+    Multi-host (TPU pod slice): pass any of coordinator_address /
+    num_processes / process_id (TPU pods auto-fill the rest); this performs
+    the collective rendezvous that MPI_Init performs under mpiexec.
+    """
+    global _initialized_distributed
+    with guarded("runtime initialize", error_policy):
+        wants_distributed = any(
+            a is not None for a in (coordinator_address, num_processes, process_id)
+        )
+        if wants_distributed and not _initialized_distributed:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+            _initialized_distributed = True
+        return RuntimeContext(
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            hostname=socket.gethostname(),
+            backend=jax.default_backend(),
+            local_devices=tuple(jax.local_devices()),
+            global_devices=tuple(jax.devices()),
+        )
+
+
+def node_census(ctx: RuntimeContext) -> int:
+    """Number of distinct hosts in the job.
+
+    The reference discovers this by rank 0 collecting every rank's hostname
+    into a std::set then broadcasting the count (mpicuda2.cu:118-156), to
+    implement round-robin GPU binding. jax already knows: process_count is
+    the host count on TPU pods (one process per host)."""
+    return ctx.process_count

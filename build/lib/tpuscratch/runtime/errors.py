@@ -1,0 +1,121 @@
+"""Error-handling layer with dual policy: raise or print-and-abort.
+
+TPU-native replacement for the reference's ``mpierr.h`` /
+``cuda_error_handler.h`` pair, which wrap every MPI/CUDA call and select at
+compile time (``MPI_ERR_USE_EXCEPTIONS``) between throwing an exception and
+printing the formatted error then calling ``MPI_Abort``
+(/root/reference/mpierr.h:30-52, /root/reference/cuda_error_handler.h:47-86).
+Here the policy is a runtime value carried in ``Config`` instead of a macro,
+and the "error class" string MPI provides becomes the exception's type name.
+
+XLA note: most failures the reference guards against (bad device pointers,
+launch errors, mismatched message sizes) are impossible by construction under
+jax — arrays carry their placement and shapes are checked at trace time. What
+remains worth guarding is host-side orchestration: mesh construction, shape
+mismatches between plan and data, device discovery, file IO. Async-execution
+errors (the class the reference documents as uncatchable at launch,
+cuda_error_handler.h:21-23) surface in jax at ``block_until_ready`` — the
+``guarded`` wrapper here is the right place to catch those too.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import sys
+import traceback
+from enum import Enum
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class CommError(RuntimeError):
+    """A failure in the communication/runtime layer, tagged with context."""
+
+    def __init__(self, op: str, message: str, rank: Optional[int] = None):
+        self.op = op
+        self.rank = rank
+        super().__init__(format_comm_err(op, message, rank))
+
+
+def format_comm_err(op: str, message: str, rank: Optional[int] = None) -> str:
+    """Format op + error + class, mirroring format_mpi_err_msg
+    (/root/reference/mpierr.h:15-28) which prints both the error string and
+    the error-class string."""
+    where = f"[rank {rank}] " if rank is not None else ""
+    return f"{where}{op}: {message}"
+
+
+class ErrorPolicy(Enum):
+    """RAISE = exception propagation; ABORT = print then hard-exit, the
+    analogue of HANDLE_MPI_ERROR_STDERR + MPI_Abort (mpierr.h:37-43)."""
+
+    RAISE = "raise"
+    ABORT = "abort"
+
+
+def _handle(exc: BaseException, op: str, policy: ErrorPolicy, rank: Optional[int]) -> None:
+    if policy is ErrorPolicy.ABORT:
+        print(
+            format_comm_err(op, f"{type(exc).__name__}: {exc}", rank),
+            file=sys.stderr,
+            flush=True,
+        )
+        traceback.print_exc()
+        # The whole-job teardown MPI_Abort performs is the scheduler's job on
+        # TPU slices; locally a nonzero hard exit is the faithful analogue.
+        os._exit(1)
+    raise CommError(op, f"{type(exc).__name__}: {exc}", rank) from exc
+
+
+@contextlib.contextmanager
+def guarded(op: str, policy: ErrorPolicy = ErrorPolicy.RAISE, rank: Optional[int] = None):
+    """Context manager guarding a block of runtime/comm calls.
+
+    Usage parity with the reference's ``MPI_(MPI_Init(...))`` wrapping of
+    every call (mpierr.h:48-52):
+
+        with guarded("mesh construction", cfg.error_policy, rank):
+            mesh = make_mesh_2d(...)
+    """
+    try:
+        yield
+    except CommError as exc:
+        # Already wrapped by an inner guard: don't re-wrap, but an ABORT
+        # policy must still abort (MPI_Abort parity).
+        if policy is ErrorPolicy.ABORT:
+            _handle(exc, exc.op, policy, exc.rank if exc.rank is not None else rank)
+        raise
+    except Exception as exc:  # SystemExit/KeyboardInterrupt pass through
+        _handle(exc, op, policy, rank)
+
+
+def guard_call(
+    fn: Callable[..., T],
+    *args,
+    op: Optional[str] = None,
+    policy: ErrorPolicy = ErrorPolicy.RAISE,
+    rank: Optional[int] = None,
+    **kwargs,
+) -> T:
+    """Functional form: ``guard_call(jax.block_until_ready, out, op="dot")``."""
+    name = op or getattr(fn, "__name__", "call")
+    with guarded(name, policy, rank):
+        return fn(*args, **kwargs)
+
+
+def guards(op: Optional[str] = None, policy: ErrorPolicy = ErrorPolicy.RAISE):
+    """Decorator form for whole entry points (each reference main() wraps its
+    body in try/catch under the exceptions policy, e.g. mpi2.cpp)."""
+
+    def deco(fn: Callable[..., T]) -> Callable[..., T]:
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs) -> T:
+            with guarded(op or fn.__name__, policy):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco

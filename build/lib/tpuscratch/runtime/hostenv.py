@@ -1,0 +1,105 @@
+"""Host-environment control: pinning a process to a virtual CPU device mesh.
+
+The framework's answer to the reference's trick of exercising multi-node code
+with N MPI ranks on one box (/root/reference/mpicuda2.cu:31-32): an N-device
+virtual CPU mesh via ``--xla_force_host_platform_device_count``. The only
+subtlety is environments where an accelerator PJRT plugin monkey-patches
+jax's backend lookup (e.g. the axon TPU tunnel in this image) so that ANY
+``jax.devices()`` call tries to claim the real chip — which hangs or wastes
+the single-chip session during CPU-only test runs. ``force_cpu_devices``
+defuses that by dropping the plugin's backend factory before first backend
+initialization.
+
+Must be called BEFORE any jax computation / ``jax.devices()`` in the process.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_devices(n: int = 8) -> None:
+    """Make this process see exactly ``n`` virtual CPU devices.
+
+    Safe to call only before jax initializes its backends; raises if a
+    backend already exists with the wrong platform.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", flag, flags
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from jax._src import xla_bridge as xb
+
+    # Accelerator plugins registered via sitecustomize (axon) both add a
+    # backend factory and may override the platforms config; drop the
+    # factory and pin the config so backends() never dials the chip.
+    for plugin in ("axon",):
+        try:
+            xb._backend_factories.pop(plugin, None)  # noqa: SLF001
+        except Exception:  # pragma: no cover - registry layout changed
+            pass
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if xb._default_backend is not None and xb._default_backend.platform != "cpu":  # noqa: SLF001
+        raise RuntimeError(
+            "force_cpu_devices() called after jax already initialized a "
+            f"non-CPU backend ({xb._default_backend.platform})"  # noqa: SLF001
+        )
+
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def on_device_requested() -> bool:
+    """True when TPUSCRATCH_ON_DEVICE asks for the real hardware mesh."""
+    return os.environ.get("TPUSCRATCH_ON_DEVICE", "").strip().lower() in _TRUTHY
+
+
+def ensure_devices(n: int = 8):
+    """Return jax with >= n visible devices (virtual CPU mesh unless opted out).
+
+    The single bring-up helper shared by examples and driver entry points:
+    unless TPUSCRATCH_ON_DEVICE requests real hardware, pins an n-device
+    virtual CPU mesh (only possible before jax's first backend init).
+    """
+    if not on_device_requested():
+        from jax._src import xla_bridge as xb
+
+        if xb._default_backend is None:  # noqa: SLF001
+            force_cpu_devices(n)
+        elif xb._default_backend.platform != "cpu":  # noqa: SLF001
+            raise RuntimeError(
+                "jax already initialized on platform "
+                f"'{xb._default_backend.platform}' without "  # noqa: SLF001
+                "TPUSCRATCH_ON_DEVICE=1 — refusing to run the CPU dev/test "
+                "path on real hardware; set TPUSCRATCH_ON_DEVICE=1 to opt "
+                "in, or call ensure_devices() before any jax use"
+            )
+    import jax
+
+    if len(jax.devices()) < n:
+        raise RuntimeError(
+            f"{len(jax.devices())} device(s) visible but {n} needed — jax "
+            "was already initialized (or TPUSCRATCH_ON_DEVICE is set) on a "
+            "smaller platform; call force_cpu_devices(n) before any jax "
+            "use, or run on a larger host"
+        )
+    return jax
+
+
+def on_tpu() -> bool:
+    """True when the default jax backend is a TPU (initializes backends)."""
+    import jax
+
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,133 @@
+"""Memory placement, staging, and introspection — the allocator layer.
+
+The reference's ``host_allocator.h`` is a std-compliant allocator over
+``cudaMallocHost``/``cudaFreeHost`` (host_allocator.h:72-83): page-locked
+host memory so staged transfers DMA at full speed, used by the pingpong
+PAGE_LOCKED ablation (test-benchmark/mpi-pingpong-gpu-async.cpp:43-49).
+
+TPU-natively the same capability is a *placement* property, not an
+allocator: every ``jax.Array`` lives in an XLA memory space — ``device``
+(HBM), ``pinned_host`` (page-locked host RAM, DMA-capable), or
+``unpinned_host`` — carried by its sharding's ``memory_kind``. Moving an
+array between spaces is ``jax.device_put`` with the same sharding under a
+different memory kind, which preserves the distributed layout. Manual
+buffer reuse (the other thing a CUDA allocator is for) becomes jit
+donation. This module wraps those idioms behind small named helpers and
+adds live-memory introspection in the spirit of the reference's capacity
+probing (mpicuda2.cu:44-47: cudaMalloc failures at 16 ranks).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+DEVICE = "device"
+PINNED_HOST = "pinned_host"
+UNPINNED_HOST = "unpinned_host"
+
+
+def _default_device():
+    import jax
+
+    return jax.devices()[0]
+
+
+def memory_kinds(device=None) -> tuple[str, ...]:
+    """Memory spaces addressable from ``device`` (e.g. device/pinned_host)."""
+    device = device if device is not None else _default_device()
+    return tuple(m.kind for m in device.addressable_memories())
+
+
+def supports_kind(kind: str, device=None) -> bool:
+    return kind in memory_kinds(device)
+
+
+def put(x, kind: str = DEVICE):
+    """Place ``x`` in memory space ``kind``, preserving its sharding.
+
+    The analogue of choosing the allocator in the reference: the array's
+    logical layout (shape, sharding over the mesh) is untouched; only the
+    memory space backing each shard changes.
+    """
+    import jax
+
+    sharding = x.sharding if hasattr(x, "sharding") else None
+    if sharding is None:  # numpy / python input: single-device placement
+        import jax.numpy as jnp
+
+        x = jnp.asarray(x)
+        sharding = x.sharding
+    return jax.device_put(x, sharding.with_memory_kind(kind))
+
+
+def pin_to_host(x):
+    """Stage ``x`` into page-locked host memory (cudaMallocHost analogue)."""
+    return put(x, PINNED_HOST)
+
+
+def to_device(x):
+    """Bring ``x`` (back) into device HBM."""
+    return put(x, DEVICE)
+
+
+def host_roundtrip(x, pinned: bool = True):
+    """Device -> host -> device staging pass; the HOST_COPY/PAGE_LOCKED
+    ablation pair (mpi-pingpong-gpu-async.cpp:59-70,43-49): ``pinned``
+    selects page-locked vs pageable host memory."""
+    kind = PINNED_HOST if pinned else UNPINNED_HOST
+    return to_device(put(x, kind))
+
+
+def donate(fn: Callable, argnums=0, **jit_kwargs):
+    """jit ``fn`` with donated input buffers — the TPU-native form of the
+    reference's in-place buffer reuse (send buffer == recv buffer patterns).
+    Donated inputs' HBM is handed to the outputs; callers must not reuse
+    the donated arrays afterwards."""
+    import jax
+
+    argnums = (argnums,) if isinstance(argnums, int) else tuple(argnums)
+    return jax.jit(fn, donate_argnums=argnums, **jit_kwargs)
+
+
+def live_bytes(device=None, kind: Optional[str] = None) -> int:
+    """Bytes held by live jax.Arrays on ``device`` (all devices if None),
+    optionally filtered by memory kind. A backend-independent census for
+    capacity probing where ``memory_stats`` is unavailable."""
+    import math
+
+    import jax
+
+    total = 0
+    for arr in jax.live_arrays():
+        try:
+            if kind is not None and arr.sharding.memory_kind != kind:
+                continue
+            devs = arr.sharding.device_set
+            if device is not None and device not in devs:
+                continue
+            if arr.is_deleted():
+                continue
+            # actual per-device footprint: one shard's bytes (replication
+            # means every device holds a full shard, so count each device)
+            shard_elems = math.prod(
+                arr.sharding.shard_shape(arr.shape)
+            )
+            shard_bytes = shard_elems * arr.dtype.itemsize
+            n_holding = 1 if device is not None else len(devs)
+            total += shard_bytes * n_holding
+        except Exception:  # array mid-deletion during iteration
+            continue
+    return total
+
+
+def memory_stats(device=None) -> dict:
+    """The backend's allocator stats (bytes_in_use etc.) when it reports
+    them, else a census dict built from live arrays."""
+    device = device if device is not None else _default_device()
+    stats = device.memory_stats() or {}
+    if stats:
+        return dict(stats)
+    return {
+        "bytes_in_use": live_bytes(device),
+        "source": "live_arrays_census",
+    }

@@ -1,0 +1,95 @@
+"""Device-mesh construction: the framework's communicator factory.
+
+TPU-native replacement for ``MPI_COMM_WORLD`` + sub-communicators + device
+binding. Where the reference binds each MPI rank to a GPU before MPI_Init
+(/root/reference/stencil2d/mpi-2d-stencil-subarray-cuda.cu:40-73) and builds
+cartesian communicators over ranks, here a ``jax.sharding.Mesh`` names the
+device axes once and every collective is addressed by axis name. A
+sub-communicator (``MPI_Comm_create`` in /root/reference/mpi9.cpp:27-44) is
+just a second mesh axis: collectives over one named axis run concurrently
+within each slice of the other, with no group objects to free.
+
+Device order contract: ``make_mesh(shape)`` reshapes ``jax.devices()``
+row-major, so mesh position == ``CartTopology`` rank == flat device index.
+All permutation tables built from ``CartTopology`` are therefore directly
+valid for ``lax.ppermute`` inside ``shard_map`` over these meshes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpuscratch.runtime.topology import CartTopology, factor2d
+
+
+def device_count(backend: Optional[str] = None) -> int:
+    return len(jax.devices(backend))
+
+
+def make_mesh(
+    shape: Sequence[int],
+    axis_names: Sequence[str],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh of the given shape over the first prod(shape) devices."""
+    shape = tuple(shape)
+    names = tuple(axis_names)
+    if len(shape) != len(names):
+        raise ValueError(f"shape {shape} and axis_names {names} length mismatch")
+    devs = list(devices) if devices is not None else jax.devices()
+    need = math.prod(shape)
+    if need > len(devs):
+        raise ValueError(f"mesh {shape} needs {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need], dtype=object).reshape(shape)
+    return Mesh(grid, names)
+
+
+def make_mesh_1d(
+    name: str = "x",
+    n: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1D mesh over all (or the first n) devices — the MPI_COMM_WORLD analogue."""
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs) if n is None else n
+    return make_mesh((n,), (name,), devs)
+
+
+def make_mesh_2d(
+    shape: Optional[tuple[int, int]] = None,
+    axis_names: tuple[str, str] = ("row", "col"),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """2D mesh; defaults to the most-square factorization of all devices.
+
+    The cartesian-communicator analogue (/root/reference/mpi10.cpp:27). A
+    square device count gives the reference drivers' sqrt(N) x sqrt(N) layout.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    if shape is None:
+        shape = factor2d(len(devs))
+    return make_mesh(shape, axis_names, devs)
+
+
+def topology_of(mesh: Mesh, periodic: bool | Sequence[bool] = True) -> CartTopology:
+    """The CartTopology matching a mesh's shape (rank == flat device index)."""
+    dims = tuple(mesh.devices.shape)
+    if isinstance(periodic, bool):
+        per = tuple(periodic for _ in dims)
+    else:
+        per = tuple(periodic)
+    return CartTopology(dims, per)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_along(mesh: Mesh, *axis_names: Optional[str]) -> NamedSharding:
+    """NamedSharding partitioning array dim i along mesh axis axis_names[i]."""
+    return NamedSharding(mesh, PartitionSpec(*axis_names))

@@ -1,0 +1,98 @@
+"""Tracing / profiling: spans, cross-rank wall-time, XLA profiler hooks.
+
+The reference's instrumentation is manual wall-clock spans — clock()
+begin/end gathered to rank 0 with the max-min convention
+(mpicuda3.cu:176-179,315-325), MPI_Wtime segment timing separating network
+from copy (mpi-pingpong-gpu.cpp:51-57), and a carve-out for one-time setup
+cost (NO_GPU_MALLOC_TIME, mpicuda3.cu:221-240). This module keeps those
+conventions and adds what the XLA runtime offers beyond them:
+
+- ``span``: a named, nestable wall-clock bracket with correct async
+  semantics (``block_until_ready`` on entry values it is asked to close
+  over) — the MPI_Wtime idiom without the async-dispatch footgun.
+- ``Timeline``: collects spans; ``cross_rank_span`` merges per-process
+  timelines with max(end)-min(begin).
+- ``trace``: context manager around ``jax.profiler`` emitting a
+  TensorBoard-readable XLA trace (device timelines, fusion names) — the
+  part clock() could never see.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Iterator
+
+import jax
+
+from tpuscratch.bench.timing import span_max_min
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    name: str
+    begin: float
+    end: float
+
+    @property
+    def seconds(self) -> float:
+        return self.end - self.begin
+
+
+class Timeline:
+    """Per-process span collector (one per rank; merge via cross_rank_span)."""
+
+    def __init__(self) -> None:
+        self.spans: list[Span] = []
+
+    @contextlib.contextmanager
+    def span(self, name: str, *sync) -> Iterator[None]:
+        """Wall-clock bracket. Any ``sync`` arrays are blocked on at both
+        edges so async dispatch cannot leak work in or out of the span."""
+        for s in sync:
+            jax.block_until_ready(s)
+        begin = time.perf_counter()
+        try:
+            yield
+        finally:
+            end = time.perf_counter()
+            self.spans.append(Span(name, begin, end))
+
+    def seconds(self, name: str) -> float:
+        """Total time across spans with this name."""
+        total = sum(s.seconds for s in self.spans if s.name == name)
+        if not any(s.name == name for s in self.spans):
+            raise KeyError(name)
+        return total
+
+    def report(self) -> str:
+        lines = [f"{s.name}: {s.seconds * 1e3:.3f} ms" for s in self.spans]
+        return "\n".join(lines)
+
+
+def cross_rank_span(timelines: list[Timeline], name: str) -> float:
+    """max(end) - min(begin) for ``name`` across per-rank timelines — the
+    mpicuda3 convention as a pure function over collected spans."""
+    begins, ends = [], []
+    for tl in timelines:
+        for s in tl.spans:
+            if s.name == name:
+                begins.append(s.begin)
+                ends.append(s.end)
+    return span_max_min(begins, ends)
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """XLA profiler trace (TensorBoard format) around a block of work."""
+    jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region visible in profiler timelines (TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)
