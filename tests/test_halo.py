@@ -553,3 +553,103 @@ class TestResidentStencil:
         spec = HaloSpec(layout=lay, topology=topo)
         with pytest.raises(ValueError, match="periodic"):
             run_stencil_resident(jnp.zeros(lay.padded_shape), spec, 2)
+
+
+class TestDmaImpl:
+    """ops.halo_dma.run_stencil_dma: the double-buffered remote-DMA halo
+    kernel must compute the exact Jacobi trajectory of the plain
+    exchange-then-compute path on every mesh shape, including the
+    degenerate self-wrap axes (where its channels become local copies).
+
+    Step counts cover every branch of the static schedule: inline head
+    (1..4), head+epilogue (5, 6), head+remainder+epilogue (7), and
+    head+pairs+epilogue (12)."""
+
+    @pytest.mark.parametrize("dims", [(2, 4), (1, 4), (2, 1), (1, 1)])
+    @pytest.mark.parametrize("steps", [1, 3, 5, 7, 12])
+    def test_matches_plain_core(self, dims, steps):
+        from tpuscratch.halo.driver import decompose
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        R, C = dims
+        TH, TW = 4, 5
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), (True, True))
+        lay = TileLayout(TH, TW, 1, 1)
+        spec = HaloSpec(layout=lay, topology=topo)
+        rng = np.random.default_rng(61)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+        tiles = jnp.asarray(decompose(world, topo, lay))
+
+        outs = {}
+        for name, fn in (
+            ("xla", lambda t: run_stencil(t, spec, steps)),
+            ("dma", lambda t: run_stencil_dma(t, spec, steps)),
+        ):
+            f = run_spmd(
+                mesh,
+                lambda x, fn=fn: fn(x[0, 0])[None, None],
+                P("row", "col", None, None),
+                P("row", "col", None, None),
+            )
+            outs[name] = np.asarray(f(tiles))[:, :, 1:-1, 1:-1]
+        np.testing.assert_allclose(outs["dma"], outs["xla"], rtol=1e-5, atol=1e-6)
+
+    def test_halo_refreshed_like_exchange(self):
+        # The returned padded tile carries a POST-run exchange (the
+        # resident-impl convention): halo == exchange of the final cores.
+        from tpuscratch.halo.driver import decompose
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        R, C, TH, TW = 2, 4, 4, 4
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), (True, True))
+        lay = TileLayout(TH, TW, 1, 1)
+        spec = HaloSpec(layout=lay, topology=topo)
+        rng = np.random.default_rng(62)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+        tiles = jnp.asarray(decompose(world, topo, lay))
+
+        f = run_spmd(
+            mesh,
+            lambda x: run_stencil_dma(x[0, 0], spec, 3)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        out = np.asarray(f(tiles))
+        g = run_spmd(
+            mesh,
+            lambda x: halo_exchange(x[0, 0], spec)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        refreshed = np.asarray(g(jnp.asarray(out)))
+        np.testing.assert_allclose(out, refreshed, rtol=1e-6)
+
+    def test_driver_dispatch(self):
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(63)
+        world = rng.standard_normal((8, 16)).astype(np.float32)
+        mesh = make_mesh_2d((2, 4))
+        got = distributed_stencil(world, steps=4, mesh=mesh, impl="dma")
+        plain = distributed_stencil(world, steps=4, mesh=mesh, impl="xla")
+        np.testing.assert_allclose(got, plain, rtol=1e-5, atol=1e-6)
+
+    def test_rejects_open_boundary(self):
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        lay = TileLayout(4, 4, 1, 1)
+        topo = CartTopology((2, 4), (True, False))
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="periodic"):
+            run_stencil_dma(jnp.zeros(lay.padded_shape), spec, 2)
+
+    def test_rejects_tiny_core(self):
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        lay = TileLayout(2, 8, 1, 1)
+        topo = CartTopology((1, 1), (True, True))
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="too small"):
+            run_stencil_dma(jnp.zeros(lay.padded_shape), spec, 2)

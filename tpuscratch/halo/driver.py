@@ -74,11 +74,17 @@ def make_stencil_program(
     """The compiled SPMD program: (rows, cols, ph, pw) tiles -> same, after
     ``steps`` exchange+compute iterations. ``impl='deep'`` selects the
     communication-avoiding trapezoid scheme (depth = the layout halo
-    width); ``impl='resident'`` the single-device VMEM-resident kernel.
+    width); ``impl='resident'`` the single-device VMEM-resident kernel;
+    ``impl='dma'`` the double-buffered remote-DMA Pallas kernel
+    (ops.halo_dma — core VMEM-resident, halo strips by async DMA).
     ``unroll`` is the scan unroll factor for the per-step impls and the
     kernel's inner unroll for 'resident' (defaults 1 and 8)."""
     if impl == "resident":
         step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=8 if unroll is None else unroll)[None, None]  # noqa: E731
+    elif impl == "dma":
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        step_fn = lambda t: run_stencil_dma(t[0, 0], spec, steps, coeffs)[None, None]  # noqa: E731
     elif impl in ("deep", "deep-pallas"):
         sub = "pallas" if impl == "deep-pallas" else "xla"
         step_fn = lambda t: run_stencil_deep(t[0, 0], spec, steps, coeffs, impl=sub)[None, None]  # noqa: E731

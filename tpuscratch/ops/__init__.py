@@ -17,6 +17,7 @@ is exercised by CPU tests and TPU benchmarks.
 
 from tpuscratch.ops.reduction import dot, dot_full, dot_partials  # noqa: F401
 from tpuscratch.ops.fill import fill, iota2d  # noqa: F401
+from tpuscratch.ops.halo_dma import run_stencil_dma  # noqa: F401
 from tpuscratch.ops.stencil_kernel import (  # noqa: F401
     five_point_blocked,
     five_point_pallas,

@@ -1,0 +1,361 @@
+"""Double-buffered remote-DMA halo stencil — the kernel-level async halo.
+
+The reference's hot loop posts all Irecvs, then all Isends, then one
+Waitall (ExchangeData, /root/reference/stencil2d/stencil2D.h:363-377),
+so the NIC moves ghost strips while the host is free to compute. The
+XLA-level analogue in ``halo.stencil.stencil_step_overlap`` merely hopes
+the compiler schedules the 8 ``ppermute``s concurrently with the interior
+FLOPs; this module makes the overlap structural. Each device's tile core
+stays resident in VMEM for the WHOLE multi-step run, and every step's
+ghost strips travel by inter-chip remote DMA
+(``pltpu.make_async_remote_copy``) that is started before — and completes
+under — the interior compute. Per direction there are TWO receive slots
+used alternately (double buffering), so step s+1's strips can fly while
+step s's are still being read, and a credit handshake (one semaphore per
+send channel) stops a sender from overwriting a slot its receiver has not
+consumed yet.
+
+Per-device protocol (SPMD, inside shard_map over the 2D mesh):
+
+    entry barrier with the 4 neighbors            [absorbs launch skew]
+    for s in 0..steps-1:
+        wait 1 credit per channel                 [only for s >= 2]
+        start 4 RDMAs: core edge strips -> neighbors' recv[s % 2]
+        interior <- 5-point(core interior)        [overlaps the DMAs]
+        wait the 4 arrival semaphores             [the Waitall]
+        ring <- 5-point(core ring, recv strips)
+        signal 1 credit back to each strip's sender  [only if s+2 < steps]
+        wait the 4 send semaphores                [source reuse is safe]
+
+Channel naming: channel ``d`` fills the RECEIVER's ``d``-side halo, so a
+device sends its ``opposite(d)`` core edge to its ``opposite(d)`` neighbor
+(e.g. channel TOP carries my bottom core row to my south neighbor, whose
+top halo row is exactly my bottom core row on the torus). Strips are one
+cell deep — all a 5-point stencil reads — independent of the layout's
+declared halo width; the caller re-wraps the padded tile afterwards.
+
+Axes of size 1 wrap onto the device itself; those channels become local
+VMEM-to-VMEM async copies (statically — the topology is compile-time), so
+a 1x1 mesh runs the same kernel as a self-wrap with no remote traffic,
+and the semaphore/credit machinery degenerates away where it is not
+needed. Semaphores all drain to zero by kernel exit (credits are only
+issued when a future step will consume them).
+
+Off-TPU the kernel runs under the Mosaic TPU interpreter
+(``pltpu.InterpretParams``), which simulates HBM/VMEM, DMAs, and
+semaphores on the CPU mesh — the same one-source dual-backend policy as
+``ops.common.use_interpret``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuscratch.halo.exchange import HaloSpec, halo_exchange
+from tpuscratch.halo.stencil import rebuild
+from tpuscratch.ops.common import use_interpret
+
+Coeffs = tuple[float, float, float, float, float]
+JACOBI: Coeffs = (0.25, 0.25, 0.25, 0.25, 0.0)
+
+#: Channel order: the halo side each channel fills at its receiver.
+TOP, BOTTOM, LEFT, RIGHT = range(4)
+
+#: Distinct collective_id for the barrier semaphore of this kernel family.
+_COLLECTIVE_ID = 11
+
+
+def _interior(src, coeffs: Coeffs):
+    """New values for core cells [1:H-1, 1:W-1] — no halo dependency."""
+    cn, cs, cw, ce, cc = coeffs
+    return (
+        cn * src[0:-2, 1:-1]
+        + cs * src[2:, 1:-1]
+        + cw * src[1:-1, 0:-2]
+        + ce * src[1:-1, 2:]
+        + cc * src[1:-1, 1:-1]
+    )
+
+
+def _ring(src, top, bot, left, right, coeffs: Coeffs):
+    """New values for the core's outermost ring, reading the freshly
+    arrived 1-deep strips. Returns (new_top_row, new_bottom_row,
+    new_left_col, new_right_col); the columns exclude the corner cells
+    (those are produced by the row pieces)."""
+    cn, cs, cw, ce, cc = coeffs
+    H = src.shape[0]
+    new_top = (
+        cn * top
+        + cs * src[1:2, :]
+        + cw * jnp.concatenate([left[0:1, :], src[0:1, :-1]], axis=1)
+        + ce * jnp.concatenate([src[0:1, 1:], right[0:1, :]], axis=1)
+        + cc * src[0:1, :]
+    )
+    new_bot = (
+        cn * src[-2:-1, :]
+        + cs * bot
+        + cw * jnp.concatenate([left[-1:, :], src[-1:, :-1]], axis=1)
+        + ce * jnp.concatenate([src[-1:, 1:], right[-1:, :]], axis=1)
+        + cc * src[-1:, :]
+    )
+    new_left = (
+        cn * src[0 : H - 2, 0:1]
+        + cs * src[2:H, 0:1]
+        + cw * left[1 : H - 1, :]
+        + ce * src[1 : H - 1, 1:2]
+        + cc * src[1 : H - 1, 0:1]
+    )
+    new_right = (
+        cn * src[0 : H - 2, -1:]
+        + cs * src[2:H, -1:]
+        + cw * src[1 : H - 1, -2:-1]
+        + ce * right[1 : H - 1, :]
+        + cc * src[1 : H - 1, -1:]
+    )
+    return new_top, new_bot, new_left, new_right
+
+
+def _make_kernel(dims: tuple[int, int], axes: tuple[str, str], steps: int, coeffs: Coeffs):
+    R, C = dims
+    ns_remote = R > 1  # north/south are other devices
+    ew_remote = C > 1
+
+    def kernel(in_ref, o_ref, buf_ref, r_top, r_bot, r_left, r_right, s_top, s_bot, s_left, s_right, send_sem, recv_sem, freed_sem):
+        H, W = in_ref.shape
+        row = lax.axis_index(axes[0])
+        col = lax.axis_index(axes[1])
+        north = lax.rem(row + R - 1, R) * C + col
+        south = lax.rem(row + 1, R) * C + col
+        west = row * C + lax.rem(col + C - 1, C)
+        east = row * C + lax.rem(col + 1, C)
+
+        # channel -> (destination device, receive-buffer ref)
+        # channel d fills the receiver's d-side halo, so its destination
+        # is my opposite(d) neighbor and my own arrival lands in recv[d].
+        dests = {TOP: south, BOTTOM: north, LEFT: east, RIGHT: west}
+        senders = {TOP: north, BOTTOM: south, LEFT: west, RIGHT: east}
+        bufs = {TOP: r_top, BOTTOM: r_bot, LEFT: r_left, RIGHT: r_right}
+        remote = {TOP: ns_remote, BOTTOM: ns_remote, LEFT: ew_remote, RIGHT: ew_remote}
+
+        # Edge strips cannot be DMA'd straight out of the core buffer: TPU
+        # DMA addresses whole (sublane, lane) tiles, so a 1-row slice at an
+        # arbitrary sublane offset or a 1-column lane slice is unaddressable.
+        # Each strip is therefore staged by a VPU copy into its own
+        # lane-padded (1, len) buffer (columns transposed to lane-major) and
+        # the DMA moves the whole staging buffer; the padded tail is never
+        # read. The reference's subarray datatypes solve the same
+        # strided-strip problem on the MPI side (stencil2D.h:210-228).
+        stages = {TOP: s_top, BOTTOM: s_bot, LEFT: s_left, RIGHT: s_right}
+
+        def stage(src_ref, ch):
+            if ch == TOP:      # my bottom row -> south's top halo
+                s_top[:, 0:W] = src_ref[H - 1 : H, :]
+            elif ch == BOTTOM:  # my top row -> north's bottom halo
+                s_bot[:, 0:W] = src_ref[0:1, :]
+            elif ch == LEFT:   # my right col -> east's left halo
+                s_left[:, 0:H] = jnp.swapaxes(src_ref[:, -1:], 0, 1)
+            else:              # my left col -> west's right halo
+                s_right[:, 0:H] = jnp.swapaxes(src_ref[:, 0:1], 0, 1)
+
+        if ns_remote or ew_remote:
+            # Entry barrier: nobody sends until all four partner devices
+            # have entered the kernel (their semaphores/scratch exist).
+            barrier = pltpu.get_barrier_semaphore()
+            n_remote = 0
+            for ch in (TOP, BOTTOM, LEFT, RIGHT):
+                if remote[ch]:
+                    pltpu.semaphore_signal(
+                        barrier, inc=1, device_id=dests[ch],
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    )
+                    n_remote += 1
+            pltpu.semaphore_wait(barrier, n_remote)
+
+        def one_step(src_ref, dst_ref, slot: int, wait_credit: bool, give_credit: bool):
+            copies = []
+            for ch in (TOP, BOTTOM, LEFT, RIGHT):
+                stage(src_ref, ch)
+                if remote[ch]:
+                    if wait_credit:
+                        pltpu.semaphore_wait(freed_sem.at[ch], 1)
+                    dma = pltpu.make_async_remote_copy(
+                        src_ref=stages[ch].at[:],
+                        dst_ref=bufs[ch].at[slot],
+                        send_sem=send_sem.at[ch],
+                        recv_sem=recv_sem.at[ch, slot],
+                        device_id=dests[ch],
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    )
+                else:
+                    # self-wrap axis: a local VMEM-to-VMEM async copy; no
+                    # credits needed — my own step order serializes reuse.
+                    dma = pltpu.make_async_copy(
+                        stages[ch].at[:],
+                        bufs[ch].at[slot],
+                        recv_sem.at[ch, slot],
+                    )
+                copies.append((ch, dma))
+                dma.start()
+
+            src = src_ref[:]
+            dst_ref[1:-1, 1:-1] = _interior(src, coeffs)  # overlaps the DMAs
+
+            for ch, dma in copies:
+                dma.wait_recv() if remote[ch] else dma.wait()
+
+            new_top, new_bot, new_left, new_right = _ring(
+                src,
+                bufs[TOP][slot][:, 0:W],
+                bufs[BOTTOM][slot][:, 0:W],
+                jnp.swapaxes(bufs[LEFT][slot][:, 0:H], 0, 1),
+                jnp.swapaxes(bufs[RIGHT][slot][:, 0:H], 0, 1),
+                coeffs,
+            )
+            dst_ref[0:1, :] = new_top
+            dst_ref[-1:, :] = new_bot
+            dst_ref[1:-1, 0:1] = new_left
+            dst_ref[1:-1, -1:] = new_right
+
+            for ch, dma in copies:
+                if remote[ch]:
+                    if give_credit:
+                        pltpu.semaphore_signal(
+                            freed_sem.at[ch], inc=1, device_id=senders[ch],
+                            device_id_type=pltpu.DeviceIdType.LOGICAL,
+                        )
+                    dma.wait_send()
+
+        # Static step schedule. Result must land in o_ref: with buffers
+        # alternating every step, step 0 writes o_ref iff steps is odd.
+        A, B = buf_ref, o_ref
+        dst0 = B if steps % 2 == 1 else A
+
+        def plan(s: int):
+            """(src, dst, slot, wait_credit, give_credit) for step s."""
+            src = in_ref if s == 0 else (dst0 if (s % 2 == 1) else (A if dst0 is B else B))
+            dst = dst0 if s % 2 == 0 else (A if dst0 is B else B)
+            return src, dst, s % 2, s >= 2, s + 2 <= steps - 1
+
+        # Steps 0..min(steps, 4)-1 inline (covers prologue with no credit
+        # wait and, for tiny step counts, the whole run)...
+        head = min(steps, 4)
+        for s in range(head):
+            src, dst, slot, w, g = plan(s)
+            one_step(src, dst, slot, w, g)
+
+        # ...then the steady state s in [4, steps-2) as a fori_loop of
+        # unrolled step pairs (all wait AND give credits; parity of s is
+        # static inside the pair), and a static epilogue for the last
+        # step(s), which wait but never give.
+        if steps > head:
+            mid = max(0, steps - 2 - head)  # steps in [head, steps-2): wait+give
+            pairs, rem = divmod(mid, 2)
+            s4, s5 = plan(4)[:2], plan(5)[:2]
+
+            def pair(_, carry):
+                one_step(s4[0], s4[1], 0, True, True)
+                one_step(s5[0], s5[1], 1, True, True)
+                return carry
+
+            if pairs > 0:
+                lax.fori_loop(0, pairs, pair, 0)
+            s = head + 2 * pairs
+            if rem:
+                src, dst, slot, _, _ = plan(s)
+                one_step(src, dst, slot, True, True)
+                s += 1
+            while s < steps:
+                src, dst, slot, _, _ = plan(s)
+                one_step(src, dst, slot, True, False)
+                s += 1
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "steps", "coeffs", "vmem_limit_bytes"))
+def run_stencil_dma(
+    tile: jax.Array,
+    spec: HaloSpec,
+    steps: int,
+    coeffs: Coeffs = JACOBI,
+    vmem_limit_bytes: int = 100 << 20,
+) -> jax.Array:
+    """``steps`` 5-point stencil iterations with the core VMEM-resident and
+    every halo exchange done by double-buffered (remote) DMA inside ONE
+    Pallas kernel. Call inside shard_map over ``spec.axes``, like
+    ``run_stencil``; the trailing padded-tile halo is refreshed by one
+    ordinary exchange so the result composes with the other impls.
+
+    This is the structural realization of the reference's
+    Isend-all/compute/Waitall overlap (stencil2D.h:363-377) — the transfers
+    are in flight WHILE the interior is computed, by construction rather
+    than by compiler scheduling luck.
+    """
+    lay = spec.layout
+    if tuple(tile.shape) != lay.padded_shape:
+        raise ValueError(f"tile {tile.shape} != padded {lay.padded_shape}")
+    if lay.halo_y < 1 or lay.halo_x < 1:
+        raise ValueError("5-point stencil needs halo >= 1 on both axes")
+    if not all(spec.topology.periodic):
+        raise ValueError("DMA halo stencil requires a periodic topology")
+    if min(lay.core_h, lay.core_w) < 3:
+        raise ValueError(
+            f"core {lay.core_h}x{lay.core_w} too small for the ring/interior "
+            "split (need >= 3 on both axes)"
+        )
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+
+    H, W = lay.core_h, lay.core_w
+    Hp = -(-H // 128) * 128  # lane-padded strip lengths (DMA granularity)
+    Wp = -(-W // 128) * 128
+    hy, hx = lay.halo_y, lay.halo_x
+    core = tile[hy : hy + H, hx : hx + W]
+    dt = core.dtype
+
+    need = 4 * core.size * dt.itemsize
+    if need > vmem_limit_bytes:
+        raise ValueError(
+            f"core {core.shape} needs ~{need >> 20} MB VMEM "
+            f"(> limit {vmem_limit_bytes >> 20} MB)"
+        )
+
+    kernel = _make_kernel(spec.topology.dims, tuple(spec.axes), steps, tuple(coeffs))
+    interpret = pltpu.InterpretParams() if use_interpret() else False
+    R, C = spec.topology.dims
+    # collective_id names the cross-device barrier; a 1x1 mesh has no
+    # remote channels, hence no barrier, and Mosaic rejects the id.
+    collective_kw = {"collective_id": _COLLECTIVE_ID} if (R > 1 or C > 1) else {}
+    new_core = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((H, W), dt),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((H, W), dt),       # second core slot (ping-pong)
+            pltpu.VMEM((2, 1, Wp), dt),   # recv: top halo row, 2 slots
+            pltpu.VMEM((2, 1, Wp), dt),   # recv: bottom halo row
+            pltpu.VMEM((2, 1, Hp), dt),   # recv: left halo col (lane-major)
+            pltpu.VMEM((2, 1, Hp), dt),   # recv: right halo col (lane-major)
+            pltpu.VMEM((1, Wp), dt),      # send stage: my bottom row
+            pltpu.VMEM((1, Wp), dt),      # send stage: my top row
+            pltpu.VMEM((1, Hp), dt),      # send stage: my right col, transposed
+            pltpu.VMEM((1, Hp), dt),      # send stage: my left col, transposed
+            pltpu.SemaphoreType.DMA((4,)),     # send completion per channel
+            pltpu.SemaphoreType.DMA((4, 2)),   # arrival per channel x slot
+            pltpu.SemaphoreType.REGULAR((4,)),  # credits per send channel
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit_bytes,
+            has_side_effects=True,
+            **collective_kw,
+        ),
+    )(core)
+    return halo_exchange(rebuild(tile, new_core, lay), spec)
