@@ -2,9 +2,11 @@
 
 Runs in Pallas interpret mode on the CPU mesh (conftest forces CPU
 devices); the same kernel source runs compiled on real TPUs, where it
-was probed at S=4096, H=8, D=128 (~99 TFLOP/s non-causal, ~69 causal).
+was probed at S=4096, H=8, D=128 (~108 TFLOP/s non-causal / ~75
+causal f32, ~110/~77 bf16 — BASELINE.md row 6).
 """
 
+import jax
 import numpy as np
 import pytest
 import jax.numpy as jnp
@@ -186,4 +188,62 @@ class TestFlashAttention:
         )
         np.testing.assert_allclose(
             got, dense_oracle(q, k, v, False), rtol=0.05, atol=0.05
+        )
+
+
+class TestCompactSquareAndBf16:
+    """The compact causal grid with square blocks (the tuned default
+    shape) and the bf16 MXU path must both match the dense oracle."""
+
+    def _oracle(self, q, k, v, causal):
+        S, H, D = q.shape
+        s = jnp.einsum("shd,thd->hst", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / float(D) ** 0.5
+        if causal:
+            mask = np.tril(np.ones((S, S), bool))
+            s = jnp.where(mask[None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("hst,thd->shd", p, v.astype(jnp.float32))
+
+    def test_square_blocks(self):
+        rng = np.random.default_rng(7)
+        S, H, D = 128, 2, 128
+        q, k, v = (jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+                   for _ in range(3))
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(self._oracle(q, k, v, True)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_square_blocks_grad(self):
+        rng = np.random.default_rng(8)
+        S, H, D = 64, 2, 128
+        q, k, v = (jnp.asarray(rng.standard_normal((S, H, D)), jnp.float32)
+                   for _ in range(3))
+        g = jax.grad(
+            lambda q: flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32
+            ).sum()
+        )(q)
+        go = jax.grad(lambda q: self._oracle(q, k, v, True).sum())(q)
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(go), rtol=1e-4, atol=1e-4
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_bf16_inputs(self, causal):
+        rng = np.random.default_rng(9)
+        S, H, D = 128, 2, 128
+        qf, kf, vf = (rng.standard_normal((S, H, D)).astype(np.float32)
+                      for _ in range(3))
+        q, k, v = (jnp.asarray(x, jnp.bfloat16) for x in (qf, kf, vf))
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        ref = self._oracle(
+            jnp.asarray(qf), jnp.asarray(kf), jnp.asarray(vf), causal
+        )
+        assert out.dtype == jnp.bfloat16
+        # bf16 has ~3 decimal digits; attention outputs are O(1)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
         )

@@ -24,27 +24,31 @@ from tpuscratch.ops.attention import flash_attention
 
 
 def attention_program(
-    causal: bool, rounds: int, block_q: int = 512, block_k: int = 1024,
+    causal: bool, rounds: int, block_q: int = 1024, block_k: int = 1024,
 ):
     """jit'd fn(q, k, v) running ``rounds`` flash calls in one scan.
 
-    The loop-carried q_offset is always 0 in value (derived from the
-    previous output times zero) but the compiler cannot prove it, so no
-    round is hoisted."""
+    Anti-hoisting: each round perturbs q by a loop-carried scalar that is
+    always 0 in value (previous output times zero) but that the compiler
+    cannot prove constant, so no round is hoisted. The perturbation is in
+    the DATA (one extra q-sized HBM read+write per round, ~5% at this
+    shape), not the offsets — offsets stay compile-time ints so the
+    benchmark measures the compact causal grid, the path real
+    self-attention callers take."""
 
     @jax.jit
     def run(q, k, v):
         def step(carry, _):
-            off, _prev = carry
+            eps, _prev = carry
             out = flash_attention(
-                q, k, v, causal=causal, q_offset=off,
+                q + eps, k, v, causal=causal,
                 block_q=block_q, block_k=block_k,
             )
             # carry (not stack) the output: stacked scan ys would
             # materialize rounds * S*H*D*4 bytes of HBM
-            return ((out[0, 0, 0] * 0).astype(jnp.int32), out), None
+            return (out[0, 0, 0] * 0, out), None
 
-        init = (jnp.int32(0), jnp.zeros(q.shape, q.dtype))
+        init = (jnp.zeros((), q.dtype), jnp.zeros(q.shape, q.dtype))
         (_, last), _ = lax.scan(step, init, None, length=rounds)
         return last
 
@@ -60,7 +64,7 @@ def bench_attention(
     iters: int = 3,
     fence: str = "readback",
     dtype=jnp.float32,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 1024,
     max_tflops: float = 250.0,
 ) -> BenchResult:

@@ -24,10 +24,14 @@ Kernel structure (the canonical TPU flash schedule):
 - both matmuls (scores = q @ k^T, update = p @ v) hit the MXU with
   ``preferred_element_type=float32``; the VPU handles the softmax
   bookkeeping in between.
-- the running max / normalizer live in (block_q, 128) VMEM scratch with
-  values broadcast across lanes: Mosaic wants lane-complete vector
-  stores, and a broadcast store + column-0 read is free compared to the
-  relayouts a (block_q, 1) slice store would trigger.
+- the running max / normalizer live in (block_q, 8) VMEM scratch with
+  values broadcast across the 8 lanes: Mosaic wants lane-complete vector
+  stores, 8 lanes is the narrowest legal layout, and a broadcast store +
+  column-0 read is free compared to the relayouts a (block_q, 1) slice
+  store would trigger.
+- bf16 inputs run the MXU passes in bf16 (fp32 accumulation), roughly
+  doubling the matmul rate vs the fp32-input path; the online-softmax
+  state stays fp32 throughout.
 """
 
 from __future__ import annotations
@@ -45,7 +49,39 @@ import numpy as np
 from tpuscratch.ops.common import mosaic_params, use_interpret
 from tpuscratch.parallel.scores import NEG_INF
 
-_LANE = 128
+#: Lane width of the m/l running-state planes. 8 is the narrowest layout
+#: Mosaic accepts for lane-complete stores; vs the 128-lane broadcast it
+#: cuts the per-KV-step state traffic 16x, measured worth ~3% non-causal
+#: and ~7% causal at S=4096 on v5e.
+_STATE_LANES = 8
+
+
+def _mm_dtype(ref):
+    """MXU operand dtype: bf16 inputs stay bf16 (native-rate systolic
+    passes, fp32 accumulation via preferred_element_type — the
+    FlashAttention-2 choice); everything else computes in fp32."""
+    return jnp.bfloat16 if ref.dtype == jnp.bfloat16 else jnp.float32
+
+
+def _raw_scores(q_ref, k_ref, scale):
+    """q @ k^T on the MXU, fp32 out, scale folded into the (bq, D) q
+    operand — 1/bk-th the VPU cost of scaling the (bq, bk) score
+    matrix after the matmul."""
+    q = q_ref[0].astype(_mm_dtype(q_ref)) * _mm_dtype(q_ref)(scale)
+    k = k_ref[0].astype(_mm_dtype(k_ref))
+    return lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _causal_mask(s, row0, col0, block_q: int, block_k: int):
+    """Mask ``s`` below the causal diagonal whose block origin is
+    (row0, col0) — origins may be traced (SMEM offsets) or static ints;
+    THE one masking definition for every kernel in this module."""
+    rows = row0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = col0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
 
 
 def _score_block(
@@ -54,38 +90,77 @@ def _score_block(
 ):
     """Scaled (and causally masked) score block + the masked-p guard.
 
-    THE one definition shared by the forward and both backward kernels —
-    a masking fix applied here cannot leave forward and gradient
-    inconsistent. Returns (s, guard) where ``p`` values must be passed
-    through ``jnp.where(guard, p, 0.0)`` after exponentiation (rows whose
-    every score is masked otherwise exponentiate s - m == 0)."""
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    s = lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale
+    THE one definition shared by the dense forward and both backward
+    kernels (the compact forward composes the same ``_raw_scores`` /
+    ``_causal_mask`` pieces with static offsets). A masking fix applied
+    here cannot leave forward and gradient inconsistent. Returns
+    (s, guard) where ``p`` values must be passed through
+    ``jnp.where(guard, p, 0.0)`` after exponentiation (rows whose every
+    score is masked otherwise exponentiate s - m == 0)."""
+    s = _raw_scores(q_ref, k_ref, scale)
     if causal:
-        rows = qoff_ref[0] + i * block_q + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
+        s = _causal_mask(
+            s, qoff_ref[0] + i * block_q, koff_ref[0] + j * block_k,
+            block_q, block_k,
         )
-        cols = koff_ref[0] + j * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(rows >= cols, s, NEG_INF)
     return s, s > NEG_INF * 0.5
 
 
 def _block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k):
     """Block-level causal skip predicate (shared by all three kernels):
     a KV block strictly above the Q block's last row contributes
-    nothing — its MXU/VPU work is skipped (~2x on long causal
-    sequences; the DMA still happens, which is what keeps the skip
-    correct under Mosaic's static pipeline)."""
+    nothing — its MXU/VPU work is skipped here, and its DMA is skipped
+    by the ``_kv_clamp``/``_q_clamp`` index maps, which pin the block
+    index at the diagonal so Mosaic's pipeline issues no new copy for
+    masked-out grid steps (~2x on long causal sequences)."""
     if not causal:
         return True
     first_masked_col = qoff_ref[0] + (i + 1) * block_q
     return koff_ref[0] + j * block_k < first_masked_col
+
+
+def _online_update(s, guard, v_ref, m_scr, l_scr, acc_scr):
+    """One online-softmax accumulation of a masked score block into the
+    running (m, l, acc) state — THE one update body shared by the dense
+    and compact forward kernels. ``guard`` zeroes fully-masked rows
+    (which keep m == NEG_INF, making s - m == 0 for masked entries) so
+    correctness is hop-order independent (same guard as
+    parallel/ring_attention.py); pass None for unmasked blocks."""
+    m_prev = m_scr[:, 0]                       # (block_q,)
+    l_prev = l_scr[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=1))
+    p = jnp.exp(s - m_new[:, None])
+    if guard is not None:
+        p = jnp.where(guard, p, 0.0)
+    corr = jnp.exp(m_prev - m_new)
+    l_new = l_prev * corr + p.sum(axis=1)
+    mmdt = _mm_dtype(v_ref)
+    acc_scr[...] = acc_scr[...] * corr[:, None] + lax.dot(
+        p.astype(mmdt), v_ref[0].astype(mmdt),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+
+def _emit_output(o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr):
+    """Final write-out, shared by the dense and compact forward kernels."""
+    if m_ref is None:
+        l_fin = l_scr[:, 0]
+        safe = jnp.where(l_fin > 0.0, l_fin, 1.0)  # fully-masked row->0
+        o_ref[0] = (acc_scr[...] / safe[:, None]).astype(o_ref.dtype)
+    else:
+        # state mode: emit the RAW fp32 accumulator (no divide, no
+        # dtype cast — the caller's softmax-merge stays exact) plus
+        # the running max / normalizer broadcast over an 8-lane
+        # plane. Mosaic requires lane-complete block stores and a
+        # sublane-divisible block shape, which rules out both a bare
+        # (1, block_q) state row and the full 128-lane broadcast;
+        # 8 lanes is the narrowest legal layout (column 0 is read
+        # back outside).
+        o_ref[0] = acc_scr[...]
+        m_ref[0] = m_scr[:, :8]
+        l_ref[0] = l_scr[:, :8]
 
 
 def _flash_kernel(
@@ -108,41 +183,13 @@ def _flash_kernel(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         )
-        m_prev = m_scr[:, 0]                       # (block_q,)
-        l_prev = l_scr[:, 0]
-        m_new = jnp.maximum(m_prev, s.max(axis=1))
-        p = jnp.exp(s - m_new[:, None])
-        # fully-masked rows keep m_new == NEG_INF, making s - m_new == 0
-        # for masked entries; zero them so correctness is hop-order
-        # independent (same guard as parallel/ring_attention.py)
-        p = jnp.where(guard, p, 0.0)
-        corr = jnp.exp(m_prev - m_new)
-        l_new = l_prev * corr + p.sum(axis=1)
-        acc_scr[...] = acc_scr[...] * corr[:, None] + lax.dot(
-            p, v_ref[0].astype(jnp.float32),
-            preferred_element_type=jnp.float32,
+        _online_update(
+            s, guard if causal else None, v_ref, m_scr, l_scr, acc_scr
         )
-        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
     @pl.when(j == nk - 1)
     def _emit():
-        if m_ref is None:
-            l_fin = l_scr[:, 0]
-            safe = jnp.where(l_fin > 0.0, l_fin, 1.0)  # fully-masked row->0
-            o_ref[0] = (acc_scr[...] / safe[:, None]).astype(o_ref.dtype)
-        else:
-            # state mode: emit the RAW fp32 accumulator (no divide, no
-            # dtype cast — the caller's softmax-merge stays exact) plus
-            # the running max / normalizer broadcast over an 8-lane
-            # plane. Mosaic requires lane-complete block stores and a
-            # sublane-divisible block shape, which rules out both a bare
-            # (1, block_q) state row and the full 128-lane broadcast;
-            # 8 lanes is the narrowest legal layout (column 0 is read
-            # back outside).
-            o_ref[0] = acc_scr[...]
-            m_ref[0] = m_scr[:, :8]
-            l_ref[0] = l_scr[:, :8]
+        _emit_output(o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr)
 
 
 def _flash_kernel_state(
@@ -156,6 +203,151 @@ def _flash_kernel_state(
         qoff_ref, koff_ref, q_ref, k_ref, v_ref, o_ref,
         m_scr, l_scr, acc_scr, m_ref=m_ref, l_ref=l_ref, **kw,
     )
+
+
+# ---- compact causal grid -------------------------------------------------
+#
+# The dense (H, nq, nk) causal grid wastes two things even with the
+# index-map DMA clamp: ~40% of grid steps are empty (masked-out blocks
+# still step the pipeline), and every COMPUTED block pays the
+# iota/compare/select masking cost although only the blocks straddling
+# the diagonal need it. Measured on v5e at S=4096 (f32, bq=512/bk=1024)
+# the two together cap causal at ~70 of the ~78 TFLOP/s the block
+# granularity allows. The compact grid schedules exactly the needed
+# (q block, kv block) pairs — grid (H, n_pairs) — through scalar-prefetch
+# index tables, classifying each pair full (no mask math) or diagonal
+# (masked): the splash-attention idea, rebuilt for this kernel's layout.
+# Offsets must be compile-time ints (self-attention's 0/0 case); ring
+# hops with traced offsets take the dense grid.
+
+_FLAG_MASKED = 1  # block straddles the diagonal: apply the causal mask
+_FLAG_EMIT = 2    # last scheduled kv block for this q block: emit output
+
+
+def _compact_applies(bq: int, dq_off: int) -> bool:
+    """The compact schedule exists iff even the FIRST q block reaches the
+    diagonal (its last kv block index is >= 0); later blocks only reach
+    further. Cheap dispatch test — ``_causal_pairs`` builds the actual
+    tables inside the jitted path."""
+    return dq_off + bq - 1 >= 0
+
+
+def _causal_pairs(nq, nk, bq, bk, dq_off: int):
+    """Static (i, j, flags) schedule for causal attention with
+    row-col offset difference ``dq_off = q_offset - kv_offset``.
+    Returns None when some q block needs no kv block at all (fully
+    masked rows) — the dense grid handles that case."""
+    pairs = []
+    for i in range(nq):
+        last = min(nk - 1, (dq_off + (i + 1) * bq - 1) // bk)
+        if last < 0:
+            return None
+        for j in range(last + 1):
+            full = (j + 1) * bk - 1 <= dq_off + i * bq
+            flags = (0 if full else _FLAG_MASKED) | (
+                _FLAG_EMIT if j == last else 0
+            )
+            pairs.append((i, j, flags))
+    return pairs
+
+
+def _flash_kernel_compact(
+    i_tab, j_tab, flag_tab, q_ref, k_ref, v_ref, *rest,
+    scale: float, qoff: int, koff: int, block_q: int, block_k: int,
+    state: bool,
+):
+    if state:
+        o_ref, m_ref, l_ref = rest[0], rest[1], rest[2]
+        m_scr, l_scr, acc_scr = rest[3:]
+    else:
+        o_ref, m_ref, l_ref = rest[0], None, None
+        m_scr, l_scr, acc_scr = rest[1:]
+    p = pl.program_id(1)
+    i, j, flags = i_tab[p], j_tab[p], flag_tab[p]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def update(masked: bool):
+        s = _raw_scores(q_ref, k_ref, scale)
+        guard = None
+        if masked:
+            s = _causal_mask(
+                s, qoff + i * block_q, koff + j * block_k, block_q, block_k
+            )
+            guard = s > NEG_INF * 0.5
+        _online_update(s, guard, v_ref, m_scr, l_scr, acc_scr)
+
+    @pl.when(flags & _FLAG_MASKED != 0)
+    def _diagonal():
+        update(True)
+
+    @pl.when(flags & _FLAG_MASKED == 0)
+    def _full():
+        update(False)
+
+    @pl.when(flags & _FLAG_EMIT != 0)
+    def _emit():
+        _emit_output(o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr)
+
+
+def _flash_fwd_compact(qh, kh, vh, qoff: int, koff: int, bq, bk,
+                       return_state):
+    """Compact-causal-grid forward. ``qoff``/``koff`` are Python ints
+    (folded into the kernel); returns None when the schedule does not
+    apply (caller falls back to the dense grid)."""
+    H, S, D = qh.shape
+    T = kh.shape[1]
+    nq, nk = S // bq, T // bk
+    pairs = _causal_pairs(nq, nk, bq, bk, qoff - koff)
+    if pairs is None:
+        return None
+    i_tab = jnp.asarray([p[0] for p in pairs], jnp.int32)
+    j_tab = jnp.asarray([p[1] for p in pairs], jnp.int32)
+    flag_tab = jnp.asarray([p[2] for p in pairs], jnp.int32)
+    scale = 1.0 / float(D) ** 0.5
+    kern = functools.partial(
+        _flash_kernel_compact,
+        scale=scale, qoff=qoff, koff=koff, block_q=bq, block_k=bk,
+        state=return_state,
+    )
+    params = mosaic_params(dimension_semantics=("parallel", "arbitrary"))
+    qspec = pl.BlockSpec((1, bq, D), lambda h, p, it, jt, ft: (h, it[p], 0))
+    kvspec = pl.BlockSpec((1, bk, D), lambda h, p, it, jt, ft: (h, jt[p], 0))
+    in_specs = [qspec, kvspec, kvspec]
+    inputs = [qh, kh, vh]
+    out_specs = [qspec]
+    out_shape = [jax.ShapeDtypeStruct((H, S, D), qh.dtype)]
+    if return_state:
+        out_shape[0] = jax.ShapeDtypeStruct((H, S, D), jnp.float32)
+        out_specs += [
+            pl.BlockSpec((1, bq, 8), lambda h, p, it, jt, ft: (h, it[p], 0))
+        ] * 2
+        out_shape += [jax.ShapeDtypeStruct((H, S, 8), jnp.float32)] * 2
+    res = pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(H, len(pairs)),
+            in_specs=in_specs,
+            out_specs=out_specs if return_state else out_specs[0],
+            scratch_shapes=[
+                pltpu.VMEM((bq, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((bq, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((bq, D), jnp.float32),
+            ],
+        ),
+        out_shape=out_shape if return_state else out_shape[0],
+        interpret=use_interpret(),
+        **params,
+    )(i_tab, j_tab, flag_tab, *inputs)
+    if return_state:
+        acc, m, l = res
+        return acc, m[..., 0], l[..., 0]
+    return res
 
 
 def _pick_block(n: int, want: int, name: str) -> int:
@@ -194,9 +386,10 @@ def _dq_kernel(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         )
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        mmdt = _mm_dtype(k_ref)
+        k = k_ref[0].astype(mmdt)
+        v = v_ref[0].astype(mmdt)
+        do = do_ref[0].astype(mmdt)
         lse = lse_ref[0][:, 0]
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(guard, p, 0.0)  # fully-masked-row guard
@@ -205,8 +398,10 @@ def _dq_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0][:, 0][:, None])
-        dq_scr[...] += scale * lax.dot(
-            ds, k, preferred_element_type=jnp.float32
+        # scale folded into the small (bk, D) k operand, not (bq, bk) ds
+        dq_scr[...] += lax.dot(
+            ds.astype(mmdt), k * mmdt(scale),
+            preferred_element_type=jnp.float32,
         )
 
     @pl.when(j == nk - 1)
@@ -233,15 +428,16 @@ def _dkv_kernel(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         )
-        q = q_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        mmdt = _mm_dtype(q_ref)
+        q = q_ref[0].astype(mmdt)
+        v = v_ref[0].astype(mmdt)
+        do = do_ref[0].astype(mmdt)
         lse = lse_ref[0][:, 0]
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(guard, p, 0.0)
         # dv += p^T @ do ; ds = p * (do v^T - delta) ; dk += ds^T @ q
         dv_scr[...] += lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(mmdt), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = lax.dot_general(
@@ -249,8 +445,8 @@ def _dkv_kernel(
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta_ref[0][:, 0][:, None])
-        dk_scr[...] += scale * lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+        dk_scr[...] += lax.dot_general(
+            ds.astype(mmdt), q * mmdt(scale), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -262,6 +458,40 @@ def _dkv_kernel(
 
 def _plane(x):  # (H, S) -> (H, S, 8) lane-broadcast input plane
     return jnp.broadcast_to(x[:, :, None], (*x.shape, 8))
+
+
+def _kv_clamp(causal, bq, bk, nk):
+    """KV-side index map for the (h, q block, kv block) grids.
+
+    For causal attention the map clamps the kv block index to the last
+    block touching the q block's diagonal: grid steps beyond it keep the
+    SAME block index, and Mosaic's pipeline only issues a copy when the
+    index changes — so masked-out KV blocks cost neither compute (the
+    ``_block_needed`` guard) nor DMA (this clamp). The offsets arrive as
+    scalar-prefetch arguments, so ring hops with rotated origins clamp
+    correctly at runtime."""
+    if not causal:
+        return lambda h, i, j, qoff, koff: (h, j, 0)
+
+    def imap(h, i, j, qoff, koff):
+        last = (qoff[0] - koff[0] + (i + 1) * bq - 1) // bk
+        return h, jnp.maximum(0, jnp.minimum(j, last)), 0
+
+    return imap
+
+
+def _q_clamp(causal, bq, bk, nq):
+    """Q-side index map for the (h, kv block, q block) dkv grid: the
+    mirror clamp — q blocks strictly above a kv block's diagonal are
+    masked, so the index is pinned at the first contributing q block."""
+    if not causal:
+        return lambda h, j, i, qoff, koff: (h, i, 0)
+
+    def imap(h, j, i, qoff, koff):
+        first = (koff[0] - qoff[0] + j * bk) // bq
+        return h, jnp.minimum(nq - 1, jnp.maximum(i, first)), 0
+
+    return imap
 
 
 def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk,
@@ -280,44 +510,48 @@ def _flash_bwd_call(q, k, v, do, lse, delta, qoff, koff, causal, bq, bk,
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
     lse_p, delta_p = _plane(lse), _plane(delta)
-    qspec = pl.BlockSpec((1, bq, D), lambda h, a, b: (h, a, 0))
-    kspec = pl.BlockSpec((1, bk, D), lambda h, a, b: (h, b, 0))
-    rowspec = pl.BlockSpec((1, bq, 8), lambda h, a, b: (h, a, 0))
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    qspec = pl.BlockSpec((1, bq, D), lambda h, a, b, *_: (h, a, 0))
+    kspec = pl.BlockSpec((1, bk, D), _kv_clamp(causal, bq, bk, nk))
+    rowspec = pl.BlockSpec((1, bq, 8), lambda h, a, b, *_: (h, a, 0))
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             block_q=bq, block_k=bk, nk=nk,
         ),
-        grid=(H, nq, nk),
-        in_specs=[smem, smem, qspec, kspec, kspec, qspec, rowspec, rowspec],
-        out_specs=qspec,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(H, nq, nk),
+            in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
         out_shape=jax.ShapeDtypeStruct((H, S, D), out_dtype or q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=interpret,
         **params,
     )(qoff, koff, q, k, v, do, lse_p, delta_p)
     # dkv grid: (h, kv block, q block); q-side specs index by the LAST
     # grid axis now
-    qspec2 = pl.BlockSpec((1, bq, D), lambda h, b, a: (h, a, 0))
-    kspec2 = pl.BlockSpec((1, bk, D), lambda h, b, a: (h, b, 0))
-    rowspec2 = pl.BlockSpec((1, bq, 8), lambda h, b, a: (h, a, 0))
+    qspec2 = pl.BlockSpec((1, bq, D), _q_clamp(causal, bq, bk, nq))
+    kspec2 = pl.BlockSpec((1, bk, D), lambda h, b, a, *_: (h, b, 0))
+    rowspec2 = pl.BlockSpec((1, bq, 8), _q_clamp(causal, bq, bk, nq))
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             block_q=bq, block_k=bk, nq=nq,
         ),
-        grid=(H, nk, nq),
-        in_specs=[smem, smem, kspec2, kspec2, qspec2, qspec2,
-                  rowspec2, rowspec2],
-        out_specs=[kspec2, kspec2],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(H, nk, nq),
+            in_specs=[kspec2, kspec2, qspec2, qspec2, rowspec2, rowspec2],
+            out_specs=[kspec2, kspec2],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((H, T, D), out_dtype or k.dtype),
             jax.ShapeDtypeStruct((H, T, D), out_dtype or v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, D), jnp.float32),
-            pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=interpret,
         **params,
@@ -340,30 +574,32 @@ def _flash_fwd_call(qh, kh, vh, qoff, koff, causal, bq, bk, return_state):
     params = mosaic_params(
         dimension_semantics=("parallel", "parallel", "arbitrary")
     )
-    out_specs = [pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0))]
+    kvspec = pl.BlockSpec((1, bk, D), _kv_clamp(causal, bq, bk, nk))
+    out_specs = [pl.BlockSpec((1, bq, D), lambda h, i, j, *_: (h, i, 0))]
     out_shape = [jax.ShapeDtypeStruct((H, S, D), qh.dtype)]
     if return_state:
         # raw fp32 accumulator + 8-lane state planes (column 0 = value)
         out_shape[0] = jax.ShapeDtypeStruct((H, S, D), jnp.float32)
-        out_specs += [pl.BlockSpec((1, bq, 8), lambda h, i, j: (h, i, 0))] * 2
+        out_specs += [pl.BlockSpec((1, bq, 8), lambda h, i, j, *_: (h, i, 0))] * 2
         out_shape += [jax.ShapeDtypeStruct((H, S, 8), jnp.float32)] * 2
     res = pl.pallas_call(
         kern,
-        grid=(H, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda h, i, j: (h, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda h, i, j: (h, j, 0)),
-        ],
-        out_specs=out_specs if return_state else out_specs[0],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(H, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda h, i, j, *_: (h, i, 0)),
+                kvspec,
+                kvspec,
+            ],
+            out_specs=out_specs if return_state else out_specs[0],
+            scratch_shapes=[
+                pltpu.VMEM((bq, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((bq, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((bq, D), jnp.float32),
+            ],
+        ),
         out_shape=out_shape if return_state else out_shape[0],
-        scratch_shapes=[
-            pltpu.VMEM((bq, _LANE), jnp.float32),
-            pltpu.VMEM((bq, _LANE), jnp.float32),
-            pltpu.VMEM((bq, D), jnp.float32),
-        ],
         interpret=interpret,
         **params,
     )(qoff, koff, qh, kh, vh)
@@ -406,10 +642,83 @@ def _flash_diff_bwd(causal, bq, bk, res, do):
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_diff_compact(qh, kh, vh, qoff, koff, bq, bk):
+    """Differentiable compact-causal-grid flash attention. ``qoff``/
+    ``koff`` are static ints; forward takes the compact grid, backward
+    reuses the dense-grid kernels (whose own clamp maps skip masked
+    blocks' DMA)."""
+    return _flash_fwd_compact(qh, kh, vh, qoff, koff, bq, bk, False)
+
+
+def _flash_diff_compact_fwd(qh, kh, vh, qoff, koff, bq, bk):
+    acc, m, l = _flash_fwd_compact(qh, kh, vh, qoff, koff, bq, bk, True)
+    l_safe = jnp.maximum(l, 1e-30)
+    o = (acc / l_safe[:, :, None]).astype(qh.dtype)
+    lse = m + jnp.log(l_safe)
+    return o, (qh, kh, vh, o, lse)
+
+
+def _flash_diff_compact_bwd(qoff, koff, bq, bk, res, do):
+    qh, kh, vh, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _flash_bwd_call(
+        qh, kh, vh, do, lse, delta,
+        jnp.asarray(qoff, jnp.int32).reshape(1),
+        jnp.asarray(koff, jnp.int32).reshape(1),
+        True, bq, bk,
+    )
+    return dq, dk, dv
+
+
+_flash_diff_compact.defvjp(_flash_diff_compact_fwd, _flash_diff_compact_bwd)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "return_state"),
 )
+def _flash_dense(q, k, v, causal, q_offset, kv_offset, block_q, block_k,
+                 return_state):
+    """Dense-grid path: any (possibly traced) offsets; masked-out causal
+    blocks skip compute (``_block_needed``) and DMA (``_kv_clamp``)."""
+    qh = jnp.swapaxes(q, 0, 1)  # (H, S, D)
+    kh = jnp.swapaxes(k, 0, 1)
+    vh = jnp.swapaxes(v, 0, 1)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    if return_state:
+        acc, m, l = _flash_fwd_call(
+            qh, kh, vh, qoff, koff, causal, block_q, block_k, True
+        )
+        return jnp.swapaxes(acc, 0, 1), m, l
+    out = _flash_diff(qh, kh, vh, qoff, koff, causal, block_q, block_k)
+    return jnp.swapaxes(out, 0, 1)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("q_offset", "kv_offset", "block_q", "block_k",
+                     "return_state"),
+)
+def _flash_compact(q, k, v, q_offset, kv_offset, block_q, block_k,
+                   return_state):
+    """Compact-causal-grid path: static int offsets baked into the
+    schedule tables and mask iotas."""
+    qh = jnp.swapaxes(q, 0, 1)
+    kh = jnp.swapaxes(k, 0, 1)
+    vh = jnp.swapaxes(v, 0, 1)
+    if return_state:
+        acc, m, l = _flash_fwd_compact(
+            qh, kh, vh, q_offset, kv_offset, block_q, block_k, True
+        )
+        return jnp.swapaxes(acc, 0, 1), m, l
+    out = _flash_diff_compact(
+        qh, kh, vh, q_offset, kv_offset, block_q, block_k
+    )
+    return jnp.swapaxes(out, 0, 1)
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -417,7 +726,7 @@ def flash_attention(
     causal: bool = False,
     q_offset=0,
     kv_offset=0,
-    block_q: int = 512,
+    block_q: int = 1024,
     block_k: int = 1024,
     return_state: bool = False,
 ):
@@ -430,6 +739,14 @@ def flash_attention(
     log-sum-exp (the standard flash backward — two Pallas kernels
     producing dq and dk/dv, never materializing the (S, T) score
     matrix).
+
+    Causal calls with compile-time int offsets (the ordinary
+    self-attention case) take the compact grid: only the (q, kv) block
+    pairs at or below the diagonal are scheduled (scalar-prefetch index
+    tables), and interior blocks skip the mask arithmetic entirely.
+    Traced offsets — ring-attention hops — take the dense grid, whose
+    per-block predicate skips masked compute and whose clamped index
+    maps skip the masked blocks' DMA.
 
     ``return_state=True`` changes the contract for cross-block merging
     (ring attention's hops): returns ``(acc, m, l)`` where ``acc`` is the
@@ -445,16 +762,17 @@ def flash_attention(
     bq = _pick_block(S, block_q, "S")
     bk = _pick_block(T, block_k, "T")
 
-    qh = jnp.swapaxes(q, 0, 1)  # (H, S, D)
-    kh = jnp.swapaxes(k, 0, 1)
-    vh = jnp.swapaxes(v, 0, 1)
-    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
-    koff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
-
-    if return_state:
-        acc, m, l = _flash_fwd_call(
-            qh, kh, vh, qoff, koff, causal, bq, bk, True
+    static_offsets = isinstance(q_offset, (int, np.integer)) and isinstance(
+        kv_offset, (int, np.integer)
+    )
+    if (
+        causal
+        and static_offsets
+        and _compact_applies(bq, int(q_offset) - int(kv_offset))
+    ):
+        return _flash_compact(
+            q, k, v, int(q_offset), int(kv_offset), bq, bk, return_state
         )
-        return jnp.swapaxes(acc, 0, 1), m, l
-    out = _flash_diff(qh, kh, vh, qoff, koff, causal, bq, bk)
-    return jnp.swapaxes(out, 0, 1)
+    return _flash_dense(
+        q, k, v, causal, q_offset, kv_offset, bq, bk, return_state
+    )
