@@ -1,0 +1,57 @@
+"""Worker process for the multi-host rendezvous test (not a test module).
+
+Each OS process plays one 'host': 1 virtual CPU device, rendezvous via a
+localhost coordinator — the same shape as N TPU-VM workers joining a pod
+slice, and the TPU-native analogue of one MPI rank under mpiexec
+(/root/reference/mpi_pbs_sample.sh:18). Run:
+
+    python tests/_multihost_worker.py <port> <rank> <nprocs>
+"""
+
+import sys
+
+port, rank, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from tpuscratch.runtime.hostenv import force_cpu_devices
+
+force_cpu_devices(1)  # one local device per process, like one chip per host
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpuscratch.runtime.context import initialize
+
+ctx = initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nprocs,
+    process_id=rank,
+)
+assert ctx.process_count == nprocs, ctx
+assert ctx.process_index == rank, ctx
+assert ctx.local_device_count == 1, ctx
+assert ctx.global_device_count == nprocs, ctx
+print(ctx.hello(), flush=True)
+
+# cross-process data-plane check: a psum over the global mesh must see
+# every process's contribution (sum of 1..nprocs)
+mesh = Mesh(np.array(jax.devices()), ("x",))
+local = jnp.full((1, 4), float(rank + 1), jnp.float32)
+garr = jax.make_array_from_single_device_arrays(
+    (nprocs, 4),
+    NamedSharding(mesh, P("x")),
+    [jax.device_put(local, jax.local_devices()[0])],
+)
+f = jax.jit(
+    shard_map(
+        lambda x: jax.lax.psum(x, "x"), mesh=mesh,
+        in_specs=P("x"), out_specs=P("x"),
+    )
+)
+out = f(garr)
+got = np.asarray(out.addressable_shards[0].data)
+want = nprocs * (nprocs + 1) / 2
+np.testing.assert_allclose(got, want)
+print(f"WORKER{rank} OK process_count={ctx.process_count} psum={float(got[0, 0])}", flush=True)

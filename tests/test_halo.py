@@ -653,3 +653,44 @@ class TestDmaImpl:
         spec = HaloSpec(layout=lay, topology=topo)
         with pytest.raises(ValueError, match="too small"):
             run_stencil_dma(jnp.zeros(lay.padded_shape), spec, 2)
+
+
+class TestPlanNativeParity:
+    """HaloSpec.plan() must be byte-identical whichever planner built it —
+    the native fast path is an accelerator, never a semantic fork."""
+
+    @pytest.mark.parametrize("dims,periodic", [
+        ((2, 4), (True, True)),
+        ((3, 3), (True, False)),
+        ((1, 4), (False, False)),
+    ])
+    @pytest.mark.parametrize("neighbors", [4, 8])
+    def test_native_and_python_plans_equal(self, dims, periodic, neighbors):
+        import tpuscratch.native as native
+        from tpuscratch.halo import exchange
+
+        if not native.available():
+            pytest.skip("native library not built")
+        spec = HaloSpec(
+            layout=TileLayout(8, 6, 2, 1),
+            topology=CartTopology(dims, periodic),
+            neighbors=neighbors,
+        )
+        exchange._cached_plan.cache_clear()
+        native_plan = spec.plan()
+        exchange._cached_plan.cache_clear()
+        orig = native.available
+        native.available = lambda: False
+        try:
+            python_plan = spec.plan()
+        finally:
+            native.available = orig
+            exchange._cached_plan.cache_clear()
+        assert native_plan == python_plan
+
+    def test_plan_is_cached(self):
+        spec = HaloSpec(
+            layout=TileLayout(4, 4, 1, 1),
+            topology=CartTopology((2, 4), (True, True)),
+        )
+        assert spec.plan() is spec.plan()

@@ -39,6 +39,7 @@ def test_data_roundtrip_through_view(pool):
         view = buf.view(np.float32, (1000,))
         np.copyto(view, data)
         np.testing.assert_array_equal(buf.view(np.float32, (1000,)), data)
+        del view  # free() (the with-exit) refuses while views are alive
 
 
 def test_free_then_alloc_reuses_buffer(pool):
@@ -147,3 +148,67 @@ def test_default_pool_singleton_and_staging_bench():
     control = pageable_buffer_staging_roundtrip(1024, iters=2)
     assert res.p50 > 0 and control.p50 > 0
     assert res.bytes_moved == control.bytes_moved == 2 * 1024 * 4
+
+
+def test_free_refuses_while_view_alive():
+    hostpool = pytest.importorskip("tpuscratch.native.hostpool")
+    if not hostpool.available():
+        pytest.skip("native library not built")
+    pool = hostpool.HostPool(lock_pages=False)
+    buf = pool.alloc(4096)
+    v = buf.view(np.float32)
+    with pytest.raises(ValueError, match="live view"):
+        buf.free()
+    del v
+    buf.free()  # now fine
+    assert buf._ptr is None
+    pool.close()
+
+
+def test_abandoned_pool_finalized():
+    hostpool = pytest.importorskip("tpuscratch.native.hostpool")
+    if not hostpool.available():
+        pytest.skip("native library not built")
+    import weakref
+
+    pool = hostpool.HostPool(lock_pages=False)
+    fin = pool._finalizer
+    assert fin.alive
+    del pool  # no close(): the finalizer must reclaim the native pool
+    import gc
+
+    gc.collect()
+    assert not fin.alive
+
+
+def test_close_then_finalizer_single_destroy():
+    hostpool = pytest.importorskip("tpuscratch.native.hostpool")
+    if not hostpool.available():
+        pytest.skip("native library not built")
+    pool = hostpool.HostPool(lock_pages=False)
+    pool.close()
+    assert pool._handle is None
+    assert not pool._finalizer.alive
+    pool.close()  # idempotent
+
+
+def test_view_keeps_pool_alive():
+    # the use-after-free guard: a live view must pin the buffer AND the
+    # pool, or the pool finalizer would free the pages under the view
+    hostpool = pytest.importorskip("tpuscratch.native.hostpool")
+    if not hostpool.available():
+        pytest.skip("native library not built")
+    import gc
+
+    v = hostpool.HostPool(lock_pages=False).alloc(4096).view(np.float32)
+    gc.collect()
+    v[:] = 1.0  # would be a write into freed heap without the anchor
+    assert float(v[0]) == 1.0
+    # walk to the ctypes block at the root of the view chain: the anchor
+    # there must be keeping the pool's finalizer alive
+    base = v
+    while getattr(base, "base", None) is not None:
+        base = base.base
+    assert base._tpuscratch_buffer._pool._finalizer.alive
+    del v, base
+    gc.collect()

@@ -1,0 +1,66 @@
+"""Multi-host rendezvous, actually executed.
+
+The reference never runs as one process: mpiexec spawns N OS processes
+that rendezvous inside MPI_Init (/root/reference/mpi_pbs_sample.sh:18).
+The framework's equivalent — ``initialize()``'s
+``jax.distributed.initialize`` branch (runtime/context.py) — is
+exercised here the same way the reference exercises multi-node MPI on
+one box (SURVEY.md §4.2): two real OS processes on localhost, each
+owning one virtual CPU device, meeting at a coordinator, then running a
+cross-process ``psum`` whose result proves the data plane spans both.
+"""
+
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = pathlib.Path(__file__).parent / "_multihost_worker.py"
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestMultiHostInitialize:
+    @pytest.mark.parametrize("nprocs", [2])
+    def test_two_process_rendezvous_and_psum(self, nprocs):
+        port = _free_port()
+        env = dict(os.environ)
+        # repo root importable in the workers; APPEND so the environment's
+        # own entries (e.g. the axon site dir) survive
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(WORKER), str(port), str(rank), str(nprocs)],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                cwd=str(REPO),
+                env=env,
+            )
+            for rank in range(nprocs)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=180)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rank, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+            assert f"WORKER{rank} OK process_count={nprocs}" in out, out
+            assert "psum=3.0" in out, out
+        # both ranks printed the mpi1-style hello with the global view
+        assert all(f"of {nprocs} on" in o for o in outs), outs

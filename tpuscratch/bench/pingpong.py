@@ -133,7 +133,10 @@ def native_pool_staging_roundtrip(n_elems: int, iters: int = 10) -> BenchResult:
     buf = hostpool.default_pool().alloc(n_elems * 4)
     try:
         view = buf.view(np.float32, (n_elems,))
-        return _buffer_staging(view, n_elems, iters, "native-pool")
+        try:
+            return _buffer_staging(view, n_elems, iters, "native-pool")
+        finally:
+            del view  # the buffer refuses to free while views are alive
     finally:
         buf.free()
 

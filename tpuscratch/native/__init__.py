@@ -168,17 +168,23 @@ def build_plan(dims, periodic, core_h, core_w, hy, hx, neighbors=8):
             f"native planner rejected dims={dims} core=({core_h},{core_w}) "
             f"halo=({hy},{hx}) neighbors={neighbors}"
         )
+    import numpy as np
+
+    # bulk views + tolist(): element-wise ctypes indexing would dominate
+    # the whole call on large meshes (8 x ranks perm entries)
+    src_np = np.ctypeslib.as_array(perm_src).reshape(ndir_max, stride)
+    dst_np = np.ctypeslib.as_array(perm_dst).reshape(ndir_max, stride)
     out = []
     for i in range(ndirs):
+        n = counts[i]
         out.append(
             {
                 "direction": (dirs[2 * i], dirs[2 * i + 1]),
                 "send_rect": tuple(send_rects[4 * i : 4 * i + 4]),
                 "recv_rect": tuple(recv_rects[4 * i : 4 * i + 4]),
-                "perm": [
-                    (perm_src[i * stride + j], perm_dst[i * stride + j])
-                    for j in range(counts[i])
-                ],
+                "perm": list(
+                    zip(src_np[i, :n].tolist(), dst_np[i, :n].tolist())
+                ),
             }
         )
     return out

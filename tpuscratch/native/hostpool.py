@@ -15,6 +15,7 @@ staging is ``view[:] = np.asarray(device_arr)`` in and
 from __future__ import annotations
 
 import ctypes
+import weakref
 from typing import Optional
 
 import numpy as np
@@ -71,6 +72,7 @@ class HostBuffer:
         self._pool = pool
         self._ptr: Optional[int] = ptr
         self.nbytes = nbytes
+        self._views: list[weakref.ref] = []
 
     @property
     def ptr(self) -> int:
@@ -79,7 +81,12 @@ class HostBuffer:
         return self._ptr
 
     def view(self, dtype=np.uint8, shape: Optional[tuple] = None) -> np.ndarray:
-        """Zero-copy numpy view of (a prefix of) the buffer."""
+        """Zero-copy numpy view of (a prefix of) the buffer.
+
+        Views are tracked (by weakref): ``free()`` refuses to return the
+        buffer to the pool while any view is still alive, because writes
+        through a stale view would silently corrupt whichever allocation
+        reuses the memory."""
         dtype = np.dtype(dtype)
         if shape is None:
             shape = (self.nbytes // dtype.itemsize,)
@@ -87,10 +94,34 @@ class HostBuffer:
         if need > self.nbytes:
             raise ValueError(f"view of {need} B exceeds buffer {self.nbytes} B")
         raw = (ctypes.c_byte * need).from_address(self.ptr)
-        return np.frombuffer(raw, dtype=dtype).reshape(shape)
+        # anchor the buffer (and through its pool reference, the pool) on
+        # the ctypes block at the view's base: a live view must keep the
+        # pool's finalizer from destroying the pages under it, even when
+        # the caller dropped every other reference
+        raw._tpuscratch_buffer = self
+        arr = np.frombuffer(raw, dtype=dtype).reshape(shape)
+        self._views.append(weakref.ref(arr))
+        return arr
+
+    def live_views(self) -> int:
+        """Number of still-referenced views of this buffer."""
+        self._views = [r for r in self._views if r() is not None]
+        return len(self._views)
 
     def free(self) -> None:
         if self._ptr is not None:
+            if self.live_views():
+                # dead-but-uncollected reference cycles are common here:
+                # jax.device_put aliases host numpy buffers zero-copy and
+                # the dropped jax Array leaves a cycle only gc clears
+                import gc
+
+                gc.collect()
+            if self.live_views():
+                raise ValueError(
+                    f"freeing buffer with {self.live_views()} live view(s); "
+                    "drop the numpy references first"
+                )
             self._pool._free(self._ptr)
             self._ptr = None
 
@@ -114,6 +145,12 @@ class HostPool:
         self._handle = lib.ts_pool_create(1 if lock_pages else 0)
         if not self._handle:
             raise MemoryError("ts_pool_create failed")
+        # reclaim abandoned pools (buffers + mlock'd pages) even without
+        # close(): RLIMIT_MEMLOCK is tiny in containers, so leaked locked
+        # pages starve later pools
+        self._finalizer = weakref.finalize(
+            self, lib.ts_pool_destroy, self._handle
+        )
 
     def alloc(self, nbytes: int) -> HostBuffer:
         if nbytes <= 0:
@@ -138,7 +175,7 @@ class HostPool:
 
     def close(self) -> None:
         if self._handle:
-            _lib().ts_pool_destroy(self._handle)
+            self._finalizer()  # runs ts_pool_destroy once, then detaches
             self._handle = None
 
     def __enter__(self) -> "HostPool":
