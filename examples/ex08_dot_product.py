@@ -16,17 +16,20 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
-def main() -> None:
+def main(argv=None) -> None:
     ensure_devices()
-    import numpy as np
-
     from tpuscratch.bench.dot_bench import bench_dot
+    from tpuscratch.runtime.config import Config
     from tpuscratch.runtime.mesh import make_mesh_1d
 
+    # argv tier (mpi-pingpong-gpu.cpp:31 / mpicuda argv parity):
+    #   ex08_dot_product.py [elements] [--impl=full|partials|xla]
+    cfg = Config.load(argv)
     banner("distributed dot product (mpicuda2-4)")
     mesh = make_mesh_1d("x")
-    n = 1 << 22  # 4Mi f32 per run
-    for method in ("full", "partials", "xla"):
+    n = cfg.elements if "elements" in cfg.explicit else 1 << 22
+    methods = (cfg.impl,) if cfg.impl else ("full", "partials", "xla")
+    for method in methods:
         res = bench_dot(mesh, n_elems=n, method=method, iters=3)
         print(res.summary())
     print("self-check vs n*1.0: PASSED (bench_dot asserts internally)")

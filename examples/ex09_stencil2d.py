@@ -18,7 +18,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
-def main() -> None:
+def main(argv=None) -> None:
     ensure_devices()
     import jax.numpy as jnp
     import numpy as np
@@ -27,19 +27,29 @@ def main() -> None:
     from tpuscratch.comm import run_spmd
     from tpuscratch.halo import HaloSpec, TileLayout, halo_exchange
     from tpuscratch.halo.driver import distributed_stencil
+    from tpuscratch.runtime.config import Config
     from tpuscratch.runtime.log import coord_filename
     from tpuscratch.runtime.mesh import make_mesh_2d, topology_of
 
+    # argv tier, the reference driver's CLI (-cuda.cu:131-138):
+    #   ex09_stencil2d.py [tile_w tile_h [stencil_w stencil_h]]
+    #                     [--steps=N] [--impl=xla|pallas|blocked|overlap]
+    cfg = Config.load(argv)
+    tile_h = cfg.tile_height if "tile_height" in cfg.explicit else 8
+    tile_w = cfg.tile_width if "tile_width" in cfg.explicit else 8
     banner("stencil2d halo exchange (flagship)")
     mesh = make_mesh_2d((2, 4))
     topo = topology_of(mesh, periodic=True)
-    lay = TileLayout.for_stencil(8, 8, 5, 5)  # 5x5 stencil -> halo 2
+    lay = TileLayout.for_stencil(
+        tile_h, tile_w, cfg.stencil_height, cfg.stencil_width
+    )
     spec = HaloSpec(layout=lay, topology=topo, axes=tuple(mesh.axis_names))
 
+    hy, hx = lay.halo_y, lay.halo_x
     tiles = np.full((2, 4) + lay.padded_shape, -1.0, dtype=np.float32)
     for r in topo.ranks():
         rr, cc = topo.coords(r)
-        tiles[rr, cc, 2:-2, 2:-2] = r
+        tiles[rr, cc, hy:-hy, hx:-hx] = r
 
     f = run_spmd(
         mesh,
@@ -63,18 +73,20 @@ def main() -> None:
     print("rank 0 tile after exchange (core=0, halo=neighbor ids):")
     print(np.array2string(out[0, 0], precision=0))
 
-    banner("real compute: 5 Jacobi iterations vs global oracle")
+    steps = cfg.steps
+    banner(f"real compute: {steps} Jacobi iterations vs global oracle")
     rng = np.random.default_rng(0)
-    world = rng.standard_normal((64, 64)).astype(np.float32)
-    got = distributed_stencil(world, steps=5, mesh=mesh)
+    world = rng.standard_normal((2 * tile_h * 4, 4 * tile_w * 2)).astype(np.float32)
+    got = distributed_stencil(world, steps=steps, mesh=mesh,
+                              impl=cfg.impl or "xla")
     expect = world
-    for _ in range(5):
+    for _ in range(steps):
         expect = 0.25 * (
             np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
             + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
         )
     err = np.abs(got - expect).max()
-    print(f"max |distributed - global| after 5 steps: {err:.2e} "
+    print(f"max |distributed - global| after {steps} steps: {err:.2e} "
           f"({'PASSED' if err < 1e-5 else 'FAILED'})")
 
 

@@ -14,16 +14,23 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
-def main() -> None:
+def main(argv=None) -> None:
     ensure_devices()
     from tpuscratch.bench.pingpong import host_staging_roundtrip, sweep, verify_echo
+    from tpuscratch.runtime.config import Config
     from tpuscratch.runtime.mesh import make_mesh_1d
 
+    # argv tier: ex10_pingpong.py [max_message_bytes]
+    # (message size from argv = mpi-pingpong-gpu.cpp:31)
+    cfg = Config.load(argv)
+    sizes = (8, 1024, 65536, 1 << 20)
+    if "elements" in cfg.explicit:
+        sizes = tuple(s for s in sizes if s <= cfg.elements) or (cfg.elements,)
     banner("pingpong (test-benchmark)")
     mesh = make_mesh_1d("x")
     ok = verify_echo(mesh, "x", 4096)
     print(f"echo self-check: {'PASSED' if ok else 'FAILED'}")
-    for res in sweep(mesh, sizes_bytes=(8, 1024, 65536, 1 << 20), iters=5):
+    for res in sweep(mesh, sizes_bytes=sizes, iters=5):
         print(" ", res.summary())
     print(" ", host_staging_roundtrip(1 << 18, iters=5).summary(), "(ablation)")
 

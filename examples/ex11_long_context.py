@@ -15,7 +15,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
-def main() -> None:
+def main(argv=None) -> None:
     jax = ensure_devices()
     import jax.numpy as jnp
     import numpy as np
@@ -24,12 +24,16 @@ def main() -> None:
     from tpuscratch.comm import run_spmd
     from tpuscratch.parallel.ring_attention import ring_attention
     from tpuscratch.parallel.ulysses import ulysses_attention
+    from tpuscratch.runtime.config import Config
     from tpuscratch.runtime.mesh import make_mesh_1d
 
+    # argv tier: ex11_long_context.py [per_rank_seq_len]
+    cfg = Config.load(argv)
     banner("long-context sequence parallelism (ring + Ulysses)")
     mesh = make_mesh_1d("seq")
     n = mesh.devices.size
-    S, H, D = 16, 8, 32  # per-rank block: global sequence = n*S
+    S = cfg.elements if "elements" in cfg.explicit else 16
+    H, D = 8, 32  # per-rank block: global sequence = n*S
     rng = np.random.default_rng(0)
     q, k, v = (
         jnp.asarray(rng.standard_normal((n * S, H, D)).astype(np.float32))

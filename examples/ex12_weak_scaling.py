@@ -14,13 +14,19 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
-def main() -> None:
+def main(argv=None) -> None:
     ensure_devices()
     from tpuscratch.bench.weak_scaling import bench_weak_scaling, report
+    from tpuscratch.runtime.config import Config
 
+    # argv tier: ex12_weak_scaling.py [tile_w tile_h] [--steps=N]
+    cfg = Config.load(argv)
+    th = cfg.tile_height if "tile_height" in cfg.explicit else 128
+    tw = cfg.tile_width if "tile_width" in cfg.explicit else 128
     banner("weak-scaling stencil (BASELINE config 5)")
     pts = bench_weak_scaling(
-        per_chip=(128, 128), steps=10, device_counts=None, iters=3,
+        per_chip=(th, tw), steps=cfg.steps if "steps" in cfg.explicit else 10,
+        device_counts=None, iters=3,
         fence="readback",
     )
     print(report(pts))

@@ -15,7 +15,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from examples._common import banner, ensure_devices
 
 
-def main() -> None:
+def main(argv=None) -> None:
     jax = ensure_devices()
     import jax.numpy as jnp
     import numpy as np
@@ -23,12 +23,16 @@ def main() -> None:
 
     from tpuscratch.comm import run_spmd
     from tpuscratch.parallel.expert import expert_parallel_ffn
+    from tpuscratch.runtime.config import Config
     from tpuscratch.runtime.mesh import make_mesh_1d
 
+    # argv tier: ex13_expert_parallel.py [tokens_per_rank]
+    cfg = Config.load(argv)
     banner("expert parallelism (routed MoE over an expert axis)")
     mesh = make_mesh_1d("ep")
     n = mesh.devices.size
-    T, D, F = 8 * n, 16, 32  # T/n tokens per rank, one expert per rank
+    per_rank = cfg.elements if "elements" in cfg.explicit else 8
+    T, D, F = per_rank * n, 16, 32  # T/n tokens per rank, one expert per rank
     rng = np.random.default_rng(0)
     x = rng.standard_normal((T, D)).astype(np.float32)
     gate_w = rng.standard_normal((D, n)).astype(np.float32)

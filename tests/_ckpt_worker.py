@@ -1,0 +1,47 @@
+"""Worker for the kill/resume checkpoint test (not a test module).
+
+Runs ``checkpointed_stencil`` and, when TPUSCRATCH_DIE_AFTER_SAVES is
+set, hard-exits (os._exit — no cleanup, the closest deterministic stand-in
+for a scheduler SIGKILL) after that many checkpoint saves. Usage:
+
+    python tests/_ckpt_worker.py <ckpt_dir> <steps> <save_every>
+"""
+
+import os
+import sys
+
+ckpt_dir, steps, save_every = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+die_after = int(os.environ.get("TPUSCRATCH_DIE_AFTER_SAVES", "0"))
+
+from tpuscratch.runtime.hostenv import force_cpu_devices
+
+force_cpu_devices(4)
+
+import numpy as np
+
+from tpuscratch.halo import driver
+from tpuscratch.runtime import checkpoint
+from tpuscratch.runtime.mesh import make_mesh_2d
+
+if die_after:
+    real_save = checkpoint.save
+    calls = {"n": 0}
+
+    def killing_save(*args, **kw):
+        path = real_save(*args, **kw)
+        calls["n"] += 1
+        if calls["n"] >= die_after:
+            print(f"WORKER dying after save #{calls['n']}", flush=True)
+            os._exit(17)  # preemption: no cleanup, no further saves
+        return path
+
+    checkpoint.save = killing_save
+
+rng = np.random.default_rng(123)  # same world every invocation
+world = rng.standard_normal((16, 16)).astype(np.float32)
+out = driver.checkpointed_stencil(
+    world, steps=steps, ckpt_dir=ckpt_dir, save_every=save_every,
+    mesh=make_mesh_2d((2, 2)),
+)
+np.save(os.path.join(ckpt_dir, "result.npy"), out)
+print(f"WORKER done at step {checkpoint.latest_step(ckpt_dir)}", flush=True)

@@ -139,3 +139,44 @@ class TestWeakScaling:
         eff = efficiency(pts)
         assert eff[1] == 1.0 and eff[4] > 0
         assert "eff" in report(pts)
+
+
+class TestPipelineBubbleBench:
+    def test_reports_measured_vs_analytic(self):
+        from tpuscratch.bench.pipeline_bench import bench_pipeline_bubble
+
+        r = bench_pipeline_bubble(n_micro=4, feature=64, iters=3)
+        # on the virtual CPU mesh this is a labeled proxy; assert the
+        # harness structure, not CPU timing fidelity
+        assert r.proxy is True
+        assert r.n_stages >= 2
+        assert r.analytic_bubble == pytest.approx(
+            (r.n_stages - 1) / (4 + r.n_stages - 1)
+        )
+        assert r.wall_s > 0 and r.tick_s > 0
+        # CPU-mesh timing is noisy enough that the measured value can
+        # stray well outside [0, 1]; assert it is finite and sane only
+        assert abs(r.measured_bubble) < 10.0
+        assert "bubble measured" in r.summary()
+        assert "[cpu-mesh proxy]" in r.summary()
+
+
+class TestHaloTraffic:
+    def test_analytic_halo_bytes(self):
+        from tpuscratch.bench.weak_scaling import halo_traffic_per_chip
+
+        # 1x1 torus: all transfers self-wrap, zero ICI bytes
+        b, cells = halo_traffic_per_chip((1, 1), (64, 64))
+        assert b == 0.0 and cells == 64 * 64
+        # 2x2 torus, halo 1, f32: every rank sends 2 rows + 2 cols + 4
+        # corner cells off-chip (all 8 neighbors are remote on a 2x2
+        # torus) = (2*64 + 2*64 + 4) * 4 B
+        b, cells = halo_traffic_per_chip((2, 2), (64, 64))
+        assert b == (2 * 64 + 2 * 64 + 4) * 4
+        # 1x4 ring: N/S wrap on-chip, only E/W + corners leave
+        b, _ = halo_traffic_per_chip((1, 4), (64, 64))
+        assert b == (2 * 64 + 4) * 4
+        # deep:4 amortizes a 4-deep halo over 4 steps: 2 N/S strips of
+        # 4x64 + 2 E/W strips of 64x4 + 4 corners of 4x4, f32, / 4 steps
+        b4, _ = halo_traffic_per_chip((2, 2), (64, 64), impl="deep:4")
+        assert b4 == ((2 * 4 * 64 + 2 * 64 * 4 + 4 * 4 * 4) * 4) / 4

@@ -19,8 +19,20 @@ EXAMPLES = sorted(p.stem for p in EXAMPLES_DIR.glob("ex*.py"))
 
 @pytest.mark.parametrize("name", EXAMPLES)
 def test_example_runs(name, capsys):
+    import inspect
+
     mod = importlib.import_module(f"examples.{name}")
-    mod.main()
+    # argv-capable examples (ex08+) get an empty CLI — defaults — rather
+    # than pytest's own argv
+    if "argv" in inspect.signature(mod.main).parameters:
+        mod.main([])
+    else:
+        mod.main()
     out = capsys.readouterr().out
     assert "==" in out  # banner printed
     assert "FAILED" not in out
+    # self-checking examples must actually REACH their check: an example
+    # that silently skipped it would otherwise pass this smoke test
+    src = (EXAMPLES_DIR / f"{name}.py").read_text()
+    if '"PASSED"' in src or "'PASSED'" in src:
+        assert "PASSED" in out, f"{name} never printed its self-check"

@@ -60,6 +60,31 @@ class TestRegionGeometry:
         assert ts.offsets == (0, 0) and ts.shape == (2, 32)
         assert rs.offsets == (0, 30) and rs.shape == (32, 2)
 
+    #: every Region id's (offsets, shape) on the 32x32/halo-2 grid — the
+    #: exhaustive 13-case table the reference's in-header self-test walks
+    #: (stencil2D.h:441-510 exercises all 13 RegionIDs on this config)
+    ALL_13 = {
+        Region.CENTER: ((2, 2), (28, 28)),
+        Region.TOP: ((0, 2), (2, 28)),
+        Region.BOTTOM: ((30, 2), (2, 28)),
+        Region.LEFT: ((2, 0), (28, 2)),
+        Region.RIGHT: ((2, 30), (28, 2)),
+        Region.TOP_LEFT: ((0, 0), (2, 2)),
+        Region.TOP_RIGHT: ((0, 30), (2, 2)),
+        Region.BOTTOM_LEFT: ((30, 0), (2, 2)),
+        Region.BOTTOM_RIGHT: ((30, 30), (2, 2)),
+        Region.TOP_STRIP: ((0, 0), (2, 32)),
+        Region.BOTTOM_STRIP: ((30, 0), (2, 32)),
+        Region.LEFT_STRIP: ((0, 0), (32, 2)),
+        Region.RIGHT_STRIP: ((0, 30), (32, 2)),
+    }
+
+    def test_all_thirteen_regions(self):
+        assert set(self.ALL_13) == set(Region)  # table is exhaustive
+        for region, (offsets, shape) in self.ALL_13.items():
+            r = sub_region(self.BASE, 2, 2, region)
+            assert r.offsets == offsets and r.shape == shape, region
+
     def test_composition_grid_core_region(self):
         # double application: grid -> CENTER -> TOP of core
         core = sub_region(self.BASE, 2, 2, Region.CENTER)
@@ -694,3 +719,18 @@ class TestPlanNativeParity:
             topology=CartTopology((2, 4), (True, True)),
         )
         assert spec.plan() is spec.plan()
+
+
+class TestBlockedImpl:
+    """impl='blocked' (row-band kernel) must be reachable end-to-end from
+    the driver dispatch and agree with the plain path."""
+
+    def test_blocked_matches_xla(self):
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(71)
+        world = rng.standard_normal((16, 16)).astype(np.float32)
+        mesh = make_mesh_2d((2, 2))
+        got = distributed_stencil(world, steps=3, mesh=mesh, impl="blocked")
+        plain = distributed_stencil(world, steps=3, mesh=mesh, impl="xla")
+        np.testing.assert_allclose(got, plain, rtol=1e-5, atol=1e-6)

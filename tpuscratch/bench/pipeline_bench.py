@@ -1,0 +1,138 @@
+"""Pipeline-parallel schedule benchmark: measured vs analytic bubble.
+
+GPipe's idle fraction is (n-1)/(M+n-1) by construction
+(parallel/pipeline.py). This bench validates that the EXECUTED schedule
+has that shape, not just the formula: wall time of a pipelined run must
+scale as ticks = M + n - 1 (one extra tick per extra microbatch), not as
+M * n (a degenerate sequential execution). The per-tick cost is taken
+from the slope between two microbatch counts, and
+
+    measured_bubble(M) = 1 - M * tick_cost / wall(M)
+
+— the share of wall time beyond the M "useful" ticks. For a healthy
+pipeline this lands near the analytic value (fixed dispatch overhead
+pushes it slightly above); a schedule that silently serialized would
+report ~0 while the analytic value is large, so the comparison catches
+breakage in either direction.
+
+The reference has no pipeline (SURVEY.md §2.7); its measurement idiom —
+wall-clock spans around the hot loop, reported beside the configuration
+(mpicuda3.cu:315-325) — is what this follows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.bench.timing import time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.parallel import bubble_fraction, pipeline_apply
+from tpuscratch.runtime.mesh import make_mesh_1d
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineBubbleResult:
+    n_stages: int
+    n_micro: int
+    wall_s: float          # p50 wall for n_micro microbatches
+    tick_s: float          # marginal cost of one extra microbatch (tick)
+    measured_bubble: float
+    analytic_bubble: float
+    proxy: bool            # True when devices are virtual (CPU mesh)
+
+    def summary(self) -> str:
+        return (
+            f"pipeline {self.n_stages} stages x {self.n_micro} micro: "
+            f"wall {self.wall_s * 1e3:.2f} ms, tick {self.tick_s * 1e6:.0f} us, "
+            f"bubble measured {self.measured_bubble:.3f} vs "
+            f"analytic {self.analytic_bubble:.3f}"
+            + (" [cpu-mesh proxy]" if self.proxy else "")
+        )
+
+
+def bench_pipeline_bubble(
+    n_micro: int = 8,
+    feature: int = 256,
+    iters: int = 10,
+    axis: str = "stage",
+    mesh=None,
+    fence: str = "block",
+) -> PipelineBubbleResult:
+    """Measure the GPipe schedule's bubble on the available devices.
+
+    Runs the same stage chain at ``n_micro`` and ``2 * n_micro``
+    microbatches; the wall-time difference prices one tick.
+
+    On a virtual CPU mesh the default stage count is capped at the HOST
+    CORE count: stages can only overlap on real execution units, and
+    timing more virtual devices than cores measures the scheduler, not
+    the schedule (the weak-scaling bench has the same caveat). Results
+    are flagged ``proxy`` off-TPU either way — the numbers that matter
+    come from a real multi-chip slice.
+    """
+    proxy = jax.default_backend() != "tpu"
+    if mesh is None:
+        devs = jax.devices()
+        if proxy:
+            import os
+
+            devs = devs[: max(2, min(len(devs), os.cpu_count() or 1))]
+        mesh = make_mesh_1d(axis, devices=devs)
+    n = mesh.devices.size
+    rng = np.random.default_rng(0)
+    Ws = jnp.asarray(
+        rng.standard_normal((n, feature, feature)).astype(np.float32) * 0.1
+    )
+
+    def stage(W, x):
+        return jnp.tanh(x @ W[0])
+
+    def program(M):
+        f = run_spmd(
+            mesh,
+            lambda W, m: pipeline_apply(stage, W, m, axis),
+            (P(axis), P()),
+            P(),
+        )
+        micro = jnp.asarray(
+            rng.standard_normal((M, feature)).astype(np.float32)
+        )
+        return f, micro
+
+    walls = {}
+    for M in (n_micro, 2 * n_micro):
+        f, micro = program(M)
+        r = time_device(
+            f, Ws, micro, iters=iters, warmup=2, fence=fence,
+            name=f"pipeline n={n} M={M}",
+        )
+        walls[M] = r.p50
+
+    tick = max((walls[2 * n_micro] - walls[n_micro]) / n_micro, 1e-12)
+    measured = 1.0 - (n_micro * tick) / walls[n_micro]
+    return PipelineBubbleResult(
+        n_stages=n,
+        n_micro=n_micro,
+        wall_s=walls[n_micro],
+        tick_s=tick,
+        measured_bubble=measured,
+        analytic_bubble=bubble_fraction(n, n_micro),
+        proxy=proxy,
+    )
+
+
+def main() -> int:
+    for M in (4, 8, 32):
+        print(bench_pipeline_bubble(n_micro=M).summary())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

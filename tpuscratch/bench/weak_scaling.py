@@ -30,10 +30,52 @@ class WeakScalingPoint:
     dims: tuple[int, int]
     grid: tuple[int, int]
     result: BenchResult
+    halo_bytes_per_chip_step: float  # analytic, from the exchange plan
+    cells_per_chip_step: int
 
     @property
     def per_chip_rate(self) -> float:
         return self.result.items_per_s / self.n_devices
+
+    @property
+    def comm_ratio(self) -> float:
+        """Exact analytic halo bytes per computed cell per step — the
+        quantity weak-scaling efficiency actually depends on. Unlike the
+        measured CPU-mesh rates (virtual devices share host cores, so
+        their per-chip rate collapses by construction), this number is
+        meaningful on any host and transfers directly to a real slice."""
+        return self.halo_bytes_per_chip_step / self.cells_per_chip_step
+
+
+def halo_traffic_per_chip(
+    dims: tuple[int, int],
+    per_chip: tuple[int, int],
+    impl: str = "xla",
+    itemsize: int = 4,
+) -> tuple[float, int]:
+    """(off-chip halo bytes per chip per step, cells per chip per step),
+    computed EXACTLY from the exchange plan: every transfer whose
+    ppermute pair leaves the rank counts its send-strip bytes; self-wrap
+    pairs (1-wide axes) move nothing over ICI. Deep-halo impls amortize a
+    k-deep exchange over k steps."""
+    from tpuscratch.halo.exchange import HaloSpec
+    from tpuscratch.halo.layout import TileLayout
+    from tpuscratch.runtime.topology import CartTopology
+
+    halo, steps_per_exchange = 1, 1
+    if impl.startswith("deep"):
+        _, _, depth = impl.partition(":")
+        halo = int(depth) if depth else 8
+        steps_per_exchange = halo
+    topo = CartTopology(tuple(dims), (True, True))
+    lay = TileLayout(per_chip[0], per_chip[1], halo, halo)
+    spec = HaloSpec(layout=lay, topology=topo)
+    total = 0
+    for t in spec.plan():
+        strip = t.send.shape[0] * t.send.shape[1] * itemsize
+        total += strip * sum(1 for s, d in t.perm if s != d)
+    per_chip_bytes = total / topo.size / steps_per_exchange
+    return per_chip_bytes, per_chip[0] * per_chip[1]
 
 
 def bench_weak_scaling(
@@ -55,6 +97,7 @@ def bench_weak_scaling(
         rows, cols = factor2d(n)
         grid = (rows * per_chip[0], cols * per_chip[1])
         mesh = make_mesh_2d((rows, cols), devices=jax.devices()[:n])
+        halo_bytes, cells = halo_traffic_per_chip((rows, cols), per_chip, impl)
         points.append(
             WeakScalingPoint(
                 n_devices=n,
@@ -63,6 +106,8 @@ def bench_weak_scaling(
                 result=bench_stencil(
                     grid, steps, mesh=mesh, impl=impl, iters=iters, fence=fence
                 ),
+                halo_bytes_per_chip_step=halo_bytes,
+                cells_per_chip_step=cells,
             )
         )
     return points
@@ -83,6 +128,7 @@ def report(points: Sequence[WeakScalingPoint]) -> str:
         lines.append(
             f"{p.n_devices:3d} dev {p.dims[0]}x{p.dims[1]}  grid "
             f"{p.grid[0]}x{p.grid[1]}  {p.per_chip_rate:.3e} cells/s/chip  "
-            f"eff {eff[p.n_devices] * 100:5.1f}%"
+            f"eff {eff[p.n_devices] * 100:5.1f}%  "
+            f"halo {p.comm_ratio:.4f} B/cell (analytic)"
         )
     return "\n".join(lines)

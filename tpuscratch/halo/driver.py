@@ -98,6 +98,71 @@ def make_stencil_program(
     )
 
 
+def checkpointed_stencil(
+    world: np.ndarray,
+    steps: int,
+    ckpt_dir: str,
+    save_every: int = 100,
+    mesh: Optional[Mesh] = None,
+    halo: tuple[int, int] = (1, 1),
+    coeffs=(0.25, 0.25, 0.25, 0.25, 0.0),
+    impl: str = "xla",
+    periodic: bool = True,
+    keep: int = 3,
+) -> np.ndarray:
+    """``distributed_stencil`` with preemption survival: the tile state is
+    checkpointed every ``save_every`` steps and the run RESUMES from the
+    newest checkpoint in ``ckpt_dir`` when one exists.
+
+    The reference runs under scheduler walltime kills with no way to
+    continue (per-rank result dumps only, mpi-2d-stencil-subarray.cpp:62;
+    SURVEY.md §5 records the gap); here a killed run re-invoked with the
+    same arguments continues where the last atomic save landed and
+    produces a BIT-IDENTICAL result to an uninterrupted run (same chunk
+    boundaries, exact f32 round trip through the .npy format —
+    tests/test_checkpoint_resume.py kills a run mid-flight to prove it).
+    """
+    from tpuscratch.runtime import checkpoint
+
+    mesh = mesh if mesh is not None else make_mesh_2d()
+    topo = topology_of(mesh, periodic=periodic)
+    rows, cols = topo.dims
+    if world.shape[0] % rows or world.shape[1] % cols:
+        raise ValueError(f"world {world.shape} not divisible by mesh {topo.dims}")
+    if save_every < 1:
+        raise ValueError(f"save_every must be >= 1, got {save_every}")
+    layout = TileLayout(
+        world.shape[0] // rows, world.shape[1] // cols, halo[0], halo[1]
+    )
+    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+
+    tiles = decompose(world, topo, layout)
+    start = 0
+    if checkpoint.latest_step(ckpt_dir) is not None:
+        tiles, start, _meta = checkpoint.restore(ckpt_dir, tiles)
+        if start > steps:
+            raise ValueError(
+                f"checkpoint in {ckpt_dir} is at step {start}, beyond the "
+                f"requested {steps} — refusing to return an over-stepped "
+                "state as the answer (use a fresh ckpt_dir)"
+            )
+    state = jnp.asarray(tiles)
+
+    programs: dict[int, object] = {}  # chunk size -> compiled program
+    while start < steps:
+        chunk = min(save_every, steps - start)
+        if chunk not in programs:
+            programs[chunk] = make_stencil_program(mesh, spec, chunk, coeffs, impl)
+        state = programs[chunk](state)
+        start += chunk
+        checkpoint.save(
+            ckpt_dir, start, np.asarray(state),
+            metadata={"steps_total": steps, "impl": impl},
+        )
+        checkpoint.prune(ckpt_dir, keep)
+    return assemble(np.asarray(state), topo, layout)
+
+
 def distributed_stencil(
     world: np.ndarray,
     steps: int,

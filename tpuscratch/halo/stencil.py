@@ -66,6 +66,10 @@ def _compute(tile: jax.Array, layout: TileLayout, coeffs, impl: str) -> jax.Arra
         from tpuscratch.ops.stencil_kernel import five_point_pallas
 
         return five_point_pallas(tile, layout, tuple(coeffs))
+    if impl == "blocked":
+        from tpuscratch.ops.stencil_kernel import five_point_blocked
+
+        return five_point_blocked(tile, layout, tuple(coeffs))
     raise ValueError(f"unknown stencil impl {impl!r}")
 
 
@@ -123,11 +127,12 @@ def stencil_step(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25
 
     ``impl`` selects the compute path — the runtime analogue of the
     reference's compile-time GPU/CPU switch: 'xla' (compiler-fused),
-    'pallas' (explicit VMEM kernel, ops/stencil_kernel.py), or 'overlap'
-    (interior compute overlapped with the halo transfers,
-    ``stencil_step_overlap``).
+    'pallas' (whole-tile VMEM kernel, ops/stencil_kernel.py), 'blocked'
+    (row-band VMEM kernel for cores too large for one block,
+    ``five_point_blocked``), or 'overlap' (interior compute overlapped
+    with the halo transfers, ``stencil_step_overlap``).
     """
-    if impl not in ("xla", "pallas", "overlap"):
+    if impl not in ("xla", "pallas", "blocked", "overlap"):
         raise ValueError(f"unknown stencil impl {impl!r}")
     if impl == "overlap":
         return stencil_step_overlap(tile, spec, coeffs)

@@ -46,10 +46,23 @@ class Config:
     stencil_width: int = 5           # reference default 5x5 stencil
     stencil_height: int = 5
     elements: int = 1 << 20          # message/vector size (argv parity)
+    steps: int = 5                   # iteration count for iterative drivers
+    impl: str = ""                   # impl selector ("" = driver default);
+    #                                  stencil: xla/pallas/blocked/overlap/
+    #                                  deep/dma/resident, dot: full/partials/
+    #                                  xla, attention: pallas/xla
     # -- instrumentation -------------------------------------------------
     log: bool = True                 # NO_LOG parity
     include_setup_time: bool = True  # NO_GPU_MALLOC_TIME parity
     error_policy: ErrorPolicy = ErrorPolicy.RAISE  # MPI_ERR_USE_EXCEPTIONS
+
+    def __post_init__(self):
+        # provenance: which fields were EXPLICITLY set (Config.load fills
+        # this) — so callers can distinguish "user asked for the default
+        # value" from "user said nothing" without sentinel comparisons.
+        # Not a dataclass field: replace()/asdict() reset it.
+        if not hasattr(self, "explicit"):
+            object.__setattr__(self, "explicit", frozenset())
 
     # ---- derived -------------------------------------------------------
 
@@ -78,36 +91,112 @@ class Config:
         """CLI parity with the reference drivers: positional
         ``[tile_w tile_h [stencil_w stencil_h]]`` (-cuda.cu:131-138, including
         fixing its stencilHeight self-assignment bug) or ``elements`` for the
-        benchmarks (mpi-pingpong-gpu.cpp:31)."""
+        benchmarks (mpi-pingpong-gpu.cpp:31). Any field is also settable as
+        ``--name=value`` (e.g. ``--steps=50 --impl=pallas``)."""
         fields = dict(overrides)
-        args = [a for a in argv if not a.startswith("-")]
-        if len(args) == 1:
-            fields.setdefault("elements", int(args[0]))
-        elif len(args) >= 2:
-            fields.setdefault("tile_width", int(args[0]))
-            fields.setdefault("tile_height", int(args[1]))
-            if len(args) >= 3:
-                fields.setdefault("stencil_width", int(args[2]))
-            if len(args) >= 4:
-                fields.setdefault("stencil_height", int(args[3]))
+        for flag, value in _parse_flags(argv).items():
+            fields.setdefault(flag, value)
+        for key, value in _parse_positional(argv).items():
+            fields.setdefault(key, value)
         return cls(**fields)
+
+    @classmethod
+    def load(cls, argv: Optional[Sequence[str]] = None) -> "Config":
+        """The example/driver entry: env tier first, argv tier on top
+        (argv wins — the reference's precedence, where a CLI tile size
+        overrides whatever the job script exported). The returned
+        config's ``explicit`` frozenset names every field that was
+        actually set by either tier, so callers can distinguish an
+        explicit request for the default value from silence."""
+        import sys
+
+        argv = list(sys.argv[1:]) if argv is None else list(argv)
+        merged = {
+            **_parse_env(dict(os.environ)),
+            **_parse_positional(argv),
+            **_parse_flags(argv),
+        }
+        cfg = cls(**merged)
+        object.__setattr__(cfg, "explicit", frozenset(merged))
+        return cfg
 
     @classmethod
     def from_env(cls, env: Optional[dict] = None, **overrides) -> "Config":
         """Env tier: TPUSCRATCH_* variables (runtime discovery only)."""
-        env = dict(os.environ if env is None else env)
         fields = dict(overrides)
-        if "TPUSCRATCH_DTYPE" in env:
-            fields.setdefault("dtype", env["TPUSCRATCH_DTYPE"])
-        if "TPUSCRATCH_NO_LOG" in env:
-            fields.setdefault("log", env["TPUSCRATCH_NO_LOG"] not in ("1", "true"))
-        if "TPUSCRATCH_MESH" in env:  # e.g. "2x4"
-            fields.setdefault(
-                "mesh_shape", tuple(int(x) for x in env["TPUSCRATCH_MESH"].split("x"))
-            )
-        if env.get("TPUSCRATCH_ABORT_ON_ERROR", "") in ("1", "true", "yes"):
-            fields.setdefault("error_policy", ErrorPolicy.ABORT)
+        for key, value in _parse_env(dict(os.environ if env is None else env)).items():
+            fields.setdefault(key, value)
         return cls(**fields)
 
     def replace(self, **kw) -> "Config":
         return dataclasses.replace(self, **kw)
+
+
+def _coerce(name: str, default, value: str):
+    """Parse a flag string by the FIELD DEFAULT's type (annotations are
+    strings under ``from __future__ import annotations``)."""
+    if name == "mesh_shape":
+        return tuple(int(x) for x in value.split("x"))
+    if name == "error_policy":
+        return ErrorPolicy[value.upper()]
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(value)
+    return value
+
+
+def _parse_positional(argv: Sequence[str]) -> dict:
+    """Positional argv tier: ``[elements]`` or
+    ``[tile_w tile_h [stencil_w stencil_h]]``."""
+    args = [a for a in argv if not a.startswith("-")]
+    out = {}
+    if len(args) == 1:
+        out["elements"] = int(args[0])
+    elif len(args) >= 2:
+        out["tile_width"] = int(args[0])
+        out["tile_height"] = int(args[1])
+        if len(args) >= 3:
+            out["stencil_width"] = int(args[2])
+        if len(args) >= 4:
+            out["stencil_height"] = int(args[3])
+    return out
+
+
+def _parse_env(env: dict) -> dict:
+    """Env tier: TPUSCRATCH_* variables (runtime discovery only)."""
+    out = {}
+    if "TPUSCRATCH_DTYPE" in env:
+        out["dtype"] = env["TPUSCRATCH_DTYPE"]
+    if "TPUSCRATCH_NO_LOG" in env:
+        out["log"] = env["TPUSCRATCH_NO_LOG"] not in ("1", "true")
+    if "TPUSCRATCH_MESH" in env:  # e.g. "2x4"
+        out["mesh_shape"] = tuple(
+            int(x) for x in env["TPUSCRATCH_MESH"].split("x")
+        )
+    if env.get("TPUSCRATCH_ABORT_ON_ERROR", "") in ("1", "true", "yes"):
+        out["error_policy"] = ErrorPolicy.ABORT
+    return out
+
+
+def _parse_flags(argv: Sequence[str]) -> dict:
+    """``--name=value`` pairs (dashes in names map to underscores)."""
+    fields = {f.name: f for f in dataclasses.fields(Config)}
+    out = {}
+    for a in argv:
+        if a.startswith("--"):
+            if "=" not in a:
+                # refuse the space-separated form rather than silently
+                # dropping the flag and mis-parsing its value as a
+                # positional argument
+                raise ValueError(
+                    f"flag {a} needs a value: use {a}=VALUE"
+                )
+            key, value = a[2:].split("=", 1)
+            key = key.replace("-", "_")
+            if key not in fields:
+                raise ValueError(
+                    f"unknown config flag --{key}; fields: {sorted(fields)}"
+                )
+            out[key] = _coerce(key, fields[key].default, value)
+    return out
