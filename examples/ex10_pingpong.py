@@ -25,7 +25,10 @@ def main(argv=None) -> None:
     cfg = Config.load(argv)
     sizes = (8, 1024, 65536, 1 << 20)
     if "elements" in cfg.explicit:
-        sizes = tuple(s for s in sizes if s <= cfg.elements) or (cfg.elements,)
+        # sweep the presets below the requested size AND the size itself
+        sizes = tuple(
+            sorted({s for s in sizes if s < cfg.elements} | {cfg.elements})
+        )
     banner("pingpong (test-benchmark)")
     mesh = make_mesh_1d("x")
     ok = verify_echo(mesh, "x", 4096)

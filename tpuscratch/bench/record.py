@@ -26,13 +26,12 @@ import sys
 import os
 
 
+from tpuscratch.runtime.hostenv import on_device_requested
+
+
 class Needs(RuntimeError):
     """A config's hardware prerequisite is absent — an expected skip, not
     a failure (exit code stays 0)."""
-
-
-def _env_true(name: str) -> bool:
-    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
 
 
 def _platform():
@@ -233,7 +232,7 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     from tpuscratch.runtime.topology import factor2d
 
     avail = len(jax.devices())
-    degenerate = avail < 16 and _env_true("TPUSCRATCH_ON_DEVICE")
+    degenerate = avail < 16 and on_device_requested()
     if avail < 16 and not degenerate:
         raise Needs(
             "config 4 needs a 4x4 mesh (16 devices); set "
@@ -244,8 +243,12 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     n = 16 if avail >= 16 else 1 << (avail.bit_length() - 1)
     dims = (4, 4) if n == 16 else factor2d(n)
     mesh = make_mesh_2d(dims, devices=jax.devices()[:n])
-    best, _ = _best_stencil(("xla", "overlap", "deep:4"), 4,
-                         (8192, 8192), 10, mesh, iters)
+    # the remote-DMA kernel is a real contender on chips; under the CPU
+    # proxy it would run in the Mosaic interpreter (hours at this size)
+    impls = ("xla", "overlap", "deep:4") + (
+        ("dma",) if jax.default_backend() == "tpu" else ()
+    )
+    best, _ = _best_stencil(impls, 4, (8192, 8192), 10, mesh, iters)
     _emit(
         out,
         config=4,
@@ -264,7 +267,7 @@ def config5_weak_scaling(out: list, per_chip: int = 1024, iters: int = 3) -> Non
     from tpuscratch.bench.weak_scaling import bench_weak_scaling, efficiency
 
     counts = [n for n in (1, 2, 4, 8, 16) if n <= len(jax.devices())]
-    degenerate = len(counts) < 2 and _env_true("TPUSCRATCH_ON_DEVICE")
+    degenerate = len(counts) < 2 and on_device_requested()
     if len(counts) < 2 and not degenerate:
         raise Needs(
             "weak scaling needs >= 2 devices; set TPUSCRATCH_ON_DEVICE=1 "

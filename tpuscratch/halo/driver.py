@@ -98,6 +98,21 @@ def make_stencil_program(
     )
 
 
+def _setup(world_shape, mesh: Optional[Mesh], halo, periodic: bool):
+    """Shared driver prologue: default mesh, topology, divisibility check,
+    layout and spec construction."""
+    mesh = mesh if mesh is not None else make_mesh_2d()
+    topo = topology_of(mesh, periodic=periodic)
+    rows, cols = topo.dims
+    if world_shape[0] % rows or world_shape[1] % cols:
+        raise ValueError(f"world {world_shape} not divisible by mesh {topo.dims}")
+    layout = TileLayout(
+        world_shape[0] // rows, world_shape[1] // cols, halo[0], halo[1]
+    )
+    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    return mesh, topo, layout, spec
+
+
 def checkpointed_stencil(
     world: np.ndarray,
     steps: int,
@@ -124,17 +139,9 @@ def checkpointed_stencil(
     """
     from tpuscratch.runtime import checkpoint
 
-    mesh = mesh if mesh is not None else make_mesh_2d()
-    topo = topology_of(mesh, periodic=periodic)
-    rows, cols = topo.dims
-    if world.shape[0] % rows or world.shape[1] % cols:
-        raise ValueError(f"world {world.shape} not divisible by mesh {topo.dims}")
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
-    layout = TileLayout(
-        world.shape[0] // rows, world.shape[1] // cols, halo[0], halo[1]
-    )
-    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    mesh, topo, layout, spec = _setup(world.shape, mesh, halo, periodic)
 
     tiles = decompose(world, topo, layout)
     start = 0
@@ -175,15 +182,7 @@ def distributed_stencil(
     """End-to-end convenience: decompose over the mesh (default: all
     devices, most-square), iterate, reassemble. A 1x1 mesh gives the
     single-device periodic stencil (the self-wrap halo exchange)."""
-    mesh = mesh if mesh is not None else make_mesh_2d()
-    topo = topology_of(mesh, periodic=periodic)
-    rows, cols = topo.dims
-    if world.shape[0] % rows or world.shape[1] % cols:
-        raise ValueError(f"world {world.shape} not divisible by mesh {topo.dims}")
-    layout = TileLayout(
-        world.shape[0] // rows, world.shape[1] // cols, halo[0], halo[1]
-    )
-    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    mesh, topo, layout, spec = _setup(world.shape, mesh, halo, periodic)
     program = make_stencil_program(mesh, spec, steps, coeffs, impl)
     out = program(jnp.asarray(decompose(world, topo, layout)))
     return assemble(np.asarray(out), topo, layout)
