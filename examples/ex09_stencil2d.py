@@ -40,10 +40,10 @@ def main(argv=None) -> None:
     banner("stencil2d halo exchange (flagship)")
     mesh = make_mesh_2d((2, 4))
     topo = topology_of(mesh, periodic=True)
-    if cfg.stencil_height < 3 or cfg.stencil_width < 3:
+    if cfg.stencil_height // 2 < 1 or cfg.stencil_width // 2 < 1:
         raise SystemExit(
             f"stencil {cfg.stencil_height}x{cfg.stencil_width} has no ghost "
-            "ring (halo = stencil//2 = 0); use >= 3x3"
+            "ring (halo = stencil//2 = 0); use >= 2x2"
         )
     lay = TileLayout.for_stencil(
         tile_h, tile_w, cfg.stencil_height, cfg.stencil_width

@@ -98,7 +98,8 @@ def make_stencil_program(
     )
 
 
-def _setup(world_shape, mesh: Optional[Mesh], halo, periodic: bool):
+def _setup(world_shape, mesh: Optional[Mesh], halo, periodic: bool,
+           neighbors: int = 8):
     """Shared driver prologue: default mesh, topology, divisibility check,
     layout and spec construction."""
     mesh = mesh if mesh is not None else make_mesh_2d()
@@ -109,7 +110,8 @@ def _setup(world_shape, mesh: Optional[Mesh], halo, periodic: bool):
     layout = TileLayout(
         world_shape[0] // rows, world_shape[1] // cols, halo[0], halo[1]
     )
-    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    spec = HaloSpec(layout=layout, topology=topo, axes=tuple(mesh.axis_names),
+                    neighbors=neighbors)
     return mesh, topo, layout, spec
 
 

@@ -1,0 +1,14 @@
+"""Distributed solvers built from the framework's primitives.
+
+The reference stops at the mechanics — halo exchange with a no-op
+``Compute`` (/root/reference/stencil2d/mpi-2d-stencil-subarray.cpp:27) and
+a distributed dot product (/root/reference/mpicuda2.cu) — and never
+composes them into an algorithm. This package is the composition: a
+conjugate-gradient Poisson solver whose matvec is the halo-exchanged
+5-point operator and whose inner products are the psum dot product, i.e.
+both reference flagships in one loop.
+"""
+
+from tpuscratch.solvers.cg import cg, dirichlet_laplacian, poisson_solve
+
+__all__ = ["cg", "dirichlet_laplacian", "poisson_solve"]
