@@ -20,9 +20,12 @@ sequence-parallel attention schemes:
   rank, microbatches streaming through an open ppermute chain.
 - ``expert``: expert parallelism — capacity-routed MoE dispatch/combine
   via all_to_all over an expert axis.
+- ``fft``: pencil-decomposition 2D FFT — local transforms plus a global
+  all_to_all transpose (the FFTW-MPI/heFFTe pattern).
 """
 
 from tpuscratch.parallel.expert import expert_parallel_ffn, topk_routing  # noqa: F401
+from tpuscratch.parallel.fft import fft2_sharded, ifft2_sharded  # noqa: F401
 from tpuscratch.parallel.pipeline import bubble_fraction, pipeline_apply  # noqa: F401
 from tpuscratch.parallel.ring import ring_scan  # noqa: F401
 from tpuscratch.parallel.ring_attention import ring_attention  # noqa: F401

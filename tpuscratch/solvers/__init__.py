@@ -6,9 +6,18 @@ a distributed dot product (/root/reference/mpicuda2.cu) — and never
 composes them into an algorithm. This package is the composition: a
 conjugate-gradient Poisson solver whose matvec is the halo-exchanged
 5-point operator and whose inner products are the psum dot product, i.e.
-both reference flagships in one loop.
+both reference flagships in one loop — and its spectral sibling, the
+periodic Poisson solve by distributed FFT diagonalization.
 """
 
+from tpuscratch.parallel.fft import ifft2_from_pencil
 from tpuscratch.solvers.cg import cg, dirichlet_laplacian, poisson_solve
+from tpuscratch.solvers.spectral import periodic_poisson_fft
 
-__all__ = ["cg", "dirichlet_laplacian", "poisson_solve"]
+__all__ = [
+    "cg",
+    "dirichlet_laplacian",
+    "poisson_solve",
+    "ifft2_from_pencil",
+    "periodic_poisson_fft",
+]
