@@ -180,3 +180,31 @@ class TestHaloTraffic:
         # 4x64 + 2 E/W strips of 64x4 + 4 corners of 4x4, f32, / 4 steps
         b4, _ = halo_traffic_per_chip((2, 2), (64, 64), impl="deep:4")
         assert b4 == ((2 * 4 * 64 + 2 * 64 * 4 + 4 * 4 * 4) * 4) / 4
+
+
+class TestCollectiveBench:
+    def test_verify_all_collectives(self, devices):
+        from tpuscratch.bench.collective_bench import verify
+        from tpuscratch.runtime.mesh import make_mesh_1d
+
+        assert verify(make_mesh_1d("x", 8))
+
+    def test_sweep_point_shapes_and_busbw(self, devices):
+        from tpuscratch.bench.collective_bench import (
+            COLLECTIVES,
+            _bus_bytes,
+            sweep,
+        )
+        from tpuscratch.runtime.mesh import make_mesh_1d
+
+        mesh = make_mesh_1d("x", 8)
+        rs = sweep(mesh, sizes_bytes=(4096,), rounds=2, iters=2)
+        assert len(rs) == len(COLLECTIVES)
+        for r in rs:
+            assert r.p50 > 0 and r.bytes_moved > 0
+        # nccl-tests conventions: allreduce moves 2(n-1)/n, ring moves 1x,
+        # all_gather's (n-1)/n applies to the GATHERED total (n * shard)
+        assert _bus_bytes("psum", 8, 4096, 1) == 2 * 7 * 4096 // 8
+        assert _bus_bytes("ppermute", 8, 4096, 1) == 4096
+        assert _bus_bytes("all_to_all", 8, 4096, 1) == 7 * 4096 // 8
+        assert _bus_bytes("all_gather", 8, 4096, 1) == 7 * 4096

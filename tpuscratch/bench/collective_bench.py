@@ -1,0 +1,182 @@
+"""Collective microbenchmark sweep — the nccl-tests analogue.
+
+The reference exercises its backend's collectives ad hoc inside programs
+(Gather in mpi6.cpp:89, Reduce in mpicuda2.cu:293, Allreduce in
+mpi9.cpp:51-54); the standard way to characterize a comm backend today is
+a per-collective bandwidth sweep (nccl-tests / its TPU equivalents). This
+module sweeps the framework's five collective shapes over message sizes
+with the repo's fenced-timing methodology and reports **bus bandwidth**
+— algorithm bandwidth scaled by the data each link must actually carry —
+so numbers are comparable across collectives and device counts:
+
+    allreduce       busBW = algBW * 2(n-1)/n
+    all_gather      busBW = algBW * (n-1)/n    (size = the gathered total)
+    reduce_scatter  busBW = algBW * (n-1)/n
+    all_to_all      busBW = algBW * (n-1)/n
+    ppermute ring   busBW = algBW             (every link carries the shard)
+
+Each op chains ``rounds`` times through a ``lax.scan`` whose carry feeds
+the next round, so a multi-round measurement cannot be constant-folded
+or overlapped away; shape-changing collectives are folded back to the
+input shape inside the round (slice / gather-back), which adds local
+data movement but no extra collective traffic.
+
+On this repo's hardware the sweep is a CPU-mesh proxy (one real chip =
+no links); the harness is the deliverable, ready to re-run on a slice.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.comm import run_spmd
+
+#: per-device payload sizes, 1 KiB .. 4 MiB f32 by default
+DEFAULT_SIZES = tuple(1024 * 4**i for i in range(7))
+
+COLLECTIVES = ("psum", "all_gather", "psum_scatter", "all_to_all", "ppermute")
+
+
+def _round_fn(name: str, axis: str, n: int):
+    """One chained round: local shard -> same-shaped local shard."""
+    if name == "psum":
+        # mean keeps the carry's scale stable across rounds
+        return lambda x: lax.psum(x, axis) * (1.0 / n)
+    if name == "all_gather":
+        # gather the full axis, keep my stripe as the next carry
+        def f(x):
+            full = lax.all_gather(x, axis, tiled=True)
+            i = lax.axis_index(axis)
+            return lax.dynamic_slice_in_dim(full, i * x.shape[0], x.shape[0])
+        return f
+    if name == "psum_scatter":
+        # scatter-reduce to 1/n, gather back to the carry shape
+        def f(x):
+            piece = lax.psum_scatter(x, axis, tiled=True) * (1.0 / n)
+            return lax.all_gather(piece, axis, tiled=True)
+        return f
+    if name == "all_to_all":
+        return lambda x: lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True
+        )
+    if name == "ppermute":
+        def f(x):
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            return lax.ppermute(x, axis, perm)
+        return f
+    raise ValueError(f"unknown collective {name!r}; have {COLLECTIVES}")
+
+
+def _bus_bytes(name: str, n: int, shard_bytes: int, rounds: int) -> int:
+    """Bytes-per-link-convention (nccl-tests busBW) for one sweep point."""
+    if name == "psum":
+        per_round = 2 * (n - 1) * shard_bytes // n
+    elif name == "all_gather":
+        # convention applies (n-1)/n to the GATHERED total (n * shard):
+        # each link in a ring gather really carries (n-1) shards
+        per_round = (n - 1) * shard_bytes
+    elif name in ("psum_scatter", "all_to_all"):
+        per_round = (n - 1) * shard_bytes // n
+    elif name == "ppermute":
+        per_round = shard_bytes
+    else:
+        raise ValueError(name)
+    # psum_scatter's fold-back all_gather moves real bytes too, but it is
+    # harness plumbing, not the op under test: excluded by convention
+    return per_round * rounds
+
+
+def collective_program(mesh: Mesh, axis: str, name: str, rounds: int):
+    """Compiled SPMD program: ``rounds`` chained executions of ``name``."""
+    n = mesh.devices.size
+    step = _round_fn(name, axis, n)
+
+    def body(x):
+        def scan_step(carry, _):
+            return step(carry), ()
+
+        out, _ = lax.scan(scan_step, x, None, length=rounds)
+        return out
+
+    return run_spmd(mesh, body, P(axis), P(axis))
+
+
+def verify(mesh: Mesh, axis: str = "x", n_elems: int = 256) -> bool:
+    """PASSED/FAILED self-check: one round of every collective against
+    numpy (the reference's echo-verify convention,
+    mpi-pingpong-gpu.cpp:58-61)."""
+    n = mesh.devices.size
+    rng = np.random.default_rng(0)
+    world = rng.standard_normal((n, n_elems)).astype(np.float32)
+    flat = jnp.asarray(world.reshape(-1))
+    ok = True
+    for name in COLLECTIVES:
+        out = np.asarray(collective_program(mesh, axis, name, 1)(flat))
+        out = out.reshape(n, n_elems)
+        if name == "psum":
+            expect = np.broadcast_to(world.mean(0), (n, n_elems))
+        elif name == "all_gather":
+            expect = world  # gather-then-keep-my-stripe is the identity
+        elif name == "psum_scatter":
+            expect = np.broadcast_to(world.mean(0), (n, n_elems))
+        elif name == "all_to_all":
+            blocks = world.reshape(n, n, n_elems // n)
+            expect = blocks.transpose(1, 0, 2).reshape(n, n_elems)
+        else:  # ppermute ring shift
+            expect = np.roll(world, 1, axis=0)
+        ok &= bool(np.allclose(out, expect, atol=1e-5))
+    return ok
+
+
+def sweep(
+    mesh: Mesh,
+    axis: str = "x",
+    names: Sequence[str] = COLLECTIVES,
+    sizes_bytes: Sequence[int] = DEFAULT_SIZES,
+    rounds: int = 10,
+    iters: int = 10,
+    fence: str = "block",
+) -> list[BenchResult]:
+    """Per-collective bandwidth sweep; GB/s in the results is busBW."""
+    n = mesh.devices.size
+    results = []
+    for name in names:
+        for size in sizes_bytes:
+            n_elems = max(n, size // 4 // n * n)  # shard size, axis-divisible
+            f = collective_program(mesh, axis, name, rounds)
+            x = jnp.zeros(n * n_elems, dtype=jnp.float32)
+            results.append(
+                time_device(
+                    f, x, iters=iters, warmup=2, fence=fence,
+                    name=f"{name} {n_elems * 4}B x{rounds}",
+                    bytes_moved=_bus_bytes(name, n, n_elems * 4, rounds),
+                )
+            )
+    return results
+
+
+def main() -> int:
+    from tpuscratch.runtime.hostenv import ensure_devices
+
+    jax = ensure_devices(8)
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    mesh = make_mesh_1d("x", 8)
+    print(f"# collective sweep on {mesh.devices.size}-device "
+          f"{jax.default_backend()} mesh (busBW convention)")
+    print("# echo-verify:", "PASSED" if verify(mesh) else "FAILED")
+    for r in sweep(mesh):
+        print(r.summary())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -38,6 +38,21 @@ def _transpose(x: jnp.ndarray, axis_name: str, *, to_pencil: bool) -> jnp.ndarra
     )
 
 
+def _transpose_pair(re, im, axis_name: str, *, to_pencil: bool):
+    """:func:`_transpose` for an (re, im) plane pair as ONE collective.
+
+    The transpose dominates the distributed FFT's wall clock, so the pair
+    path stacks the planes and pays a single all_to_all (of twice the
+    payload) instead of two latencies per transpose.
+    """
+    z = jnp.stack([re, im])
+    split, concat = (2, 1) if to_pencil else (1, 2)
+    z = lax.all_to_all(
+        z, axis_name, split_axis=split, concat_axis=concat, tiled=True
+    )
+    return z[0], z[1]
+
+
 def fft2_sharded(
     local: jnp.ndarray,
     axis_name: str,
@@ -135,12 +150,10 @@ def fft2_sharded_pair(
     (re, im) pair in the same layout contract as :func:`fft2_sharded`.
     """
     re, im = _dft_axis(re, im, 1, inverse)
-    re = _transpose(re, axis_name, to_pencil=True)
-    im = _transpose(im, axis_name, to_pencil=True)
+    re, im = _transpose_pair(re, im, axis_name, to_pencil=True)
     re, im = _dft_axis(re, im, 0, inverse)
     if restore_layout:
-        re = _transpose(re, axis_name, to_pencil=False)
-        im = _transpose(im, axis_name, to_pencil=False)
+        re, im = _transpose_pair(re, im, axis_name, to_pencil=False)
     return re, im
 
 
@@ -159,8 +172,7 @@ def ifft2_from_pencil(pencil: jnp.ndarray, axis_name: str) -> jnp.ndarray:
 def ifft2_from_pencil_pair(re, im, axis_name: str):
     """Pair-plane (MXU matmul) version of :func:`ifft2_from_pencil`."""
     re, im = _dft_axis(re, im, 0, True)
-    re = _transpose(re, axis_name, to_pencil=False)
-    im = _transpose(im, axis_name, to_pencil=False)
+    re, im = _transpose_pair(re, im, axis_name, to_pencil=False)
     return _dft_axis(re, im, 1, True)
 
 

@@ -10,7 +10,6 @@ both reference flagships in one loop — and its spectral sibling, the
 periodic Poisson solve by distributed FFT diagonalization.
 """
 
-from tpuscratch.parallel.fft import ifft2_from_pencil
 from tpuscratch.solvers.cg import cg, dirichlet_laplacian, poisson_solve
 from tpuscratch.solvers.spectral import periodic_poisson_fft
 
@@ -18,6 +17,5 @@ __all__ = [
     "cg",
     "dirichlet_laplacian",
     "poisson_solve",
-    "ifft2_from_pencil",
     "periodic_poisson_fft",
 ]
