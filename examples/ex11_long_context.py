@@ -88,6 +88,26 @@ def main(argv=None) -> None:
             + f" -> {ok}"
         )
 
+    banner("sequence-parallel SSM recurrence (aggregate exchange)")
+    from tpuscratch.parallel.ssm import ssm_scan
+
+    T, D = n * 16, 8
+    rng = np.random.default_rng(7)
+    a = rng.uniform(0.2, 0.99, (T, D)).astype(np.float32)
+    b = rng.standard_normal((T, D)).astype(np.float32)
+    got = np.asarray(run_spmd(
+        mesh, lambda aa, bb: ssm_scan(aa, bb, "seq"),
+        (P("seq"), P("seq")), P("seq"),
+    )(jnp.asarray(a), jnp.asarray(b)))
+    h = np.zeros(D, dtype=np.float64)
+    expect = np.empty((T, D))
+    for t in range(T):
+        h = a[t] * h + b[t]
+        expect[t] = h
+    err = np.abs(got - expect).max()
+    print(f"h_t = a_t h_(t-1) + b_t, seq={T} over {n} ranks: err {err:.2e} "
+          f"({'PASSED' if err < 1e-4 else 'FAILED'})")
+
 
 if __name__ == "__main__":
     main()

@@ -56,10 +56,11 @@ def _round_fn(name: str, axis: str, n: int):
             return lax.dynamic_slice_in_dim(full, i * x.shape[0], x.shape[0])
         return f
     if name == "psum_scatter":
-        # scatter-reduce to 1/n, gather back to the carry shape
+        # scatter-reduce to 1/n; restore the carry shape LOCALLY (tile) so
+        # the round's only collective traffic is the op under test
         def f(x):
             piece = lax.psum_scatter(x, axis, tiled=True) * (1.0 / n)
-            return lax.all_gather(piece, axis, tiled=True)
+            return jnp.tile(piece, n)
         return f
     if name == "all_to_all":
         return lambda x: lax.all_to_all(
@@ -124,7 +125,10 @@ def verify(mesh: Mesh, axis: str = "x", n_elems: int = 256) -> bool:
         elif name == "all_gather":
             expect = world  # gather-then-keep-my-stripe is the identity
         elif name == "psum_scatter":
-            expect = np.broadcast_to(world.mean(0), (n, n_elems))
+            # rank r holds its scattered piece (mean of everyone's r-th
+            # slice), tiled back to the carry shape locally
+            pieces = world.mean(0).reshape(n, n_elems // n)
+            expect = np.stack([np.tile(pieces[r], n) for r in range(n)])
         elif name == "all_to_all":
             blocks = world.reshape(n, n, n_elems // n)
             expect = blocks.transpose(1, 0, 2).reshape(n, n_elems)

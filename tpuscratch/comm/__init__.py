@@ -11,6 +11,7 @@ Mapping table (reference -> here):
 - MPI_Scatter           -> ``scatter_from_root``
 - MPI_Isend/Irecv rings -> ``ring_shift`` / ``neighbor_exchange`` (ppermute)
 - MPI_Send/Recv pairs   -> ``send_pairs`` / ``pingpong``
+- MPI_Scan/Exscan       -> ``prefix_sum``
 - sub-communicators     -> collectives over one axis of a multi-axis mesh
 """
 
@@ -22,6 +23,7 @@ from tpuscratch.comm.collectives import (  # noqa: F401
     allreduce_sum,
     broadcast,
     gather_to_root,
+    prefix_sum,
     reduce_scatter,
     reduce_to_root,
     scatter_from_root,

@@ -105,3 +105,22 @@ def all_to_all(x, axis: str, split_axis: int = 0, concat_axis: int = 0, tiled: b
     return lax.all_to_all(
         x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
     )
+
+
+def prefix_sum(x, axis: AxisName, exclusive: bool = False):
+    """Per-rank running sum along the axis — MPI_Scan / MPI_Exscan.
+
+    Rank r receives ``sum(x_0..x_r)`` (inclusive) or ``sum(x_0..x_{r-1})``
+    (exclusive; rank 0 gets zeros, where MPI_Exscan leaves it undefined).
+    Rounds out the MPI collective family the reference's backend offers
+    (SURVEY.md §2.8); the implementation is one all_gather + a static
+    masked sum — the right trade at mesh sizes where the gather is one
+    ICI hop, vs a log-depth ppermute tree.
+    """
+    idx = _axis_index(axis)
+    gathered = lax.all_gather(x, axis)  # (n, *x.shape), same on every rank
+    n = gathered.shape[0]
+    ranks = jnp.arange(n)
+    keep = (ranks < idx) if exclusive else (ranks <= idx)
+    mask = keep.reshape((n,) + (1,) * x.ndim).astype(gathered.dtype)
+    return jnp.sum(gathered * mask, axis=0)

@@ -14,3 +14,5 @@ from tpuscratch.models.transformer import (  # noqa: F401
     model_apply,
     train_step,
 )
+from tpuscratch.models.ssm import SSMConfig, ssm_block  # noqa: F401
+from tpuscratch.models.ssm import init_params as init_ssm_params  # noqa: F401

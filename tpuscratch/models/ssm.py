@@ -1,0 +1,59 @@
+"""A selective state-space (SSM) block — the second model family.
+
+A minimal Mamba-shaped layer over the sequence-parallel recurrence
+(parallel/ssm.py): input-dependent decay ``a_t = sigmoid(x_t W_a + c)``,
+drive ``b_t = x_t W_b``, hidden scan ``h_t = a_t h_{t-1} + b_t`` carried
+ACROSS sequence shards by ``ssm_scan``, and a readout with residual.
+Where models.transformer composes ring attention + MoE over a (dp, sp)
+mesh, this block is the recurrence-based long-context alternative: the
+sequence axis shards the same way, but the cross-device traffic is O(n*D)
+aggregates instead of rotating KV blocks.
+
+Everything is plain lax, so jax.grad flows through the distributed scan
+unmodified — the training-parity test checks the sharded gradient against
+the single-device oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from tpuscratch.parallel.ssm import local_scan, ssm_scan
+
+
+@dataclasses.dataclass(frozen=True)
+class SSMConfig:
+    d_model: int = 16
+    d_state: int = 32
+
+
+def init_params(seed: int, cfg: SSMConfig) -> dict:
+    k = jax.random.split(jax.random.PRNGKey(seed), 3)
+    s_in = cfg.d_model ** -0.5
+    return {
+        "w_a": jax.random.normal(k[0], (cfg.d_model, cfg.d_state)) * s_in,
+        # start decays near 1 (long memory): sigmoid(2) ~ 0.88
+        "c_a": jnp.full((cfg.d_state,), 2.0),
+        "w_b": jax.random.normal(k[1], (cfg.d_model, cfg.d_state)) * s_in,
+        "w_out": jax.random.normal(k[2], (cfg.d_state, cfg.d_model))
+        * cfg.d_state ** -0.5,
+    }
+
+
+def ssm_block(params: dict, x: jnp.ndarray, seq_axis: str | None) -> jnp.ndarray:
+    """Apply the block to a (T_local, d_model) sequence shard.
+
+    ``seq_axis`` names the mesh axis the sequence is sharded over; None
+    runs the purely-local scan (the single-device oracle path).
+    """
+    a = jax.nn.sigmoid(x @ params["w_a"] + params["c_a"])
+    b = x @ params["w_b"]
+    if seq_axis is None:
+        (_, cum_b), _ = local_scan(a, b)  # inclusive scan from h_{-1}=0
+        h = cum_b
+    else:
+        h = ssm_scan(a, b, seq_axis)
+    return x + h @ params["w_out"]

@@ -22,6 +22,9 @@ sequence-parallel attention schemes:
   via all_to_all over an expert axis.
 - ``fft``: pencil-decomposition 2D FFT — local transforms plus a global
   all_to_all transpose (the FFTW-MPI/heFFTe pattern).
+- ``ssm``: sequence-parallel linear recurrence — local associative scan
+  plus an exclusive scan of shard aggregates (distributed Blelloch-style
+  prefix structure, O(n*d_state) bytes regardless of sequence length).
 """
 
 from tpuscratch.parallel.expert import expert_parallel_ffn, topk_routing  # noqa: F401
@@ -29,4 +32,5 @@ from tpuscratch.parallel.fft import fft2_sharded, ifft2_sharded  # noqa: F401
 from tpuscratch.parallel.pipeline import bubble_fraction, pipeline_apply  # noqa: F401
 from tpuscratch.parallel.ring import ring_scan  # noqa: F401
 from tpuscratch.parallel.ring_attention import ring_attention  # noqa: F401
+from tpuscratch.parallel.ssm import ssm_scan  # noqa: F401
 from tpuscratch.parallel.ulysses import ulysses_attention  # noqa: F401

@@ -1,0 +1,75 @@
+"""Sequence-parallel linear recurrence — the SSM scan over a mesh axis.
+
+The third long-context strategy next to ring attention and Ulysses: state
+-space models advance ``h_t = a_t * h_{t-1} + b_t`` along the sequence,
+and a sequence sharded across devices needs the recurrence carried over
+shard boundaries. The classical distributed-prefix structure applies
+(Blelloch scan at cluster scale): the pair ``(a, b)`` composes
+associatively —
+
+    (a1, b1) . (a2, b2) = (a1*a2, b2 + a2*b1)   [apply seg 1, then seg 2]
+
+— so each device scans its shard locally (``lax.associative_scan`` on
+the VPU), publishes its shard AGGREGATE (one (D,) pair, not the
+sequence), and the cross-device exclusive scan of those n aggregates
+costs one small all_gather + a static n-step combine, exactly the
+prefix_sum pattern (comm.collectives.prefix_sum) lifted to a
+non-commutative monoid. Communication is O(n * D) bytes total,
+independent of sequence length — the same "exchange aggregates, not
+payloads" shape as the reference's two-phase reduction
+(/root/reference/mpicuda4.cu:157-185, per-block partials then a final
+combine), here along time instead of across a vector.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _combine(left, right):
+    """Compose two (A, B) recurrence segments, left first."""
+    a1, b1 = left
+    a2, b2 = right
+    return a1 * a2, b2 + a2 * b1
+
+
+def local_scan(a: jnp.ndarray, b: jnp.ndarray):
+    """Inclusive scan of ``h_t = a_t h_{t-1} + b_t`` (h_{-1}=0) along
+    axis 0, plus the shard aggregate (A, B) describing the whole shard as
+    one segment."""
+    cum_a, cum_b = lax.associative_scan(_combine, (a, b), axis=0)
+    return (cum_a, cum_b), (cum_a[-1], cum_b[-1])
+
+
+def ssm_scan(a: jnp.ndarray, b: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Distributed inclusive scan of the recurrence over ``axis_name``.
+
+    ``a``, ``b`` are this device's (T/n, ...) shards of the per-step decay
+    and input sequences; returns the (T/n, ...) shard of ``h``. SPMD: call
+    inside shard_map over a 1D (sub)mesh axis.
+    """
+    if a.shape != b.shape:
+        raise ValueError(f"a {a.shape} != b {b.shape}")
+    (cum_a, cum_b), (agg_a, agg_b) = local_scan(a, b)
+
+    # exclusive scan of the shard aggregates over the mesh axis: the
+    # incoming state h_in each shard must continue from. Aggregates are
+    # tiny (one element per feature), so one all_gather + a static
+    # masked combine beats a log-tree of ppermutes at mesh sizes.
+    me = lax.axis_index(axis_name)
+    all_a = lax.all_gather(agg_a, axis_name)  # (n, ...) on every rank
+    all_b = lax.all_gather(agg_b, axis_name)
+    n = all_a.shape[0]
+    carry = (jnp.ones_like(agg_a), jnp.zeros_like(agg_b))
+    for i in range(n):  # static in the trace; masked for ranks >= me
+        combined = _combine(carry, (all_a[i], all_b[i]))
+        use = i < me
+        carry = tuple(
+            jnp.where(use, c_new, c_old)
+            for c_new, c_old in zip(combined, carry)
+        )
+    _, h_in = carry
+
+    # continue the local scan from h_in: h_t = cum_b_t + cum_a_t * h_in
+    return cum_b + cum_a * h_in
