@@ -18,8 +18,8 @@ so numbers are comparable across collectives and device counts:
 Each op chains ``rounds`` times through a ``lax.scan`` whose carry feeds
 the next round, so a multi-round measurement cannot be constant-folded
 or overlapped away; shape-changing collectives are folded back to the
-input shape inside the round (slice / gather-back), which adds local
-data movement but no extra collective traffic.
+input shape inside the round by purely LOCAL ops (slice / tile), so the
+round's only collective traffic is the op under test.
 
 On this repo's hardware the sweep is a CPU-mesh proxy (one real chip =
 no links); the harness is the deliverable, ready to re-run on a slice.
@@ -88,8 +88,6 @@ def _bus_bytes(name: str, n: int, shard_bytes: int, rounds: int) -> int:
         per_round = shard_bytes
     else:
         raise ValueError(name)
-    # psum_scatter's fold-back all_gather moves real bytes too, but it is
-    # harness plumbing, not the op under test: excluded by convention
     return per_round * rounds
 
 

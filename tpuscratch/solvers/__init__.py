@@ -6,16 +6,21 @@ a distributed dot product (/root/reference/mpicuda2.cu) — and never
 composes them into an algorithm. This package is the composition: a
 conjugate-gradient Poisson solver whose matvec is the halo-exchanged
 5-point operator and whose inner products are the psum dot product, i.e.
-both reference flagships in one loop — and its spectral sibling, the
-periodic Poisson solve by distributed FFT diagonalization.
+both reference flagships in one loop — its spectral sibling, the periodic
+Poisson solve by distributed FFT diagonalization — and geometric
+multigrid, the O(1)-cycle solver built from halo-exchanged smoothing and
+local inter-level transfers.
 """
 
 from tpuscratch.solvers.cg import cg, dirichlet_laplacian, poisson_solve
+from tpuscratch.solvers.multigrid import mg_poisson_solve, v_cycle
 from tpuscratch.solvers.spectral import periodic_poisson_fft
 
 __all__ = [
     "cg",
     "dirichlet_laplacian",
     "poisson_solve",
+    "mg_poisson_solve",
+    "v_cycle",
     "periodic_poisson_fft",
 ]

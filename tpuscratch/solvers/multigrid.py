@@ -1,0 +1,237 @@
+"""Geometric multigrid V-cycle for the periodic Poisson problem.
+
+The third solver over the framework's operator family: CG (solvers/cg.py)
+iterates O(sqrt(cond)) halo-matvecs on the Dirichlet problem, the
+spectral method (solvers/spectral.py) diagonalizes the periodic problem
+in one FFT round trip; multigrid solves the same periodic system in O(1)
+V-cycles of purely local + neighbor work — no global transpose, which is
+the regime that wins once the grid outgrows what two all_to_alls can
+move cheaply. Measured contraction ~0.25 per V(2,2)-cycle, grid-size
+independent (tests assert it), i.e. ~10 cycles to 1e-6.
+
+Why the PERIODIC problem: cell-centered coarsening (the choice that makes
+the inter-level transfers cheap and local) nests exactly on a torus. On a
+Dirichlet box the wall sits h/2 from the first cell center, a distance
+that doubles every coarsening, and with rediscretized unit-form operators
+the boundary mismatch caps V-cycle contraction near ~0.45 and makes the
+inter-level scaling empirical (both measured here before the switch). The
+torus also exercises the framework's flagship boundary condition — the
+periodic 8/4-neighbor halo of the reference's stencil drivers
+(/root/reference/stencil2d/mpi-2d-stencil-subarray.cpp:49-52).
+
+TPU-shaped decisions:
+- EVERY level reuses the same 2D device mesh with a halved local tile, so
+  the only communication anywhere is the halo exchange inside smoothing,
+  restriction, and prolongation — all nearest-neighbor ppermutes on ICI.
+- Weighted-Jacobi smoothing (omega=0.8), not Gauss-Seidel: one fused
+  elementwise update over the whole tile, VPU-parallel; lexicographic GS
+  would serialize what XLA vectorizes.
+- Transfers are the adjoint pair: bilinear (cell-centered) prolongation
+  and full-weighting restriction R = P^T/4 ([1,3,3,1]/8 tensor stencil),
+  with the continuum (2h)^2/h^2 = 4 scaling on the restricted residual.
+  On the torus this is Galerkin-consistent; mean restriction or
+  piecewise-constant prolongation each cost ~2x in contraction
+  (0.45-0.65, measured).
+- One trace: the level recursion unrolls at trace time and the cycle
+  loop is a lax.while_loop on the psum'd residual — zero host round
+  trips, like CG.
+
+The singular constant mode is handled the spectral solver's way: solve
+``A x = b - mean(b)`` and return the zero-mean branch (Jacobi and both
+transfers preserve zero-mean on the torus, so the iteration never leaks
+into the nullspace beyond rounding).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.halo.exchange import HaloSpec, halo_exchange
+from tpuscratch.halo.layout import TileLayout
+from tpuscratch.runtime.mesh import make_mesh_2d, topology_of
+
+#: 1D full-weighting stencil, the adjoint of cell-centered bilinear
+#: interpolation (normalized to sum 1).
+_W4 = (0.125, 0.375, 0.375, 0.125)
+
+
+def _padded(core: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
+    """Embed a core tile and fill its 1-ghost ring from the torus."""
+    p = jnp.zeros(spec.layout.padded_shape, core.dtype)
+    p = lax.dynamic_update_slice(p, core, (1, 1))
+    return halo_exchange(p, spec)
+
+
+def periodic_laplacian(core: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
+    """``A @ core`` for the periodic 5-point operator, shard-local."""
+    u = _padded(core, spec)
+    return (
+        4.0 * u[1:-1, 1:-1]
+        - u[:-2, 1:-1] - u[2:, 1:-1] - u[1:-1, :-2] - u[1:-1, 2:]
+    )
+
+
+def jacobi_smooth(u, f, spec: HaloSpec, omega: float, sweeps: int):
+    """``sweeps`` damped-Jacobi iterations on ``A u = f`` (diagonal 4)."""
+    def body(_, u):
+        return u + (omega / 4.0) * (f - periodic_laplacian(u, spec))
+
+    return lax.fori_loop(0, sweeps, body, u)
+
+
+def restrict_fw(r: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
+    """Full-weighting restriction: [1,3,3,1]/8 tensor stencil over each
+    coarse cell's 4x4 fine neighborhood (needs the fine halo)."""
+    rp = _padded(r, spec)
+    ch, cw = r.shape[0] // 2, r.shape[1] // 2
+    acc = jnp.zeros((ch, cw), r.dtype)
+    for a, wa in enumerate(_W4):
+        for b, wb in enumerate(_W4):
+            acc = acc + wa * wb * lax.slice(
+                rp, (a, b), (a + 2 * ch - 1, b + 2 * cw - 1), (2, 2)
+            )
+    return acc
+
+
+def prolong_bilinear(e: jnp.ndarray, spec: HaloSpec) -> jnp.ndarray:
+    """Cell-centered bilinear prolongation: each fine cell is the
+    (9, 3, 3, 1)/16 blend of its 4 nearest coarse cells (coarse halo)."""
+    ep = _padded(e, spec)
+    c = ep[1:-1, 1:-1]
+    no, so = ep[:-2, 1:-1], ep[2:, 1:-1]
+    we, ea = ep[1:-1, :-2], ep[1:-1, 2:]
+    nw, ne = ep[:-2, :-2], ep[:-2, 2:]
+    sw, se = ep[2:, :-2], ep[2:, 2:]
+    f00 = (9 * c + 3 * no + 3 * we + nw) / 16
+    f01 = (9 * c + 3 * no + 3 * ea + ne) / 16
+    f10 = (9 * c + 3 * so + 3 * we + sw) / 16
+    f11 = (9 * c + 3 * so + 3 * ea + se) / 16
+    ch, cw = e.shape
+    top = jnp.stack([f00, f01], axis=-1).reshape(ch, 2 * cw)
+    bot = jnp.stack([f10, f11], axis=-1).reshape(ch, 2 * cw)
+    return jnp.stack([top, bot], axis=1).reshape(2 * ch, 2 * cw)
+
+
+def level_specs(layout: TileLayout, topo, axes, levels: int) -> list[HaloSpec]:
+    """One HaloSpec per level; level l's core is the top core >> l."""
+    specs = []
+    for l in range(levels):
+        th, tw = layout.core_h >> l, layout.core_w >> l
+        if th < 1 or tw < 1 or (l < levels - 1 and (th % 2 or tw % 2)):
+            raise ValueError(
+                f"tile {layout.core_h}x{layout.core_w} does not support "
+                f"{levels} levels (level {l} would be {th}x{tw})"
+            )
+        specs.append(
+            HaloSpec(
+                layout=TileLayout(th, tw, 1, 1),
+                topology=topo,
+                axes=axes,
+                neighbors=4,
+            )
+        )
+    return specs
+
+
+def v_cycle(
+    u, f, specs: list[HaloSpec], level: int = 0,
+    nu: int = 2, coarse_sweeps: int = 32, omega: float = 0.8,
+):
+    """One V-cycle on ``A u = f`` at ``level`` (recursion unrolls in trace)."""
+    spec = specs[level]
+    if level == len(specs) - 1:
+        return jacobi_smooth(u, f, spec, omega, coarse_sweeps)
+    u = jacobi_smooth(u, f, spec, omega, nu)
+    r = f - periodic_laplacian(u, spec)
+    rc = 4.0 * restrict_fw(r, spec)  # (2h)^2/h^2 keeps the unit-spacing form
+    ec = v_cycle(
+        jnp.zeros_like(rc), rc, specs, level + 1, nu, coarse_sweeps, omega
+    )
+    u = u + prolong_bilinear(ec, specs[level + 1])
+    return jacobi_smooth(u, f, spec, omega, nu)
+
+
+def mg_poisson_solve(
+    b_world: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_cycles: int = 50,
+    nu: int = 2,
+    coarse_sweeps: int = 32,
+    omega: float = 0.8,
+):
+    """Solve ``A x = b - mean(b)`` (periodic 5-point Laplacian) by
+    V-cycles, distributed over a 2D mesh.
+
+    Same contract as ``solvers.spectral.periodic_poisson_fft`` plus the
+    iteration report: returns ``(x_world, cycles, relres)`` with
+    zero-mean ``x``. ``levels`` defaults to the deepest the per-device
+    tile allows (coarsest tile >= 2 in both dims).
+    """
+    from tpuscratch.halo.driver import _setup, assemble, decompose
+
+    mesh, topo, layout, _ = _setup(
+        b_world.shape, mesh, (1, 1), periodic=True, neighbors=4
+    )
+    if levels is None:
+        levels = 1
+        while (
+            layout.core_h >> levels >= 2
+            and layout.core_w >> levels >= 2
+            and (layout.core_h >> (levels - 1)) % 2 == 0
+            and (layout.core_w >> (levels - 1)) % 2 == 0
+        ):
+            levels += 1
+    specs = level_specs(layout, topo, tuple(mesh.axis_names), levels)
+    axes = tuple(mesh.axis_names)
+    cells = float(b_world.shape[0] * b_world.shape[1])
+
+    def local(b_tile):
+        b = b_tile[0, 0]
+        f = b - lax.psum(jnp.sum(b), axes) / cells  # project out nullspace
+
+        def rs_of(u):
+            r = f - periodic_laplacian(u, specs[0])
+            return lax.psum(jnp.sum(r * r), axes)
+
+        rs0 = lax.psum(jnp.sum(f * f), axes)
+        stop2 = jnp.asarray(tol, f.dtype) ** 2 * rs0
+
+        def cond(st):
+            _, rs, prev, k = st
+            # stagnation guard: a healthy cycle contracts rs (the SQUARED
+            # norm) by ~0.06; under 2x means we are at the f32 residual
+            # floor and further cycles only burn time
+            return (k < max_cycles) & (rs > stop2) & (rs < 0.5 * prev)
+
+        def body(st):
+            u, rs, _, k = st
+            u = v_cycle(u, f, specs, 0, nu, coarse_sweeps, omega)
+            return u, rs_of(u), rs, k + 1
+
+        u0 = jnp.zeros_like(f)
+        u, rs, _, k = lax.while_loop(
+            cond, body,
+            (u0, rs0, jnp.asarray(np.inf, f.dtype), jnp.asarray(0, jnp.int32)),
+        )
+        u = u - lax.psum(jnp.sum(u), axes) / cells  # zero-mean branch
+        tiny = jnp.asarray(np.finfo(np.dtype(f.dtype)).tiny, f.dtype)
+        return u[None, None], k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
+
+    program = run_spmd(
+        mesh,
+        local,
+        P(*mesh.axis_names, None, None),
+        (P(*mesh.axis_names, None, None), P(), P()),
+    )
+    flat = TileLayout(layout.core_h, layout.core_w, 0, 0)
+    u_tiles, k, relres = program(jnp.asarray(decompose(b_world, topo, flat)))
+    return assemble(np.asarray(u_tiles), topo, flat), int(k), float(relres)
