@@ -208,3 +208,14 @@ class TestCollectiveBench:
         assert _bus_bytes("ppermute", 8, 4096, 1) == 4096
         assert _bus_bytes("all_to_all", 8, 4096, 1) == 7 * 4096 // 8
         assert _bus_bytes("all_gather", 8, 4096, 1) == 7 * 4096
+
+
+class TestFftBench:
+    def test_dft_roundtrip_program_and_accounting(self, devices):
+        from tpuscratch.bench.fft_bench import bench_dft
+        from tpuscratch.runtime.mesh import make_mesh_1d
+
+        r = bench_dft(n=32, rounds=2, iters=2, mesh=make_mesh_1d("x", 4),
+                      fence="block")
+        assert r.p50 > 0
+        assert r.items == 32 * 32**3 * 2  # 16 N^3 FLOPs per direction, fwd+inv
