@@ -1,0 +1,137 @@
+"""3D halo exchange + 7-point stencil vs numpy oracles.
+
+Mirrors the 2D library's test strategy (SURVEY.md §4) one dimension up:
+pure region-geometry unit tests, a rank-id "golden" exchange on the
+2x2x2 torus, and dual-backend oracles against the undecomposed grid.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.halo.halo3d import (
+    FACES,
+    HaloSpec3D,
+    TileLayout3D,
+    decompose3d,
+    distributed_stencil3d,
+    halo_exchange3d,
+)
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.runtime.topology import CartTopology, factor3d
+
+
+class TestLayout3D:
+    def test_regions(self):
+        lay = TileLayout3D((4, 6, 8), (1, 1, 1))
+        assert lay.padded_shape == (6, 8, 10)
+        up = lay.send_region((-1, 0, 0))  # slab travelling toward -z
+        assert up.offsets == (1, 1, 1) and up.shape == (1, 6, 8)
+        dn_halo = lay.halo_region((1, 0, 0))  # ghosts fed by the +z neighbor
+        assert dn_halo.offsets == (5, 1, 1) and dn_halo.shape == (1, 6, 8)
+        rt = lay.send_region((0, 0, 1))
+        assert rt.offsets == (1, 1, 8) and rt.shape == (4, 6, 1)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            TileLayout3D((4, 4), (1, 1, 1))
+        with pytest.raises(ValueError):
+            TileLayout3D((2, 2, 2), (3, 1, 1))
+
+    def test_factor3d(self):
+        assert factor3d(8) == (2, 2, 2)
+        assert factor3d(1) == (1, 1, 1)
+        assert np.prod(factor3d(12)) == 12
+
+
+class TestExchange3D:
+    def test_rank_id_golden_on_2x2x2_torus(self, devices):
+        """core = rank id, one exchange: every face ghost equals the
+        correct neighbor's rank (periodic wrap — the 3D analogue of the
+        reference's sample-output check)."""
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        topo = CartTopology((2, 2, 2), (True, True, True))
+        lay = TileLayout3D((2, 2, 2), (1, 1, 1))
+        spec = HaloSpec3D(layout=lay, topology=topo)
+
+        tiles = np.full((2, 2, 2) + lay.padded_shape, -1.0, np.float32)
+        for r in topo.ranks():
+            z, y, x = topo.coords(r)
+            tiles[z, y, x, 1:-1, 1:-1, 1:-1] = r
+        prog = run_spmd(
+            mesh,
+            lambda t: halo_exchange3d(t[0, 0, 0], spec)[None, None, None],
+            P("z", "row", "col", None, None, None),
+            P("z", "row", "col", None, None, None),
+        )
+        out = np.asarray(prog(jnp.asarray(tiles)))
+        for r in topo.ranks():
+            z, y, x = topo.coords(r)
+            tile = out[z, y, x]
+            for d in FACES:
+                n = topo.neighbor(r, d)
+                ghost = spec.layout.halo_region(d).region(tile)
+                assert (ghost == n).all(), (r, d, n, ghost)
+            # corners were never exchanged (face-only plan): still -1
+            assert tile[0, 0, 0] == -1.0
+
+    def test_open_boundary_keeps_ghosts(self, devices):
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        topo = CartTopology((2, 2, 2), (False, False, False))
+        lay = TileLayout3D((2, 2, 2), (1, 1, 1))
+        spec = HaloSpec3D(layout=lay, topology=topo)
+        tiles = decompose3d(
+            np.ones((4, 4, 4), np.float32), topo, lay
+        )  # ghosts start 0
+        prog = run_spmd(
+            mesh,
+            lambda t: halo_exchange3d(t[0, 0, 0], spec)[None, None, None],
+            P("z", "row", "col", None, None, None),
+            P("z", "row", "col", None, None, None),
+        )
+        out = np.asarray(prog(jnp.asarray(tiles)))
+        # rank (0,0,0): -z/-y/-x ghosts have no sender -> still zero
+        t000 = out[0, 0, 0]
+        assert (t000[0, 1:-1, 1:-1] == 0).all()
+        assert (t000[1:-1, 0, 1:-1] == 0).all()
+        assert (t000[1:-1, 1:-1, 0] == 0).all()
+        # +z ghost fed by rank (1,0,0)'s core of ones
+        assert (t000[-1, 1:-1, 1:-1] == 1).all()
+
+
+class TestStencil3D:
+    @pytest.mark.parametrize("mesh_dims", [(1, 1, 1), (2, 2, 2), (1, 2, 4)])
+    def test_jacobi_matches_roll_oracle(self, devices, mesh_dims):
+        rng = np.random.default_rng(0)
+        world = rng.standard_normal((4, 8, 8)).astype(np.float32)
+        steps = 3
+        got = distributed_stencil3d(
+            world, steps, make_mesh(mesh_dims, ("z", "row", "col"))
+        )
+        expect = world.astype(np.float64)
+        for _ in range(steps):
+            expect = (
+                np.roll(expect, 1, 0) + np.roll(expect, -1, 0)
+                + np.roll(expect, 1, 1) + np.roll(expect, -1, 1)
+                + np.roll(expect, 1, 2) + np.roll(expect, -1, 2)
+            ) / 6.0
+        assert np.allclose(got, expect, atol=1e-5)
+
+    def test_open_boundary_matches_zero_padded_oracle(self, devices):
+        rng = np.random.default_rng(1)
+        world = rng.standard_normal((4, 4, 8)).astype(np.float32)
+        got = distributed_stencil3d(
+            world, 2, make_mesh((2, 2, 2), ("z", "row", "col")),
+            periodic=False,
+        )
+        expect = world.astype(np.float64)
+        for _ in range(2):
+            p = np.pad(expect, 1)
+            expect = (
+                p[:-2, 1:-1, 1:-1] + p[2:, 1:-1, 1:-1]
+                + p[1:-1, :-2, 1:-1] + p[1:-1, 2:, 1:-1]
+                + p[1:-1, 1:-1, :-2] + p[1:-1, 1:-1, 2:]
+            ) / 6.0
+        assert np.allclose(got, expect, atol=1e-5)

@@ -59,8 +59,8 @@ def bench_dft(
 
     Defaults size the scan so the chip work dwarfs the tunnel's fixed
     ~150-200 ms per-invocation cost: 1000 rounds at 1024^2 is 3.4e13
-    multiply-adds (~1.1 s marginal at the measured rate) vs a few-round
-    smoke size on CPU backends.
+    FLOPs (~1.1 s marginal at the measured rate) vs a few-round smoke
+    size on CPU backends.
     """
     from tpuscratch.runtime.mesh import make_mesh_1d
 

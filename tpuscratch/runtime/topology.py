@@ -224,3 +224,15 @@ def factor2d(n: int) -> tuple[int, int]:
         if n % rows == 0:
             best = (rows, n // rows)
     return best
+
+
+def factor3d(n: int) -> tuple[int, int, int]:
+    """Most-cubic (z, rows, cols) factorization of n, z <= rows <= cols."""
+    best, best_spread = (1, 1, n), n
+    for z in range(1, round(n ** (1 / 3)) + 2):
+        if n % z:
+            continue
+        rows, cols = factor2d(n // z)
+        if z <= rows and cols - z < best_spread:
+            best, best_spread = (z, rows, cols), cols - z
+    return best
