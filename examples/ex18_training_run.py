@@ -1,0 +1,66 @@
+"""Checkpointed distributed training run: kill it, rerun it, it resumes.
+
+The capstone composition: the mini MoE transformer's train step (ring
+attention over sp, expert all_to_all over dp, grad + SGD in one compiled
+program) driven by the checkpointing trainer. The demo trains in two
+invocations sharing one checkpoint directory — the second resumes at the
+saved step and lands bit-identical to a straight-through run, the
+contract a walltime-killed job needs (the reference runs under PBS
+walltime kills with no way to continue, SURVEY.md §5).
+
+argv tier:  ex18_training_run.py [--steps=N]
+"""
+
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main(argv=None) -> None:
+    ensure_devices()
+    import jax
+    import numpy as np
+
+    from tpuscratch.models import TransformerConfig
+    from tpuscratch.models.trainer import train
+    from tpuscratch.runtime.config import Config
+    from tpuscratch.runtime.mesh import make_mesh
+
+    cfg_cli = Config.load(argv)
+    steps = cfg_cli.steps if "steps" in cfg_cli.explicit else 20
+    # the resume demo needs >= 2 save points before AND after the cut
+    steps = max(10, (steps + 4) // 5 * 5)
+    mesh = make_mesh((2, 4), ("dp", "sp"))
+    mcfg = TransformerConfig(
+        d_model=16, n_heads=2, n_experts=2, d_ff=32, capacity_factor=2.0
+    )
+    banner(f"checkpointed training, {steps} steps on a 2x4 (dp x sp) mesh")
+
+    with tempfile.TemporaryDirectory(prefix="trainer_") as tmp:
+        straight, rep = train(
+            mesh, mcfg, steps, f"{tmp}/straight", save_every=5, log=print
+        )
+        print(f"straight run: {rep.steps_run} steps, "
+              f"loss {rep.losses[0]:.4f} -> {rep.losses[-1]:.4f}")
+
+        banner("interrupted at the halfway save, then resumed")
+        half = min(max(5, steps // 2 // 5 * 5), steps - 5)
+        train(mesh, mcfg, half, f"{tmp}/resumed", save_every=5)
+        resumed, rep2 = train(
+            mesh, mcfg, steps, f"{tmp}/resumed", save_every=5, log=print
+        )
+        exact = all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(jax.tree.leaves(straight), jax.tree.leaves(resumed))
+        )
+        improving = rep.losses[-1] < rep.losses[0]
+        print(f"resumed run executed {rep2.steps_run} steps; params "
+              f"bit-identical to straight run: {exact}")
+        print("PASSED" if exact and improving else "FAILED")
+
+
+if __name__ == "__main__":
+    main()

@@ -237,18 +237,19 @@ def distributed_stencil3d(
     mesh: Optional[Mesh] = None,
     halo: tuple[int, int, int] = (1, 1, 1),
     coeffs=JACOBI7,
-    periodic: bool = True,
+    periodic: bool | Sequence[bool] = True,
 ) -> np.ndarray:
     """End-to-end 3D driver: decompose over a 3-axis mesh, iterate,
     reassemble (the 3D analogue of halo.driver.distributed_stencil)."""
     import jax
 
+    from tpuscratch.runtime.mesh import topology_of
     from tpuscratch.runtime.topology import factor3d
 
     if mesh is None:
         mesh = make_mesh(factor3d(len(jax.devices())), ("z", "row", "col"))
     dims = tuple(mesh.devices.shape)
-    topo = CartTopology(dims, tuple(periodic for _ in dims))
+    topo = topology_of(mesh, periodic=periodic)
     if any(w % d for w, d in zip(world.shape, dims)):
         raise ValueError(f"world {world.shape} not divisible by mesh {dims}")
     layout = TileLayout3D(

@@ -16,3 +16,4 @@ from tpuscratch.models.transformer import (  # noqa: F401
 )
 from tpuscratch.models.ssm import SSMConfig, ssm_block  # noqa: F401
 from tpuscratch.models.ssm import init_params as init_ssm_params  # noqa: F401
+from tpuscratch.models.trainer import TrainReport, train  # noqa: F401
