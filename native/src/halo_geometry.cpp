@@ -108,4 +108,76 @@ int32_t ts_build_plan(int32_t rows, int32_t cols, int32_t per_r, int32_t per_c,
   return ndirs;
 }
 
+// ---------------------------------------------------------------------------
+// 3D face-only planner (mirrors tpuscratch/halo/halo3d.py). Rank layout is
+// row-major over (dz, dy, dx); rect = {o0, o1, o2, e0, e1, e2} in padded
+// coords; the 6 faces use the same stable order as halo3d.FACES.
+// ---------------------------------------------------------------------------
+
+// Rank at coords + off, honoring per-axis periodicity; -1 if off-grid.
+int32_t ts_neighbor3d(int32_t dz, int32_t dy, int32_t dx, int32_t per_z,
+                      int32_t per_y, int32_t per_x, int32_t rank, int32_t oz,
+                      int32_t oy, int32_t ox) {
+  if (dz <= 0 || dy <= 0 || dx <= 0 || rank < 0 || rank >= dz * dy * dx)
+    return -1;
+  int32_t dims[3] = {dz, dy, dx};
+  int32_t per[3] = {per_z, per_y, per_x};
+  int32_t off[3] = {oz, oy, ox};
+  int32_t c[3] = {rank / (dy * dx), (rank / dx) % dy, rank % dx};
+  for (int a = 0; a < 3; ++a) {
+    c[a] += off[a];
+    if (c[a] < 0 || c[a] >= dims[a]) {
+      if (!per[a]) return -1;
+      c[a] = ((c[a] % dims[a]) + dims[a]) % dims[a];
+    }
+  }
+  return (c[0] * dy + c[1]) * dx + c[2];
+}
+
+// Full 6-face plan. Outputs, per face i:
+//   offs[3i..]   = the face offset (halo side)
+//   send_rects[6i..] / recv_rects[6i..] = {o0,o1,o2,e0,e1,e2}
+//   perm pairs at perm_src/dst[i*nranks ..], count in perm_counts[i]
+// Returns 6, or -1 on invalid input.
+int32_t ts_build_plan3d(int32_t dz, int32_t dy, int32_t dx, int32_t per_z,
+                        int32_t per_y, int32_t per_x, int32_t cz, int32_t cy,
+                        int32_t cx, int32_t hz, int32_t hy, int32_t hx,
+                        int32_t* offs, int32_t* send_rects,
+                        int32_t* recv_rects, int32_t* perm_src,
+                        int32_t* perm_dst, int32_t* perm_counts) {
+  if (dz <= 0 || dy <= 0 || dx <= 0 || cz <= 0 || cy <= 0 || cx <= 0 ||
+      hz < 0 || hy < 0 || hx < 0 || hz > cz || hy > cy || hx > cx)
+    return -1;
+  static const int32_t kFaces[6][3] = {{-1, 0, 0}, {1, 0, 0}, {0, -1, 0},
+                                       {0, 1, 0},  {0, 0, -1}, {0, 0, 1}};
+  const int32_t core[3] = {cz, cy, cx};
+  const int32_t halo[3] = {hz, hy, hx};
+  const int32_t nranks = dz * dy * dx;
+  for (int32_t i = 0; i < 6; ++i) {
+    const int32_t* d = kFaces[i];
+    for (int a = 0; a < 3; ++a) {
+      offs[3 * i + a] = d[a];
+      const int32_t o = d[a], c = core[a], h = halo[a];
+      // send slab travels toward flow = -d (the neighbor feeding my d halo)
+      const int32_t f = -o;
+      send_rects[6 * i + a] = f > 0 ? c : h;       // start (f>0: h+c-h == c)
+      send_rects[6 * i + 3 + a] = f == 0 ? c : h;  // extent
+      recv_rects[6 * i + a] = o < 0 ? 0 : (o > 0 ? h + c : h);
+      recv_rects[6 * i + 3 + a] = o == 0 ? c : h;
+    }
+    int32_t n = 0;
+    for (int32_t rank = 0; rank < nranks; ++rank) {
+      int32_t nb = ts_neighbor3d(dz, dy, dx, per_z, per_y, per_x, rank,
+                                 -d[0], -d[1], -d[2]);
+      if (nb >= 0) {
+        perm_src[i * nranks + n] = rank;
+        perm_dst[i * nranks + n] = nb;
+        ++n;
+      }
+    }
+    perm_counts[i] = n;
+  }
+  return 6;
+}
+
 }  // extern "C"

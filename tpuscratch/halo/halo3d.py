@@ -116,6 +116,31 @@ class HaloSpec3D:
 def _cached_plan3d(
     layout: TileLayout3D, topology: CartTopology
 ) -> tuple[Transfer3D, ...]:
+    from tpuscratch import native
+
+    if native.available() and native.has_plan3d():
+        raw = native.build_plan3d(
+            topology.dims, topology.periodic, layout.core, layout.halo
+        )
+        out = []
+        for nat in raw:
+            perm = tuple((int(a), int(b)) for a, b in nat["perm"])
+            receivers = {dst for _, dst in perm}
+            so, se = nat["send_rect"][:3], nat["send_rect"][3:]
+            ro, re_ = nat["recv_rect"][:3], nat["recv_rect"][3:]
+            out.append(
+                Transfer3D(
+                    offset=tuple(nat["offset"]),
+                    send=SubarraySpec(tuple(so), tuple(se)),
+                    recv=SubarraySpec(tuple(ro), tuple(re_)),
+                    perm=perm,
+                    has_sender=tuple(
+                        r in receivers for r in topology.ranks()
+                    ),
+                )
+            )
+        return tuple(out)
+
     out = []
     for d in FACES:
         flow = tuple(-x for x in d)  # data in my d halo was sent toward -d

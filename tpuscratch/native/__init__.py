@@ -97,12 +97,26 @@ def load() -> Optional[ctypes.CDLL]:
     lib.ts_send_rect.argtypes = [i32] * 6 + [p32]
     lib.ts_build_plan.restype = i32
     lib.ts_build_plan.argtypes = [i32] * 9 + [p32] * 6
+    try:
+        lib.ts_neighbor3d.restype = i32
+        lib.ts_neighbor3d.argtypes = [i32] * 10
+        lib.ts_build_plan3d.restype = i32
+        lib.ts_build_plan3d.argtypes = [i32] * 12 + [p32] * 6
+    except AttributeError:
+        pass  # pre-3D library build; has_plan3d() reports it
     _lib = lib
     return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+def has_plan3d() -> bool:
+    """Whether the loaded library includes the 3D planner (an older .so
+    on disk may predate it; the Python path then serves 3D plans)."""
+    lib = load()
+    return lib is not None and hasattr(lib, "ts_build_plan3d")
 
 
 def _rect(fn, core_h: int, core_w: int, hy: int, hx: int, dr: int, dc: int):
@@ -182,6 +196,49 @@ def build_plan(dims, periodic, core_h, core_w, hy, hx, neighbors=8):
                 "direction": (dirs[2 * i], dirs[2 * i + 1]),
                 "send_rect": tuple(send_rects[4 * i : 4 * i + 4]),
                 "recv_rect": tuple(recv_rects[4 * i : 4 * i + 4]),
+                "perm": list(
+                    zip(src_np[i, :n].tolist(), dst_np[i, :n].tolist())
+                ),
+            }
+        )
+    return out
+
+
+def build_plan3d(dims, periodic, core, halo):
+    """Full 6-face 3D plan in one native call. Returns a list of dicts:
+    {offset, send_rect, recv_rect, perm} in halo3d.FACES order; rects are
+    (o0, o1, o2, e0, e1, e2) in padded coords."""
+    lib = load()
+    assert lib is not None and has_plan3d()
+    nranks = dims[0] * dims[1] * dims[2]
+    offs = (ctypes.c_int32 * (3 * 6))()
+    send_rects = (ctypes.c_int32 * (6 * 6))()
+    recv_rects = (ctypes.c_int32 * (6 * 6))()
+    perm_src = (ctypes.c_int32 * (6 * nranks))()
+    perm_dst = (ctypes.c_int32 * (6 * nranks))()
+    counts = (ctypes.c_int32 * 6)()
+    nfaces = lib.ts_build_plan3d(
+        dims[0], dims[1], dims[2],
+        int(periodic[0]), int(periodic[1]), int(periodic[2]),
+        core[0], core[1], core[2], halo[0], halo[1], halo[2],
+        offs, send_rects, recv_rects, perm_src, perm_dst, counts,
+    )
+    if nfaces < 0:
+        raise ValueError(
+            f"native 3D planner rejected dims={dims} core={core} halo={halo}"
+        )
+    import numpy as np
+
+    src_np = np.ctypeslib.as_array(perm_src).reshape(6, nranks)
+    dst_np = np.ctypeslib.as_array(perm_dst).reshape(6, nranks)
+    out = []
+    for i in range(nfaces):
+        n = counts[i]
+        out.append(
+            {
+                "offset": tuple(offs[3 * i : 3 * i + 3]),
+                "send_rect": tuple(send_rects[6 * i : 6 * i + 6]),
+                "recv_rect": tuple(recv_rects[6 * i : 6 * i + 6]),
                 "perm": list(
                     zip(src_np[i, :n].tolist(), dst_np[i, :n].tolist())
                 ),
