@@ -734,3 +734,80 @@ class TestBlockedImpl:
         got = distributed_stencil(world, steps=3, mesh=mesh, impl="blocked")
         plain = distributed_stencil(world, steps=3, mesh=mesh, impl="xla")
         np.testing.assert_allclose(got, plain, rtol=1e-5, atol=1e-6)
+
+
+class TestNinePoint:
+    """The stencil shape that actually reads the corner ghosts."""
+
+    def test_distributed_matches_roll_oracle(self, devices):
+        from tpuscratch.halo.driver import distributed_stencil
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        rng = np.random.default_rng(0)
+        world = rng.standard_normal((16, 32)).astype(np.float32)
+        c = (0.125, 0.125, 0.125, 0.125, 0.0625, 0.0625, 0.0625, 0.0625, 0.0)
+        got = distributed_stencil(
+            world, steps=3, mesh=make_mesh_2d((2, 4)), coeffs=c
+        )
+        expect = world.astype(np.float64)
+        for _ in range(3):
+            r = lambda dy, dx: np.roll(np.roll(expect, -dy, 0), -dx, 1)
+            expect = (
+                c[0] * r(-1, 0) + c[1] * r(1, 0) + c[2] * r(0, -1)
+                + c[3] * r(0, 1) + c[4] * r(-1, -1) + c[5] * r(-1, 1)
+                + c[6] * r(1, -1) + c[7] * r(1, 1) + c[8] * expect
+            )
+        assert np.allclose(got, expect, atol=1e-5)
+
+    def test_pure_diagonal_reads_corner_ghosts(self, devices):
+        """Weight ONLY the nw corner: the result is the diagonal shift,
+        which crosses rank boundaries through the corner transfers."""
+        from tpuscratch.halo.driver import distributed_stencil
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        rng = np.random.default_rng(1)
+        world = rng.standard_normal((8, 16)).astype(np.float32)
+        c = (0.0, 0.0, 0.0, 0.0, 1.0, 0.0, 0.0, 0.0, 0.0)
+        got = distributed_stencil(
+            world, steps=1, mesh=make_mesh_2d((2, 4)), coeffs=c
+        )
+        expect = np.roll(np.roll(world, 1, 0), 1, 1)
+        assert np.allclose(got, expect, atol=1e-6)
+
+    def test_nine_point_rejects_non_xla_impls(self, devices):
+        from tpuscratch.halo.driver import distributed_stencil
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        c = (0.125,) * 4 + (0.0625,) * 4 + (0.0,)
+        with pytest.raises(ValueError, match="only supported by impl='xla'"):
+            distributed_stencil(
+                np.zeros((8, 8), np.float32), steps=1,
+                mesh=make_mesh_2d((1, 1)), coeffs=c, impl="pallas",
+            )
+
+    def test_nine_point_rejects_four_neighbor_spec(self, devices):
+        import jax.numpy as jnp
+
+        from tpuscratch.halo.stencil import stencil_step
+        from tpuscratch.runtime.topology import CartTopology
+
+        spec = HaloSpec(
+            layout=TileLayout(4, 4, 1, 1),
+            topology=CartTopology((1, 1), (True, True)),
+            neighbors=4,
+        )
+        c = (0.125,) * 4 + (0.0625,) * 4 + (0.0,)
+        with pytest.raises(ValueError, match="neighbors=8"):
+            stencil_step(jnp.zeros((6, 6)), spec, coeffs=c)
+
+    def test_nine_point_rejects_deep_and_resident_impls(self, devices):
+        from tpuscratch.halo.driver import distributed_stencil
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        c = (0.125,) * 4 + (0.0625,) * 4 + (0.0,)
+        for impl in ("deep:2", "resident", "dma"):
+            with pytest.raises(ValueError, match="only supported by impl='xla'"):
+                distributed_stencil(
+                    np.zeros((8, 8), np.float32), steps=2,
+                    mesh=make_mesh_2d((1, 1)), coeffs=c, impl=impl,
+                )

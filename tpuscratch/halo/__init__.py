@@ -24,7 +24,7 @@ re-grounded in XLA:
 
 from tpuscratch.halo.layout import Region, TileLayout, sub_region  # noqa: F401
 from tpuscratch.halo.exchange import HaloSpec, halo_exchange  # noqa: F401
-from tpuscratch.halo.stencil import five_point, stencil_step  # noqa: F401
+from tpuscratch.halo.stencil import five_point, nine_point, stencil_step  # noqa: F401
 from tpuscratch.halo.halo3d import (  # noqa: F401
     HaloSpec3D,
     TileLayout3D,

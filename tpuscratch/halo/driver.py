@@ -79,6 +79,10 @@ def make_stencil_program(
     (ops.halo_dma — core VMEM-resident, halo strips by async DMA).
     ``unroll`` is the scan unroll factor for the per-step impls and the
     kernel's inner unroll for 'resident' (defaults 1 and 8)."""
+    if len(coeffs) == 9 and impl != "xla":
+        raise ValueError(
+            f"9-point coeffs are only supported by impl='xla', got {impl!r}"
+        )
     if impl == "resident":
         step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=8 if unroll is None else unroll)[None, None]  # noqa: E731
     elif impl == "dma":

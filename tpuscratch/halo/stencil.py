@@ -41,6 +41,36 @@ def five_point(tile: jax.Array, layout: TileLayout, coeffs=(0.25, 0.25, 0.25, 0.
     return rebuild(tile, new_core, layout)
 
 
+def nine_point(
+    tile: jax.Array,
+    layout: TileLayout,
+    coeffs=(0.125, 0.125, 0.125, 0.125, 0.0625, 0.0625, 0.0625, 0.0625, 0.0),
+) -> jax.Array:
+    """One 9-point update of the core — the stencil shape that actually
+    READS the corner ghosts the 8-neighbor exchange fills (a 5-point
+    stencil leaves the diagonal transfers write-only). ``coeffs`` =
+    (north, south, west, east, nw, ne, sw, se, center); the default is
+    the 2D Mehrstellen/blur-style weighting.
+    """
+    if layout.halo_y < 1 or layout.halo_x < 1:
+        raise ValueError(
+            f"nine_point needs halo >= 1, got ({layout.halo_y},{layout.halo_x})"
+        )
+    hy, hx = layout.halo_y, layout.halo_x
+    h, w = layout.core_h, layout.core_w
+    sl = lambda dy, dx: tile[  # noqa: E731
+        hy + dy : hy + dy + h, hx + dx : hx + dx + w
+    ]
+    cn, cs, cw, ce, cnw, cne, csw, cse, cc = coeffs
+    new_core = (
+        cn * sl(-1, 0) + cs * sl(1, 0) + cw * sl(0, -1) + ce * sl(0, 1)
+        + cnw * sl(-1, -1) + cne * sl(-1, 1)
+        + csw * sl(1, -1) + cse * sl(1, 1)
+        + cc * sl(0, 0)
+    )
+    return rebuild(tile, new_core, layout)
+
+
 def rebuild(tile: jax.Array, new_core: jax.Array, layout: TileLayout) -> jax.Array:
     """Wrap a freshly-computed core back into the padded tile's border.
 
@@ -60,6 +90,12 @@ def rebuild(tile: jax.Array, new_core: jax.Array, layout: TileLayout) -> jax.Arr
 
 
 def _compute(tile: jax.Array, layout: TileLayout, coeffs, impl: str) -> jax.Array:
+    if len(coeffs) == 9:
+        if impl != "xla":
+            raise ValueError(
+                f"9-point coeffs are only supported by impl='xla', got {impl!r}"
+            )
+        return nine_point(tile, layout, coeffs)
     if impl == "xla":
         return five_point(tile, layout, coeffs)
     if impl == "pallas":
@@ -101,6 +137,8 @@ def stencil_step_overlap(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.
     overlap pattern its plan-executor design enables (SURVEY.md §7.5).
     """
     lay = spec.layout
+    if len(coeffs) != 5:
+        raise ValueError("the overlap impl supports 5-point coeffs only")
     if lay.halo_y < 1 or lay.halo_x < 1:
         raise ValueError("five_point needs halo >= 1 on both axes")
     h, w = lay.core_h, lay.core_w
@@ -134,6 +172,11 @@ def stencil_step(tile: jax.Array, spec: HaloSpec, coeffs=(0.25, 0.25, 0.25, 0.25
     """
     if impl not in ("xla", "pallas", "blocked", "overlap"):
         raise ValueError(f"unknown stencil impl {impl!r}")
+    if len(coeffs) == 9 and spec.neighbors != 8:
+        raise ValueError(
+            "9-point coeffs need a neighbors=8 HaloSpec: a 4-neighbor "
+            "exchange never fills the corner ghosts the stencil reads"
+        )
     if impl == "overlap":
         return stencil_step_overlap(tile, spec, coeffs)
     tile = halo_exchange(tile, spec)
