@@ -57,6 +57,15 @@ def main(argv=None) -> None:
     print(f"max |x_mg - x_fft| = {gap:.2e} after {cycles} cycles "
           f"({'PASSED' if gap < 1e-3 else 'FAILED'})")
 
+    banner("composed: CG preconditioned by one V-cycle")
+    from tpuscratch.solvers import pcg_poisson_solve
+
+    x_pcg, iters, relres = pcg_poisson_solve(b, mesh, tol=1e-6)
+    gap2 = np.abs(x_pcg - x_sp).max()
+    print(f"PCG: {iters} iterations (vs {cycles} V-cycles), relres "
+          f"{relres:.2e}, max |x_pcg - x_fft| = {gap2:.2e} "
+          f"({'PASSED' if iters < cycles and gap2 < 1e-3 else 'FAILED'})")
+
 
 if __name__ == "__main__":
     main()

@@ -13,7 +13,11 @@ local inter-level transfers.
 """
 
 from tpuscratch.solvers.cg import cg, dirichlet_laplacian, poisson_solve
-from tpuscratch.solvers.multigrid import mg_poisson_solve, v_cycle
+from tpuscratch.solvers.multigrid import (
+    mg_poisson_solve,
+    pcg_poisson_solve,
+    v_cycle,
+)
 from tpuscratch.solvers.spectral import periodic_poisson_fft
 
 __all__ = [
@@ -21,6 +25,7 @@ __all__ = [
     "dirichlet_laplacian",
     "poisson_solve",
     "mg_poisson_solve",
+    "pcg_poisson_solve",
     "v_cycle",
     "periodic_poisson_fft",
 ]
