@@ -135,3 +135,36 @@ class TestStencil3D:
                 + p[1:-1, 1:-1, :-2] + p[1:-1, 1:-1, 2:]
             ) / 6.0
         assert np.allclose(got, expect, atol=1e-5)
+
+
+class TestCompactImpl:
+    @pytest.mark.parametrize("periodic", [True, False])
+    def test_compact_equals_padded(self, devices, periodic):
+        rng = np.random.default_rng(5)
+        world = rng.standard_normal((4, 8, 8)).astype(np.float32)
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        a = distributed_stencil3d(world, 3, mesh, periodic=periodic,
+                                  impl="compact")
+        b = distributed_stencil3d(world, 3, mesh, periodic=periodic,
+                                  impl="padded")
+        assert np.allclose(a, b, atol=1e-6)
+
+    def test_explicit_compact_rejects_deep_halo(self, devices):
+        with pytest.raises(ValueError, match="halo \\(1,1,1\\) only"):
+            distributed_stencil3d(
+                np.zeros((8, 8, 8), np.float32), 1,
+                make_mesh((1, 1, 1), ("z", "row", "col")),
+                halo=(2, 2, 2), impl="compact",
+            )
+
+    def test_default_auto_selects_padded_for_deep_halo(self, devices):
+        rng = np.random.default_rng(6)
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
+        mesh = make_mesh((1, 1, 1), ("z", "row", "col"))
+        got = distributed_stencil3d(world, 2, mesh, halo=(2, 2, 2))
+        expect = world.astype(np.float64)
+        for _ in range(2):
+            expect = sum(
+                np.roll(expect, s, a) for a in range(3) for s in (1, -1)
+            ) / 6.0
+        assert np.allclose(got, expect, atol=1e-5)

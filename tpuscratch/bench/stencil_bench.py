@@ -63,3 +63,65 @@ def bench_stencil(
         name=f"stencil {grid[0]}x{grid[1]} x{steps} on {rows}x{cols} ({label})",
         items=grid[0] * grid[1] * steps,
     )
+
+
+def bench_stencil3d(
+    grid: tuple[int, int, int] = (64, 64, 64),
+    steps: int = 10,
+    mesh: Optional[Mesh] = None,
+    impl: str = "compact",
+    iters: int = 5,
+    fence: str = "block",
+) -> BenchResult:
+    """cell-updates/s for the 3D face-halo 7-point pipeline
+    (halo.halo3d) on a ``grid`` world over a 3-axis mesh."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tpuscratch.comm import run_spmd
+    from tpuscratch.halo.halo3d import (
+        HaloSpec3D,
+        TileLayout3D,
+        decompose3d,
+        decompose3d_cores,
+        run_stencil3d,
+        run_stencil3d_compact,
+    )
+    from tpuscratch.runtime.mesh import make_mesh
+    from tpuscratch.runtime.topology import CartTopology, factor3d
+
+    if impl not in ("compact", "padded"):
+        raise ValueError(f"unknown 3D stencil impl {impl!r}")
+    if mesh is None:
+        mesh = make_mesh(factor3d(len(jax.devices())), ("z", "row", "col"))
+    dims = tuple(mesh.devices.shape)
+    if any(g % d for g, d in zip(grid, dims)):
+        raise ValueError(f"grid {grid} not divisible by mesh {dims}")
+    topo = CartTopology(dims, (True,) * 3)
+    layout = TileLayout3D(tuple(g // d for g, d in zip(grid, dims)))
+    spec = HaloSpec3D(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    rng = np.random.default_rng(0)
+    world = rng.standard_normal(grid).astype(np.float32)
+    if impl == "compact":
+        tiles = jnp.asarray(decompose3d_cores(world, dims))
+        body = lambda t: run_stencil3d_compact(  # noqa: E731
+            t[0, 0, 0], spec, steps
+        )[None, None, None]
+    else:
+        tiles = jnp.asarray(decompose3d(world, topo, layout))
+        body = lambda t: run_stencil3d(  # noqa: E731
+            t[0, 0, 0], spec, steps
+        )[None, None, None]
+    program = run_spmd(
+        mesh,
+        body,
+        P(*mesh.axis_names, None, None, None),
+        P(*mesh.axis_names, None, None, None),
+    )
+    cells = grid[0] * grid[1] * grid[2]
+    return time_device(
+        program, tiles, iters=iters, warmup=2, fence=fence,
+        name=f"stencil3d {grid[0]}x{grid[1]}x{grid[2]} x{steps} on "
+             f"{dims[0]}x{dims[1]}x{dims[2]} ({impl})",
+        items=cells * steps,
+    )
