@@ -138,13 +138,14 @@ class TestStencil3D:
 
 
 class TestCompactImpl:
+    @pytest.mark.parametrize("impl", ["compact", "compact-pallas"])
     @pytest.mark.parametrize("periodic", [True, False])
-    def test_compact_equals_padded(self, devices, periodic):
+    def test_compact_equals_padded(self, devices, periodic, impl):
         rng = np.random.default_rng(5)
         world = rng.standard_normal((4, 8, 8)).astype(np.float32)
         mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
         a = distributed_stencil3d(world, 3, mesh, periodic=periodic,
-                                  impl="compact")
+                                  impl=impl)
         b = distributed_stencil3d(world, 3, mesh, periodic=periodic,
                                   impl="padded")
         assert np.allclose(a, b, atol=1e-6)

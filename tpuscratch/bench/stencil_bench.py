@@ -76,22 +76,17 @@ def bench_stencil3d(
     """cell-updates/s for the 3D face-halo 7-point pipeline
     (halo.halo3d) on a ``grid`` world over a 3-axis mesh."""
     import jax
-    from jax.sharding import PartitionSpec as P
 
-    from tpuscratch.comm import run_spmd
     from tpuscratch.halo.halo3d import (
         HaloSpec3D,
         TileLayout3D,
         decompose3d,
         decompose3d_cores,
-        run_stencil3d,
-        run_stencil3d_compact,
+        make_stencil3d_program,
     )
     from tpuscratch.runtime.mesh import make_mesh
     from tpuscratch.runtime.topology import CartTopology, factor3d
 
-    if impl not in ("compact", "padded"):
-        raise ValueError(f"unknown 3D stencil impl {impl!r}")
     if mesh is None:
         mesh = make_mesh(factor3d(len(jax.devices())), ("z", "row", "col"))
     dims = tuple(mesh.devices.shape)
@@ -100,24 +95,13 @@ def bench_stencil3d(
     topo = CartTopology(dims, (True,) * 3)
     layout = TileLayout3D(tuple(g // d for g, d in zip(grid, dims)))
     spec = HaloSpec3D(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    program = make_stencil3d_program(mesh, spec, steps, impl=impl)
     rng = np.random.default_rng(0)
     world = rng.standard_normal(grid).astype(np.float32)
-    if impl == "compact":
+    if impl.startswith("compact"):
         tiles = jnp.asarray(decompose3d_cores(world, dims))
-        body = lambda t: run_stencil3d_compact(  # noqa: E731
-            t[0, 0, 0], spec, steps
-        )[None, None, None]
     else:
         tiles = jnp.asarray(decompose3d(world, topo, layout))
-        body = lambda t: run_stencil3d(  # noqa: E731
-            t[0, 0, 0], spec, steps
-        )[None, None, None]
-    program = run_spmd(
-        mesh,
-        body,
-        P(*mesh.axis_names, None, None, None),
-        P(*mesh.axis_names, None, None, None),
-    )
     cells = grid[0] * grid[1] * grid[2]
     return time_device(
         program, tiles, iters=iters, warmup=2, fence=fence,

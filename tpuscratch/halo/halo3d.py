@@ -221,7 +221,7 @@ def run_stencil3d(
 
 
 def stencil_step3d_compact(
-    core: jnp.ndarray, spec: HaloSpec3D, coeffs=JACOBI7
+    core: jnp.ndarray, spec: HaloSpec3D, coeffs=JACOBI7, compute: str = "xla"
 ) -> jnp.ndarray:
     """One exchange + 7-point update carrying the CORE only — the fast
     path. The padded-carry step pays 6 sequential full-tile
@@ -277,6 +277,10 @@ def stencil_step3d_compact(
     )
     u = jnp.concatenate([top, mid, bot], axis=0)             # padded tile
 
+    if compute == "pallas":
+        from tpuscratch.ops.stencil_kernel import seven_point_banded_pallas
+
+        return seven_point_banded_pallas(u, (cz, cy, cx), tuple(coeffs))
     sl = lambda dz, dy, dx: u[  # noqa: E731
         1 + dz : 1 + dz + cz, 1 + dy : 1 + dy + cy, 1 + dx : 1 + dx + cx
     ]
@@ -287,11 +291,20 @@ def stencil_step3d_compact(
 
 
 def run_stencil3d_compact(
-    core: jnp.ndarray, spec: HaloSpec3D, steps: int, coeffs=JACOBI7
+    core: jnp.ndarray,
+    spec: HaloSpec3D,
+    steps: int,
+    coeffs=JACOBI7,
+    compute: str = "xla",
 ) -> jnp.ndarray:
-    """``steps`` compact iterations in one scanned program (core carry)."""
+    """``steps`` compact iterations in one scanned program (core carry).
+
+    ``compute='pallas'`` runs the 7-point sum as the banded VMEM kernel
+    (ops.stencil_kernel.seven_point_banded_pallas) instead of XLA's
+    fused slices.
+    """
     def step(c, _):
-        return stencil_step3d_compact(c, spec, coeffs), ()
+        return stencil_step3d_compact(c, spec, coeffs, compute), ()
 
     out, _ = lax.scan(step, core, None, length=steps)
     return out
@@ -314,6 +327,34 @@ def decompose3d(
                     z * cz:(z + 1) * cz, y * cy:(y + 1) * cy, x * cx:(x + 1) * cx
                 ]
     return tiles
+
+
+IMPLS3D = ("compact", "compact-pallas", "padded")
+
+
+def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
+                           coeffs=JACOBI7, impl: str = "compact"):
+    """The compiled 3D SPMD program (driver/bench shared): tiles ->
+    tiles after ``steps`` iterations. Compact impls take/return CORE
+    tiles (decompose3d_cores), 'padded' takes ghost-padded tiles
+    (decompose3d)."""
+    if impl not in IMPLS3D:
+        raise ValueError(f"unknown 3D stencil impl {impl!r}; have {IMPLS3D}")
+    if impl.startswith("compact"):
+        compute = "pallas" if impl == "compact-pallas" else "xla"
+        body = lambda t: run_stencil3d_compact(  # noqa: E731
+            t[0, 0, 0], spec, steps, coeffs, compute
+        )[None, None, None]
+    else:
+        body = lambda t: run_stencil3d(  # noqa: E731
+            t[0, 0, 0], spec, steps, coeffs
+        )[None, None, None]
+    return run_spmd(
+        mesh,
+        body,
+        P(*mesh.axis_names, None, None, None),
+        P(*mesh.axis_names, None, None, None),
+    )
 
 
 def decompose3d_cores(world: np.ndarray, dims: tuple[int, int, int]) -> np.ndarray:
@@ -376,9 +417,7 @@ def distributed_stencil3d(
 
     if impl is None:
         impl = "compact" if tuple(halo) == (1, 1, 1) else "padded"
-    if impl not in ("compact", "padded"):
-        raise ValueError(f"unknown 3D stencil impl {impl!r}")
-    if impl == "compact" and tuple(halo) != (1, 1, 1):
+    if impl.startswith("compact") and tuple(halo) != (1, 1, 1):
         raise ValueError(
             f"impl='compact' supports halo (1,1,1) only, got {halo}; "
             "use impl='padded' for deeper ghosts"
@@ -393,22 +432,9 @@ def distributed_stencil3d(
         tuple(w // d for w, d in zip(world.shape, dims)), halo
     )
     spec = HaloSpec3D(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
-    if impl == "compact":
-        program = run_spmd(
-            mesh,
-            lambda t: run_stencil3d_compact(
-                t[0, 0, 0], spec, steps, coeffs
-            )[None, None, None],
-            P(*mesh.axis_names, None, None, None),
-            P(*mesh.axis_names, None, None, None),
-        )
+    program = make_stencil3d_program(mesh, spec, steps, coeffs, impl)
+    if impl.startswith("compact"):
         out = np.asarray(program(jnp.asarray(decompose3d_cores(world, dims))))
         return assemble3d_cores(out)
-    program = run_spmd(
-        mesh,
-        lambda t: run_stencil3d(t[0, 0, 0], spec, steps, coeffs)[None, None, None],
-        P(*mesh.axis_names, None, None, None),
-        P(*mesh.axis_names, None, None, None),
-    )
     out = program(jnp.asarray(decompose3d(world, topo, layout)))
     return assemble3d(np.asarray(out), topo, layout)

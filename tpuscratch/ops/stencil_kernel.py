@@ -85,6 +85,15 @@ def _trapezoid_kernel(t_ref, o_ref, *, substeps: int, crop: int, coeffs: Coeffs)
     o_ref[:] = a
 
 
+def _largest_divisor_band(n: int, cost_of_band, budget_bytes: int) -> int:
+    """Largest divisor band of ``n`` with ``cost_of_band(band) <= budget``
+    (shared by the banded kernels' block sizing)."""
+    band = n
+    while band > 1 and cost_of_band(band) > budget_bytes:
+        band = next((d for d in range(band - 1, 0, -1) if n % d == 0), 1)
+    return band
+
+
 def _trapezoid_band(layout: TileLayout, itemsize: int, budget_bytes: int) -> int:
     """Largest divisor band of core_h whose input block fits the VMEM
     budget (block is (band + 2*halo) x padded_w; the pyramid's temporaries
@@ -92,13 +101,11 @@ def _trapezoid_band(layout: TileLayout, itemsize: int, budget_bytes: int) -> int
     ph, pw = layout.padded_shape
     if ph * pw * itemsize <= budget_bytes:  # whole tile in one block
         return layout.core_h
-    band = layout.core_h
-    while band > 1 and (band + 2 * layout.halo_y) * pw * itemsize > budget_bytes:
-        # walk down through divisors of core_h
-        band = next(
-            (d for d in range(band - 1, 0, -1) if layout.core_h % d == 0), 1
-        )
-    return band
+    return _largest_divisor_band(
+        layout.core_h,
+        lambda band: (band + 2 * layout.halo_y) * pw * itemsize,
+        budget_bytes,
+    )
 
 
 @functools.partial(
@@ -252,6 +259,78 @@ def resident_periodic_pallas(
         interpret=interpret,
         **params,
     )(core)
+
+
+def _band3d_kernel(t_ref, o_ref, *, band: int, cy: int, cx: int, coeffs7):
+    t = t_ref[:]  # (band + 2, cy + 2, cx + 2): one overlap plane each side
+    sl = lambda dz, dy, dx: t[  # noqa: E731
+        1 + dz : 1 + dz + band, 1 + dy : 1 + dy + cy, 1 + dx : 1 + dx + cx
+    ]
+    faces = ((-1, 0, 0), (1, 0, 0), (0, -1, 0), (0, 1, 0), (0, 0, -1), (0, 0, 1))
+    new = coeffs7[6] * sl(0, 0, 0) if coeffs7[6] else None
+    for d, w in zip(faces, coeffs7[:6]):
+        term = w * sl(*d)
+        new = term if new is None else new + term
+    o_ref[:] = new
+
+
+#: v5e/v5p scoped-VMEM ceiling the banded 3D kernel sizes itself against.
+_VMEM_CEILING = 100 << 20
+
+
+def _band3d_cost(band: int, cy: int, cx: int, itemsize: int) -> int:
+    """Scoped-VMEM footprint model for one z-band: double-buffered input
+    and output blocks plus ~3 output-sized slice temporaries (the factor
+    measured on v5e — Mosaic accounts all of them against scoped vmem)."""
+    in_block = (band + 2) * (cy + 2) * (cx + 2) * itemsize
+    out_block = band * cy * cx * itemsize
+    return 2 * in_block + 2 * out_block + 3 * out_block
+
+
+@functools.partial(jax.jit, static_argnames=("core_shape", "coeffs7", "budget_bytes"))
+def seven_point_banded_pallas(
+    padded: jax.Array,
+    core_shape: tuple[int, int, int],
+    coeffs7,
+    budget_bytes: int = _VMEM_CEILING,
+) -> jax.Array:
+    """7-point update of a 3D padded tile, banded over z-planes.
+
+    The 3D sibling of ``five_point_blocked``: a 1D grid over z bands whose
+    input blocks overlap by one plane (Element-indexed BlockSpec), each
+    band's seven shifted reads fused in VMEM. Emits only the new core.
+    The band is the largest divisor of cz whose FULL footprint (buffers +
+    temporaries, ``_band3d_cost``) fits ``budget_bytes``, which is also
+    the Mosaic scoped-vmem limit — one knob, no way to pick a band the
+    compiler then rejects.
+    """
+    cz, cy, cx = core_shape
+    if tuple(padded.shape) != (cz + 2, cy + 2, cx + 2):
+        raise ValueError(
+            f"padded {padded.shape} != core {core_shape} + 1-ghost ring"
+        )
+    band = _largest_divisor_band(
+        cz,
+        lambda b: _band3d_cost(b, cy, cx, padded.dtype.itemsize),
+        budget_bytes,
+    )
+    kern = functools.partial(
+        _band3d_kernel, band=band, cy=cy, cx=cx, coeffs7=tuple(coeffs7)
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(cz // band,),
+        in_specs=[
+            pl.BlockSpec(
+                (Element(band + 2), Element(cy + 2), Element(cx + 2)),
+                lambda i: (i * band, 0, 0),
+            )
+        ],
+        out_specs=pl.BlockSpec((band, cy, cx), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((cz, cy, cx), padded.dtype),
+        interpret=use_interpret(),
+        **mosaic_params(vmem_limit_bytes=budget_bytes),
+    )(padded)
 
 
 def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
