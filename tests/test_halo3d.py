@@ -138,7 +138,8 @@ class TestStencil3D:
 
 
 class TestCompactImpl:
-    @pytest.mark.parametrize("impl", ["compact", "compact-pallas"])
+    @pytest.mark.parametrize("impl", ["compact", "compact-pallas",
+                                      "compact-strips"])
     @pytest.mark.parametrize("periodic", [True, False])
     def test_compact_equals_padded(self, devices, periodic, impl):
         rng = np.random.default_rng(5)

@@ -258,10 +258,21 @@ def stencil_step3d_compact(
             core[take], axes, list(topo.send_permutation(flow))
         )
 
+    a_mz, a_pz, a_my, a_py, a_mx, a_px = (arrival(d) for d in FACES)
+
+    if compute == "pallas-strips":
+        # only the z axis is assembled outside; the y/x strips feed the
+        # kernel directly — two fewer full-grid concat passes per step
+        from tpuscratch.ops.stencil_kernel import seven_point_strips_pallas
+
+        zpad = jnp.concatenate([a_mz, core, a_pz], axis=0)
+        return seven_point_strips_pallas(
+            zpad, a_my, a_py, a_mx, a_px, (cz, cy, cx), tuple(coeffs)
+        )
+
     # ONE padded-tile materialization by nested concat (edge/corner lines
     # are zeros — a 7-point stencil never reads them), then the 7 shifted
     # reads fuse into the weighted sum
-    a_mz, a_pz, a_my, a_py, a_mx, a_px = (arrival(d) for d in FACES)
     mid = jnp.concatenate([a_mx, core, a_px], axis=2)        # (cz, cy, cx+2)
     zy = jnp.zeros((cz, 1, 1), core.dtype)
     north = jnp.concatenate([zy, a_my, zy], axis=2)          # (cz, 1, cx+2)
@@ -329,7 +340,15 @@ def decompose3d(
     return tiles
 
 
-IMPLS3D = ("compact", "compact-pallas", "padded")
+IMPLS3D = ("compact", "compact-pallas", "compact-strips", "padded")
+
+#: impl name -> compact compute backend ('compact-strips' is the fastest
+#: measured: BASELINE.md row 9)
+_COMPACT_COMPUTE = {
+    "compact": "xla",
+    "compact-pallas": "pallas",
+    "compact-strips": "pallas-strips",
+}
 
 
 def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
@@ -341,7 +360,7 @@ def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
     if impl not in IMPLS3D:
         raise ValueError(f"unknown 3D stencil impl {impl!r}; have {IMPLS3D}")
     if impl.startswith("compact"):
-        compute = "pallas" if impl == "compact-pallas" else "xla"
+        compute = _COMPACT_COMPUTE[impl]
         body = lambda t: run_stencil3d_compact(  # noqa: E731
             t[0, 0, 0], spec, steps, coeffs, compute
         )[None, None, None]
@@ -419,7 +438,7 @@ def distributed_stencil3d(
         impl = "compact" if tuple(halo) == (1, 1, 1) else "padded"
     if impl.startswith("compact") and tuple(halo) != (1, 1, 1):
         raise ValueError(
-            f"impl='compact' supports halo (1,1,1) only, got {halo}; "
+            f"impl={impl!r} supports halo (1,1,1) only, got {halo}; "
             "use impl='padded' for deeper ghosts"
         )
     if mesh is None:

@@ -85,12 +85,24 @@ def _trapezoid_kernel(t_ref, o_ref, *, substeps: int, crop: int, coeffs: Coeffs)
     o_ref[:] = a
 
 
-def _largest_divisor_band(n: int, cost_of_band, budget_bytes: int) -> int:
+def _largest_divisor_band(
+    n: int, cost_of_band, budget_bytes: int, strict: bool = False
+) -> int:
     """Largest divisor band of ``n`` with ``cost_of_band(band) <= budget``
-    (shared by the banded kernels' block sizing)."""
+    (shared by the banded kernels' block sizing). With ``strict``, raises
+    when even the single-unit band exceeds the budget — launching anyway
+    would fail in Mosaic with an opaque scoped-vmem error. (The 2D
+    trapezoid caller stays non-strict: its budget is an input-block bound
+    with deliberate margin, not a full-footprint model.)"""
     band = n
     while band > 1 and cost_of_band(band) > budget_bytes:
         band = next((d for d in range(band - 1, 0, -1) if n % d == 0), 1)
+    if strict and cost_of_band(band) > budget_bytes:
+        raise ValueError(
+            f"no band fits: even band=1 needs {cost_of_band(1)} B "
+            f"(> budget {budget_bytes} B); shrink the plane extents or "
+            "raise the budget"
+        )
     return band
 
 
@@ -313,6 +325,7 @@ def seven_point_banded_pallas(
         cz,
         lambda b: _band3d_cost(b, cy, cx, padded.dtype.itemsize),
         budget_bytes,
+        strict=True,
     )
     kern = functools.partial(
         _band3d_kernel, band=band, cy=cy, cx=cx, coeffs7=tuple(coeffs7)
@@ -331,6 +344,75 @@ def seven_point_banded_pallas(
         interpret=use_interpret(),
         **mosaic_params(vmem_limit_bytes=budget_bytes),
     )(padded)
+
+
+def _strips3d_kernel(z_ref, my_ref, py_ref, mx_ref, px_ref, o_ref, *,
+                     band: int, cy: int, cx: int, coeffs7):
+    t = z_ref[:]                      # (band + 2, cy, cx): z-overlap only
+    c = t[1 : band + 1]
+    up_z, dn_z = t[0:band], t[2 : band + 2]
+    ym = jnp.concatenate([my_ref[:], c[:, :-1, :]], axis=1)
+    yp = jnp.concatenate([c[:, 1:, :], py_ref[:]], axis=1)
+    xm = jnp.concatenate([mx_ref[:], c[:, :, :-1]], axis=2)
+    xp = jnp.concatenate([c[:, :, 1:], px_ref[:]], axis=2)
+    w = coeffs7
+    out = (
+        w[0] * up_z + w[1] * dn_z + w[2] * ym + w[3] * yp
+        + w[4] * xm + w[5] * xp
+    )
+    o_ref[:] = out + w[6] * c if w[6] else out
+
+
+@functools.partial(jax.jit, static_argnames=("core_shape", "coeffs7", "budget_bytes"))
+def seven_point_strips_pallas(
+    zpad: jax.Array,
+    a_my: jax.Array,
+    a_py: jax.Array,
+    a_mx: jax.Array,
+    a_px: jax.Array,
+    core_shape: tuple[int, int, int],
+    coeffs7,
+    budget_bytes: int = _VMEM_CEILING,
+) -> jax.Array:
+    """7-point update taking the y/x boundary strips as kernel inputs.
+
+    Saves the y/x concat materializations the padded-tile path pays on
+    the XLA side (each a full-grid HBM pass per step): only the z-padded
+    array (core + 2 arrival planes) is assembled outside; the in-band
+    y/x shifts concatenate the strip blocks in VMEM.
+    """
+    cz, cy, cx = core_shape
+    if tuple(zpad.shape) != (cz + 2, cy, cx):
+        raise ValueError(f"zpad {zpad.shape} != core {core_shape} + 2 z planes")
+    itemsize = zpad.dtype.itemsize
+
+    def cost(b):
+        in_block = (b + 2) * cy * cx * itemsize
+        out_block = b * cy * cx * itemsize
+        return 2 * in_block + 2 * out_block + 5 * out_block  # concat temps
+
+    band = _largest_divisor_band(cz, cost, budget_bytes, strict=True)
+    kern = functools.partial(
+        _strips3d_kernel, band=band, cy=cy, cx=cx, coeffs7=tuple(coeffs7)
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(cz // band,),
+        in_specs=[
+            pl.BlockSpec(
+                (Element(band + 2), Element(cy), Element(cx)),
+                lambda i: (i * band, 0, 0),
+            ),
+            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((band, cy, cx), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((cz, cy, cx), zpad.dtype),
+        interpret=use_interpret(),
+        **mosaic_params(vmem_limit_bytes=budget_bytes),
+    )(zpad, a_my, a_py, a_mx, a_px)
 
 
 def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
