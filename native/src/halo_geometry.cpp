@@ -21,6 +21,11 @@
 
 extern "C" {
 
+// Bumped whenever an exported signature changes; the Python binding
+// refuses libraries older than it expects (a stale .so called through a
+// newer ctypes prototype would silently read garbage arguments).
+int32_t ts_abi_version() { return 2; }
+
 // Rank at coords + (dr,dc), honoring per-axis periodicity; -1 if off-grid.
 int32_t ts_neighbor(int32_t rows, int32_t cols, int32_t per_r, int32_t per_c,
                     int32_t rank, int32_t dr, int32_t dc) {
@@ -134,27 +139,36 @@ int32_t ts_neighbor3d(int32_t dz, int32_t dy, int32_t dx, int32_t per_z,
   return (c[0] * dy + c[1]) * dx + c[2];
 }
 
-// Full 6-face plan. Outputs, per face i:
-//   offs[3i..]   = the face offset (halo side)
+// Full plan over `neighbors` (6 face-only or all 26) directions.
+// Outputs, per direction i:
+//   offs[3i..]   = the offset (halo side)
 //   send_rects[6i..] / recv_rects[6i..] = {o0,o1,o2,e0,e1,e2}
 //   perm pairs at perm_src/dst[i*nranks ..], count in perm_counts[i]
-// Returns 6, or -1 on invalid input.
+// Returns the direction count, or -1 on invalid input.
 int32_t ts_build_plan3d(int32_t dz, int32_t dy, int32_t dx, int32_t per_z,
                         int32_t per_y, int32_t per_x, int32_t cz, int32_t cy,
                         int32_t cx, int32_t hz, int32_t hy, int32_t hx,
-                        int32_t* offs, int32_t* send_rects,
+                        int32_t neighbors, int32_t* offs, int32_t* send_rects,
                         int32_t* recv_rects, int32_t* perm_src,
                         int32_t* perm_dst, int32_t* perm_counts) {
   if (dz <= 0 || dy <= 0 || dx <= 0 || cz <= 0 || cy <= 0 || cx <= 0 ||
       hz < 0 || hy < 0 || hx < 0 || hz > cz || hy > cy || hx > cx)
     return -1;
-  static const int32_t kFaces[6][3] = {{-1, 0, 0}, {1, 0, 0}, {0, -1, 0},
-                                       {0, 1, 0},  {0, 0, -1}, {0, 0, 1}};
+  if (neighbors != 6 && neighbors != 26) return -1;
+  // Same stable order as halo3d.OFFSETS26: faces, then edges, then
+  // corners, each block sorted lexicographically.
+  static const int32_t kDirs[26][3] = {
+      {-1, 0, 0},  {1, 0, 0},   {0, -1, 0},  {0, 1, 0},   {0, 0, -1},
+      {0, 0, 1},   {-1, -1, 0}, {-1, 0, -1}, {-1, 0, 1},  {-1, 1, 0},
+      {0, -1, -1}, {0, -1, 1},  {0, 1, -1},  {0, 1, 1},   {1, -1, 0},
+      {1, 0, -1},  {1, 0, 1},   {1, 1, 0},   {-1, -1, -1}, {-1, -1, 1},
+      {-1, 1, -1}, {-1, 1, 1},  {1, -1, -1}, {1, -1, 1},  {1, 1, -1},
+      {1, 1, 1}};
   const int32_t core[3] = {cz, cy, cx};
   const int32_t halo[3] = {hz, hy, hx};
   const int32_t nranks = dz * dy * dx;
-  for (int32_t i = 0; i < 6; ++i) {
-    const int32_t* d = kFaces[i];
+  for (int32_t i = 0; i < neighbors; ++i) {
+    const int32_t* d = kDirs[i];
     for (int a = 0; a < 3; ++a) {
       offs[3 * i + a] = d[a];
       const int32_t o = d[a], c = core[a], h = halo[a];
@@ -177,7 +191,7 @@ int32_t ts_build_plan3d(int32_t dz, int32_t dy, int32_t dx, int32_t per_z,
     }
     perm_counts[i] = n;
   }
-  return 6;
+  return neighbors;
 }
 
 }  // extern "C"

@@ -170,3 +170,72 @@ class TestCompactImpl:
                 np.roll(expect, s, a) for a in range(3) for s in (1, -1)
             ) / 6.0
         assert np.allclose(got, expect, atol=1e-5)
+
+
+class Test26Neighbors:
+    def test_rank_id_golden_all_26_regions(self, devices):
+        from tpuscratch.halo.halo3d import OFFSETS26
+
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        topo = CartTopology((2, 2, 2), (True, True, True))
+        lay = TileLayout3D((2, 2, 2), (1, 1, 1))
+        spec = HaloSpec3D(layout=lay, topology=topo, neighbors=26)
+        tiles = np.full((2, 2, 2) + lay.padded_shape, -1.0, np.float32)
+        for r in topo.ranks():
+            z, y, x = topo.coords(r)
+            tiles[z, y, x, 1:-1, 1:-1, 1:-1] = r
+        prog = run_spmd(
+            mesh,
+            lambda t: halo_exchange3d(t[0, 0, 0], spec)[None, None, None],
+            P("z", "row", "col", None, None, None),
+            P("z", "row", "col", None, None, None),
+        )
+        out = np.asarray(prog(jnp.asarray(tiles)))
+        assert len(OFFSETS26) == 26
+        for r in topo.ranks():
+            z, y, x = topo.coords(r)
+            tile = out[z, y, x]
+            for d in OFFSETS26:
+                n = topo.neighbor(r, d)
+                ghost = spec.layout.halo_region(d).region(tile)
+                assert (ghost == n).all(), (r, d, n)
+        # nothing left unfilled: the 26 regions + core tile everything
+        assert (out != -1.0).all()
+
+    def test_27_point_stencil_matches_roll_oracle(self, devices):
+        rng = np.random.default_rng(7)
+        world = rng.standard_normal((4, 8, 8)).astype(np.float32)
+        from tpuscratch.halo.halo3d import OFFSETS26
+
+        w = np.linspace(0.01, 0.26, 26)
+        coeffs = tuple(w) + (0.3,)
+        got = distributed_stencil3d(
+            world, 2, make_mesh((2, 2, 2), ("z", "row", "col")),
+            coeffs=coeffs,
+        )
+        expect = world.astype(np.float64)
+        for _ in range(2):
+            new = 0.3 * expect
+            for (dz, dy, dx), ww in zip(OFFSETS26, w):
+                new = new + ww * np.roll(
+                    np.roll(np.roll(expect, -dz, 0), -dy, 1), -dx, 2
+                )
+            expect = new
+        assert np.allclose(got, expect, atol=1e-4)
+
+    def test_27_point_rejects_face_only_spec_and_compact(self, devices):
+        import jax.numpy as jnp
+
+        from tpuscratch.halo.halo3d import stencil_step3d
+
+        topo = CartTopology((1, 1, 1), (True,) * 3)
+        spec6 = HaloSpec3D(layout=TileLayout3D((2, 2, 2)), topology=topo)
+        c27 = (0.01,) * 26 + (0.0,)
+        with pytest.raises(ValueError, match="neighbors=26"):
+            stencil_step3d(jnp.zeros((4, 4, 4)), spec6, coeffs=c27)
+        with pytest.raises(ValueError, match="7-point only"):
+            distributed_stencil3d(
+                np.zeros((4, 4, 4), np.float32), 1,
+                make_mesh((1, 1, 1), ("z", "row", "col")),
+                coeffs=c27, impl="compact",
+            )

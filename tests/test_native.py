@@ -93,9 +93,11 @@ CONFIGS_3D = [
 ]
 
 
+@pytest.mark.parametrize("neighbors", [6, 26])
 @pytest.mark.parametrize("dims,periodic,core,halo", CONFIGS_3D)
-def test_plan3d_matches_python(dims, periodic, core, halo):
-    """The native 6-face 3D plan equals the pure-Python one exactly."""
+def test_plan3d_matches_python(dims, periodic, core, halo, neighbors):
+    """The native 3D plan (faces-only and all-26) equals the pure-Python
+    one exactly."""
     from unittest import mock
 
     from tpuscratch.halo import halo3d
@@ -104,11 +106,12 @@ def test_plan3d_matches_python(dims, periodic, core, halo):
     topo = CartTopology(dims, periodic)
     lay = halo3d.TileLayout3D(core, halo)
     halo3d._cached_plan3d.cache_clear()
-    nat = halo3d._cached_plan3d(lay, topo)
+    nat = halo3d._cached_plan3d(lay, topo, neighbors)
     with mock.patch.object(native, "available", lambda: False):
         halo3d._cached_plan3d.cache_clear()
-        py = halo3d._cached_plan3d(lay, topo)
+        py = halo3d._cached_plan3d(lay, topo, neighbors)
     halo3d._cached_plan3d.cache_clear()
+    assert len(nat) == neighbors
     assert nat == py
 
 

@@ -7,12 +7,15 @@ devices: per-face slab transfers compiled to single-hop ``ppermute``s
 over a 3-axis mesh, MPI_PROC_NULL semantics on open boundaries, and a
 7-point Jacobi update.
 
-Lean by design: a 7-point stencil needs only the 6 FACE slabs, so the 2D
-library's 13-region taxonomy does not reappear as 27 regions — edge and
-corner transfers (needed for 27-point stencils) are out of scope, and the
-face-only plan keeps the per-step collective count at 6. Everything else
-carries over unchanged: ``CartTopology`` was already N-dimensional, and
-``SubarraySpec`` rectangles are rank-agnostic.
+Lean by default: a 7-point stencil needs only the 6 FACE slabs, so the
+default plan keeps the per-step collective count at 6 — the 2D library's
+13-region taxonomy does not reappear. For 27-point stencils the full
+26-neighbor plan (faces + 12 edges + 8 corners, ``neighbors=26``) is
+available: every transfer is still one single-hop ``ppermute`` (an edge
+or corner neighbor is one diagonal hop on the torus, exactly like the 2D
+corners). Everything else carries over unchanged: ``CartTopology`` was
+already N-dimensional, ``SubarraySpec`` rectangles are rank-agnostic,
+and the send/halo region math is generic over any offset in {-1,0,1}^3.
 """
 
 from __future__ import annotations
@@ -36,6 +39,22 @@ FACES: tuple[tuple[int, int, int], ...] = (
     (-1, 0, 0), (1, 0, 0),
     (0, -1, 0), (0, 1, 0),
     (0, 0, -1), (0, 0, 1),
+)
+
+#: All 26 neighbor offsets: faces first (plan-order stability), then the
+#: 12 edges, then the 8 corners.
+OFFSETS26: tuple[tuple[int, int, int], ...] = FACES + tuple(
+    sorted(
+        (
+            (dz, dy, dx)
+            for dz in (-1, 0, 1)
+            for dy in (-1, 0, 1)
+            for dx in (-1, 0, 1)
+            if (dz, dy, dx) != (0, 0, 0)
+            and abs(dz) + abs(dy) + abs(dx) >= 2
+        ),
+        key=lambda d: (abs(d[0]) + abs(d[1]) + abs(d[2]), d),
+    )
 )
 
 
@@ -98,29 +117,40 @@ class Transfer3D:
 
 @dataclasses.dataclass(frozen=True)
 class HaloSpec3D:
-    """Compiled-constant description of one 3D face exchange."""
+    """Compiled-constant description of one 3D halo exchange.
+
+    ``neighbors``: 6 (faces only — 7-point stencils) or 26 (faces +
+    edges + corners — 27-point stencils)."""
 
     layout: TileLayout3D
     topology: CartTopology
     axes: tuple[str, str, str] = ("z", "row", "col")
+    neighbors: int = 6
 
     def __post_init__(self):
         if self.topology.ndim != 3:
             raise ValueError("3D halo exchange requires a 3D topology")
+        if self.neighbors not in (6, 26):
+            raise ValueError("neighbors must be 6 or 26")
+
+    def directions(self) -> tuple[tuple[int, int, int], ...]:
+        return OFFSETS26 if self.neighbors == 26 else FACES
 
     def plan(self) -> tuple[Transfer3D, ...]:
-        return _cached_plan3d(self.layout, self.topology)
+        return _cached_plan3d(self.layout, self.topology, self.neighbors)
 
 
 @functools.lru_cache(maxsize=None)
 def _cached_plan3d(
-    layout: TileLayout3D, topology: CartTopology
+    layout: TileLayout3D, topology: CartTopology, neighbors: int = 6
 ) -> tuple[Transfer3D, ...]:
     from tpuscratch import native
 
+    directions = OFFSETS26 if neighbors == 26 else FACES
     if native.available() and native.has_plan3d():
         raw = native.build_plan3d(
-            topology.dims, topology.periodic, layout.core, layout.halo
+            topology.dims, topology.periodic, layout.core, layout.halo,
+            neighbors,
         )
         out = []
         for nat in raw:
@@ -142,7 +172,7 @@ def _cached_plan3d(
         return tuple(out)
 
     out = []
-    for d in FACES:
+    for d in directions:
         flow = tuple(-x for x in d)  # data in my d halo was sent toward -d
         perm = tuple(topology.send_permutation(flow))
         receivers = {dst for _, dst in perm}
@@ -159,7 +189,8 @@ def _cached_plan3d(
 
 
 def halo_exchange3d(tile: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
-    """Fill ``tile``'s 6 ghost slabs from its mesh neighbors (SPMD).
+    """Fill ``tile``'s ghost regions (6 face slabs, or all 26 regions for
+    a ``neighbors=26`` spec) from its mesh neighbors (SPMD).
 
     Delegates to the 2D library's executor pair (halo/exchange.py
     ``halo_arrivals``/``halo_scatter``): the plan protocol
@@ -180,21 +211,35 @@ JACOBI7 = (1 / 6,) * 6 + (0.0,)
 def stencil_step3d(
     tile: jnp.ndarray, spec: HaloSpec3D, coeffs=JACOBI7
 ) -> jnp.ndarray:
-    """One exchange + 7-point update; coeffs order = FACES + (center,)."""
-    if len(coeffs) != 7:
-        raise ValueError(f"need 6 face + 1 center coeffs, got {len(coeffs)}")
+    """One exchange + stencil update.
+
+    ``coeffs`` order: 7-point = FACES + (center,); 27-point = OFFSETS26 +
+    (center,), which requires a ``neighbors=26`` spec (a face-only
+    exchange never fills the edge/corner ghosts a 27-point stencil
+    reads — rejected rather than silently wrong, like the 2D 9-point)."""
+    if len(coeffs) not in (7, 27):
+        raise ValueError(
+            f"need 6+1 or 26+1 coeffs (FACES/OFFSETS26 + center), "
+            f"got {len(coeffs)}"
+        )
+    if len(coeffs) == 27 and spec.neighbors != 26:
+        raise ValueError(
+            "27-point coeffs need a neighbors=26 HaloSpec3D: the face-only "
+            "exchange never fills the edge/corner ghosts the stencil reads"
+        )
     hz, hy, hx = spec.layout.halo
     if hz < 1 or hy < 1 or hx < 1:
         raise ValueError(
-            f"7-point stencil needs halo >= 1 on every axis, got {spec.layout.halo}"
+            f"3D stencils need halo >= 1 on every axis, got {spec.layout.halo}"
         )
     u = halo_exchange3d(tile, spec)
     cz, cy, cx = spec.layout.core
     core = lambda dz, dy, dx: lax.dynamic_slice(  # noqa: E731
         u, (hz + dz, hy + dy, hx + dx), (cz, cy, cx)
     )
-    new = coeffs[6] * core(0, 0, 0)
-    for (dz, dy, dx), w in zip(FACES, coeffs[:6]):
+    directions = OFFSETS26 if len(coeffs) == 27 else FACES
+    new = coeffs[-1] * core(0, 0, 0)
+    for (dz, dy, dx), w in zip(directions, coeffs[:-1]):
         new = new + w * core(dz, dy, dx)
     # rebuild by CONCATENATION, not dynamic_update_slice: an in-place core
     # update fused with overlapping shifted reads of the same buffer
@@ -359,6 +404,11 @@ def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
     (decompose3d)."""
     if impl not in IMPLS3D:
         raise ValueError(f"unknown 3D stencil impl {impl!r}; have {IMPLS3D}")
+    if impl.startswith("compact") and len(coeffs) != 7:
+        raise ValueError(
+            f"compact impls are 7-point only ({len(coeffs)} coeffs given); "
+            "use impl='padded' for 27-point stencils"
+        )
     if impl.startswith("compact"):
         compute = _COMPACT_COMPUTE[impl]
         body = lambda t: run_stencil3d_compact(  # noqa: E731
@@ -435,7 +485,11 @@ def distributed_stencil3d(
     from tpuscratch.runtime.topology import factor3d
 
     if impl is None:
-        impl = "compact" if tuple(halo) == (1, 1, 1) else "padded"
+        impl = (
+            "compact"
+            if tuple(halo) == (1, 1, 1) and len(coeffs) == 7
+            else "padded"
+        )
     if impl.startswith("compact") and tuple(halo) != (1, 1, 1):
         raise ValueError(
             f"impl={impl!r} supports halo (1,1,1) only, got {halo}; "
@@ -450,7 +504,10 @@ def distributed_stencil3d(
     layout = TileLayout3D(
         tuple(w // d for w, d in zip(world.shape, dims)), halo
     )
-    spec = HaloSpec3D(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
+    spec = HaloSpec3D(
+        layout=layout, topology=topo, axes=tuple(mesh.axis_names),
+        neighbors=26 if len(coeffs) == 27 else 6,
+    )
     program = make_stencil3d_program(mesh, spec, steps, coeffs, impl)
     if impl.startswith("compact"):
         out = np.asarray(program(jnp.asarray(decompose3d_cores(world, dims))))

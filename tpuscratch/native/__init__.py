@@ -101,7 +101,7 @@ def load() -> Optional[ctypes.CDLL]:
         lib.ts_neighbor3d.restype = i32
         lib.ts_neighbor3d.argtypes = [i32] * 10
         lib.ts_build_plan3d.restype = i32
-        lib.ts_build_plan3d.argtypes = [i32] * 12 + [p32] * 6
+        lib.ts_build_plan3d.argtypes = [i32] * 13 + [p32] * 6
     except AttributeError:
         pass  # pre-3D library build; has_plan3d() reports it
     _lib = lib
@@ -113,10 +113,19 @@ def available() -> bool:
 
 
 def has_plan3d() -> bool:
-    """Whether the loaded library includes the 3D planner (an older .so
-    on disk may predate it; the Python path then serves 3D plans)."""
+    """Whether the loaded library includes the CURRENT 3D planner ABI
+    (an older .so on disk may predate it or carry the pre-`neighbors`
+    signature; the Python path then serves 3D plans)."""
     lib = load()
-    return lib is not None and hasattr(lib, "ts_build_plan3d")
+    if lib is None or not hasattr(lib, "ts_build_plan3d"):
+        return False
+    if not hasattr(lib, "ts_abi_version"):
+        return False  # ABI v1: ts_build_plan3d lacks the neighbors arg
+    lib.ts_abi_version.restype = ctypes.c_int32
+    # exact match, not >=: signature bumps change symbols IN PLACE, so a
+    # newer library through this prototype would misread arguments —
+    # fail safe to the Python fallback instead
+    return lib.ts_abi_version() == 2
 
 
 def _rect(fn, core_h: int, core_w: int, hy: int, hx: int, dr: int, dc: int):
@@ -204,33 +213,36 @@ def build_plan(dims, periodic, core_h, core_w, hy, hx, neighbors=8):
     return out
 
 
-def build_plan3d(dims, periodic, core, halo):
-    """Full 6-face 3D plan in one native call. Returns a list of dicts:
-    {offset, send_rect, recv_rect, perm} in halo3d.FACES order; rects are
-    (o0, o1, o2, e0, e1, e2) in padded coords."""
+def build_plan3d(dims, periodic, core, halo, neighbors: int = 6):
+    """Full 3D plan (6 faces or all 26 directions) in one native call.
+    Returns a list of dicts {offset, send_rect, recv_rect, perm} in
+    halo3d.OFFSETS26 order; rects are (o0, o1, o2, e0, e1, e2) in padded
+    coords."""
     lib = load()
     assert lib is not None and has_plan3d()
     nranks = dims[0] * dims[1] * dims[2]
-    offs = (ctypes.c_int32 * (3 * 6))()
-    send_rects = (ctypes.c_int32 * (6 * 6))()
-    recv_rects = (ctypes.c_int32 * (6 * 6))()
-    perm_src = (ctypes.c_int32 * (6 * nranks))()
-    perm_dst = (ctypes.c_int32 * (6 * nranks))()
-    counts = (ctypes.c_int32 * 6)()
+    nd = 26
+    offs = (ctypes.c_int32 * (3 * nd))()
+    send_rects = (ctypes.c_int32 * (6 * nd))()
+    recv_rects = (ctypes.c_int32 * (6 * nd))()
+    perm_src = (ctypes.c_int32 * (nd * nranks))()
+    perm_dst = (ctypes.c_int32 * (nd * nranks))()
+    counts = (ctypes.c_int32 * nd)()
     nfaces = lib.ts_build_plan3d(
         dims[0], dims[1], dims[2],
         int(periodic[0]), int(periodic[1]), int(periodic[2]),
-        core[0], core[1], core[2], halo[0], halo[1], halo[2],
+        core[0], core[1], core[2], halo[0], halo[1], halo[2], neighbors,
         offs, send_rects, recv_rects, perm_src, perm_dst, counts,
     )
     if nfaces < 0:
         raise ValueError(
-            f"native 3D planner rejected dims={dims} core={core} halo={halo}"
+            f"native 3D planner rejected dims={dims} core={core} "
+            f"halo={halo} neighbors={neighbors}"
         )
     import numpy as np
 
-    src_np = np.ctypeslib.as_array(perm_src).reshape(6, nranks)
-    dst_np = np.ctypeslib.as_array(perm_dst).reshape(6, nranks)
+    src_np = np.ctypeslib.as_array(perm_src).reshape(nd, nranks)
+    dst_np = np.ctypeslib.as_array(perm_dst).reshape(nd, nranks)
     out = []
     for i in range(nfaces):
         n = counts[i]
