@@ -1,0 +1,288 @@
+"""Geometric multigrid for the 3D periodic Poisson problem.
+
+The 2D solver (solvers/multigrid.py) one dimension up, built on the 3D
+halo library: the 7-point operator and smoothers need only face ghosts,
+but the trilinear transfer pair reads CORNER ghosts — the first consumer
+of the 26-neighbor exchange (halo3d ``neighbors=26``). Same design
+decisions as 2D, same reasons:
+
+- every level reuses the same 3-axis device mesh with a halved tile;
+- VPU-friendly smoothers (damped Jacobi / red-black GS via two fused
+  masked half-updates, parity (i+j+k) mod 2 — global when core extents
+  are even);
+- adjoint transfers: trilinear prolongation and full-weighting
+  restriction R = P^T/8 ([1,3,3,1]/8 tensor cubed), continuum scaling
+  4 = (2h)^2/h^2 on the restricted residual (dimension-independent);
+- spec PAIRS per level: the hot smoothing/residual exchanges use the
+  faces-only plan (6 ppermutes), only the two inter-level transfers per
+  cycle pay the 26-transfer plan;
+- one trace: unrolled level recursion, while_loop cycle iteration,
+  psum'd residuals, zero host round trips.
+
+Measured (tests assert the bounds): cycle count flat in grid size,
+~8-10 cycles to 1e-6 at 32^3-64^3 — the same O(1) behavior as 2D.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.halo.halo3d import (
+    HaloSpec3D,
+    TileLayout3D,
+    decompose3d_cores,
+    assemble3d_cores,
+    halo_exchange3d,
+)
+from tpuscratch.runtime.mesh import make_mesh, topology_of
+from tpuscratch.runtime.topology import factor3d
+
+_W4 = (0.125, 0.375, 0.375, 0.125)
+
+
+def _padded3(core: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
+    """Embed a core tile and fill its 1-ghost shell from the torus."""
+    p = jnp.zeros(spec.layout.padded_shape, core.dtype)
+    p = lax.dynamic_update_slice(p, core, (1, 1, 1))
+    return halo_exchange3d(p, spec)
+
+
+def periodic_laplacian3(core: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
+    """``A @ core`` for the periodic 7-point operator (diagonal 6)."""
+    u = _padded3(core, spec)
+    return (
+        6.0 * u[1:-1, 1:-1, 1:-1]
+        - u[:-2, 1:-1, 1:-1] - u[2:, 1:-1, 1:-1]
+        - u[1:-1, :-2, 1:-1] - u[1:-1, 2:, 1:-1]
+        - u[1:-1, 1:-1, :-2] - u[1:-1, 1:-1, 2:]
+    )
+
+
+def _neighbor_sum3(u, spec: HaloSpec3D):
+    p = _padded3(u, spec)
+    return (
+        p[:-2, 1:-1, 1:-1] + p[2:, 1:-1, 1:-1]
+        + p[1:-1, :-2, 1:-1] + p[1:-1, 2:, 1:-1]
+        + p[1:-1, 1:-1, :-2] + p[1:-1, 1:-1, 2:]
+    )
+
+
+def jacobi_smooth3(u, f, spec: HaloSpec3D, omega: float, sweeps: int):
+    def body(_, u):
+        return u + (omega / 6.0) * (f - periodic_laplacian3(u, spec))
+
+    return lax.fori_loop(0, sweeps, body, u)
+
+
+def rbgs_smooth3(u, f, spec: HaloSpec3D, sweeps: int, reverse: bool = False):
+    """Red-black GS with (i+j+k) mod 2 parity (global for even cores)."""
+    cz, cy, cx = spec.layout.core
+    if cz % 2 or cy % 2 or cx % 2:
+        raise ValueError(
+            f"red-black smoothing needs even core extents, got {spec.layout.core}"
+        )
+    ii = jnp.arange(cz)[:, None, None]
+    jj = jnp.arange(cy)[None, :, None]
+    kk = jnp.arange(cx)[None, None, :]
+    red = (ii + jj + kk) % 2 == 0
+    first, second = (~red, red) if reverse else (red, ~red)
+
+    def half(u, mask):
+        return jnp.where(mask, (f + _neighbor_sum3(u, spec)) / 6.0, u)
+
+    def body(_, u):
+        return half(half(u, first), second)
+
+    return lax.fori_loop(0, sweeps, body, u)
+
+
+def _smooth3(u, f, spec, omega, sweeps, smoother, reverse=False):
+    cz, cy, cx = spec.layout.core
+    if smoother == "rbgs" and not (cz % 2 or cy % 2 or cx % 2):
+        return rbgs_smooth3(u, f, spec, sweeps, reverse)
+    if smoother not in ("jacobi", "rbgs"):
+        raise ValueError(f"unknown smoother {smoother!r}")
+    return jacobi_smooth3(u, f, spec, omega, sweeps)
+
+
+def restrict_fw3(r: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
+    """Full-weighting restriction: the [1,3,3,1]/8 stencil cubed over each
+    coarse cell's 4x4x4 fine neighborhood — reads EDGE and CORNER ghosts,
+    so ``spec`` must carry the 26-neighbor plan."""
+    rp = _padded3(r, spec)
+    cz, cy, cx = (s // 2 for s in r.shape)
+    acc = jnp.zeros((cz, cy, cx), r.dtype)
+    for a, wa in enumerate(_W4):
+        for b, wb in enumerate(_W4):
+            for c, wc in enumerate(_W4):
+                acc = acc + wa * wb * wc * lax.slice(
+                    rp, (a, b, c),
+                    (a + 2 * cz - 1, b + 2 * cy - 1, c + 2 * cx - 1),
+                    (2, 2, 2),
+                )
+    return acc
+
+
+def prolong_trilinear(e: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
+    """Cell-centered trilinear prolongation: each fine cell blends its 8
+    nearest coarse cells with (3/4, 1/4) per-axis weights (corner ghosts
+    again — 26-neighbor spec)."""
+    ep = _padded3(e, spec)
+    cz, cy, cx = e.shape
+
+    def sl(dz, dy, dx):
+        return ep[1 + dz:1 + dz + cz, 1 + dy:1 + dy + cy, 1 + dx:1 + dx + cx]
+
+    octants = []
+    for a in (0, 1):          # fine z within the coarse cell
+        planes = []
+        for b in (0, 1):      # fine y
+            rows = []
+            for c in (0, 1):  # fine x
+                sz = -1 if a == 0 else 1
+                sy = -1 if b == 0 else 1
+                sx = -1 if c == 0 else 1
+                v = (
+                    27 * sl(0, 0, 0)
+                    + 9 * (sl(sz, 0, 0) + sl(0, sy, 0) + sl(0, 0, sx))
+                    + 3 * (sl(sz, sy, 0) + sl(sz, 0, sx) + sl(0, sy, sx))
+                    + sl(sz, sy, sx)
+                ) / 64.0
+                rows.append(v)
+            planes.append(jnp.stack(rows, axis=-1).reshape(cz, cy, 2 * cx))
+        stacked = jnp.stack(planes, axis=2).reshape(cz, 2 * cy, 2 * cx)
+        octants.append(stacked)
+    return jnp.stack(octants, axis=1).reshape(2 * cz, 2 * cy, 2 * cx)
+
+
+def level_specs3(
+    layout: TileLayout3D, topo, axes, levels: int
+) -> list[tuple[HaloSpec3D, HaloSpec3D]]:
+    """Per level, a (faces-only, all-26) spec PAIR: smoothing and the
+    residual are 7-point and pay only 6 ppermutes per exchange in the hot
+    loop; the two inter-level transfers read edge/corner ghosts and use
+    the 26-plan (the 2D solver's neighbors=4 split, one dimension up)."""
+    specs = []
+    for l in range(levels):
+        core = tuple(c >> l for c in layout.core)
+        if any(c < 1 for c in core) or (
+            l < levels - 1 and any(c % 2 for c in core)
+        ):
+            raise ValueError(
+                f"tile {layout.core} does not support {levels} levels "
+                f"(level {l} would be {core})"
+            )
+        lay = TileLayout3D(core, (1, 1, 1))
+        specs.append(tuple(
+            HaloSpec3D(layout=lay, topology=topo, axes=axes, neighbors=n)
+            for n in (6, 26)
+        ))
+    return specs
+
+
+def v_cycle3(
+    u, f, specs, level: int = 0,
+    nu: int = 2, coarse_sweeps: int = 32, omega: float = 6 / 7,
+    smoother: str = "rbgs",
+):
+    """One 3D V-cycle (recursion unrolls at trace time); post-smoothing
+    reverses color order so the cycle is symmetric. ``specs`` is the
+    ``level_specs3`` list of (faces, all-26) pairs."""
+    s6, s26 = specs[level]
+    if level == len(specs) - 1:
+        half = (coarse_sweeps + 1) // 2
+        u = _smooth3(u, f, s6, omega, half, smoother)
+        return _smooth3(u, f, s6, omega, half, smoother, reverse=True)
+    u = _smooth3(u, f, s6, omega, nu, smoother)
+    r = f - periodic_laplacian3(u, s6)
+    rc = 4.0 * restrict_fw3(r, s26)
+    ec = v_cycle3(
+        jnp.zeros_like(rc), rc, specs, level + 1, nu, coarse_sweeps, omega,
+        smoother,
+    )
+    u = u + prolong_trilinear(ec, specs[level + 1][1])
+    return _smooth3(u, f, s6, omega, nu, smoother, reverse=True)
+
+
+def mg_poisson3d_solve(
+    b_world: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_cycles: int = 50,
+    nu: int = 2,
+    coarse_sweeps: int = 32,
+    omega: float = 6 / 7,
+    smoother: str = "rbgs",
+):
+    """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
+    V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
+    with zero-mean ``x`` (same contract as the 2D solver)."""
+    import jax
+
+    if mesh is None:
+        mesh = make_mesh(factor3d(len(jax.devices())), ("z", "row", "col"))
+    dims = tuple(mesh.devices.shape)
+    topo = topology_of(mesh, periodic=True)
+    if any(w % d for w, d in zip(b_world.shape, dims)):
+        raise ValueError(f"grid {b_world.shape} not divisible by mesh {dims}")
+    layout = TileLayout3D(
+        tuple(w // d for w, d in zip(b_world.shape, dims)), (1, 1, 1)
+    )
+    if levels is None:
+        levels = 1
+        while (
+            all(c >> levels >= 2 for c in layout.core)
+            and all((c >> (levels - 1)) % 2 == 0 for c in layout.core)
+        ):
+            levels += 1
+    specs = level_specs3(layout, topo, tuple(mesh.axis_names), levels)
+    axes = tuple(mesh.axis_names)
+    cells = float(np.prod(b_world.shape))
+
+    def local(b_tile):
+        b = b_tile[0, 0, 0]
+        f = b - lax.psum(jnp.sum(b), axes) / cells
+
+        def rs_of(u):
+            r = f - periodic_laplacian3(u, specs[0][0])
+            return lax.psum(jnp.sum(r * r), axes)
+
+        rs0 = lax.psum(jnp.sum(f * f), axes)
+        stop2 = jnp.asarray(tol, f.dtype) ** 2 * rs0
+
+        def cond(st):
+            _, rs, prev, k = st
+            return (k < max_cycles) & (rs > stop2) & (rs < 0.5 * prev)
+
+        def body(st):
+            u, rs, _, k = st
+            u = v_cycle3(u, f, specs, 0, nu, coarse_sweeps, omega, smoother)
+            return u, rs_of(u), rs, k + 1
+
+        u0 = jnp.zeros_like(f)
+        u, rs, _, k = lax.while_loop(
+            cond, body,
+            (u0, rs0, jnp.asarray(np.inf, f.dtype), jnp.asarray(0, jnp.int32)),
+        )
+        u = u - lax.psum(jnp.sum(u), axes) / cells
+        tiny = jnp.asarray(np.finfo(np.dtype(f.dtype)).tiny, f.dtype)
+        return u[None, None, None], k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
+
+    program = run_spmd(
+        mesh,
+        local,
+        P(*mesh.axis_names, None, None, None),
+        (P(*mesh.axis_names, None, None, None), P(), P()),
+    )
+    x_tiles, k, relres = program(
+        jnp.asarray(decompose3d_cores(b_world, dims))
+    )
+    return assemble3d_cores(np.asarray(x_tiles)), int(k), float(relres)
