@@ -327,3 +327,21 @@ class TestMultigrid3D:
             jnp.asarray(e)[None, None, None], jnp.asarray(r)[None, None, None]
         )
         assert np.isclose(float(lhs), float(rhs), rtol=1e-5)
+
+    def test_3d_pcg_beats_vcycle_iteration(self, devices):
+        from tpuscratch.runtime.mesh import make_mesh
+        from tpuscratch.solvers.multigrid3d import (
+            mg_poisson3d_solve,
+            pcg_poisson3d_solve,
+        )
+
+        rng = np.random.default_rng(3)
+        b = rng.standard_normal((16, 16, 16)).astype(np.float32)
+        b -= b.mean()
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        x, iters, relres = pcg_poisson3d_solve(b, mesh, tol=1e-6)
+        assert relres <= 1e-6 and iters <= 8
+        _, cycles, _ = mg_poisson3d_solve(b, mesh, tol=1e-6)
+        assert iters < cycles
+        resid = np.abs(self._lap3(x.astype(np.float64)) - b).max()
+        assert resid < 1e-4

@@ -18,7 +18,11 @@ from tpuscratch.solvers.multigrid import (
     pcg_poisson_solve,
     v_cycle,
 )
-from tpuscratch.solvers.multigrid3d import mg_poisson3d_solve, v_cycle3
+from tpuscratch.solvers.multigrid3d import (
+    mg_poisson3d_solve,
+    pcg_poisson3d_solve,
+    v_cycle3,
+)
 from tpuscratch.solvers.spectral import periodic_poisson_fft
 
 __all__ = [
@@ -28,6 +32,7 @@ __all__ = [
     "mg_poisson_solve",
     "mg_poisson3d_solve",
     "pcg_poisson_solve",
+    "pcg_poisson3d_solve",
     "v_cycle",
     "v_cycle3",
     "periodic_poisson_fft",

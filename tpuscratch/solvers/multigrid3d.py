@@ -210,21 +210,9 @@ def v_cycle3(
     return _smooth3(u, f, s6, omega, nu, smoother, reverse=True)
 
 
-def mg_poisson3d_solve(
-    b_world: np.ndarray,
-    mesh: Optional[Mesh] = None,
-    *,
-    levels: Optional[int] = None,
-    tol: float = 1e-5,
-    max_cycles: int = 50,
-    nu: int = 2,
-    coarse_sweeps: int = 32,
-    omega: float = 6 / 7,
-    smoother: str = "rbgs",
-):
-    """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
-    V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
-    with zero-mean ``x`` (same contract as the 2D solver)."""
+def _mg_prologue3(b_world: np.ndarray, mesh: Optional[Mesh], levels: Optional[int]):
+    """Shared 3D driver prologue (the 2D _mg_prologue one dimension up):
+    default mesh, divisibility check, per-level spec pairs."""
     import jax
 
     if mesh is None:
@@ -244,8 +232,26 @@ def mg_poisson3d_solve(
         ):
             levels += 1
     specs = level_specs3(layout, topo, tuple(mesh.axis_names), levels)
-    axes = tuple(mesh.axis_names)
     cells = float(np.prod(b_world.shape))
+    return mesh, dims, specs, tuple(mesh.axis_names), cells
+
+
+def mg_poisson3d_solve(
+    b_world: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_cycles: int = 50,
+    nu: int = 2,
+    coarse_sweeps: int = 32,
+    omega: float = 6 / 7,
+    smoother: str = "rbgs",
+):
+    """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
+    V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
+    with zero-mean ``x`` (same contract as the 2D solver)."""
+    mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
 
     def local(b_tile):
         b = b_tile[0, 0, 0]
@@ -275,6 +281,59 @@ def mg_poisson3d_solve(
         u = u - lax.psum(jnp.sum(u), axes) / cells
         tiny = jnp.asarray(np.finfo(np.dtype(f.dtype)).tiny, f.dtype)
         return u[None, None, None], k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
+
+    program = run_spmd(
+        mesh,
+        local,
+        P(*mesh.axis_names, None, None, None),
+        (P(*mesh.axis_names, None, None, None), P(), P()),
+    )
+    x_tiles, k, relres = program(
+        jnp.asarray(decompose3d_cores(b_world, dims))
+    )
+    return assemble3d_cores(np.asarray(x_tiles)), int(k), float(relres)
+
+
+def pcg_poisson3d_solve(
+    b_world: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_iters: int = 50,
+    nu: int = 2,
+    coarse_sweeps: int = 16,
+    omega: float = 6 / 7,
+    smoother: str = "rbgs",
+):
+    """Multigrid-preconditioned CG on the 3D periodic Poisson problem —
+    the 2D ``pcg_poisson_solve`` one dimension up, same contract:
+    ``(x_world, iters, relres)``, nullspace-projected symmetric V-cycle
+    preconditioner, true-residual stopping."""
+    from tpuscratch.solvers.cg import cg
+
+    mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
+
+    def local(b_tile):
+        b = b_tile[0, 0, 0]
+        f = b - lax.psum(jnp.sum(b), axes) / cells
+
+        def project(v):
+            return v - lax.psum(jnp.sum(v), axes) / cells
+
+        def precond(r):
+            z = v_cycle3(
+                jnp.zeros_like(r), project(r), specs, 0, nu,
+                coarse_sweeps, omega, smoother,
+            )
+            return project(z)
+
+        x, k, relres = cg(
+            lambda p: periodic_laplacian3(p, specs[0][0]),
+            f, axes, tol=tol, max_iters=max_iters, precond=precond,
+        )
+        x = project(x)
+        return x[None, None, None], k, relres
 
     program = run_spmd(
         mesh,
