@@ -1,0 +1,74 @@
+"""Worker process for the multi-host TRAINING test (not a test module).
+
+Two OS processes x two virtual CPU devices each = a 2x2 (dp x sp) global
+mesh whose sp axis crosses the process boundary: the composed train step
+(models/transformer — ring attention over sp, expert all_to_all over dp,
+grad + SGD) runs with its collectives spanning hosts, the way a real
+pod-slice training job does. Run:
+
+    python tests/_multihost_train_worker.py <port> <rank> <nprocs>
+"""
+
+import sys
+
+port, rank, nprocs = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+from tpuscratch.runtime.hostenv import force_cpu_devices
+
+force_cpu_devices(2)  # two local devices per process
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpuscratch.models import TransformerConfig, init_params
+from tpuscratch.models.transformer import train_step
+from tpuscratch.runtime.context import initialize
+from tpuscratch.runtime.mesh import make_mesh
+
+ctx = initialize(
+    coordinator_address=f"127.0.0.1:{port}",
+    num_processes=nprocs,
+    process_id=rank,
+)
+assert ctx.global_device_count == 2 * nprocs, ctx
+
+cfg = TransformerConfig(
+    d_model=16, n_heads=2, n_experts=2, d_ff=32, capacity_factor=2.0
+)
+# the LEADING mesh axis spans processes (jax.devices() is process-major):
+# make it sp, so the ring-attention ppermutes genuinely cross hosts
+mesh = make_mesh((nprocs, 2), ("sp", "dp"))
+
+
+def globalize(np_val, spec):
+    return jax.make_array_from_callback(
+        np_val.shape, NamedSharding(mesh, spec), lambda idx: np_val[idx]
+    )
+
+
+# identical data + params on every host (deterministic seeds), turned
+# into GLOBAL arrays shard-by-shard — the multi-host input contract
+rng = np.random.default_rng(0)
+B, S = 4, 8 * nprocs  # batch over dp (intra-host), sequence over sp (cross)
+x = globalize(
+    rng.standard_normal((B, S, cfg.d_model)).astype(np.float32), P("dp", "sp")
+)
+y = globalize(
+    rng.standard_normal((B, S, cfg.d_model)).astype(np.float32), P("dp", "sp")
+)
+params = jax.tree.map(
+    lambda p: globalize(np.asarray(p, np.float32), P()), init_params(7, cfg)
+)
+
+step = train_step(mesh, cfg, lr=0.05)
+losses = []
+for _ in range(3):
+    params, loss = step(params, x, y)
+    losses.append(float(loss))  # replicated scalar: every host may read it
+assert losses[-1] < losses[0], losses
+print(
+    f"WORKER{rank} TRAIN OK losses={losses[0]:.4f}->{losses[-1]:.4f} "
+    f"devices={ctx.global_device_count}",
+    flush=True,
+)

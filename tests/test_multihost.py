@@ -28,39 +28,61 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _run_workers(script: pathlib.Path, nprocs: int, timeout: float) -> list[str]:
+    """Spawn nprocs worker processes on a fresh coordinator port, wait,
+    assert zero exit, return each worker's combined output."""
+    port = _free_port()
+    env = dict(os.environ)
+    # repo root importable in the workers; APPEND so the environment's
+    # own entries (e.g. the axon site dir) survive
+    env["PYTHONPATH"] = os.pathsep.join(
+        [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(rank), str(nprocs)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=str(REPO),
+            env=env,
+        )
+        for rank in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    return outs
+
+
 class TestMultiHostInitialize:
     @pytest.mark.parametrize("nprocs", [2])
     def test_two_process_rendezvous_and_psum(self, nprocs):
-        port = _free_port()
-        env = dict(os.environ)
-        # repo root importable in the workers; APPEND so the environment's
-        # own entries (e.g. the axon site dir) survive
-        env["PYTHONPATH"] = os.pathsep.join(
-            [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
-        )
-        procs = [
-            subprocess.Popen(
-                [sys.executable, str(WORKER), str(port), str(rank), str(nprocs)],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-                cwd=str(REPO),
-                env=env,
-            )
-            for rank in range(nprocs)
-        ]
-        outs = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=180)
-                outs.append(out)
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        for rank, (p, out) in enumerate(zip(procs, outs)):
-            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        outs = _run_workers(WORKER, nprocs, timeout=180)
+        for rank, out in enumerate(outs):
             assert f"WORKER{rank} OK process_count={nprocs}" in out, out
             assert "psum=3.0" in out, out
         # both ranks printed the mpi1-style hello with the global view
         assert all(f"of {nprocs} on" in o for o in outs), outs
+
+
+TRAIN_WORKER = pathlib.Path(__file__).parent / "_multihost_train_worker.py"
+
+
+class TestMultiHostTraining:
+    def test_composed_train_step_spans_two_processes(self):
+        """The full dp x sp train step (ring attention + MoE all_to_all +
+        grad + SGD) with the sp ring collectives crossing a REAL process
+        boundary — the pod-slice training shape on localhost."""
+        outs = _run_workers(TRAIN_WORKER, 2, timeout=300)
+        for rank, out in enumerate(outs):
+            assert f"WORKER{rank} TRAIN OK" in out, out
+            assert "devices=4" in out, out
