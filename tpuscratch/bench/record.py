@@ -13,6 +13,12 @@ the ones this repo establishes. Configs follow BASELINE.md:
 4. 8192^2 stencil on a 4x4 mesh                  (16 devices; CPU proxy
    on single-chip sessions)
 5. weak-scaling stencil, fixed per-chip tile     (ditto)
+6. flash attention TFLOP/s, causal + full        (real chip when present)
+7. per-collective busBW sweep                    (needs >= 2 devices;
+   CPU proxy on single-chip sessions)
+8. matmul-form pair-DFT round-trip TFLOP/s       (real chip when present)
+9. 3D 7-point stencil cell-updates/s             (per-device tile scales
+   with the mesh; real chip when present)
 
 Each config prints one JSON line with the platform recorded, so CPU-proxy
 numbers can never masquerade as chip numbers.
@@ -324,6 +330,90 @@ def config6_flash_attention(out: list, iters: int = 3) -> None:
         )
 
 
+def config7_collectives(out: list, iters: int = 10) -> None:
+    """Beyond-reference: per-collective busBW sweep (BASELINE row 7).
+
+    Host-memory proxy on the CPU mesh; re-run on a slice for ICI."""
+    import jax
+
+    from tpuscratch.bench.collective_bench import sweep, verify
+    from tpuscratch.runtime.mesh import make_mesh_1d
+
+    n = min(8, len(jax.devices()))
+    if n < 2:
+        raise Needs("collective sweep needs >= 2 devices (use --cpu-devices 8)")
+    mesh = make_mesh_1d("x", n)
+    if not verify(mesh):
+        raise AssertionError("collective echo-verify FAILED")
+    on_tpu = jax.default_backend() == "tpu"
+    peaks: dict[str, float] = {}
+    for r in sweep(mesh, iters=iters,
+                   fence="readback" if on_tpu else "block"):
+        name = r.name.split()[0]
+        peaks[name] = max(peaks.get(name, 0.0), r.gbps)
+        print(f"# {r.summary()}", file=sys.stderr)
+    _emit(
+        out,
+        config=7,
+        metric="collective_busbw_peak_gbps",
+        value=max(peaks.values()),
+        peaks=peaks,
+        detail=f"busBW peaks over 1KiB-4MiB/device on {n} devices; "
+        "echo-verify PASSED",
+    )
+
+
+def config8_dft(out: list, iters: int = 3) -> None:
+    """Beyond-reference: matmul-form pair DFT TFLOP/s (BASELINE row 8)."""
+    from tpuscratch.bench.fft_bench import bench_dft
+
+    r = bench_dft(iters=iters)
+    print(f"# {r.summary()}", file=sys.stderr)
+    _emit(
+        out,
+        config=8,
+        metric="pair_dft_roundtrip_tflops",
+        value=r.items_per_s / 1e12,
+        p50_s=r.p50,
+        detail=f"{r.name} (precision=HIGHEST f32)",
+    )
+
+
+def config9_stencil3d(out: list, iters: int = 3) -> None:
+    """Beyond-reference: 3D 7-point stencil cell-updates/s (BASELINE row 9)."""
+    import jax
+
+    from tpuscratch.bench.stencil_bench import bench_stencil3d
+    from tpuscratch.runtime.mesh import make_mesh
+    from tpuscratch.runtime.topology import factor3d
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = len(jax.devices())
+    dims = factor3d(n)
+    mesh = make_mesh(dims, ("z", "row", "col"))
+    # per-DEVICE tile is fixed; the grid scales with the mesh so a slice
+    # run measures real per-chip work, never a degenerate sliver
+    tile = (256, 512, 512) if on_tpu else (8, 8, 8)
+    grid = tuple(t * d for t, d in zip(tile, dims))
+    r = bench_stencil3d(
+        grid=grid,
+        steps=3000 if on_tpu else 3,
+        mesh=mesh,
+        impl="compact-strips" if on_tpu else "compact",
+        iters=iters,
+        fence="readback" if on_tpu else "block",
+    )
+    print(f"# {r.summary()}", file=sys.stderr)
+    _emit(
+        out,
+        config=9,
+        metric="stencil3d_cell_updates_per_s",
+        value=r.items_per_s,
+        p50_s=r.p50,
+        detail=r.name,
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -331,12 +421,15 @@ CONFIGS = {
     4: config4_stencil_mesh,
     5: config5_weak_scaling,
     6: config6_flash_attention,
+    7: config7_collectives,
+    8: config8_dft,
+    9: config9_stencil3d,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--configs", default="1,2,3,4,5,6")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh first (dev path)")
