@@ -46,6 +46,7 @@ into the nullspace beyond rounding).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax.numpy as jnp
@@ -242,30 +243,11 @@ def _mg_prologue(b_world: np.ndarray, mesh: Optional[Mesh], levels: Optional[int
     return mesh, topo, layout, specs, tuple(mesh.axis_names), cells
 
 
-def mg_poisson_solve(
-    b_world: np.ndarray,
-    mesh: Optional[Mesh] = None,
-    *,
-    levels: Optional[int] = None,
-    tol: float = 1e-5,
-    max_cycles: int = 50,
-    nu: int = 2,
-    coarse_sweeps: int = 32,
-    omega: float = 0.8,
-    smoother: str = "rbgs",
-):
-    """Solve ``A x = b - mean(b)`` (periodic 5-point Laplacian) by
-    V-cycles, distributed over a 2D mesh.
-
-    Same contract as ``solvers.spectral.periodic_poisson_fft`` plus the
-    iteration report: returns ``(x_world, cycles, relres)`` with
-    zero-mean ``x``. ``omega`` applies to the Jacobi smoother/fallback
-    only; the default rbgs smoother has no damping knob.
-    """
-    from tpuscratch.halo.driver import assemble, decompose
-
-    mesh, topo, layout, specs, axes, cells = _mg_prologue(b_world, mesh, levels)
-
+@functools.lru_cache(maxsize=32)
+def _mg_program(mesh, specs, axes, cells, tol, max_cycles, nu,
+                coarse_sweeps, omega, smoother):
+    """Compiled-per-config V-cycle solver program (repeat solves skip
+    the ~seconds of re-tracing the driver would otherwise pay)."""
     def local(b_tile):
         b = b_tile[0, 0]
         f = b - lax.psum(jnp.sum(b), axes) / cells  # project out nullspace
@@ -298,11 +280,79 @@ def mg_poisson_solve(
         tiny = jnp.asarray(np.finfo(np.dtype(f.dtype)).tiny, f.dtype)
         return u[None, None], k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
 
-    program = run_spmd(
+    return run_spmd(
         mesh,
         local,
         P(*mesh.axis_names, None, None),
         (P(*mesh.axis_names, None, None), P(), P()),
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _pcg_program(mesh, specs, axes, cells, tol, max_iters, nu,
+                 coarse_sweeps, omega, smoother):
+    """Compiled-per-config MG-preconditioned CG program."""
+    from tpuscratch.solvers.cg import cg
+
+    def local(b_tile):
+        b = b_tile[0, 0]
+        f = b - lax.psum(jnp.sum(b), axes) / cells
+
+        def project(v):
+            return v - lax.psum(jnp.sum(v), axes) / cells
+
+        def precond(r):
+            # projected V-cycle (P M P): f32 rounding leaks a constant
+            # component into r, and on the singular torus operator the
+            # V-cycle AMPLIFIES the nullspace without bound — unprojected,
+            # PCG stalls at ~1e-4 relres on 256^2 (measured)
+            z = v_cycle(
+                jnp.zeros_like(r), project(r), specs, 0, nu,
+                coarse_sweeps, omega, smoother,
+            )
+            return project(z)
+
+        x, k, relres = cg(
+            lambda p: periodic_laplacian(p, specs[0]),
+            f, axes, tol=tol, max_iters=max_iters, precond=precond,
+        )
+        x = x - lax.psum(jnp.sum(x), axes) / cells
+        return x[None, None], k, relres
+
+    return run_spmd(
+        mesh,
+        local,
+        P(*mesh.axis_names, None, None),
+        (P(*mesh.axis_names, None, None), P(), P()),
+    )
+
+
+def mg_poisson_solve(
+    b_world: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_cycles: int = 50,
+    nu: int = 2,
+    coarse_sweeps: int = 32,
+    omega: float = 0.8,
+    smoother: str = "rbgs",
+):
+    """Solve ``A x = b - mean(b)`` (periodic 5-point Laplacian) by
+    V-cycles, distributed over a 2D mesh.
+
+    Same contract as ``solvers.spectral.periodic_poisson_fft`` plus the
+    iteration report: returns ``(x_world, cycles, relres)`` with
+    zero-mean ``x``. ``omega`` applies to the Jacobi smoother/fallback
+    only; the default rbgs smoother has no damping knob.
+    """
+    from tpuscratch.halo.driver import assemble, decompose
+
+    mesh, topo, layout, specs, axes, cells = _mg_prologue(b_world, mesh, levels)
+    program = _mg_program(
+        mesh, tuple(specs), axes, cells, float(tol), int(max_cycles),
+        int(nu), int(coarse_sweeps), float(omega), smoother,
     )
     flat = TileLayout(layout.core_h, layout.core_w, 0, 0)
     u_tiles, k, relres = program(jnp.asarray(decompose(b_world, topo, flat)))
@@ -335,40 +385,11 @@ def pcg_poisson_solve(
     ``mg_poisson_solve``: returns ``(x_world, iters, relres)``.
     """
     from tpuscratch.halo.driver import assemble, decompose
-    from tpuscratch.solvers.cg import cg
 
     mesh, topo, layout, specs, axes, cells = _mg_prologue(b_world, mesh, levels)
-
-    def local(b_tile):
-        b = b_tile[0, 0]
-        f = b - lax.psum(jnp.sum(b), axes) / cells
-
-        def project(v):
-            return v - lax.psum(jnp.sum(v), axes) / cells
-
-        def precond(r):
-            # projected V-cycle (P M P): f32 rounding leaks a constant
-            # component into r, and on the singular torus operator the
-            # V-cycle AMPLIFIES the nullspace without bound — unprojected,
-            # PCG stalls at ~1e-4 relres on 256^2 (measured)
-            z = v_cycle(
-                jnp.zeros_like(r), project(r), specs, 0, nu,
-                coarse_sweeps, omega, smoother,
-            )
-            return project(z)
-
-        x, k, relres = cg(
-            lambda p: periodic_laplacian(p, specs[0]),
-            f, axes, tol=tol, max_iters=max_iters, precond=precond,
-        )
-        x = x - lax.psum(jnp.sum(x), axes) / cells
-        return x[None, None], k, relres
-
-    program = run_spmd(
-        mesh,
-        local,
-        P(*mesh.axis_names, None, None),
-        (P(*mesh.axis_names, None, None), P(), P()),
+    program = _pcg_program(
+        mesh, tuple(specs), axes, cells, float(tol), int(max_iters),
+        int(nu), int(coarse_sweeps), float(omega), smoother,
     )
     flat = TileLayout(layout.core_h, layout.core_w, 0, 0)
     x_tiles, k, relres = program(jnp.asarray(decompose(b_world, topo, flat)))

@@ -25,6 +25,7 @@ Measured (tests assert the bounds): cycle count flat in grid size,
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax.numpy as jnp
@@ -236,23 +237,10 @@ def _mg_prologue3(b_world: np.ndarray, mesh: Optional[Mesh], levels: Optional[in
     return mesh, dims, specs, tuple(mesh.axis_names), cells
 
 
-def mg_poisson3d_solve(
-    b_world: np.ndarray,
-    mesh: Optional[Mesh] = None,
-    *,
-    levels: Optional[int] = None,
-    tol: float = 1e-5,
-    max_cycles: int = 50,
-    nu: int = 2,
-    coarse_sweeps: int = 32,
-    omega: float = 6 / 7,
-    smoother: str = "rbgs",
-):
-    """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
-    V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
-    with zero-mean ``x`` (same contract as the 2D solver)."""
-    mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
-
+@functools.lru_cache(maxsize=16)
+def _mg3_program(mesh, specs, axes, cells, tol, max_cycles, nu,
+                 coarse_sweeps, omega, smoother):
+    """Compiled-per-config 3D V-cycle solver program."""
     def local(b_tile):
         b = b_tile[0, 0, 0]
         f = b - lax.psum(jnp.sum(b), axes) / cells
@@ -282,11 +270,33 @@ def mg_poisson3d_solve(
         tiny = jnp.asarray(np.finfo(np.dtype(f.dtype)).tiny, f.dtype)
         return u[None, None, None], k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
 
-    program = run_spmd(
+    return run_spmd(
         mesh,
         local,
         P(*mesh.axis_names, None, None, None),
         (P(*mesh.axis_names, None, None, None), P(), P()),
+    )
+
+
+def mg_poisson3d_solve(
+    b_world: np.ndarray,
+    mesh: Optional[Mesh] = None,
+    *,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_cycles: int = 50,
+    nu: int = 2,
+    coarse_sweeps: int = 32,
+    omega: float = 6 / 7,
+    smoother: str = "rbgs",
+):
+    """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
+    V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
+    with zero-mean ``x`` (same contract as the 2D solver)."""
+    mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
+    program = _mg3_program(
+        mesh, tuple(specs), axes, cells, float(tol), int(max_cycles),
+        int(nu), int(coarse_sweeps), float(omega), smoother,
     )
     x_tiles, k, relres = program(
         jnp.asarray(decompose3d_cores(b_world, dims))
@@ -310,9 +320,22 @@ def pcg_poisson3d_solve(
     the 2D ``pcg_poisson_solve`` one dimension up, same contract:
     ``(x_world, iters, relres)``, nullspace-projected symmetric V-cycle
     preconditioner, true-residual stopping."""
-    from tpuscratch.solvers.cg import cg
-
     mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
+    program = _pcg3_program(
+        mesh, tuple(specs), axes, cells, float(tol), int(max_iters),
+        int(nu), int(coarse_sweeps), float(omega), smoother,
+    )
+    x_tiles, k, relres = program(
+        jnp.asarray(decompose3d_cores(b_world, dims))
+    )
+    return assemble3d_cores(np.asarray(x_tiles)), int(k), float(relres)
+
+
+@functools.lru_cache(maxsize=16)
+def _pcg3_program(mesh, specs, axes, cells, tol, max_iters, nu,
+                  coarse_sweeps, omega, smoother):
+    """Compiled-per-config 3D MG-preconditioned CG program."""
+    from tpuscratch.solvers.cg import cg
 
     def local(b_tile):
         b = b_tile[0, 0, 0]
@@ -335,13 +358,9 @@ def pcg_poisson3d_solve(
         x = project(x)
         return x[None, None, None], k, relres
 
-    program = run_spmd(
+    return run_spmd(
         mesh,
         local,
         P(*mesh.axis_names, None, None, None),
         (P(*mesh.axis_names, None, None, None), P(), P()),
     )
-    x_tiles, k, relres = program(
-        jnp.asarray(decompose3d_cores(b_world, dims))
-    )
-    return assemble3d_cores(np.asarray(x_tiles)), int(k), float(relres)
