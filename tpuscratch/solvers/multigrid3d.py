@@ -19,8 +19,9 @@ decisions as 2D, same reasons:
 - one trace: unrolled level recursion, while_loop cycle iteration,
   psum'd residuals, zero host round trips.
 
-Measured (tests assert the bounds): cycle count flat in grid size,
-~8-10 cycles to 1e-6 at 32^3-64^3 — the same O(1) behavior as 2D.
+Measured (tests assert the bounds): cycle count flat in grid size —
+7-8 cycles to 1e-6 from 16^3 to 128^3 (chip-verified) — the same O(1)
+behavior as 2D; MG-PCG (``pcg_poisson3d_solve``) needs 5-6 iterations.
 """
 
 from __future__ import annotations
