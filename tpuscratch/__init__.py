@@ -9,12 +9,22 @@ scratchpad ``ugovaretto-accel/cuda-mpi-scratch`` (surveyed in ``SURVEY.md``):
   (replaces the raw ``MPI_*`` call surface: psum/ppermute/all_gather/...).
 - **dtypes**   — structured slice specs, the functional equivalent of MPI
   derived datatypes (indexed / struct / subarray / hindexed).
-- **halo**     — the flagship: a generic 2D domain-decomposition library with
-  8-neighbor periodic ghost-cell exchange (replaces ``stencil2D.h``).
-- **ops**      — Pallas TPU kernels: reductions, stencil compute, fills
+- **halo**     — the flagship: generic 2D AND 3D domain decomposition with
+  ghost-cell exchange (8-neighbor 2D, 6/26-neighbor 3D; replaces
+  ``stencil2D.h`` and extends it a dimension).
+- **ops**      — Pallas TPU kernels: reductions, stencil compute (2D + 3D
+  banded/strip variants), flash attention, remote-DMA halo, fills
   (replaces the CUDA ``__global__`` kernels).
+- **parallel** — the parallelism strategies: ring + Ulysses attention,
+  GPipe pipeline, expert (MoE) all_to_all, sequence-parallel SSM scan,
+  distributed 2D FFT.
+- **solvers**  — the algorithm layer: CG, spectral, 2D/3D multigrid and
+  MG-preconditioned CG over the halo/collective machinery.
+- **models**   — composed demonstrations: the MoE transformer training
+  step, the selective-SSM block, the checkpointed trainer.
 - **bench**    — timing harnesses: pingpong latency/BW, distributed dot,
-  stencil throughput (replaces ``test-benchmark/``).
+  stencil throughput (2D + 3D), collective busBW, matmul-DFT TFLOP/s
+  (replaces ``test-benchmark/``).
 
 Everything is runnable on a single host via a CPU device mesh
 (``XLA_FLAGS=--xla_force_host_platform_device_count=N``), mirroring how the
