@@ -115,3 +115,24 @@ def test_pair_pencil_round_trip(devices):
 
     prog = run_spmd(mesh, round_trip, P("x"), P("x"))
     assert np.allclose(np.asarray(prog(jnp.asarray(x))), x, atol=1e-4)
+
+
+class TestComplexOverrideParsing:
+    """TPUSCRATCH_COMPLEX must treat every plausible spelling of 'no' as
+    falsy — a truthy-by-accident 'False' would enable the complex path on
+    a backend that wedges on it (ADVICE r2)."""
+
+    def test_falsy_spellings(self, monkeypatch):
+        from tpuscratch.parallel.fft import complex_supported
+
+        for v in ("0", "false", "False", "FALSE", "no", "No", "off",
+                  "OFF", "", "  false  "):
+            monkeypatch.setenv("TPUSCRATCH_COMPLEX", v)
+            assert complex_supported() is False, v
+
+    def test_truthy_spellings(self, monkeypatch):
+        from tpuscratch.parallel.fft import complex_supported
+
+        for v in ("1", "true", "True", "yes", "on"):
+            monkeypatch.setenv("TPUSCRATCH_COMPLEX", v)
+            assert complex_supported() is True, v

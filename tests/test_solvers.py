@@ -345,3 +345,45 @@ class TestMultigrid3D:
         assert iters < cycles
         resid = np.abs(self._lap3(x.astype(np.float64)) - b).max()
         assert resid < 1e-4
+
+
+class TestUnconvergedWarning:
+    """An unconverged return must not look like success (ADVICE r2)."""
+
+    def test_mg_warns_when_cycle_cap_hit(self, devices):
+        import warnings
+
+        from tpuscratch.runtime.mesh import make_mesh_2d
+        from tpuscratch.solvers.multigrid import mg_poisson_solve
+
+        b = np.random.default_rng(7).standard_normal((64, 64)).astype(
+            np.float32
+        )
+        b -= b.mean()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _, _, relres = mg_poisson_solve(
+                b, make_mesh_2d((1, 1)), tol=1e-12, max_cycles=1
+            )
+        assert relres > 1e-12
+        assert any(
+            issubclass(x.category, RuntimeWarning)
+            and "did not reach tol" in str(x.message)
+            for x in w
+        )
+
+    def test_mg_silent_when_converged(self, devices):
+        import warnings
+
+        from tpuscratch.runtime.mesh import make_mesh_2d
+        from tpuscratch.solvers.multigrid import mg_poisson_solve
+
+        b = np.random.default_rng(7).standard_normal((64, 64)).astype(
+            np.float32
+        )
+        b -= b.mean()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            _, _, relres = mg_poisson_solve(b, make_mesh_2d((1, 1)), tol=1e-5)
+        assert relres <= 1e-5
+        assert not [x for x in w if issubclass(x.category, RuntimeWarning)]

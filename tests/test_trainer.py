@@ -63,3 +63,23 @@ def test_mismatched_resume_rejected(devices, tmp_path):
     train(mesh, cfg, steps=5, ckpt_dir=d, save_every=5, lr=0.05, seed=0)
     with pytest.raises(ValueError, match="resume mismatch"):
         train(mesh, cfg, steps=10, ckpt_dir=d, save_every=5, lr=0.1, seed=0)
+
+
+def test_mismatched_shape_or_config_resume_rejected(devices, tmp_path):
+    """batch/seq/architecture changes divert the data stream or the
+    model itself — the bit-identical contract requires rejecting them
+    just like lr/seed (ADVICE r2)."""
+    import pytest
+
+    mesh, cfg = _mesh(), _cfg()
+    d = str(tmp_path / "mm2")
+    train(mesh, cfg, steps=5, ckpt_dir=d, save_every=5, batch=4, seq=16)
+    with pytest.raises(ValueError, match="resume mismatch"):
+        train(mesh, cfg, steps=10, ckpt_dir=d, save_every=5, batch=8, seq=16)
+    with pytest.raises(ValueError, match="resume mismatch"):
+        train(mesh, cfg, steps=10, ckpt_dir=d, save_every=5, batch=4, seq=32)
+    cfg2 = TransformerConfig(
+        d_model=16, n_heads=4, n_experts=2, d_ff=32, capacity_factor=2.0
+    )
+    with pytest.raises(ValueError, match="resume mismatch"):
+        train(mesh, cfg2, steps=10, ckpt_dir=d, save_every=5, batch=4, seq=16)

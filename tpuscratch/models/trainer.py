@@ -56,6 +56,15 @@ def synthetic_batch(seed: int, step: int, batch: int, seq: int, d_model: int):
     return jnp.asarray(x), jnp.asarray(y)
 
 
+def _cfg_fingerprint(cfg: TransformerConfig) -> str:
+    """JSON-stable identity of the model config, stored in checkpoint
+    metadata so a resume with a different architecture fails loudly
+    instead of silently training a different model from restored
+    weights."""
+    fields = dataclasses.asdict(cfg)
+    return ",".join(f"{k}={fields[k]}" for k in sorted(fields))
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainReport:
     steps_run: int       # executed in THIS invocation (resume skips the rest)
@@ -89,20 +98,41 @@ def train(
     params = init_params(seed, cfg)
     start = 0
     if checkpoint.latest_step(ckpt_dir) is not None:
-        params, start, meta = checkpoint.restore(ckpt_dir, params)
+        # the bit-identical contract only holds if the resumed run replays
+        # the same trajectory: fail loudly on a mismatched re-invocation —
+        # batch/seq/cfg change the data stream and the compiled step just
+        # as much as lr/seed do. Metadata is checked BEFORE any leaf load
+        # so an architecture change surfaces as this error, not as a
+        # leaf-count mismatch from restore.
+        start, meta = checkpoint.peek_metadata(ckpt_dir)
         if start > steps:
             raise ValueError(
                 f"checkpoint in {ckpt_dir} is at step {start}, beyond the "
                 f"requested {steps} (use a fresh ckpt_dir)"
             )
-        # the bit-identical contract only holds if the resumed run replays
-        # the same trajectory: fail loudly on a mismatched re-invocation
-        for key, val in (("lr", lr), ("seed", seed)):
-            if key in meta and meta[key] != val:
+        for key, val in (
+            ("lr", lr), ("seed", seed), ("batch", batch), ("seq", seq),
+            ("cfg", _cfg_fingerprint(cfg)),
+        ):
+            if key not in meta:
+                # legacy checkpoint (pre-dates this key): resumable, but
+                # the guard cannot vouch for this field — say so rather
+                # than silently skipping the very check we promise
+                import warnings
+
+                warnings.warn(
+                    f"resuming from a checkpoint without {key!r} in its "
+                    f"metadata — cannot verify it matches this run's "
+                    f"{key}={val}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            elif meta[key] != val:
                 raise ValueError(
                     f"resume mismatch: checkpoint has {key}={meta[key]}, "
                     f"this run asked for {val} (use a fresh ckpt_dir)"
                 )
+        params, start, meta = checkpoint.restore(ckpt_dir, params, step=start)
         log(f"resumed at step {start} (meta {meta})")
 
     step_fn = train_step(mesh, cfg, lr=lr)
@@ -120,7 +150,10 @@ def train(
         losses.append(loss_f)
         checkpoint.save(
             ckpt_dir, start, jax.tree.map(np.asarray, params),
-            metadata={"steps_total": steps, "lr": lr, "seed": seed},
+            metadata={
+                "steps_total": steps, "lr": lr, "seed": seed,
+                "batch": batch, "seq": seq, "cfg": _cfg_fingerprint(cfg),
+            },
         )
         checkpoint.prune(ckpt_dir, keep)
         log(f"step {start}/{steps}: loss {loss_f:.5f}")

@@ -191,7 +191,9 @@ def complex_supported() -> bool:
 
     override = os.environ.get("TPUSCRATCH_COMPLEX")
     if override is not None:
-        return override not in ("0", "false", "")
+        # case/spelling-tolerant: "False", "NO", "off" must all disable —
+        # a truthy-by-accident override would wedge the axon client
+        return override.strip().lower() not in ("0", "false", "no", "off", "")
     return _platform_has_complex()
 
 

@@ -83,12 +83,8 @@ def restore(ckpt_dir: str | os.PathLike, example_tree: Any, step: Optional[int] 
     Defaults to the latest step. Leaf count is validated against the
     example so a structure drift fails loudly instead of mis-zipping.
     """
-    if step is None:
-        step = latest_step(ckpt_dir)
-        if step is None:
-            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    step, manifest = _read_manifest(ckpt_dir, step)
     path = pathlib.Path(ckpt_dir) / f"step_{step:09d}"
-    manifest = json.loads((path / _MANIFEST).read_text())
     leaves, treedef = jax.tree.flatten(example_tree)
     if manifest["n_leaves"] != len(leaves):
         raise ValueError(
@@ -99,6 +95,23 @@ def restore(ckpt_dir: str | os.PathLike, example_tree: Any, step: Optional[int] 
         np.load(path / f"leaf_{i}.npy") for i in range(manifest["n_leaves"])
     ]
     return jax.tree.unflatten(treedef, loaded), step, manifest["metadata"]
+
+
+def _read_manifest(ckpt_dir: str | os.PathLike, step: Optional[int]) -> tuple[int, dict]:
+    """Resolve ``step`` (default: latest) and load its manifest."""
+    if step is None:
+        step = latest_step(ckpt_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
+    path = pathlib.Path(ckpt_dir) / f"step_{step:09d}"
+    return step, json.loads((path / _MANIFEST).read_text())
+
+
+def peek_metadata(ckpt_dir: str | os.PathLike, step: Optional[int] = None) -> tuple[int, dict]:
+    """(step, metadata) without loading any leaf arrays — the cheap
+    pre-restore compatibility check (manifest.json only)."""
+    step, manifest = _read_manifest(ckpt_dir, step)
+    return step, manifest["metadata"]
 
 
 def prune(ckpt_dir: str | os.PathLike, keep: int = 3) -> None:

@@ -327,6 +327,28 @@ def _pcg_program(mesh, specs, axes, cells, tol, max_iters, nu,
     )
 
 
+def warn_unconverged(name: str, relres: float, tol: float) -> None:
+    """Surface an unconverged return loudly: the stagnation guard exits
+    the cycle loop at the f32 residual floor, which can leave
+    ``relres > tol`` looking exactly like a normal return. Callers who
+    need a guarantee must check ``relres``; this warning is the safety
+    net for callers who forget. The 4x slack skips the healthy
+    stopped-a-shade-above-the-floor case (~1.6e-6 at tol 1e-6 with rbgs,
+    measured — warning there would make every near-floor solve noisy).
+    Written as ``not (<=)`` so a NaN residual — divergence, the worst
+    case — also warns."""
+    if not (relres <= 4 * tol):
+        import warnings
+
+        warnings.warn(
+            f"{name}: did not reach tol={tol:g} (relres={relres:.3e}) — "
+            "stagnated at the dtype residual floor or hit the cycle cap; "
+            "check the returned relres",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+
 def mg_poisson_solve(
     b_world: np.ndarray,
     mesh: Optional[Mesh] = None,
@@ -346,6 +368,11 @@ def mg_poisson_solve(
     iteration report: returns ``(x_world, cycles, relres)`` with
     zero-mean ``x``. ``omega`` applies to the Jacobi smoother/fallback
     only; the default rbgs smoother has no damping knob.
+
+    ``relres`` is the convergence verdict: the stagnation guard may stop
+    before ``tol`` when cycles hit the dtype residual floor, so check
+    ``relres <= tol`` when the tolerance matters (a ``RuntimeWarning``
+    also fires when the return misses tol by more than 4x).
     """
     from tpuscratch.halo.driver import assemble, decompose
 
@@ -356,6 +383,7 @@ def mg_poisson_solve(
     )
     flat = TileLayout(layout.core_h, layout.core_w, 0, 0)
     u_tiles, k, relres = program(jnp.asarray(decompose(b_world, topo, flat)))
+    warn_unconverged("mg_poisson_solve", float(relres), tol)
     return assemble(np.asarray(u_tiles), topo, flat), int(k), float(relres)
 
 
@@ -393,4 +421,5 @@ def pcg_poisson_solve(
     )
     flat = TileLayout(layout.core_h, layout.core_w, 0, 0)
     x_tiles, k, relres = program(jnp.asarray(decompose(b_world, topo, flat)))
+    warn_unconverged("pcg_poisson_solve", float(relres), tol)
     return assemble(np.asarray(x_tiles), topo, flat), int(k), float(relres)

@@ -293,7 +293,10 @@ def mg_poisson3d_solve(
 ):
     """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
     V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
-    with zero-mean ``x`` (same contract as the 2D solver)."""
+    with zero-mean ``x`` (same contract as the 2D solver, including the
+    check-``relres`` convergence caveat on ``mg_poisson_solve``)."""
+    from tpuscratch.solvers.multigrid import warn_unconverged
+
     mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
     program = _mg3_program(
         mesh, tuple(specs), axes, cells, float(tol), int(max_cycles),
@@ -302,6 +305,7 @@ def mg_poisson3d_solve(
     x_tiles, k, relres = program(
         jnp.asarray(decompose3d_cores(b_world, dims))
     )
+    warn_unconverged("mg_poisson3d_solve", float(relres), tol)
     return assemble3d_cores(np.asarray(x_tiles)), int(k), float(relres)
 
 
@@ -321,6 +325,8 @@ def pcg_poisson3d_solve(
     the 2D ``pcg_poisson_solve`` one dimension up, same contract:
     ``(x_world, iters, relres)``, nullspace-projected symmetric V-cycle
     preconditioner, true-residual stopping."""
+    from tpuscratch.solvers.multigrid import warn_unconverged
+
     mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
     program = _pcg3_program(
         mesh, tuple(specs), axes, cells, float(tol), int(max_iters),
@@ -329,6 +335,7 @@ def pcg_poisson3d_solve(
     x_tiles, k, relres = program(
         jnp.asarray(decompose3d_cores(b_world, dims))
     )
+    warn_unconverged("pcg_poisson3d_solve", float(relres), tol)
     return assemble3d_cores(np.asarray(x_tiles)), int(k), float(relres)
 
 
