@@ -136,3 +136,75 @@ class TestComplexOverrideParsing:
         for v in ("1", "true", "True", "yes", "on"):
             monkeypatch.setenv("TPUSCRATCH_COMPLEX", v)
             assert complex_supported() is True, v
+
+
+class TestFourStep:
+    """Four-step (N = N1*N2 Cooley-Tukey) matmul FFT: must equal the
+    dense DFT / numpy to f32 accuracy at a fraction of the MACs."""
+
+    @pytest.mark.parametrize("inverse", [False, True])
+    def test_sharded_four_step_matches_numpy(self, devices, inverse):
+        from tpuscratch.parallel.fft import fft2_sharded_pair
+
+        n = 8
+        mesh = make_mesh_1d("x", n)
+        x = _grid(32, 64, seed=6, complex_=True)
+        prog = run_spmd(
+            mesh,
+            lambda r, i: fft2_sharded_pair(
+                r, i, "x", inverse=inverse, method="four-step"
+            ),
+            (P("x"), P("x")),
+            (P("x"), P("x")),
+        )
+        re, im = prog(jnp.asarray(x.real), jnp.asarray(x.imag))
+        got = np.asarray(re) + 1j * np.asarray(im)
+        expect = np.fft.ifft2(x) if inverse else np.fft.fft2(x)
+        scale = max(np.abs(expect).max(), 1e-6)
+        assert np.allclose(got, expect, atol=1e-4 * scale)
+
+    def test_auto_threshold_dispatch(self):
+        from tpuscratch.parallel import fft as F
+
+        # below FOUR_STEP_MIN auto stays direct; at/above it goes
+        # four-step when the length is composite
+        assert F._split(F.FOUR_STEP_MIN) is not None  # threshold composite
+        assert F.resolve_method(F.FOUR_STEP_MIN, "auto") == "four-step"
+        assert F.resolve_method(F.FOUR_STEP_MIN // 2, "auto") == "direct"
+        # ...and both routes compute the same transform at a length
+        # where they genuinely differ
+        rng = np.random.default_rng(7)
+        xr = jnp.asarray(rng.standard_normal((4, 64)).astype(np.float32))
+        xi = jnp.zeros_like(xr)
+        a = F._pair_axis(xr, xi, 1, False, "four-step")
+        d = F._pair_axis(xr, xi, 1, False, "direct")
+        np.testing.assert_allclose(
+            np.asarray(a[0]), np.asarray(d[0]), rtol=1e-5, atol=1e-4
+        )
+
+    def test_explicit_four_step_on_prime_raises(self):
+        from tpuscratch.parallel import fft as F
+
+        with pytest.raises(ValueError, match="composite"):
+            F.resolve_method(13, "four-step")
+        with pytest.raises(ValueError, match="unknown"):
+            F.resolve_method(64, "stockham")
+
+    def test_split_balanced_and_prime(self):
+        from tpuscratch.parallel.fft import _split
+
+        assert _split(1024) == (32, 32)
+        assert _split(8192) == (64, 128)
+        assert _split(96) == (8, 12)
+        assert _split(13) is None
+
+    def test_four_step_rejects_prime_via_auto_fallback(self):
+        from tpuscratch.parallel import fft as F
+
+        rng = np.random.default_rng(8)
+        xr = jnp.asarray(rng.standard_normal((4, 13)).astype(np.float32))
+        xi = jnp.zeros_like(xr)
+        # auto on a prime length must fall back to direct, not crash
+        yr, yi = F._pair_axis(xr, xi, 1, False, "auto")
+        want = np.fft.fft(np.asarray(xr), axis=1)
+        np.testing.assert_allclose(np.asarray(yr), want.real, atol=1e-4)

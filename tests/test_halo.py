@@ -680,6 +680,91 @@ class TestDmaImpl:
             run_stencil_dma(jnp.zeros(lay.padded_shape), spec, 2)
 
 
+class TestDmaDeepImpl:
+    """The generalized remote-DMA kernel: corner strips (9-point) and
+    in-kernel depth-k folding must reproduce the plain exchange-compute
+    trajectory bit-for-bit on every mesh shape, including self-wrap axes.
+
+    Step/depth combos cover uneven fold tails (7 = 3+3+1), the
+    steady-state pairs loop (12 rounds at depth 1), and odd depths
+    (buffer parity alternates per round)."""
+
+    C9 = (0.125, 0.125, 0.125, 0.125, 0.0625, 0.0625, 0.0625, 0.0625, 0.0)
+    C5 = (0.25, 0.25, 0.25, 0.25, 0.0)
+
+    @pytest.mark.parametrize("dims", [(2, 4), (1, 4), (1, 1)])
+    @pytest.mark.parametrize("coeffs,depth,steps", [
+        ("C9", 1, 3),    # corners ride the DMA, one substep per round
+        ("C9", 1, 12),   # ...through the pairs loop
+        ("C5", 2, 5),    # deep fold, uneven tail (2+2+1)
+        ("C5", 3, 7),    # odd depth: buffer parity alternates per round
+        ("C9", 2, 4),    # corners + fold together
+    ])
+    def test_matches_plain_core(self, dims, coeffs, depth, steps):
+        from tpuscratch.halo.driver import decompose
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        c = getattr(self, coeffs)
+        R, C = dims
+        TH, TW = 4, 5
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), (True, True))
+        lay = TileLayout(TH, TW, 1, 1)
+        spec = HaloSpec(layout=lay, topology=topo, neighbors=8)
+        rng = np.random.default_rng(64)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+        tiles = jnp.asarray(decompose(world, topo, lay))
+
+        outs = {}
+        for name, fn in (
+            ("xla", lambda t: run_stencil(t, spec, steps, c)),
+            ("dma", lambda t: run_stencil_dma(t, spec, steps, c, depth)),
+        ):
+            f = run_spmd(
+                mesh,
+                lambda x, fn=fn: fn(x[0, 0])[None, None],
+                P("row", "col", None, None),
+                P("row", "col", None, None),
+            )
+            outs[name] = np.asarray(f(tiles))[:, :, 1:-1, 1:-1]
+        np.testing.assert_allclose(outs["dma"], outs["xla"], rtol=1e-5, atol=1e-6)
+
+    def test_driver_dispatch_deep_and_nine_point(self):
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(65)
+        world = rng.standard_normal((8, 16)).astype(np.float32)
+        mesh = make_mesh_2d((2, 4))
+        deep = distributed_stencil(world, steps=5, mesh=mesh, impl="dma-deep:2")
+        plain = distributed_stencil(world, steps=5, mesh=mesh, impl="xla")
+        np.testing.assert_allclose(deep, plain, rtol=1e-5, atol=1e-6)
+        nine = distributed_stencil(
+            world, steps=3, mesh=mesh, impl="dma", coeffs=self.C9
+        )
+        nine_ref = distributed_stencil(
+            world, steps=3, mesh=mesh, impl="xla", coeffs=self.C9
+        )
+        np.testing.assert_allclose(nine, nine_ref, rtol=1e-5, atol=1e-6)
+
+    def test_rejects_nine_point_without_corner_spec(self):
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        lay = TileLayout(4, 4, 1, 1)
+        topo = CartTopology((2, 4), (True, True))
+        spec = HaloSpec(layout=lay, topology=topo, neighbors=4)
+        with pytest.raises(ValueError, match="neighbors=8"):
+            run_stencil_dma(jnp.zeros(lay.padded_shape), spec, 2, self.C9)
+
+    def test_rejects_depth_beyond_core(self):
+        from tpuscratch.ops.halo_dma import run_stencil_dma
+
+        lay = TileLayout(4, 4, 1, 1)
+        topo = CartTopology((1, 1), (True, True))
+        spec = HaloSpec(layout=lay, topology=topo)
+        with pytest.raises(ValueError, match="too small"):
+            run_stencil_dma(jnp.zeros(lay.padded_shape), spec, 8, depth=6)
+
+
 class TestPlanNativeParity:
     """HaloSpec.plan() must be byte-identical whichever planner built it —
     the native fast path is an accelerator, never a semantic fork."""
@@ -779,7 +864,7 @@ class TestNinePoint:
         from tpuscratch.runtime.mesh import make_mesh_2d
 
         c = (0.125,) * 4 + (0.0625,) * 4 + (0.0,)
-        with pytest.raises(ValueError, match="only supported by impl='xla'"):
+        with pytest.raises(ValueError, match="impl='xla' or a dma impl"):
             distributed_stencil(
                 np.zeros((8, 8), np.float32), steps=1,
                 mesh=make_mesh_2d((1, 1)), coeffs=c, impl="pallas",
@@ -805,8 +890,8 @@ class TestNinePoint:
         from tpuscratch.runtime.mesh import make_mesh_2d
 
         c = (0.125,) * 4 + (0.0625,) * 4 + (0.0,)
-        for impl in ("deep:2", "resident", "dma"):
-            with pytest.raises(ValueError, match="only supported by impl='xla'"):
+        for impl in ("deep:2", "resident"):
+            with pytest.raises(ValueError, match="impl='xla' or a dma impl"):
                 distributed_stencil(
                     np.zeros((8, 8), np.float32), steps=2,
                     mesh=make_mesh_2d((1, 1)), coeffs=c, impl=impl,

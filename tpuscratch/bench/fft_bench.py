@@ -29,14 +29,17 @@ from tpuscratch.comm import run_spmd
 from tpuscratch.parallel.fft import fft2_sharded_pair
 
 
-def dft_roundtrip_program(mesh: Mesh, axis: str, rounds: int):
-    """jit'd fn(re, im) running ``rounds`` fwd+inv pair-DFTs in one scan."""
+def dft_roundtrip_program(mesh: Mesh, axis: str, rounds: int,
+                          method: str = "direct"):
+    """jit'd fn(re, im) running ``rounds`` fwd+inv pair-FFTs in one scan."""
 
     def body(re, im):
         def step(carry, _):
             r, i = carry
-            fr, fi = fft2_sharded_pair(r, i, axis)
-            br, bi = fft2_sharded_pair(fr, fi, axis, inverse=True)
+            fr, fi = fft2_sharded_pair(r, i, axis, method=method)
+            br, bi = fft2_sharded_pair(
+                fr, fi, axis, inverse=True, method=method
+            )
             # loop-carried zero (mean of the difference from the input,
             # which IS zero up to rounding) the compiler can't fold away
             eps = jnp.mean(br - r) * 0.0
@@ -48,19 +51,37 @@ def dft_roundtrip_program(mesh: Mesh, axis: str, rounds: int):
     return run_spmd(mesh, body, (P(axis), P(axis)), (P(axis), P(axis)))
 
 
+def pair_fft_flops(n: int, method: str, rounds: int) -> int:
+    """FLOPs of ``rounds`` fwd+inv 2D pair transforms at the given
+    method's OWN cost: direct = 32 n^3 (4 real (n,n)@(n,n) matmuls per
+    axis per direction), four-step = 32 n^2 (n1+n2) for the two sub-DFT
+    einsum batches (twiddle's O(n^2) elementwise is noise). Cross-method
+    comparisons must use seconds per round, not these."""
+    from tpuscratch.parallel.fft import _split, resolve_method
+
+    if resolve_method(n, method) == "four-step":
+        n1, n2 = _split(n)
+        return 32 * n * n * (n1 + n2) * rounds
+    return 32 * n**3 * rounds
+
+
 def bench_dft(
     n: Optional[int] = None,
     rounds: Optional[int] = None,
     iters: int = 3,
     mesh: Optional[Mesh] = None,
     fence: str = "readback",
+    method: str = "direct",
 ) -> BenchResult:
-    """Matmul-DFT round-trip throughput on an n x n f32 pair.
+    """Pair-FFT round-trip throughput on an n x n f32 pair.
 
     Defaults size the scan so the chip work dwarfs the tunnel's fixed
     ~150-200 ms per-invocation cost: 1000 rounds at 1024^2 is 3.4e13
     FLOPs (~1.1 s marginal at the measured rate) vs a few-round smoke
-    size on CPU backends.
+    size on CPU backends. ``method`` selects the local transform
+    (direct dense DFT / four-step / auto); ``items`` is that method's
+    own FLOP count (see :func:`pair_fft_flops`), so compare methods by
+    ``p50``, not ``items_per_s``.
     """
     from tpuscratch.runtime.mesh import make_mesh_1d
 
@@ -72,17 +93,17 @@ def bench_dft(
     rng = np.random.default_rng(0)
     re = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
     im = jnp.asarray(rng.standard_normal((n, n)).astype(np.float32))
-    prog = dft_roundtrip_program(mesh, axis, rounds)
+    prog = dft_roundtrip_program(mesh, axis, rounds, method)
     # verify the round trip BEFORE timing (this run doubles as compile
     # warmup; time_device's own warmup then costs only execution)
     out = prog(re, im)
     err = float(jnp.max(jnp.abs(out[0] - re)))
     if err > 1e-2 * float(jnp.max(jnp.abs(re))):
         raise AssertionError(f"round trip drifted: err {err}")
-    flops = 32 * n**3 * rounds
+    flops = pair_fft_flops(n, method, rounds)
     return time_device(
         prog, re, im, iters=iters, warmup=1, fence=fence,
-        name=f"pair-DFT fwd+inv {n}x{n} x{rounds}", items=flops,
+        name=f"pair-FFT[{method}] fwd+inv {n}x{n} x{rounds}", items=flops,
     )
 
 

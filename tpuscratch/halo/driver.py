@@ -76,19 +76,22 @@ def make_stencil_program(
     communication-avoiding trapezoid scheme (depth = the layout halo
     width); ``impl='resident'`` the single-device VMEM-resident kernel;
     ``impl='dma'`` the double-buffered remote-DMA Pallas kernel
-    (ops.halo_dma — core VMEM-resident, halo strips by async DMA).
+    (ops.halo_dma — core VMEM-resident, halo strips by async DMA; takes
+    9-point coeffs too, corners riding the DMA); ``impl='dma-deep:k'``
+    the same kernel folding k substeps per exchange in-kernel.
     ``unroll`` is the scan unroll factor for the per-step impls and the
     kernel's inner unroll for 'resident' (defaults 1 and 8)."""
-    if len(coeffs) == 9 and impl != "xla":
+    if len(coeffs) == 9 and impl != "xla" and not impl.startswith("dma"):
         raise ValueError(
-            f"9-point coeffs are only supported by impl='xla', got {impl!r}"
+            f"9-point coeffs need impl='xla' or a dma impl, got {impl!r}"
         )
     if impl == "resident":
         step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=8 if unroll is None else unroll)[None, None]  # noqa: E731
-    elif impl == "dma":
+    elif impl == "dma" or impl.startswith("dma-deep:"):
         from tpuscratch.ops.halo_dma import run_stencil_dma
 
-        step_fn = lambda t: run_stencil_dma(t[0, 0], spec, steps, coeffs)[None, None]  # noqa: E731
+        depth = int(impl.split(":", 1)[1]) if ":" in impl else 1
+        step_fn = lambda t: run_stencil_dma(t[0, 0], spec, steps, coeffs, depth)[None, None]  # noqa: E731
     elif impl in ("deep", "deep-pallas"):
         sub = "pallas" if impl == "deep-pallas" else "xla"
         step_fn = lambda t: run_stencil_deep(t[0, 0], spec, steps, coeffs, impl=sub)[None, None]  # noqa: E731

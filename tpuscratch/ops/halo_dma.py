@@ -66,9 +66,45 @@ JACOBI: Coeffs = (0.25, 0.25, 0.25, 0.25, 0.0)
 
 #: Channel order: the halo side each channel fills at its receiver.
 TOP, BOTTOM, LEFT, RIGHT = range(4)
+#: Corner channels (the generalized kernel only): receiver's pad corner.
+NW, NE, SW, SE = range(4, 8)
 
 #: Distinct collective_id for the barrier semaphore of this kernel family.
 _COLLECTIVE_ID = 11
+#: ...and for the generalized (depth-k, corner-carrying) kernel.
+_COLLECTIVE_ID_DEEP = 12
+
+#: (dy, dx) per coefficient, in halo.stencil.nine_point coeff order
+#: (n, s, w, e, nw, ne, sw, se, center).
+_OFFS9 = (
+    (-1, 0), (1, 0), (0, -1), (0, 1),
+    (-1, -1), (-1, 1), (1, -1), (1, 1), (0, 0),
+)
+
+
+def as_nine(coeffs) -> tuple[float, ...]:
+    """Normalize 5-point (n,s,w,e,c) to 9-point coeff order with zero
+    diagonals; 9-tuples pass through."""
+    c = tuple(float(x) for x in coeffs)
+    if len(c) == 9:
+        return c
+    if len(c) == 5:
+        return c[:4] + (0.0, 0.0, 0.0, 0.0) + c[4:]
+    raise ValueError(f"coeffs must have 5 or 9 entries, got {len(c)}")
+
+
+def _patch(s, r0: int, r1: int, c0: int, c1: int, coeffs9):
+    """9-point update of padded-coordinate region [r0,r1)x[c0,c1), read
+    from the loaded padded array ``s``. Zero coefficients are skipped
+    statically, so a 5-point stencil pays no diagonal FLOPs."""
+    h, w = r1 - r0, c1 - c0
+    acc = None
+    for (dy, dx), cc in zip(_OFFS9, coeffs9):
+        if cc == 0.0:
+            continue
+        term = cc * s[r0 + dy : r0 + dy + h, c0 + dx : c0 + dx + w]
+        acc = term if acc is None else acc + term
+    return acc
 
 
 def _interior(src, coeffs: Coeffs):
@@ -278,19 +314,306 @@ def _make_kernel(dims: tuple[int, int], axes: tuple[str, str], steps: int, coeff
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "steps", "coeffs", "vmem_limit_bytes"))
+def _make_kernel_deep(dims: tuple[int, int], axes: tuple[str, str], steps: int,
+                      coeffs9: tuple[float, ...], k: int,
+                      H: int, W: int):
+    """The generalized remote-DMA halo kernel: ghost depth ``k`` (one
+    exchange buys ``k`` fused substeps — the in-kernel trapezoid) and
+    corner strips (8 channels), serving any 9-point-family stencil.
+
+    Each device holds TWO (H+2k, W+2k) ghost-padded buffers in VMEM and
+    ping-pongs substeps between them; per round it stages 4 edge strips
+    (k deep) + 4 corner blocks (k x k) and moves them by double-buffered
+    remote DMA under the first substep's interior compute, exactly like
+    the k=1 specialized kernel. The reference's exchange carries the same
+    8 transfers for any stencil width (ghost depth = stencil/2,
+    /root/reference/stencil2d/stencil2D.h:116-117, corner sends
+    stencil2D.h:389-428); here width is a fold-depth knob on top.
+    """
+    R, C = dims
+    ns_remote = R > 1
+    ew_remote = C > 1
+    dg_remote = R > 1 or C > 1
+    full, rem = divmod(steps, k)
+    rounds = full + (1 if rem else 0)
+    H2, W2 = H + 2 * k, W + 2 * k
+
+    def kernel(in_ref, o_ref, pa, pb,
+               r_top, r_bot, r_left, r_right, r_nw, r_ne, r_sw, r_se,
+               s_top, s_bot, s_left, s_right, s_nw, s_ne, s_sw, s_se,
+               send_sem, recv_sem, freed_sem):
+        row = lax.axis_index(axes[0])
+        col = lax.axis_index(axes[1])
+        north = lax.rem(row + R - 1, R)
+        south = lax.rem(row + 1, R)
+        west = lax.rem(col + C - 1, C)
+        east = lax.rem(col + 1, C)
+
+        def dev(r, c):
+            return r * C + c
+
+        # Channel d fills the RECEIVER's d-side pad region, so its
+        # destination is my opposite(d) neighbor (diagonals included:
+        # my SE corner block is my SE neighbor's NW ghost corner).
+        dests = {
+            TOP: dev(south, col), BOTTOM: dev(north, col),
+            LEFT: dev(row, east), RIGHT: dev(row, west),
+            NW: dev(south, east), NE: dev(south, west),
+            SW: dev(north, east), SE: dev(north, west),
+        }
+        senders = {
+            TOP: dev(north, col), BOTTOM: dev(south, col),
+            LEFT: dev(row, west), RIGHT: dev(row, east),
+            NW: dev(north, west), NE: dev(north, east),
+            SW: dev(south, west), SE: dev(south, east),
+        }
+        bufs = {TOP: r_top, BOTTOM: r_bot, LEFT: r_left, RIGHT: r_right,
+                NW: r_nw, NE: r_ne, SW: r_sw, SE: r_se}
+        stages = {TOP: s_top, BOTTOM: s_bot, LEFT: s_left, RIGHT: s_right,
+                  NW: s_nw, NE: s_ne, SW: s_sw, SE: s_se}
+        remote = {TOP: ns_remote, BOTTOM: ns_remote,
+                  LEFT: ew_remote, RIGHT: ew_remote,
+                  NW: dg_remote, NE: dg_remote, SW: dg_remote, SE: dg_remote}
+        channels = (TOP, BOTTOM, LEFT, RIGHT, NW, NE, SW, SE)
+        bufP = (pa, pb)
+
+        # Load the core; pads of bufP[0] are garbage until the first
+        # round's arrival fill, and nothing reads them before that.
+        pa[k : H + k, k : W + k] = in_ref[:]
+
+        def stage_all(src_ref):
+            # edge strips k deep (columns lane-major), corners k x k
+            s_top[:, 0:W] = src_ref[H : H + k, k : W + k]
+            s_bot[:, 0:W] = src_ref[k : 2 * k, k : W + k]
+            s_left[:, 0:H] = jnp.swapaxes(src_ref[k : H + k, W : W + k], 0, 1)
+            s_right[:, 0:H] = jnp.swapaxes(src_ref[k : H + k, k : 2 * k], 0, 1)
+            s_nw[:, 0:k] = src_ref[H : H + k, W : W + k]   # my SE corner
+            s_ne[:, 0:k] = src_ref[H : H + k, k : 2 * k]   # my SW corner
+            s_sw[:, 0:k] = src_ref[k : 2 * k, W : W + k]   # my NE corner
+            s_se[:, 0:k] = src_ref[k : 2 * k, k : 2 * k]   # my NW corner
+
+        def fill_pads(dst_ref, slot: int):
+            dst_ref[0:k, k : W + k] = r_top[slot][:, 0:W]
+            dst_ref[H + k : H2, k : W + k] = r_bot[slot][:, 0:W]
+            dst_ref[k : H + k, 0:k] = jnp.swapaxes(r_left[slot][:, 0:H], 0, 1)
+            dst_ref[k : H + k, W + k : W2] = jnp.swapaxes(
+                r_right[slot][:, 0:H], 0, 1
+            )
+            dst_ref[0:k, 0:k] = r_nw[slot][:, 0:k]
+            dst_ref[0:k, W + k : W2] = r_ne[slot][:, 0:k]
+            dst_ref[H + k : H2, 0:k] = r_sw[slot][:, 0:k]
+            dst_ref[H + k : H2, W + k : W2] = r_se[slot][:, 0:k]
+
+        if dg_remote:
+            barrier = pltpu.get_barrier_semaphore()
+            n_remote = 0
+            for ch in channels:
+                if remote[ch]:
+                    pltpu.semaphore_signal(
+                        barrier, inc=1, device_id=dests[ch],
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    )
+                    n_remote += 1
+            pltpu.semaphore_wait(barrier, n_remote)
+
+        def one_round(pidx: int, slot: int, wait_credit: bool,
+                      give_credit: bool, substeps: int):
+            src_ref = bufP[pidx]
+            dst_ref = bufP[1 - pidx]
+            stage_all(src_ref)
+            copies = []
+            for ch in channels:
+                if remote[ch]:
+                    if wait_credit:
+                        pltpu.semaphore_wait(freed_sem.at[ch], 1)
+                    dma = pltpu.make_async_remote_copy(
+                        src_ref=stages[ch].at[:],
+                        dst_ref=bufs[ch].at[slot],
+                        send_sem=send_sem.at[ch],
+                        recv_sem=recv_sem.at[ch, slot],
+                        device_id=dests[ch],
+                        device_id_type=pltpu.DeviceIdType.LOGICAL,
+                    )
+                else:
+                    dma = pltpu.make_async_copy(
+                        stages[ch].at[:], bufs[ch].at[slot],
+                        recv_sem.at[ch, slot],
+                    )
+                copies.append((ch, dma))
+                dma.start()
+
+            # substep 1, interior: reads core cells only — overlaps DMAs
+            s = src_ref[:]
+            dst_ref[k + 1 : H + k - 1, k + 1 : W + k - 1] = _patch(
+                s, k + 1, H + k - 1, k + 1, W + k - 1, coeffs9
+            )
+
+            for ch, dma in copies:
+                dma.wait_recv() if remote[ch] else dma.wait()
+
+            # substep 1, frame: the four bands that read the fresh pads
+            # (rows [1,k+1) and [H+k-1,H2-1) full-width, plus the side
+            # columns between them) — together with the interior they
+            # tile the substep-1 valid region [1,H2-1)x[1,W2-1)
+            fill_pads(src_ref, slot)
+            s = src_ref[:]
+            dst_ref[1 : k + 1, 1 : W2 - 1] = _patch(
+                s, 1, k + 1, 1, W2 - 1, coeffs9
+            )
+            dst_ref[H + k - 1 : H2 - 1, 1 : W2 - 1] = _patch(
+                s, H + k - 1, H2 - 1, 1, W2 - 1, coeffs9
+            )
+            dst_ref[k + 1 : H + k - 1, 1 : k + 1] = _patch(
+                s, k + 1, H + k - 1, 1, k + 1, coeffs9
+            )
+            dst_ref[k + 1 : H + k - 1, W + k - 1 : W2 - 1] = _patch(
+                s, k + 1, H + k - 1, W + k - 1, W2 - 1, coeffs9
+            )
+
+            for ch, dma in copies:
+                if remote[ch]:
+                    if give_credit:
+                        pltpu.semaphore_signal(
+                            freed_sem.at[ch], inc=1, device_id=senders[ch],
+                            device_id_type=pltpu.DeviceIdType.LOGICAL,
+                        )
+                    dma.wait_send()
+
+            # substeps 2..substeps: shrinking trapezoid, all-local
+            for j in range(2, substeps + 1):
+                sj = bufP[(pidx + j - 1) % 2][:]
+                bufP[(pidx + j) % 2][j : H2 - j, j : W2 - j] = _patch(
+                    sj, j, H2 - j, j, W2 - j, coeffs9
+                )
+
+        def plan(r: int):
+            """(pidx, slot, wait_credit, give_credit) for round r; the
+            buffer index advances k substeps per completed round."""
+            return (r * k) % 2, r % 2, r >= 2, r + 2 <= rounds - 1
+
+        def subs(r: int) -> int:
+            return rem if (rem and r == rounds - 1) else k
+
+        head = min(rounds, 4)
+        for r in range(head):
+            pidx, slot, w, g = plan(r)
+            one_round(pidx, slot, w, g, subs(r))
+
+        if rounds > head:
+            mid = max(0, rounds - 2 - head)  # never the last round
+            pairs, prem = divmod(mid, 2)
+            p4, p5 = plan(4), plan(5)
+
+            def pair(_, carry):
+                one_round(p4[0], p4[1], True, True, k)
+                one_round(p5[0], p5[1], True, True, k)
+                return carry
+
+            if pairs > 0:
+                lax.fori_loop(0, pairs, pair, 0)
+            r = head + 2 * pairs
+            if prem:
+                pidx, slot, _, _ = plan(r)
+                one_round(pidx, slot, True, True, k)
+                r += 1
+            while r < rounds:
+                pidx, slot, _, _ = plan(r)
+                one_round(pidx, slot, True, False, subs(r))
+                r += 1
+
+        # total substeps == steps, starting from buffer 0
+        o_ref[:] = bufP[steps % 2][k : H + k, k : W + k]
+
+    return kernel
+
+
+def _run_stencil_dma_deep(tile, spec, steps, coeffs9, depth, vmem_limit_bytes):
+    """Dispatch helper for the generalized kernel (see run_stencil_dma)."""
+    lay = spec.layout
+    H, W, k = lay.core_h, lay.core_w, depth
+    dt = tile.dtype
+    Hp = -(-H // 128) * 128
+    Wp = -(-W // 128) * 128
+    H2, W2 = H + 2 * k, W + 2 * k
+
+    need = (2 * H2 * W2 + 2 * H * W) * dt.itemsize
+    if need > vmem_limit_bytes:
+        raise ValueError(
+            f"padded core {H2}x{W2} x2 needs ~{need >> 20} MB VMEM "
+            f"(> limit {vmem_limit_bytes >> 20} MB)"
+        )
+
+    core = tile[lay.halo_y : lay.halo_y + H, lay.halo_x : lay.halo_x + W]
+    kernel = _make_kernel_deep(
+        spec.topology.dims, tuple(spec.axes), steps, coeffs9, k, H, W
+    )
+    interpret = pltpu.InterpretParams() if use_interpret() else False
+    R, C = spec.topology.dims
+    collective_kw = (
+        {"collective_id": _COLLECTIVE_ID_DEEP} if (R > 1 or C > 1) else {}
+    )
+    new_core = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((H, W), dt),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((H2, W2), dt),         # padded ping
+            pltpu.VMEM((H2, W2), dt),         # padded pong
+            pltpu.VMEM((2, k, Wp), dt),       # recv: top rows, 2 slots
+            pltpu.VMEM((2, k, Wp), dt),       # recv: bottom rows
+            pltpu.VMEM((2, k, Hp), dt),       # recv: left cols (lane-major)
+            pltpu.VMEM((2, k, Hp), dt),       # recv: right cols
+            pltpu.VMEM((2, k, 128), dt),      # recv: NW corner
+            pltpu.VMEM((2, k, 128), dt),      # recv: NE corner
+            pltpu.VMEM((2, k, 128), dt),      # recv: SW corner
+            pltpu.VMEM((2, k, 128), dt),      # recv: SE corner
+            pltpu.VMEM((k, Wp), dt),          # stage: bottom rows out
+            pltpu.VMEM((k, Wp), dt),          # stage: top rows out
+            pltpu.VMEM((k, Hp), dt),          # stage: right cols out
+            pltpu.VMEM((k, Hp), dt),          # stage: left cols out
+            pltpu.VMEM((k, 128), dt),         # stage: SE corner out
+            pltpu.VMEM((k, 128), dt),         # stage: SW corner out
+            pltpu.VMEM((k, 128), dt),         # stage: NE corner out
+            pltpu.VMEM((k, 128), dt),         # stage: NW corner out
+            pltpu.SemaphoreType.DMA((8,)),    # send completion / channel
+            pltpu.SemaphoreType.DMA((8, 2)),  # arrival / channel x slot
+            pltpu.SemaphoreType.REGULAR((8,)),  # credits / channel
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit_bytes,
+            has_side_effects=True,
+            **collective_kw,
+        ),
+    )(core)
+    return halo_exchange(rebuild(tile, new_core, lay), spec)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "steps", "coeffs", "depth", "vmem_limit_bytes"))
 def run_stencil_dma(
     tile: jax.Array,
     spec: HaloSpec,
     steps: int,
     coeffs: Coeffs = JACOBI,
+    depth: int = 1,
     vmem_limit_bytes: int = 100 << 20,
 ) -> jax.Array:
-    """``steps`` 5-point stencil iterations with the core VMEM-resident and
+    """``steps`` stencil iterations with the core VMEM-resident and
     every halo exchange done by double-buffered (remote) DMA inside ONE
     Pallas kernel. Call inside shard_map over ``spec.axes``, like
     ``run_stencil``; the trailing padded-tile halo is refreshed by one
     ordinary exchange so the result composes with the other impls.
+
+    ``coeffs`` may be 5-point (n,s,w,e,c) or 9-point (nine_point order —
+    corner blocks then ride the DMA alongside the edge strips, matching
+    the reference's diagonal sends, stencil2D.h:389-428). ``depth`` > 1
+    folds that many substeps per exchange INSIDE the kernel (the
+    trapezoid scheme of run_stencil_deep, but with the ghost traffic on
+    the DMA engine): one k-deep exchange, k fused substeps, k x fewer
+    messages. The 5-point/depth-1 case keeps the specialized
+    ring-decomposition kernel; anything else uses the generalized
+    8-channel ghost-padded kernel.
 
     This is the structural realization of the reference's
     Isend-all/compute/Waitall overlap (stencil2D.h:363-377) — the transfers
@@ -301,16 +624,27 @@ def run_stencil_dma(
     if tuple(tile.shape) != lay.padded_shape:
         raise ValueError(f"tile {tile.shape} != padded {lay.padded_shape}")
     if lay.halo_y < 1 or lay.halo_x < 1:
-        raise ValueError("5-point stencil needs halo >= 1 on both axes")
+        raise ValueError("stencil needs halo >= 1 on both axes")
     if not all(spec.topology.periodic):
         raise ValueError("DMA halo stencil requires a periodic topology")
-    if min(lay.core_h, lay.core_w) < 3:
-        raise ValueError(
-            f"core {lay.core_h}x{lay.core_w} too small for the ring/interior "
-            "split (need >= 3 on both axes)"
-        )
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
+    if len(coeffs) == 9 and spec.neighbors != 8:
+        raise ValueError(
+            "9-point coeffs need a neighbors=8 HaloSpec: the trailing "
+            "re-wrap must fill the corner ghosts the stencil reads"
+        )
+    if depth < 1:
+        raise ValueError(f"depth must be >= 1, got {depth}")
+    if min(lay.core_h, lay.core_w) < max(3, depth):
+        raise ValueError(
+            f"core {lay.core_h}x{lay.core_w} too small: need >= "
+            f"max(3, depth={depth}) on both axes"
+        )
+    if len(coeffs) == 9 or depth > 1:
+        return _run_stencil_dma_deep(
+            tile, spec, steps, as_nine(coeffs), depth, vmem_limit_bytes
+        )
 
     H, W = lay.core_h, lay.core_w
     Hp = -(-H // 128) * 128  # lane-padded strip lengths (DMA granularity)

@@ -135,6 +135,125 @@ def _dft_axis(re, im, axis: int, inverse: bool):
     return yr, yi
 
 
+# ---------------------------------------------------------------------------
+# Four-step (Cooley-Tukey N = N1*N2) matmul FFT on pair planes.
+#
+# The O(N^2) dense DFT above is MXU-roofline-bound but pays N MACs per
+# element; splitting N into N1*N2 pays N1+N2 per element — still every
+# FLOP a matmul (sub-DFT matrices of size N1 and N2, batched over the
+# other factor), plus one elementwise twiddle plane. The decimation:
+# x[j1*N2 + j2] -> B[k1,j2] = F_N1 @ x  (contract j1)
+#              -> C = B * W_N^(k1*j2)   (twiddle)
+#              -> X[k1 + N1*k2] = C @ F_N2 (contract j2), read out k2-major.
+# This is radix-sqrt(N) Cooley-Tukey — the classical "four-step" NUMA/
+# out-of-core FFT — which maps onto the MXU where a radix-2 Stockham's
+# butterflies would be VPU-bound gather/scatter. One split is enough for
+# the sizes a 2D grid axis reaches (N1,N2 <= 128 at N=16384).
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _split(n: int):
+    """(n1, n2) with n1*n2 == n, n1 the largest divisor <= sqrt(n)
+    (most balanced), or None when n is prime/too small to profit."""
+    best = None
+    for d in range(2, int(n**0.5) + 1):
+        if n % d == 0:
+            best = d
+    return (best, n // best) if best else None
+
+
+@functools.lru_cache(maxsize=None)
+def _twiddle_tables(n1: int, n2: int, n: int):
+    """(cos, sin) of W_n^(k1*j2), the four-step twiddle plane."""
+    ang = 2.0 * np.pi * np.outer(
+        np.arange(n1, dtype=np.float64), np.arange(n2, dtype=np.float64)
+    ) / n
+    return np.cos(ang).astype(np.float32), np.sin(ang).astype(np.float32)
+
+
+def _cplx_einsum(spec: str, c, s, xr, xi, inverse: bool):
+    """Complex einsum (C -+ iS) . (xr + i xi), the constant operand first
+    in ``spec``. Forward uses C - iS, inverse C + iS; scaling is the
+    caller's job."""
+    ee = functools.partial(jnp.einsum, precision=lax.Precision.HIGHEST)
+    sgn = -1.0 if inverse else 1.0
+    yr = ee(spec, c, xr) + sgn * ee(spec, s, xi)
+    yi = ee(spec, c, xi) - sgn * ee(spec, s, xr)
+    return yr, yi
+
+
+def _four_step_axis(re, im, axis: int, inverse: bool):
+    """Transform one axis of the (re, im) pair by the four-step matmul
+    FFT. Requires a composite axis length (see :func:`_split`)."""
+    n = re.shape[axis]
+    n1, n2 = _split(n)
+    c1, s1 = (jnp.asarray(t) for t in _dft_tables(n1))
+    c2, s2 = (jnp.asarray(t) for t in _dft_tables(n2))
+    tc, ts = (jnp.asarray(t) for t in _twiddle_tables(n1, n2, n))
+    sgn = -1.0 if inverse else 1.0
+
+    if axis == 1:
+        h = re.shape[0]
+        xr = re.reshape(h, n1, n2)
+        xi = im.reshape(h, n1, n2)
+        br, bi = _cplx_einsum("ab,hbw->haw", c1, s1, xr, xi, inverse)
+        # twiddle: (br + i bi) * (tc -+ i ts), broadcast over rows
+        cr = br * tc + sgn * bi * ts
+        ci = bi * tc - sgn * br * ts
+        yr, yi = _cplx_einsum("jm,haj->hma", c2, s2, cr, ci, inverse)
+        yr = yr.reshape(h, n)
+        yi = yi.reshape(h, n)
+    else:
+        w = re.shape[1]
+        xr = re.reshape(n1, n2, w)
+        xi = im.reshape(n1, n2, w)
+        br, bi = _cplx_einsum("ab,bcw->acw", c1, s1, xr, xi, inverse)
+        cr = br * tc[:, :, None] + sgn * bi * ts[:, :, None]
+        ci = bi * tc[:, :, None] - sgn * br * ts[:, :, None]
+        yr, yi = _cplx_einsum("jm,ajw->maw", c2, s2, cr, ci, inverse)
+        yr = yr.reshape(n, w)
+        yi = yi.reshape(n, w)
+    if inverse:
+        yr = yr / n
+        yi = yi / n
+    return yr, yi
+
+
+#: Axis lengths at or above this use the four-step path under
+#: method="auto" (chip-raced crossover, see BASELINE.md row 8).
+FOUR_STEP_MIN = 1024
+
+
+def resolve_method(n: int, method: str) -> str:
+    """The single source of the method-dispatch rule: 'auto' becomes
+    'four-step' for composite lengths at/above :data:`FOUR_STEP_MIN`,
+    else 'direct'; an explicit 'four-step' on a prime/too-small length is
+    a ValueError (not a crash inside tracing). Bench FLOP accounting
+    (bench/fft_bench.pair_fft_flops) resolves through here too, so it
+    can never diverge from what actually runs."""
+    if method == "auto":
+        return (
+            "four-step"
+            if n >= FOUR_STEP_MIN and _split(n) is not None
+            else "direct"
+        )
+    if method == "four-step" and _split(n) is None:
+        raise ValueError(
+            f"four-step needs a composite axis length >= 4, got {n}"
+        )
+    if method not in ("four-step", "direct"):
+        raise ValueError(f"unknown pair-FFT method {method!r}")
+    return method
+
+
+def _pair_axis(re, im, axis: int, inverse: bool, method: str):
+    method = resolve_method(re.shape[axis], method)
+    if method == "four-step":
+        return _four_step_axis(re, im, axis, inverse)
+    return _dft_axis(re, im, axis, inverse)
+
+
 def fft2_sharded_pair(
     re: jnp.ndarray,
     im: jnp.ndarray,
@@ -142,16 +261,20 @@ def fft2_sharded_pair(
     *,
     inverse: bool = False,
     restore_layout: bool = True,
+    method: str = "auto",
 ):
     """:func:`fft2_sharded` on (real, imag) f32 planes — no complex dtype.
 
     Same pencil decomposition and all_to_all transposes, with each local
-    transform a pair of MXU matmuls instead of an FFT. Returns the
-    (re, im) pair in the same layout contract as :func:`fft2_sharded`.
+    transform on the MXU: ``method='direct'`` is the dense O(N) MACs/elt
+    DFT matmul pair, ``'four-step'`` the O(sqrt(N)) MACs/elt split-radix
+    decomposition (needs a composite axis length), ``'auto'`` (default)
+    picks four-step from :data:`FOUR_STEP_MIN` up. Returns the (re, im)
+    pair in the same layout contract as :func:`fft2_sharded`.
     """
-    re, im = _dft_axis(re, im, 1, inverse)
+    re, im = _pair_axis(re, im, 1, inverse, method)
     re, im = _transpose_pair(re, im, axis_name, to_pencil=True)
-    re, im = _dft_axis(re, im, 0, inverse)
+    re, im = _pair_axis(re, im, 0, inverse, method)
     if restore_layout:
         re, im = _transpose_pair(re, im, axis_name, to_pencil=False)
     return re, im
@@ -169,11 +292,11 @@ def ifft2_from_pencil(pencil: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     return jnp.fft.ifft(y, axis=1)
 
 
-def ifft2_from_pencil_pair(re, im, axis_name: str):
+def ifft2_from_pencil_pair(re, im, axis_name: str, method: str = "auto"):
     """Pair-plane (MXU matmul) version of :func:`ifft2_from_pencil`."""
-    re, im = _dft_axis(re, im, 0, True)
+    re, im = _pair_axis(re, im, 0, True, method)
     re, im = _transpose_pair(re, im, axis_name, to_pencil=False)
-    return _dft_axis(re, im, 1, True)
+    return _pair_axis(re, im, 1, True, method)
 
 
 def complex_supported() -> bool:
