@@ -139,17 +139,63 @@ class TestStencil3D:
 
 class TestCompactImpl:
     @pytest.mark.parametrize("impl", ["compact", "compact-pallas",
-                                      "compact-strips"])
+                                      "compact-strips", "compact-asm"])
     @pytest.mark.parametrize("periodic", [True, False])
     def test_compact_equals_padded(self, devices, periodic, impl):
         rng = np.random.default_rng(5)
-        world = rng.standard_normal((4, 8, 8)).astype(np.float32)
+        # 8 deep so the per-tile core (4 planes) satisfies compact-asm's
+        # two-band minimum; the other impls are size-indifferent
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
         mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
         a = distributed_stencil3d(world, 3, mesh, periodic=periodic,
                                   impl=impl)
         b = distributed_stencil3d(world, 3, mesh, periodic=periodic,
                                   impl="padded")
         assert np.allclose(a, b, atol=1e-6)
+
+    def test_assembled_multiband_branches(self, devices):
+        # >= 3 bands on a single device so the first / middle / last
+        # z-branch of the assembled kernel all execute
+        rng = np.random.default_rng(9)
+        world = rng.standard_normal((12, 8, 8)).astype(np.float32)
+        mesh = make_mesh((1, 1, 1), ("z", "row", "col"))
+        from tpuscratch.ops import stencil_kernel as sk
+
+        budget = (2 * 6 + 3 * 4) * 8 * 8 * 4 + 4 * 8 * 8 * 4  # band<=4
+        got = distributed_stencil3d(world, 2, mesh, impl="compact-asm")
+        expect = world.astype(np.float64)
+        for _ in range(2):
+            expect = sum(
+                np.roll(expect, s, a) for a in range(3) for s in (1, -1)
+            ) / 6.0
+        assert np.allclose(got, expect, atol=1e-5)
+        # and directly at a forced small band (3 bands of 4)
+        core = jnp.asarray(world)
+        a_mz = core[-1:]
+        a_pz = core[:1]
+        a_my = core[:, -1:, :]
+        a_py = core[:, :1, :]
+        a_mx = core[:, :, -1:]
+        a_px = core[:, :, :1]
+        out = sk.seven_point_assembled_pallas(
+            core, a_mz, a_pz, a_my, a_py, a_mx, a_px, world.shape,
+            (1 / 6,) * 6 + (0.0,), budget_bytes=budget,
+        )
+        one = world.astype(np.float64)
+        one = sum(
+            np.roll(one, s, a) for a in range(3) for s in (1, -1)
+        ) / 6.0
+        assert np.allclose(np.asarray(out), one, atol=1e-5)
+
+    def test_assembled_rejects_tiny_core(self, devices):
+        from tpuscratch.ops.stencil_kernel import seven_point_assembled_pallas
+
+        z = jnp.zeros((2, 4, 4))
+        with pytest.raises(ValueError, match="too small"):
+            seven_point_assembled_pallas(
+                z, z[:1], z[:1], z[:, :1], z[:, :1], z[:, :, :1],
+                z[:, :, :1], (2, 4, 4), (1 / 6,) * 6 + (0.0,)
+            )
 
     def test_explicit_compact_rejects_deep_halo(self, devices):
         with pytest.raises(ValueError, match="halo \\(1,1,1\\) only"):

@@ -143,3 +143,34 @@ class TestComposedTrainStep:
         )
         _, loss = step(params, x, y)
         assert np.isfinite(float(loss))
+
+
+def test_bf16_compute_dtype_trains(devices):
+    """Mixed precision: bf16 forward/backward under f32 master params
+    must still descend, and params must stay f32."""
+    import dataclasses
+
+    import jax
+
+    from tpuscratch.models import TransformerConfig, init_params, train_step
+    from tpuscratch.runtime.mesh import make_mesh
+
+    mesh = make_mesh((2, 2), ("dp", "sp"))
+    cfg = TransformerConfig(
+        d_model=16, n_heads=2, n_experts=2, d_ff=32, capacity_factor=2.0,
+        compute_dtype="bfloat16",
+    )
+    step = train_step(mesh, cfg, lr=0.05)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 16, 16)).astype(np.float32))
+    y = 0.5 * x
+    params = init_params(0, cfg)
+    losses = []
+    for _ in range(8):
+        params, loss = step(params, x, y)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(params)
+    ), "master params must remain f32"

@@ -43,7 +43,9 @@ def verify_echo(mesh: Mesh, axis: str, n_elems: int) -> bool:
     n = mesh.devices.size
     payload = np.zeros((n, n_elems), dtype=np.float32)
     payload[0] = np.random.default_rng(0).standard_normal(n_elems)
-    f = pingpong_program(mesh, axis, n_elems)
+    # b = n-1: partner rank 1 normally, self-loop on a degenerate
+    # 1-device mesh (the full code path, zero-hop transport)
+    f = pingpong_program(mesh, axis, n_elems, b=n - 1)
     out = np.asarray(f(jnp.asarray(payload.reshape(-1)))).reshape(n, n_elems)
     return bool((out[0] == payload[0]).all())
 
@@ -65,7 +67,7 @@ def sweep(
     results = []
     for size in sizes_bytes:
         n_elems = max(1, size // 4)  # f32 payload
-        f = pingpong_program(mesh, axis, n_elems, rounds=rounds)
+        f = pingpong_program(mesh, axis, n_elems, b=n - 1, rounds=rounds)
         x = jnp.zeros(n * n_elems, dtype=jnp.float32)
         results.append(
             time_device(

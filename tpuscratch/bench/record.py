@@ -20,6 +20,7 @@ the ones this repo establishes. Configs follow BASELINE.md:
 9. 3D 7-point stencil cell-updates/s             (per-device tile scales
    with the mesh; real chip when present)
 10. remote-DMA halo kernel, 1024^2 self-wrap     (real chip when present)
+11. composed-training tokens/s, f32 + bf16       (real chip when present)
 
 Each config prints one JSON line with the platform recorded, so CPU-proxy
 numbers can never masquerade as chip numbers.
@@ -53,16 +54,14 @@ def _emit(out: list, **kv) -> None:
     print(json.dumps(kv), flush=True)
 
 
-def _best_stencil(impls, config_no, grid, steps, mesh, iters):
-    """(best result, winning impl) over impls; a failing impl is reported
-    and skipped."""
-    from tpuscratch.bench.stencil_bench import bench_stencil
-
+def _race(config_no, impls, bench_fn):
+    """(best result, winning impl): run ``bench_fn(impl)`` for each impl,
+    report each to stderr, return the items_per_s argmax. A failing impl
+    is reported and skipped; ALL failing raises."""
     best, best_impl = None, None
     for impl in impls:
         try:
-            r = bench_stencil(grid, steps, mesh=mesh, impl=impl,
-                              iters=iters, fence="readback")
+            r = bench_fn(impl)
         except Exception as e:  # one impl failing shouldn't kill the config
             print(f"# config {config_no} impl {impl} failed: {e}",
                   file=sys.stderr)
@@ -73,6 +72,17 @@ def _best_stencil(impls, config_no, grid, steps, mesh, iters):
     if best is None:
         raise RuntimeError(f"all config-{config_no} impls failed")
     return best, best_impl
+
+
+def _best_stencil(impls, config_no, grid, steps, mesh, iters):
+    """2D-stencil specialization of :func:`_race`."""
+    from tpuscratch.bench.stencil_bench import bench_stencil
+
+    return _race(
+        config_no, impls,
+        lambda impl: bench_stencil(grid, steps, mesh=mesh, impl=impl,
+                                   iters=iters, fence="readback"),
+    )
 
 
 def two_phase_stencil(impls, config_no, grid, mesh, iters,
@@ -209,9 +219,14 @@ def config3_pingpong(out: list, iters: int = 10) -> None:
     from tpuscratch.bench.pingpong import DEFAULT_SIZES, sweep, verify_echo
     from tpuscratch.runtime.mesh import make_mesh_1d
 
-    if len(jax.devices()) < 2:
-        raise Needs("pingpong needs >= 2 devices")
-    mesh = make_mesh_1d("x", devices=jax.devices()[:2])
+    degenerate = len(jax.devices()) < 2 and on_device_requested()
+    if len(jax.devices()) < 2 and not degenerate:
+        raise Needs(
+            "pingpong needs >= 2 devices; set TPUSCRATCH_ON_DEVICE=1 to "
+            "run the full code path as a 1-device self-loop"
+        )
+    n = min(2, len(jax.devices()))
+    mesh = make_mesh_1d("x", devices=jax.devices()[:n])
     if not verify_echo(mesh, "x", 1024):
         raise AssertionError("pingpong echo self-check FAILED")
     results = sweep(mesh, sizes_bytes=DEFAULT_SIZES, iters=iters,
@@ -224,7 +239,9 @@ def config3_pingpong(out: list, iters: int = 10) -> None:
         metric="pingpong_peak_GBps",
         value=peak.gbps,
         p50_latency_s_smallest=small.p50,
-        detail=f"peak at {peak.name}; echo PASSED",
+        detail=f"peak at {peak.name}; echo PASSED"
+        + (" [degenerate 1-device self-loop]" if degenerate else ""),
+        degenerate=degenerate,
         sweep=[
             {"bytes": r.bytes_moved // 2, "p50_s": r.p50, "gbps": r.gbps}
             for r in results
@@ -341,8 +358,13 @@ def config7_collectives(out: list, iters: int = 10) -> None:
     from tpuscratch.runtime.mesh import make_mesh_1d
 
     n = min(8, len(jax.devices()))
-    if n < 2:
-        raise Needs("collective sweep needs >= 2 devices (use --cpu-devices 8)")
+    degenerate = n < 2 and on_device_requested()
+    if n < 2 and not degenerate:
+        raise Needs(
+            "collective sweep needs >= 2 devices (use --cpu-devices 8, "
+            "or TPUSCRATCH_ON_DEVICE=1 for a 1-device degenerate run)"
+        )
+    n = max(n, 1)
     mesh = make_mesh_1d("x", n)
     if not verify(mesh):
         raise AssertionError("collective echo-verify FAILED")
@@ -359,8 +381,10 @@ def config7_collectives(out: list, iters: int = 10) -> None:
         metric="collective_busbw_peak_gbps",
         value=max(peaks.values()),
         peaks=peaks,
+        degenerate=degenerate,
         detail=f"busBW peaks over 1KiB-4MiB/device on {n} devices; "
-        "echo-verify PASSED",
+        "echo-verify PASSED"
+        + (" [degenerate 1-device]" if degenerate else ""),
     )
 
 
@@ -397,10 +421,12 @@ def config8_dft(out: list, iters: int = 3) -> None:
             from tpuscratch.bench.fft_bench import pair_fft_flops
 
             per_round = pair_fft_flops(n, method, 1)
-            if per_round > 3e15:
-                # one round alone would exceed ~2 min at the f32 MXU
-                # roofline (direct at 8192^2 is ~11 min/round and its DFT
-                # table alone is grid-sized); record the structural loss
+            if per_round > 3e13 and method == "direct":
+                # direct's trace-constant DFT table is (n, n) — at
+                # 8192^2 that is a 268 MB constant, which the tunnel's
+                # remote compile rejects (observed: Broken pipe), and a
+                # round is ~0.8 s of pure MXU anyway; record the
+                # structural loss
                 print(f"# config 8 {method}@{n} skipped: {per_round:.1e} "
                       "FLOPs/round exceeds the race budget", file=sys.stderr)
                 continue
@@ -450,15 +476,26 @@ def config9_stencil3d(out: list, iters: int = 3) -> None:
     # run measures real per-chip work, never a degenerate sliver
     tile = (256, 512, 512) if on_tpu else (8, 8, 8)
     grid = tuple(t * d for t, d in zip(tile, dims))
-    r = bench_stencil3d(
-        grid=grid,
-        steps=3000 if on_tpu else 3,
-        mesh=mesh,
-        impl="compact-strips" if on_tpu else "compact",
-        iters=iters,
-        fence="readback" if on_tpu else "block",
+    # screen the two kernel paths at a modest step count, re-measure the
+    # winner at full depth (the config-1 two-phase methodology)
+    impls = ("compact-asm", "compact-strips") if on_tpu else ("compact",)
+    r, winner = _race(
+        9, impls,
+        lambda impl: bench_stencil3d(
+            grid=grid, steps=300 if on_tpu else 3, mesh=mesh, impl=impl,
+            iters=iters, fence="readback" if on_tpu else "block",
+        ),
     )
-    print(f"# {r.summary()}", file=sys.stderr)
+    if on_tpu:
+        try:
+            r = bench_stencil3d(
+                grid=grid, steps=3000, mesh=mesh, impl=winner,
+                iters=iters, fence="readback",
+            )
+            print(f"# final: {r.summary()}", file=sys.stderr)
+        except Exception as e:
+            print(f"# config 9 final re-measure failed, using screen: {e}",
+                  file=sys.stderr)
     _emit(
         out,
         config=9,
@@ -522,6 +559,64 @@ def config10_dma_halo(out: list, iters: int = 3) -> None:
     )
 
 
+def config11_train(out: list, iters: int = 3) -> None:
+    """Composed-training throughput (BASELINE row 11): tokens/s of the
+    full dp x sp train step — ring attention + expert MoE + grad + SGD
+    in one program — f32 and bf16, with the FLOP estimate recorded so
+    the rate carries its own roofline argument."""
+    import dataclasses
+
+    import jax
+
+    from tpuscratch.bench.train_bench import bench_train, train_flops_per_token
+    from tpuscratch.models.transformer import TransformerConfig
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    base = (
+        TransformerConfig(
+            d_model=1024, n_heads=8, n_experts=4, d_ff=4096, n_layers=4,
+            capacity_factor=2.0, attn_impl="pallas",
+        )
+        if on_tpu
+        else TransformerConfig(
+            d_model=32, n_heads=2, n_experts=2, d_ff=64, n_layers=1
+        )
+    )
+    seq = 2048 if on_tpu else 16
+    batch = 8 if on_tpu else 2
+    emitted = 0
+    for dtype in ("float32", "bfloat16"):
+        cfg = dataclasses.replace(base, compute_dtype=dtype)
+        try:
+            r = bench_train(
+                mesh=mesh, cfg=cfg, batch=batch, seq=seq,
+                steps=20 if on_tpu else 2, iters=iters,
+                fence="readback" if on_tpu else "block",
+            )
+        except Exception as e:
+            print(f"# config 11 {dtype} failed: {e}", file=sys.stderr)
+            continue
+        fpt = train_flops_per_token(cfg, seq)
+        print(f"# {r.summary()} -> {r.items_per_s:.3e} tok/s, "
+              f"~{r.items_per_s * fpt / 1e12:.1f} TFLOP/s model",
+              file=sys.stderr)
+        _emit(
+            out,
+            config=11,
+            metric=f"train_tokens_per_s_{dtype}",
+            value=r.items_per_s,
+            p50_s=r.p50,
+            flops_per_token=fpt,
+            model_tflops=r.items_per_s * fpt / 1e12,
+            detail=r.name,
+        )
+        emitted += 1
+    if not emitted:
+        raise RuntimeError("all config-11 dtypes failed")
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -533,12 +628,13 @@ CONFIGS = {
     8: config8_dft,
     9: config9_stencil3d,
     10: config10_dma_halo,
+    11: config11_train,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh first (dev path)")

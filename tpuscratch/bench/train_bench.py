@@ -1,0 +1,140 @@
+"""Composed-training throughput: tokens/s of the full train step.
+
+Every other BASELINE row is a kernel or collective microbench; this one
+measures the thing the framework exists to compose — the dp x sp
+transformer train step (models/transformer.py: ring attention over sp,
+expert-parallel MoE over dp, grad + copy-axis reduction + SGD in ONE
+compiled program) — end to end, with the repo's standard methodology:
+many steps folded into one compiled scan, loop-carried data dependence
+so steps cannot be hoisted, readback fencing.
+
+FLOP accounting (reported alongside tokens/s for the roofline argument):
+active parameters per token = 4 d^2 (attention projections) + 2 d d_ff
+(the ONE routed expert) per layer; a train step costs ~6 FLOPs per
+active parameter per token (fwd 2, bwd 4), plus attention's
+sequence-quadratic term 12 S d per token per layer (QK^T and PV, fwd +
+bwd, x0.5 when causal). MoE capacity slack (capacity_factor tokens
+processed per expert slot vs tokens routed) is charged at the router's
+capacity, i.e. the arithmetic actually executed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from tpuscratch.bench.timing import BenchResult, time_device
+from tpuscratch.models.transformer import (
+    TransformerConfig,
+    init_params,
+    param_spec,
+    train_step_fn,
+)
+
+
+def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
+    """Approximate train-step FLOPs per token (see module docstring)."""
+    d, f = cfg.d_model, cfg.d_ff
+    dense = 4 * d * d + cfg.capacity_factor * 2 * d * f
+    attn = 12 * seq * d * (0.5 if cfg.causal else 1.0)
+    return 6.0 * dense * cfg.n_layers + attn * cfg.n_layers
+
+
+def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
+                             lr: float = 1e-3):
+    """jit'd fn(params, x, y) -> (params, loss) running ``steps`` train
+    steps in one scan (the data is reused — throughput, not learning)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpuscratch.comm import run_spmd
+
+    step = train_step_fn(cfg, lr=lr)
+
+    def body(params, x, y):
+        # params are the loop carry: every step reads the previous
+        # step's SGD update, so the scan cannot be collapsed or hoisted
+        def one(p, _):
+            p, loss = step(p, x, y)
+            return p, loss
+
+        params, losses = lax.scan(one, params, None, length=steps)
+        return params, losses[-1]
+
+    pspec = param_spec(cfg)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, P("dp", "sp"), P("dp", "sp")),
+        (pspec, P()),
+    )
+
+
+def bench_train(
+    mesh: Optional[Mesh] = None,
+    cfg: Optional[TransformerConfig] = None,
+    batch: Optional[int] = None,
+    seq: Optional[int] = None,
+    steps: Optional[int] = None,
+    iters: int = 3,
+    fence: str = "readback",
+    seed: int = 0,
+) -> BenchResult:
+    """tokens/s of the composed train step; items = tokens processed."""
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    if mesh is None:
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+    if cfg is None:
+        cfg = (
+            TransformerConfig(
+                d_model=1024, n_heads=8, n_experts=4, d_ff=4096,
+                n_layers=4, capacity_factor=2.0, attn_impl="pallas",
+            )
+            if on_tpu
+            else TransformerConfig(
+                d_model=32, n_heads=2, n_experts=2, d_ff=64, n_layers=1,
+                capacity_factor=2.0,
+            )
+        )
+    batch = batch if batch is not None else (8 if on_tpu else 2 * mesh.shape["dp"])
+    seq = seq if seq is not None else (2048 if on_tpu else 8 * mesh.shape["sp"])
+    steps = steps if steps is not None else (20 if on_tpu else 2)
+
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32))
+    params = init_params(seed, cfg)
+    prog = train_throughput_program(mesh, cfg, steps)
+    # correctness gate doubles as compile warmup: the loss must be finite
+    out_params, loss = prog(params, x, y)
+    if not np.isfinite(float(loss)):
+        raise AssertionError(f"train step produced loss {float(loss)}")
+    tokens = batch * seq * steps
+    return time_device(
+        prog, params, x, y, iters=iters, warmup=1, fence=fence,
+        name=(
+            f"train d{cfg.d_model} ff{cfg.d_ff} L{cfg.n_layers} "
+            f"e{cfg.n_experts} {cfg.compute_dtype} b{batch} s{seq} "
+            f"x{steps} on {mesh.shape['dp']}x{mesh.shape['sp']} "
+            f"({cfg.attn_impl})"
+        ),
+        items=tokens,
+    )
+
+
+def main() -> int:
+    r = bench_train()
+    print(f"{r.summary()} -> {r.items_per_s:.3e} tokens/s")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -305,6 +305,19 @@ def stencil_step3d_compact(
 
     a_mz, a_pz, a_my, a_py, a_mx, a_px = (arrival(d) for d in FACES)
 
+    if compute == "pallas-asm":
+        # nothing assembled outside at all: the kernel's z-band pipeline
+        # reads the core through clamped overlapping blocks and the six
+        # arrival planes/strips through their own banded inputs — the
+        # zpad build pass and the full-plane in-kernel concats are gone
+        # (BASELINE row 9's named levers)
+        from tpuscratch.ops.stencil_kernel import seven_point_assembled_pallas
+
+        return seven_point_assembled_pallas(
+            core, a_mz, a_pz, a_my, a_py, a_mx, a_px,
+            (cz, cy, cx), tuple(coeffs),
+        )
+
     if compute == "pallas-strips":
         # only the z axis is assembled outside; the y/x strips feed the
         # kernel directly — two fewer full-grid concat passes per step
@@ -385,14 +398,15 @@ def decompose3d(
     return tiles
 
 
-IMPLS3D = ("compact", "compact-pallas", "compact-strips", "padded")
+IMPLS3D = ("compact", "compact-pallas", "compact-strips", "compact-asm",
+           "padded")
 
-#: impl name -> compact compute backend ('compact-strips' is the fastest
-#: measured: BASELINE.md row 9)
+#: impl name -> compact compute backend (BASELINE.md row 9 races them)
 _COMPACT_COMPUTE = {
     "compact": "xla",
     "compact-pallas": "pallas",
     "compact-strips": "pallas-strips",
+    "compact-asm": "pallas-asm",
 }
 
 

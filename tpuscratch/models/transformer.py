@@ -55,6 +55,10 @@ class TransformerConfig:
     #   (needs n_heads % sp_size == 0)
     # all three are trainable
     attn_impl: str = "xla"
+    # forward/backward arithmetic dtype; master params, the loss, and
+    # the SGD update stay float32 (standard mixed precision: the cast
+    # sits inside the loss, so value_and_grad returns f32 grads)
+    compute_dtype: str = "float32"
 
     @property
     def d_head(self) -> int:
@@ -162,8 +166,14 @@ def model_apply(params, x, cfg: TransformerConfig, sp: str = "sp", dp: str = "dp
 
 
 def _loss(params, x, y, cfg: TransformerConfig, sp: str, dp: str):
+    cd = jnp.dtype(cfg.compute_dtype)
+    if cd != jnp.float32:
+        params = jax.tree.map(lambda w: w.astype(cd), params)
+        x = x.astype(cd)
     out, aux = model_apply(params, x, cfg, sp, dp)
-    mse = jnp.mean(jnp.square(out - y))
+    # the error and the objective are f32 regardless of compute dtype
+    mse = jnp.mean(jnp.square(out.astype(jnp.float32) - y.astype(jnp.float32)))
+    aux = jnp.asarray(aux, jnp.float32)
     # identical on every rank: the global objective, not a local one
     return lax.pmean(mse + cfg.aux_coef * aux, (dp, sp))
 

@@ -143,6 +143,27 @@ def _online_update(s, guard, v_ref, m_scr, l_scr, acc_scr):
     l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
 
 
+def _online_first(s, guard, v_ref, m_scr, l_scr, acc_scr):
+    """First KV step fused with state initialization: writes (m, l, acc)
+    directly from the block instead of zero-initializing and then
+    correcting — saves the acc zero-store, its read-back, and the corr
+    multiply on every q block's first step. Equivalent by algebra:
+    m_prev = -inf makes corr = 0 and l_prev = 0, so the first
+    _online_update reduces to exactly this."""
+    m_new = s.max(axis=1)
+    p = jnp.exp(s - m_new[:, None])
+    if guard is not None:
+        p = jnp.where(guard, p, 0.0)
+    l_new = p.sum(axis=1)
+    mmdt = _mm_dtype(v_ref)
+    acc_scr[...] = lax.dot(
+        p.astype(mmdt), v_ref[0].astype(mmdt),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+
 def _emit_output(o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr):
     """Final write-out, shared by the dense and compact forward kernels."""
     if m_ref is None:
@@ -171,13 +192,26 @@ def _flash_kernel(
     i = pl.program_id(1)
     j = pl.program_id(2)
 
+    # j == 0 fuses init into the first accumulation (_online_first); it
+    # runs unconditionally — when even the first block is fully masked
+    # (a ring hop whose KV is entirely in the future), the mask zeroes p
+    # and the fused write produces the same (NEG_INF, 0, 0) state the
+    # explicit init did, at the cost of one wasted MXU block on a case
+    # the schedule hits at most once per hop.
     @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
+    def _first():
+        s, guard = _score_block(
+            q_ref, k_ref, qoff_ref, koff_ref, i, j,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        )
+        _online_first(
+            s, guard if causal else None, v_ref, m_scr, l_scr, acc_scr
+        )
 
-    @pl.when(_block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k))
+    @pl.when(jnp.logical_and(
+        j > 0,
+        _block_needed(qoff_ref, koff_ref, i, j, causal, block_q, block_k),
+    ))
     def _compute():
         s, guard = _score_block(
             q_ref, k_ref, qoff_ref, koff_ref, i, j,
@@ -265,13 +299,7 @@ def _flash_kernel_compact(
     p = pl.program_id(1)
     i, j, flags = i_tab[p], j_tab[p], flag_tab[p]
 
-    @pl.when(j == 0)
-    def _init():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
-
-    def update(masked: bool):
+    def update(masked: bool, first: bool):
         s = _raw_scores(q_ref, k_ref, scale)
         guard = None
         if masked:
@@ -279,15 +307,28 @@ def _flash_kernel_compact(
                 s, qoff + i * block_q, koff + j * block_k, block_q, block_k
             )
             guard = s > NEG_INF * 0.5
-        _online_update(s, guard, v_ref, m_scr, l_scr, acc_scr)
+        body = _online_first if first else _online_update
+        body(s, guard, v_ref, m_scr, l_scr, acc_scr)
 
-    @pl.when(flags & _FLAG_MASKED != 0)
+    # first KV step fused with init (see _online_first); the masked/full
+    # split stays so full blocks pay no mask arithmetic
+    masked = flags & _FLAG_MASKED != 0
+
+    @pl.when(jnp.logical_and(j == 0, masked))
+    def _first_diagonal():
+        update(True, True)
+
+    @pl.when(jnp.logical_and(j == 0, jnp.logical_not(masked)))
+    def _first_full():
+        update(False, True)
+
+    @pl.when(jnp.logical_and(j > 0, masked))
     def _diagonal():
-        update(True)
+        update(True, False)
 
-    @pl.when(flags & _FLAG_MASKED == 0)
+    @pl.when(jnp.logical_and(j > 0, jnp.logical_not(masked)))
     def _full():
-        update(False)
+        update(False, False)
 
     @pl.when(flags & _FLAG_EMIT != 0)
     def _emit():

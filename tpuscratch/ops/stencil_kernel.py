@@ -415,6 +415,161 @@ def seven_point_strips_pallas(
     )(zpad, a_my, a_py, a_mx, a_px)
 
 
+def _asm3d_compute(o_ref, up, dn, c, my, py, mx, px, cy: int, cx: int, w):
+    """Ring-decomposed 7-point band update: the interior is pure shifted
+    slices (no temporaries beyond the fused sum), and only the four
+    boundary LINES pay concats — (band,1,cx)/(band,cy-2,1) sized, ~cy/2
+    times smaller than the full-plane concats of _strips3d_kernel."""
+    o_ref[:, 1 : cy - 1, 1 : cx - 1] = (
+        w[0] * up[:, 1:-1, 1:-1] + w[1] * dn[:, 1:-1, 1:-1]
+        + w[2] * c[:, 0:-2, 1:-1] + w[3] * c[:, 2:, 1:-1]
+        + w[4] * c[:, 1:-1, 0:-2] + w[5] * c[:, 1:-1, 2:]
+        + w[6] * c[:, 1:-1, 1:-1]
+    )
+    o_ref[:, 0:1, :] = (
+        w[0] * up[:, 0:1, :] + w[1] * dn[:, 0:1, :]
+        + w[2] * my + w[3] * c[:, 1:2, :]
+        + w[4] * jnp.concatenate([mx[:, 0:1, :], c[:, 0:1, :-1]], axis=2)
+        + w[5] * jnp.concatenate([c[:, 0:1, 1:], px[:, 0:1, :]], axis=2)
+        + w[6] * c[:, 0:1, :]
+    )
+    o_ref[:, cy - 1 : cy, :] = (
+        w[0] * up[:, -1:, :] + w[1] * dn[:, -1:, :]
+        + w[2] * c[:, -2:-1, :] + w[3] * py
+        + w[4] * jnp.concatenate([mx[:, -1:, :], c[:, -1:, :-1]], axis=2)
+        + w[5] * jnp.concatenate([c[:, -1:, 1:], px[:, -1:, :]], axis=2)
+        + w[6] * c[:, -1:, :]
+    )
+    o_ref[:, 1 : cy - 1, 0:1] = (
+        w[0] * up[:, 1:-1, 0:1] + w[1] * dn[:, 1:-1, 0:1]
+        + w[2] * c[:, 0:-2, 0:1] + w[3] * c[:, 2:, 0:1]
+        + w[4] * mx[:, 1:-1, :] + w[5] * c[:, 1:-1, 1:2]
+        + w[6] * c[:, 1:-1, 0:1]
+    )
+    o_ref[:, 1 : cy - 1, cx - 1 : cx] = (
+        w[0] * up[:, 1:-1, -1:] + w[1] * dn[:, 1:-1, -1:]
+        + w[2] * c[:, 0:-2, -1:] + w[3] * c[:, 2:, -1:]
+        + w[4] * c[:, 1:-1, -2:-1] + w[5] * px[:, 1:-1, :]
+        + w[6] * c[:, 1:-1, -1:]
+    )
+
+
+def _asm3d_kernel(z_ref, mz_ref, pz_ref, my_ref, py_ref, mx_ref, px_ref,
+                  o_ref, *, band: int, cy: int, cx: int, nb: int, coeffs7):
+    i = pl.program_id(0)
+    t = z_ref[:]  # (band + 2, cy, cx): core planes, z-clamped at the rims
+
+    def emit(up, dn, c):
+        _asm3d_compute(
+            o_ref, up, dn, c,
+            my_ref[:], py_ref[:], mx_ref[:], px_ref[:], cy, cx, coeffs7,
+        )
+
+    # The clamped index map shifts the first and last bands' blocks by
+    # one plane, so which rows are (up, core, down) is band-dependent —
+    # statically branched on the grid index; the arrival planes slot in
+    # as plane-sized concats on just those two bands.
+    @pl.when(i == 0)
+    def _():
+        emit(
+            jnp.concatenate([mz_ref[:], t[0 : band - 1]], axis=0),
+            t[1 : band + 1],
+            t[0:band],
+        )
+
+    @pl.when(jnp.logical_and(i > 0, i < nb - 1))
+    def _():
+        emit(t[0:band], t[2 : band + 2], t[1 : band + 1])
+
+    @pl.when(i == nb - 1)
+    def _():
+        emit(
+            t[1 : band + 1],
+            jnp.concatenate([t[3 : band + 2], pz_ref[:]], axis=0),
+            t[2 : band + 2],
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("core_shape", "coeffs7", "budget_bytes"))
+def seven_point_assembled_pallas(
+    core: jax.Array,
+    a_mz: jax.Array,
+    a_pz: jax.Array,
+    a_my: jax.Array,
+    a_py: jax.Array,
+    a_mx: jax.Array,
+    a_px: jax.Array,
+    core_shape: tuple[int, int, int],
+    coeffs7,
+    budget_bytes: int = _VMEM_CEILING,
+) -> jax.Array:
+    """7-point update assembled entirely inside the kernel pipeline — no
+    host-side padded-array build at all.
+
+    The two passes the strips path still paid on the XLA side are gone:
+    the z-band pipeline reads the CORE directly through overlapping
+    clamped Element blocks (the zpad concat was a full read+write of the
+    grid per step), and the boundary values come in as their own banded
+    inputs whose async block copies the pipeline overlaps with compute —
+    consumed by ring-decomposed slices instead of full-plane
+    concatenations. HBM traffic per step is one core read (x (band+2)/
+    band overlap) + one core write + 2*nb arrival planes, i.e. the
+    2-pass roofline BASELINE.md row 9 names. The reference's analogue is
+    communicating strided subarrays without materializing them
+    (/root/reference/stencil2d/stencil2D.h:210-228).
+    """
+    cz, cy, cx = core_shape
+    if tuple(core.shape) != core_shape:
+        raise ValueError(f"core {core.shape} != {core_shape}")
+    if cz < 3 or cy < 3 or cx < 3:
+        raise ValueError(
+            f"core {core_shape} too small for the assembled kernel "
+            "(need >= 3 on every axis)"
+        )
+    itemsize = core.dtype.itemsize
+    plane = cy * cx * itemsize
+
+    def cost(b):
+        # double-buffered in (b+2 planes) + out (b) + the fused interior
+        # temp (~1 out block) + the two arrival planes, double-buffered
+        return (2 * (b + 2) + 2 * b + b) * plane + 4 * plane
+
+    band = _largest_divisor_band(
+        cz, cost, budget_bytes, strict=True
+    )
+    if cz // band < 2:
+        # the branch structure needs >= 2 bands: drop to the largest
+        # proper divisor (band=1 in the worst case — prime cz runs fine,
+        # every band then takes a first/middle/last branch)
+        band = next(d for d in range(cz // 2, 0, -1) if cz % d == 0)
+    nb = cz // band
+    kern = functools.partial(
+        _asm3d_kernel, band=band, cy=cy, cx=cx, nb=nb, coeffs7=tuple(coeffs7)
+    )
+    zmax = cz - band - 2
+
+    return pl.pallas_call(
+        kern,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec(
+                (Element(band + 2), Element(cy), Element(cx)),
+                lambda i: (jnp.clip(i * band - 1, 0, zmax), 0, 0),
+            ),
+            pl.BlockSpec((1, cy, cx), lambda i: (0, 0, 0)),
+            pl.BlockSpec((1, cy, cx), lambda i: (0, 0, 0)),
+            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((band, cy, cx), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((cz, cy, cx), core.dtype),
+        interpret=use_interpret(),
+        **mosaic_params(vmem_limit_bytes=budget_bytes),
+    )(core, a_mz, a_pz, a_my, a_py, a_mx, a_px)
+
+
 def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
     cn, cs, cw, ce, cc = coeffs
     t = t_ref[:]  # (band + 2, 2*halo_x + width): one overlap row each side

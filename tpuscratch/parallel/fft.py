@@ -148,7 +148,11 @@ def _dft_axis(re, im, axis: int, inverse: bool):
 # This is radix-sqrt(N) Cooley-Tukey — the classical "four-step" NUMA/
 # out-of-core FFT — which maps onto the MXU where a radix-2 Stockham's
 # butterflies would be VPU-bound gather/scatter. One split is enough for
-# the sizes a 2D grid axis reaches (N1,N2 <= 128 at N=16384).
+# the sizes a 2D grid axis reaches (N1,N2 <= 128 at N=16384). Reference
+# lineage: this is the transform layer the reference's complex-typed
+# strided exchanges exist to feed
+# (/root/reference/mpi-complex-types.cpp:35-88); the reference ships the
+# datatype machinery, never the transform.
 # ---------------------------------------------------------------------------
 
 
