@@ -299,6 +299,13 @@ def stencil_step3d_compact(
         take = (slice(None),) * axis + (
             slice(-1, None) if flow[axis] > 0 else slice(0, 1),
         )
+        if topo.dims[axis] == 1 and topo.periodic[axis]:
+            # degenerate periodic axis: the neighbor is myself, so the
+            # ghost plane is my own far plane — skip the collective (6
+            # per-step self-ppermutes measured ~1.2 ms/step of pure
+            # launch overhead at 256x512x512 on v5e; the 3D analogue of
+            # run_stencil_resident's self-wrap)
+            return core[take]
         return lax.ppermute(
             core[take], axes, list(topo.send_permutation(flow))
         )
@@ -310,11 +317,17 @@ def stencil_step3d_compact(
         # reads the core through clamped overlapping blocks and the six
         # arrival planes/strips through their own banded inputs — the
         # zpad build pass and the full-plane in-kernel concats are gone
-        # (BASELINE row 9's named levers)
+        # (BASELINE row 9's named levers). Degenerate periodic y/x axes
+        # pass None: the kernel reads its own block edges instead of
+        # carry slices (a lane-dim carry slice costs ~a full HBM pass)
         from tpuscratch.ops.stencil_kernel import seven_point_assembled_pallas
 
+        wrap_y = topo.dims[1] == 1 and topo.periodic[1]
+        wrap_x = topo.dims[2] == 1 and topo.periodic[2]
         return seven_point_assembled_pallas(
-            core, a_mz, a_pz, a_my, a_py, a_mx, a_px,
+            core, a_mz, a_pz,
+            None if wrap_y else a_my, None if wrap_y else a_py,
+            None if wrap_x else a_mx, None if wrap_x else a_px,
             (cz, cy, cx), tuple(coeffs),
         )
 

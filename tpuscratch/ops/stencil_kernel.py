@@ -454,15 +454,32 @@ def _asm3d_compute(o_ref, up, dn, c, my, py, mx, px, cy: int, cx: int, w):
     )
 
 
-def _asm3d_kernel(z_ref, mz_ref, pz_ref, my_ref, py_ref, mx_ref, px_ref,
-                  o_ref, *, band: int, cy: int, cx: int, nb: int, coeffs7):
+def _asm3d_kernel(*refs, band: int, cy: int, cx: int, nb: int, coeffs7,
+                  has_y: bool, has_x: bool):
+    z_ref, mz_ref, pz_ref = refs[0], refs[1], refs[2]
+    k = 3
+    if has_y:
+        my_ref, py_ref = refs[k], refs[k + 1]
+        k += 2
+    if has_x:
+        mx_ref, px_ref = refs[k], refs[k + 1]
+        k += 2
+    o_ref = refs[k]
     i = pl.program_id(0)
     t = z_ref[:]  # (band + 2, cy, cx): core planes, z-clamped at the rims
 
     def emit(up, dn, c):
+        # absent strips mean the axis self-wraps (degenerate periodic):
+        # the ghost line is the band's OWN far line, already in VMEM —
+        # a carry-slice input would cost a near-full HBM pass (lane-dim
+        # extraction of the whole core, ~0.4 ms/step at 512^2 planes,
+        # measured) for data the block is holding anyway
+        my = my_ref[:] if has_y else c[:, cy - 1 : cy, :]
+        py = py_ref[:] if has_y else c[:, 0:1, :]
+        mx = mx_ref[:] if has_x else c[:, :, cx - 1 : cx]
+        px = px_ref[:] if has_x else c[:, :, 0:1]
         _asm3d_compute(
-            o_ref, up, dn, c,
-            my_ref[:], py_ref[:], mx_ref[:], px_ref[:], cy, cx, coeffs7,
+            o_ref, up, dn, c, my, py, mx, px, cy, cx, coeffs7,
         )
 
     # The clamped index map shifts the first and last bands' blocks by
@@ -517,6 +534,12 @@ def seven_point_assembled_pallas(
     2-pass roofline BASELINE.md row 9 names. The reference's analogue is
     communicating strided subarrays without materializing them
     (/root/reference/stencil2d/stencil2D.h:210-228).
+
+    ``a_my/a_py`` (and ``a_mx/a_px``) may be ``None`` per axis, meaning
+    that axis self-wraps (degenerate periodic): the kernel then reads
+    the ghost lines from its own blocks instead of strip inputs —
+    extracting them outside would cost a near-full HBM pass (lane-dim
+    slicing of the carry, measured ~0.4 ms/step at 512^2 planes).
     """
     cz, cy, cx = core_shape
     if tuple(core.shape) != core_shape:
@@ -530,9 +553,12 @@ def seven_point_assembled_pallas(
     plane = cy * cx * itemsize
 
     def cost(b):
-        # double-buffered in (b+2 planes) + out (b) + the fused interior
-        # temp (~1 out block) + the two arrival planes, double-buffered
-        return (2 * (b + 2) + 2 * b + b) * plane + 4 * plane
+        # double-buffered in (b+2 planes) + out (b) + register-allocator
+        # spill slots, which Mosaic charges against scoped VMEM and which
+        # measure ~3.4x the out block for this kernel's five regional
+        # stores (54.29M at band=16/512^2 planes, from the chip
+        # compiler's allocation dump) + arrival planes and slack
+        return (2 * (b + 2) + 2 * b + 3.5 * b) * plane + 6 * plane
 
     band = _largest_divisor_band(
         cz, cost, budget_bytes, strict=True
@@ -543,31 +569,47 @@ def seven_point_assembled_pallas(
         # every band then takes a first/middle/last branch)
         band = next(d for d in range(cz // 2, 0, -1) if cz % d == 0)
     nb = cz // band
+    has_y = a_my is not None
+    has_x = a_mx is not None
+    if (a_py is None) != (a_my is None) or (a_px is None) != (a_mx is None):
+        raise ValueError("strip inputs must be present or absent per axis")
     kern = functools.partial(
-        _asm3d_kernel, band=band, cy=cy, cx=cx, nb=nb, coeffs7=tuple(coeffs7)
+        _asm3d_kernel, band=band, cy=cy, cx=cx, nb=nb,
+        coeffs7=tuple(coeffs7), has_y=has_y, has_x=has_x,
     )
     zmax = cz - band - 2
+
+    in_specs = [
+        pl.BlockSpec(
+            (Element(band + 2), Element(cy), Element(cx)),
+            lambda i: (jnp.clip(i * band - 1, 0, zmax), 0, 0),
+        ),
+        pl.BlockSpec((1, cy, cx), lambda i: (0, 0, 0)),
+        pl.BlockSpec((1, cy, cx), lambda i: (0, 0, 0)),
+    ]
+    inputs = [core, a_mz, a_pz]
+    if has_y:
+        in_specs += [
+            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
+        ]
+        inputs += [a_my, a_py]
+    if has_x:
+        in_specs += [
+            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
+            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
+        ]
+        inputs += [a_mx, a_px]
 
     return pl.pallas_call(
         kern,
         grid=(nb,),
-        in_specs=[
-            pl.BlockSpec(
-                (Element(band + 2), Element(cy), Element(cx)),
-                lambda i: (jnp.clip(i * band - 1, 0, zmax), 0, 0),
-            ),
-            pl.BlockSpec((1, cy, cx), lambda i: (0, 0, 0)),
-            pl.BlockSpec((1, cy, cx), lambda i: (0, 0, 0)),
-            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
-            pl.BlockSpec((band, 1, cx), lambda i: (i, 0, 0)),
-            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
-            pl.BlockSpec((band, cy, 1), lambda i: (i, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((band, cy, cx), lambda i: (i, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((cz, cy, cx), core.dtype),
         interpret=use_interpret(),
         **mosaic_params(vmem_limit_bytes=budget_bytes),
-    )(core, a_mz, a_pz, a_my, a_py, a_mx, a_px)
+    )(*inputs)
 
 
 def _band_kernel(t_ref, o_ref, *, band: int, halo_x: int, width: int, coeffs: Coeffs):
