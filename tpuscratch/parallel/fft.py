@@ -39,14 +39,18 @@ def _transpose(x: jnp.ndarray, axis_name: str, *, to_pencil: bool) -> jnp.ndarra
 
 
 def _transpose_pair(re, im, axis_name: str, *, to_pencil: bool):
-    """:func:`_transpose` for an (re, im) plane pair as ONE collective.
+    """:func:`_transpose` for an (re, im) pair as ONE collective.
 
     The transpose dominates the distributed FFT's wall clock, so the pair
     path stacks the planes and pays a single all_to_all (of twice the
-    payload) instead of two latencies per transpose.
+    payload) instead of two latencies per transpose. Rank-generic: after
+    the stack, ``to_pencil`` always splits the LAST axis and gathers the
+    leading grid axis (axis 1), whatever the rank — the same helper
+    serves the 2D (H/n, W) and 3D (Z/n, X, Y) layouts.
     """
     z = jnp.stack([re, im])
-    split, concat = (2, 1) if to_pencil else (1, 2)
+    last = z.ndim - 1
+    split, concat = (last, 1) if to_pencil else (1, last)
     z = lax.all_to_all(
         z, axis_name, split_axis=split, concat_axis=concat, tiled=True
     )
@@ -301,6 +305,99 @@ def ifft2_from_pencil_pair(re, im, axis_name: str, method: str = "auto"):
     re, im = _pair_axis(re, im, 0, True, method)
     re, im = _transpose_pair(re, im, axis_name, to_pencil=False)
     return _pair_axis(re, im, 1, True, method)
+
+
+# ---------------------------------------------------------------------------
+# 3D: the same pencil decomposition one dimension up. Local block is the
+# z-shard (Z/n, Y, X); X and Y transform locally (last-axis reshape), ONE
+# all_to_all repartitions z, and Z transforms locally in the pencil
+# layout (X, Y/n, Z). Complex path and (re, im) pair path mirror 2D.
+# ---------------------------------------------------------------------------
+
+
+def _pair_last(re, im, inverse: bool, method: str):
+    """Transform the LAST axis of an arbitrary-rank pair by flattening
+    the leading dims — reuses the whole 2D machinery (incl. four-step)."""
+    shape = re.shape
+    yr, yi = _pair_axis(
+        re.reshape(-1, shape[-1]), im.reshape(-1, shape[-1]),
+        1, inverse, method,
+    )
+    return yr.reshape(shape), yi.reshape(shape)
+
+
+def fft3_sharded_pair(
+    re: jnp.ndarray,
+    im: jnp.ndarray,
+    axis_name: str,
+    *,
+    inverse: bool = False,
+    restore_layout: bool = True,
+    method: str = "auto",
+):
+    """3D (i)FFT of a z-sharded (Z/n, Y, X) pair, SPMD over ``axis_name``.
+
+    Complex-free MXU path (see :func:`fft2_sharded_pair`). Returns the
+    same (Z/n, Y, X) layout when ``restore_layout``; otherwise the
+    transposed pencil — an (X, Y/n, Z) block whose device-local
+    coordinates are (kx = all, ky = shard, kz = all), which is what a
+    spectral multiply wants (solvers.spectral.periodic_poisson3d_fft).
+    """
+    re, im = _pair_last(re, im, inverse, method)                    # X
+    re, im = jnp.swapaxes(re, 1, 2), jnp.swapaxes(im, 1, 2)         # (Z/n, X, Y)
+    re, im = _pair_last(re, im, inverse, method)                    # Y
+    re, im = _transpose_pair(re, im, axis_name, to_pencil=True)     # (Z, X, Y/n)
+    re = jnp.transpose(re, (1, 2, 0))
+    im = jnp.transpose(im, (1, 2, 0))                               # (X, Y/n, Z)
+    re, im = _pair_last(re, im, inverse, method)                    # Z
+    if restore_layout:
+        re, im = ifft3_restore_pair(re, im, axis_name)
+    return re, im
+
+
+def ifft3_restore_pair(re, im, axis_name: str):
+    """Bring an (X, Y/n, Z) pencil pair back to the (Z/n, Y, X) row
+    layout (no transform — pure layout moves, shared by forward-restore
+    and the inverse path)."""
+    re = jnp.transpose(re, (2, 0, 1))
+    im = jnp.transpose(im, (2, 0, 1))                               # (Z, X, Y/n)
+    re, im = _transpose_pair(re, im, axis_name, to_pencil=False)    # (Z/n, X, Y)
+    return jnp.swapaxes(re, 1, 2), jnp.swapaxes(im, 1, 2)
+
+
+def ifft3_from_pencil_pair(re, im, axis_name: str, method: str = "auto"):
+    """Inverse 3D FFT starting from the (X, Y/n, Z) pencil — the forward
+    path run backwards, saving one all_to_all per round trip."""
+    re, im = _pair_last(re, im, True, method)                       # Z
+    re = jnp.transpose(re, (2, 0, 1))
+    im = jnp.transpose(im, (2, 0, 1))                               # (Z, X, Y/n)
+    re, im = _transpose_pair(re, im, axis_name, to_pencil=False)    # (Z/n, X, Y)
+    re, im = _pair_last(re, im, True, method)                       # Y
+    re, im = jnp.swapaxes(re, 1, 2), jnp.swapaxes(im, 1, 2)         # (Z/n, Y, X)
+    return _pair_last(re, im, True, method)                         # X
+
+
+def fft3_sharded(
+    local: jnp.ndarray,
+    axis_name: str,
+    *,
+    inverse: bool = False,
+    restore_layout: bool = True,
+) -> jnp.ndarray:
+    """Complex-dtype 3D (i)FFT of a z-sharded (Z/n, Y, X) block — the
+    `jnp.fft` sibling of :func:`fft3_sharded_pair`, same layout contract."""
+    f = jnp.fft.ifft if inverse else jnp.fft.fft
+    y = f(jnp.asarray(local, jnp.complex64), axis=2)                # X
+    y = jnp.swapaxes(y, 1, 2)                                       # (Z/n, X, Y)
+    y = f(y, axis=2)                                                # Y
+    z = lax.all_to_all(y, axis_name, split_axis=2, concat_axis=0, tiled=True)
+    z = jnp.transpose(z, (1, 2, 0))                                 # (X, Y/n, Z)
+    z = f(z, axis=2)                                                # Z
+    if restore_layout:
+        z = jnp.transpose(z, (2, 0, 1))                             # (Z, X, Y/n)
+        z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=2, tiled=True)
+        z = jnp.swapaxes(z, 1, 2)                                   # (Z/n, Y, X)
+    return z
 
 
 def complex_supported() -> bool:

@@ -23,7 +23,10 @@ from tpuscratch.solvers.multigrid3d import (
     pcg_poisson3d_solve,
     v_cycle3,
 )
-from tpuscratch.solvers.spectral import periodic_poisson_fft
+from tpuscratch.solvers.spectral import (
+    periodic_poisson3d_fft,
+    periodic_poisson_fft,
+)
 
 __all__ = [
     "cg",
@@ -35,5 +38,6 @@ __all__ = [
     "pcg_poisson3d_solve",
     "v_cycle",
     "v_cycle3",
+    "periodic_poisson3d_fft",
     "periodic_poisson_fft",
 ]

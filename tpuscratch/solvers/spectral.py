@@ -34,8 +34,10 @@ from tpuscratch.parallel.fft import (
     complex_supported,
     fft2_sharded,
     fft2_sharded_pair,
+    fft3_sharded_pair,
     ifft2_from_pencil,
     ifft2_from_pencil_pair,
+    ifft3_from_pencil_pair,
 )
 from tpuscratch.runtime.mesh import make_mesh_1d
 
@@ -102,5 +104,63 @@ def _spectral_program(mesh, ax, n, gh, gw, impl):
             return re.astype(b.dtype)
         hat = fft2_sharded(b, ax, restore_layout=False)  # (gh, gw/n) pencil
         return jnp.real(ifft2_from_pencil(hat * inv, ax)).astype(b.dtype)
+
+    return run_spmd(mesh, local, P(ax), P(ax))
+
+
+def periodic_poisson3d_fft(
+    b_world: np.ndarray, mesh: Optional[Mesh] = None, impl: str = "auto"
+):
+    """Solve ``A x = b - mean(b)`` for the periodic 7-point Laplacian —
+    :func:`periodic_poisson_fft` one dimension up, over the 3D pencil
+    FFT (`parallel.fft.fft3_sharded_pair`): z-slabs sharded on a 1D
+    mesh, ONE all_to_all per transform direction, sin²-form eigenvalues
+    ``4 sin²(πk/Z) + 4 sin²(πl/Y) + 4 sin²(πm/X)``. Direct (one round
+    trip, machine-precision residual) where multigrid3d iterates — the
+    two are cross-checked in tests. Complex-free: runs the (re, im)
+    pair path on every backend (``impl='dft'``/'auto'; 'xla' uses it
+    too — the complex 3D path exists for parity but the solver needs
+    only the pair form)."""
+    if impl not in ("auto", "dft", "xla"):
+        raise ValueError(f"impl must be auto|xla|dft, got {impl!r}")
+    mesh = mesh if mesh is not None else make_mesh_1d("x")
+    (ax,) = mesh.axis_names
+    n = mesh.devices.size
+    gz, gy, gx = b_world.shape
+    if gz % n or gy % n:
+        raise ValueError(
+            f"grid {b_world.shape} needs Z and Y divisible by the "
+            f"{n}-device mesh (Z for the shard, Y for the transpose)"
+        )
+    program = _spectral3_program(mesh, ax, n, gz, gy, gx)
+    return np.asarray(program(jnp.asarray(b_world)))
+
+
+@functools.lru_cache(maxsize=32)
+def _spectral3_program(mesh, ax, n, gz, gy, gx):
+    def inv_eigenvalues(d):
+        # pencil layout (X, Y/n, Z): kx full, ky this device's shard, kz full
+        m = jnp.arange(gx, dtype=jnp.float32)
+        l = d * (gy // n) + jnp.arange(gy // n, dtype=jnp.float32)
+        k = jnp.arange(gz, dtype=jnp.float32)
+        lam = (
+            4.0 * jnp.sin(jnp.pi * m / gx)[:, None, None] ** 2
+            + 4.0 * jnp.sin(jnp.pi * l / gy)[None, :, None] ** 2
+            + 4.0 * jnp.sin(jnp.pi * k / gz)[None, None, :] ** 2
+        )
+        singular = (
+            (m == 0)[:, None, None]
+            & (l == 0)[None, :, None]
+            & (k == 0)[None, None, :]
+        )
+        return jnp.where(singular, 0.0, 1.0 / jnp.where(singular, 1.0, lam))
+
+    def local(b):
+        inv = inv_eigenvalues(lax.axis_index(ax))
+        re, im = fft3_sharded_pair(
+            b, jnp.zeros_like(b), ax, restore_layout=False
+        )
+        re, _ = ifft3_from_pencil_pair(re * inv, im * inv, ax)
+        return re.astype(b.dtype)
 
     return run_spmd(mesh, local, P(ax), P(ax))
