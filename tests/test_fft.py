@@ -266,7 +266,8 @@ class TestFFT3:
         prog = run_spmd(mesh, round_trip, P("x"), P("x"))
         assert np.allclose(np.asarray(prog(jnp.asarray(x))), x, atol=1e-4)
 
-    def test_poisson3d_fft_solves_and_matches_multigrid(self, devices):
+    @pytest.mark.parametrize("impl", ["xla", "dft"])
+    def test_poisson3d_fft_solves_and_matches_multigrid(self, devices, impl):
         from tpuscratch.runtime.mesh import make_mesh
         from tpuscratch.solvers import periodic_poisson3d_fft
         from tpuscratch.solvers.multigrid3d import mg_poisson3d_solve
@@ -274,7 +275,7 @@ class TestFFT3:
         rng = np.random.default_rng(13)
         b = rng.standard_normal((16, 16, 16)).astype(np.float32)
         b -= b.mean()
-        x_sp = periodic_poisson3d_fft(b, make_mesh_1d("x", 8))
+        x_sp = periodic_poisson3d_fft(b, make_mesh_1d("x", 8), impl=impl)
         # residual oracle: 7-point periodic Laplacian
         lap = 6 * x_sp.astype(np.float64) - sum(
             np.roll(x_sp.astype(np.float64), s, a)

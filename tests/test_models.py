@@ -174,3 +174,47 @@ def test_bf16_compute_dtype_trains(devices):
     assert all(
         leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(params)
     ), "master params must remain f32"
+
+
+def test_adam_step_sharding_invariance(devices):
+    """The Adam step is mesh-shape-invariant: 1x1 and 2x2 meshes produce
+    the same params and moments (the moments genuinely shard over dp on
+    the expert axis — elementwise updates compose with the sharding)."""
+    import jax
+
+    from tpuscratch.models import (
+        TransformerConfig,
+        init_adam_state,
+        init_params,
+        train_step_adam,
+    )
+    from tpuscratch.runtime.mesh import make_mesh
+
+    cfg = TransformerConfig(
+        d_model=16, n_heads=2, n_experts=2, d_ff=32, capacity_factor=2.0
+    )
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 16, 16)).astype(np.float32))
+    y = 0.5 * x
+    outs = []
+    for dims in ((1, 1), (2, 2)):
+        params = init_params(9, cfg)
+        opt = init_adam_state(params)
+        step = train_step_adam(make_mesh(dims, ("dp", "sp")), cfg, lr=1e-3)
+        for _ in range(3):
+            params, opt, loss = step(params, opt, x, y)
+        outs.append((params, opt, float(loss)))
+    (p1, o1, l1), (p2, o2, l2) = outs
+    # looser than the SGD invariance test: Adam's m/(sqrt(v)+eps) with
+    # tiny early v amplifies the f32 psum reduction-order differences
+    # between mesh shapes by orders of magnitude (measured ~4e-5 on the
+    # loss after 3 steps); the check is about routing, not ulp parity
+    assert abs(l1 - l2) < 3e-4
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-3, atol=1e-4
+        )
+    for a, b in zip(jax.tree.leaves(o1), jax.tree.leaves(o2)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-3, atol=1e-4
+        )

@@ -83,3 +83,35 @@ def test_mismatched_shape_or_config_resume_rejected(devices, tmp_path):
     )
     with pytest.raises(ValueError, match="resume mismatch"):
         train(mesh, cfg2, steps=10, ckpt_dir=d, save_every=5, batch=4, seq=16)
+
+
+def test_adam_trains_and_resumes_bit_identical(devices, tmp_path):
+    """Adam: moments shard like their params, descend the loss, and the
+    FULL training state (params + moments + step count) round-trips
+    through the checkpoint so resume is bit-identical."""
+    mesh, cfg = _mesh(), _cfg()
+    kw = dict(save_every=5, lr=0.005, seed=5, optimizer="adam")
+    params_straight, rep = train(
+        mesh, cfg, steps=20, ckpt_dir=str(tmp_path / "as"), **kw
+    )
+    assert rep.losses[-1] < rep.losses[0]
+    inter = str(tmp_path / "ai")
+    train(mesh, cfg, steps=10, ckpt_dir=inter, **kw)
+    params_resumed, rep2 = train(mesh, cfg, steps=20, ckpt_dir=inter, **kw)
+    assert rep2.steps_run == 10
+    for a, b in zip(
+        jax.tree.leaves(params_straight), jax.tree.leaves(params_resumed)
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_optimizer_mismatch_rejected(devices, tmp_path):
+    import pytest
+
+    mesh, cfg = _mesh(), _cfg()
+    d = str(tmp_path / "om")
+    train(mesh, cfg, steps=5, ckpt_dir=d, save_every=5, optimizer="adam",
+          lr=0.005)
+    with pytest.raises(ValueError, match="resume mismatch"):
+        train(mesh, cfg, steps=10, ckpt_dir=d, save_every=5,
+              optimizer="sgd", lr=0.005)

@@ -10,9 +10,11 @@ parallel/* modules exist to make possible.
 
 from tpuscratch.models.transformer import (  # noqa: F401
     TransformerConfig,
+    init_adam_state,
     init_params,
     model_apply,
     train_step,
+    train_step_adam,
 )
 from tpuscratch.models.ssm import SSMConfig, ssm_block  # noqa: F401
 from tpuscratch.models.ssm import init_params as init_ssm_params  # noqa: F401

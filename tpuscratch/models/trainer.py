@@ -31,8 +31,10 @@ from jax.sharding import Mesh
 
 from tpuscratch.models.transformer import (
     TransformerConfig,
+    init_adam_state,
     init_params,
     train_step,
+    train_step_adam,
 )
 from tpuscratch.runtime import checkpoint
 
@@ -79,6 +81,7 @@ def train(
     ckpt_dir: str,
     *,
     lr: float = 0.05,
+    optimizer: str = "sgd",
     save_every: int = 10,
     batch: Optional[int] = None,
     seq: Optional[int] = None,
@@ -87,15 +90,21 @@ def train(
     log: Callable[[str], None] = lambda s: None,
 ) -> tuple[dict, TrainReport]:
     """Run (or resume) ``steps`` training steps, checkpointing every
-    ``save_every``. Returns (params, report)."""
+    ``save_every``. Returns (params, report). ``optimizer`` is 'sgd' or
+    'adam'; Adam's moment state is checkpointed WITH the params (the
+    full training state, sharded like the params), so resume is
+    bit-identical for both."""
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
+    if optimizer not in ("sgd", "adam"):
+        raise ValueError(f"optimizer must be sgd|adam, got {optimizer!r}")
     dp_n = mesh.shape["dp"]
     sp_n = mesh.shape["sp"]
     batch = batch if batch is not None else 2 * dp_n
     seq = seq if seq is not None else 8 * sp_n
 
     params = init_params(seed, cfg)
+    opt = init_adam_state(params) if optimizer == "adam" else None
     start = 0
     if checkpoint.latest_step(ckpt_dir) is not None:
         # the bit-identical contract only holds if the resumed run replays
@@ -105,6 +114,11 @@ def train(
         # so an architecture change surfaces as this error, not as a
         # leaf-count mismatch from restore.
         start, meta = checkpoint.peek_metadata(ckpt_dir)
+        # pre-optimizer checkpoints hold bare params and trained with
+        # SGD (the only format that existed): make that explicit so an
+        # adam resume against one fails as a clear mismatch instead of
+        # a leaf-count error from restore
+        meta.setdefault("optimizer", "sgd")
         if start > steps:
             raise ValueError(
                 f"checkpoint in {ckpt_dir} is at step {start}, beyond the "
@@ -112,7 +126,7 @@ def train(
             )
         for key, val in (
             ("lr", lr), ("seed", seed), ("batch", batch), ("seq", seq),
-            ("cfg", _cfg_fingerprint(cfg)),
+            ("cfg", _cfg_fingerprint(cfg)), ("optimizer", optimizer),
         ):
             if key not in meta:
                 # legacy checkpoint (pre-dates this key): resumable, but
@@ -132,10 +146,18 @@ def train(
                     f"resume mismatch: checkpoint has {key}={meta[key]}, "
                     f"this run asked for {val} (use a fresh ckpt_dir)"
                 )
-        params, start, meta = checkpoint.restore(ckpt_dir, params, step=start)
+        state = {"params": params, "opt": opt} if opt is not None else params
+        state, start, meta = checkpoint.restore(ckpt_dir, state, step=start)
+        if opt is not None:
+            params, opt = state["params"], state["opt"]
+        else:
+            params = state
         log(f"resumed at step {start} (meta {meta})")
 
-    step_fn = train_step(mesh, cfg, lr=lr)
+    if optimizer == "adam":
+        adam_fn = train_step_adam(mesh, cfg, lr=lr)
+    else:
+        sgd_fn = train_step(mesh, cfg, lr=lr)
     losses = []
     ran = 0
     while start < steps:
@@ -143,16 +165,23 @@ def train(
         loss = None
         for i in range(chunk):
             x, y = synthetic_batch(seed, start + i, batch, seq, cfg.d_model)
-            params, loss = step_fn(params, x, y)
+            if optimizer == "adam":
+                params, opt, loss = adam_fn(params, opt, x, y)
+            else:
+                params, loss = sgd_fn(params, x, y)
         start += chunk
         ran += chunk
         loss_f = float(jax.block_until_ready(loss))
         losses.append(loss_f)
+        state = (
+            {"params": params, "opt": opt} if opt is not None else params
+        )
         checkpoint.save(
-            ckpt_dir, start, jax.tree.map(np.asarray, params),
+            ckpt_dir, start, jax.tree.map(np.asarray, state),
             metadata={
                 "steps_total": steps, "lr": lr, "seed": seed,
                 "batch": batch, "seq": seq, "cfg": _cfg_fingerprint(cfg),
+                "optimizer": optimizer,
             },
         )
         checkpoint.prune(ckpt_dir, keep)

@@ -215,21 +215,85 @@ def train_step_fn(cfg: TransformerConfig, lr: float = 1e-2,
     return step
 
 
-def train_step(
+def init_adam_state(params) -> dict:
+    """Fresh Adam moments, laid out EXACTLY like the params — expert-leaf
+    moments carry the leading (n_experts,) axis and shard over ``dp``
+    with their leaves (optimizer-state sharding: each device stores the
+    first/second moments only for the expert slices it owns — the
+    ZeRO-flavored placement a replicated optimizer would waste
+    dp-times the memory on)."""
+    return {
+        "mu": jax.tree.map(jnp.zeros_like, params),
+        "nu": jax.tree.map(jnp.zeros_like, params),
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def adam_state_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
+    """PartitionSpec pytree for :func:`init_adam_state`'s output."""
+    return {
+        "mu": param_spec(cfg, dp),
+        "nu": param_spec(cfg, dp),
+        "t": P(),
+    }
+
+
+def train_step_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
+                       b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                       sp: str = "sp", dp: str = "dp"):
+    """The shard_map body: (params, opt, x, y) -> (params, opt, loss).
+
+    Adam is elementwise, so the per-shard update composes with any
+    sharding as long as the moments shard like the params (they do, by
+    construction); the cross-rank math is all in ``_grad_reduce``."""
+
+    def step(params, opt, x, y):
+        loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
+        grads = _grad_reduce(grads, dp, sp)
+        t = opt["t"] + 1
+        mu = jax.tree.map(
+            lambda m, g: b1 * m + (1.0 - b1) * g, opt["mu"], grads
+        )
+        nu = jax.tree.map(
+            lambda v, g: b2 * v + (1.0 - b2) * g * g, opt["nu"], grads
+        )
+        # bias correction folded into the step size (scalar, traced once)
+        tf = t.astype(jnp.float32)
+        alpha = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+        new_params = jax.tree.map(
+            lambda w, m, v: w - alpha * m / (jnp.sqrt(v) + eps),
+            params, mu, nu,
+        )
+        return new_params, {"mu": mu, "nu": nu, "t": t}, loss
+
+    return step
+
+
+def train_step_adam(
     mesh: Mesh,
     cfg: TransformerConfig,
-    lr: float = 1e-2,
+    lr: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
     dp: str = "dp",
     sp: str = "sp",
 ):
-    """Compiled training step over ``mesh`` (axes ``dp`` x ``sp``).
+    """:func:`train_step` with Adam: jit'd fn(params, opt_state, x, y)
+    -> (params, opt_state, loss); ``opt_state`` from
+    :func:`init_adam_state`, moments sharded like their params."""
+    _validate_step_config(mesh, cfg, dp, sp)
+    pspec = param_spec(cfg, dp)
+    ospec = adam_state_spec(cfg, dp)
+    return run_spmd(
+        mesh,
+        train_step_adam_fn(cfg, lr, b1, b2, eps, sp=sp, dp=dp),
+        (pspec, ospec, P(dp, sp), P(dp, sp)),
+        (pspec, ospec, P()),
+    )
 
-    Returns jit'd fn(params, x, y) -> (new_params, loss) with x, y
-    (batch, seq, d_model) sharded P(dp, sp) and params laid out by
-    ``param_spec``. The full composed surface — ring attention over sp,
-    expert all_to_all over dp, grad, psum totals, SGD — is ONE XLA
-    program.
-    """
+
+def _validate_step_config(mesh, cfg: TransformerConfig, dp: str, sp: str):
     n_dp = mesh.shape[dp]
     if cfg.n_experts % n_dp:
         raise ValueError(
@@ -245,6 +309,24 @@ def train_step(
             f"ulysses-pallas needs n_heads {cfg.n_heads} divisible by "
             f"sp size {mesh.shape[sp]}"
         )
+
+
+def train_step(
+    mesh: Mesh,
+    cfg: TransformerConfig,
+    lr: float = 1e-2,
+    dp: str = "dp",
+    sp: str = "sp",
+):
+    """Compiled training step over ``mesh`` (axes ``dp`` x ``sp``).
+
+    Returns jit'd fn(params, x, y) -> (new_params, loss) with x, y
+    (batch, seq, d_model) sharded P(dp, sp) and params laid out by
+    ``param_spec``. The full composed surface — ring attention over sp,
+    expert all_to_all over dp, grad, psum totals, SGD — is ONE XLA
+    program.
+    """
+    _validate_step_config(mesh, cfg, dp, sp)
     pspec = param_spec(cfg, dp)
     return run_spmd(
         mesh,

@@ -377,6 +377,17 @@ def ifft3_from_pencil_pair(re, im, axis_name: str, method: str = "auto"):
     return _pair_last(re, im, True, method)                         # X
 
 
+def ifft3_from_pencil(pencil: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Complex-dtype inverse 3D FFT from the (X, Y/n, Z) pencil — the
+    `jnp.fft` sibling of :func:`ifft3_from_pencil_pair`."""
+    z = jnp.fft.ifft(pencil, axis=2)                                # Z
+    z = jnp.transpose(z, (2, 0, 1))                                 # (Z, X, Y/n)
+    z = lax.all_to_all(z, axis_name, split_axis=0, concat_axis=2, tiled=True)
+    z = jnp.fft.ifft(z, axis=2)                                     # Y
+    z = jnp.swapaxes(z, 1, 2)                                       # (Z/n, Y, X)
+    return jnp.fft.ifft(z, axis=2)                                  # X
+
+
 def fft3_sharded(
     local: jnp.ndarray,
     axis_name: str,

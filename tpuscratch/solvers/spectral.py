@@ -34,9 +34,11 @@ from tpuscratch.parallel.fft import (
     complex_supported,
     fft2_sharded,
     fft2_sharded_pair,
+    fft3_sharded,
     fft3_sharded_pair,
     ifft2_from_pencil,
     ifft2_from_pencil_pair,
+    ifft3_from_pencil,
     ifft3_from_pencil_pair,
 )
 from tpuscratch.runtime.mesh import make_mesh_1d
@@ -117,11 +119,14 @@ def periodic_poisson3d_fft(
     mesh, ONE all_to_all per transform direction, sin²-form eigenvalues
     ``4 sin²(πk/Z) + 4 sin²(πl/Y) + 4 sin²(πm/X)``. Direct (one round
     trip, machine-precision residual) where multigrid3d iterates — the
-    two are cross-checked in tests. Complex-free: runs the (re, im)
-    pair path on every backend (``impl='dft'``/'auto'; 'xla' uses it
-    too — the complex 3D path exists for parity but the solver needs
-    only the pair form)."""
-    if impl not in ("auto", "dft", "xla"):
+    two are cross-checked in tests. Same backend contract as the 2D
+    solver: ``impl='xla'`` uses complex64 `jnp.fft`
+    (`fft3_sharded`), ``'dft'`` the (re, im) pair path (required on
+    complex-free TPU runtimes), ``'auto'`` picks by
+    :func:`parallel.fft.complex_supported`."""
+    if impl == "auto":
+        impl = "xla" if complex_supported() else "dft"
+    if impl not in ("dft", "xla"):
         raise ValueError(f"impl must be auto|xla|dft, got {impl!r}")
     mesh = mesh if mesh is not None else make_mesh_1d("x")
     (ax,) = mesh.axis_names
@@ -132,12 +137,12 @@ def periodic_poisson3d_fft(
             f"grid {b_world.shape} needs Z and Y divisible by the "
             f"{n}-device mesh (Z for the shard, Y for the transpose)"
         )
-    program = _spectral3_program(mesh, ax, n, gz, gy, gx)
+    program = _spectral3_program(mesh, ax, n, gz, gy, gx, impl)
     return np.asarray(program(jnp.asarray(b_world)))
 
 
 @functools.lru_cache(maxsize=32)
-def _spectral3_program(mesh, ax, n, gz, gy, gx):
+def _spectral3_program(mesh, ax, n, gz, gy, gx, impl):
     def inv_eigenvalues(d):
         # pencil layout (X, Y/n, Z): kx full, ky this device's shard, kz full
         m = jnp.arange(gx, dtype=jnp.float32)
@@ -157,10 +162,13 @@ def _spectral3_program(mesh, ax, n, gz, gy, gx):
 
     def local(b):
         inv = inv_eigenvalues(lax.axis_index(ax))
-        re, im = fft3_sharded_pair(
-            b, jnp.zeros_like(b), ax, restore_layout=False
-        )
-        re, _ = ifft3_from_pencil_pair(re * inv, im * inv, ax)
-        return re.astype(b.dtype)
+        if impl == "dft":
+            re, im = fft3_sharded_pair(
+                b, jnp.zeros_like(b), ax, restore_layout=False
+            )
+            re, _ = ifft3_from_pencil_pair(re * inv, im * inv, ax)
+            return re.astype(b.dtype)
+        hat = fft3_sharded(b, ax, restore_layout=False)  # (X, Y/n, Z)
+        return jnp.real(ifft3_from_pencil(hat * inv, ax)).astype(b.dtype)
 
     return run_spmd(mesh, local, P(ax), P(ax))
