@@ -269,7 +269,7 @@ class Test26Neighbors:
             expect = new
         assert np.allclose(got, expect, atol=1e-4)
 
-    def test_27_point_rejects_face_only_spec_and_compact(self, devices):
+    def test_27_point_rejects_face_only_spec_and_kernel_computes(self, devices):
         import jax.numpy as jnp
 
         from tpuscratch.halo.halo3d import stencil_step3d
@@ -279,9 +279,61 @@ class Test26Neighbors:
         c27 = (0.01,) * 26 + (0.0,)
         with pytest.raises(ValueError, match="neighbors=26"):
             stencil_step3d(jnp.zeros((4, 4, 4)), spec6, coeffs=c27)
-        with pytest.raises(ValueError, match="7-point only"):
+        # impl='compact' (xla compute) now SERVES 27-point (core-carry
+        # with edge/corner arrivals); only the 7-point banded kernels
+        # reject it
+        with pytest.raises(ValueError, match="compute='xla' only"):
             distributed_stencil3d(
                 np.zeros((4, 4, 4), np.float32), 1,
                 make_mesh((1, 1, 1), ("z", "row", "col")),
-                coeffs=c27, impl="compact",
+                coeffs=c27, impl="compact-strips",
+            )
+
+
+class TestCompact27:
+    """27-point core-carry: the compact path's edge/corner arrivals must
+    reproduce the padded 26-neighbor executor exactly."""
+
+    @pytest.mark.parametrize("periodic", [True, False])
+    def test_compact27_equals_padded(self, devices, periodic):
+        rng = np.random.default_rng(27)
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
+        w = np.linspace(0.01, 0.26, 26)
+        coeffs = tuple(w) + (0.3,)
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        a = distributed_stencil3d(world, 3, mesh, coeffs=coeffs,
+                                  periodic=periodic, impl="compact")
+        b = distributed_stencil3d(world, 3, mesh, coeffs=coeffs,
+                                  periodic=periodic, impl="padded")
+        assert np.allclose(a, b, atol=1e-5)
+
+    def test_compact27_single_device_roll_oracle(self, devices):
+        from tpuscratch.halo.halo3d import OFFSETS26
+
+        rng = np.random.default_rng(28)
+        world = rng.standard_normal((6, 8, 8)).astype(np.float32)
+        w = np.linspace(0.01, 0.26, 26)
+        coeffs = tuple(w) + (0.3,)
+        got = distributed_stencil3d(
+            world, 2, make_mesh((1, 1, 1), ("z", "row", "col")),
+            coeffs=coeffs, impl="compact",
+        )
+        expect = world.astype(np.float64)
+        for _ in range(2):
+            new = 0.3 * expect
+            for (dz, dy, dx), ww in zip(OFFSETS26, w):
+                new = new + ww * np.roll(
+                    np.roll(np.roll(expect, -dz, 0), -dy, 1), -dx, 2
+                )
+            expect = new
+        assert np.allclose(got, expect, atol=1e-4)
+
+    def test_compact27_rejects_kernel_computes(self, devices):
+        rng = np.random.default_rng(29)
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
+        coeffs = (0.01,) * 26 + (0.3,)
+        with pytest.raises(ValueError, match="compute='xla' only"):
+            distributed_stencil3d(
+                world, 1, make_mesh((1, 1, 1), ("z", "row", "col")),
+                coeffs=coeffs, impl="compact-asm",
             )

@@ -46,24 +46,47 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
 
 
 def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
-                             lr: float = 1e-3):
+                             lr: float = 1e-3, optimizer: str = "sgd"):
     """jit'd fn(params, x, y) -> (params, loss) running ``steps`` train
-    steps in one scan (the data is reused — throughput, not learning)."""
+    steps in one scan (the data is reused — throughput, not learning).
+    ``optimizer='adam'`` carries the moment state through the scan too
+    (initialized fresh inside the program — throughput, not a resumable
+    run)."""
     from jax.sharding import PartitionSpec as P
 
     from tpuscratch.comm import run_spmd
+    from tpuscratch.models.transformer import (
+        init_adam_state,
+        train_step_adam_fn,
+    )
 
-    step = train_step_fn(cfg, lr=lr)
+    if optimizer not in ("sgd", "adam"):
+        raise ValueError(f"optimizer must be sgd|adam, got {optimizer!r}")
+    if optimizer == "adam":
+        step = train_step_adam_fn(cfg, lr=lr)
 
-    def body(params, x, y):
-        # params are the loop carry: every step reads the previous
-        # step's SGD update, so the scan cannot be collapsed or hoisted
-        def one(p, _):
-            p, loss = step(p, x, y)
-            return p, loss
+        def body(params, x, y):
+            def one(carry, _):
+                p, o = carry
+                p, o, loss = step(p, o, x, y)
+                return (p, o), loss
 
-        params, losses = lax.scan(one, params, None, length=steps)
-        return params, losses[-1]
+            (params, _), losses = lax.scan(
+                one, (params, init_adam_state(params)), None, length=steps
+            )
+            return params, losses[-1]
+    else:
+        step = train_step_fn(cfg, lr=lr)
+
+        def body(params, x, y):
+            # params are the loop carry: every step reads the previous
+            # step's update, so the scan cannot be collapsed or hoisted
+            def one(p, _):
+                p, loss = step(p, x, y)
+                return p, loss
+
+            params, losses = lax.scan(one, params, None, length=steps)
+            return params, losses[-1]
 
     pspec = param_spec(cfg)
     return run_spmd(
@@ -83,6 +106,7 @@ def bench_train(
     iters: int = 3,
     fence: str = "readback",
     seed: int = 0,
+    optimizer: str = "sgd",
 ) -> BenchResult:
     """tokens/s of the composed train step; items = tokens processed."""
     from tpuscratch.runtime.mesh import make_mesh
@@ -110,7 +134,7 @@ def bench_train(
     x = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32))
     y = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32))
     params = init_params(seed, cfg)
-    prog = train_throughput_program(mesh, cfg, steps)
+    prog = train_throughput_program(mesh, cfg, steps, optimizer=optimizer)
     # correctness gate doubles as compile warmup: the loss must be finite
     out_params, loss = prog(params, x, y)
     if not np.isfinite(float(loss)):
@@ -120,8 +144,8 @@ def bench_train(
         prog, params, x, y, iters=iters, warmup=1, fence=fence,
         name=(
             f"train d{cfg.d_model} ff{cfg.d_ff} L{cfg.n_layers} "
-            f"e{cfg.n_experts} {cfg.compute_dtype} b{batch} s{seq} "
-            f"x{steps} on {mesh.shape['dp']}x{mesh.shape['sp']} "
+            f"e{cfg.n_experts} {cfg.compute_dtype} {optimizer} b{batch} "
+            f"s{seq} x{steps} on {mesh.shape['dp']}x{mesh.shape['sp']} "
             f"({cfg.attn_impl})"
         ),
         items=tokens,

@@ -268,22 +268,30 @@ def run_stencil3d(
 def stencil_step3d_compact(
     core: jnp.ndarray, spec: HaloSpec3D, coeffs=JACOBI7, compute: str = "xla"
 ) -> jnp.ndarray:
-    """One exchange + 7-point update carrying the CORE only — the fast
-    path. The padded-carry step pays 6 sequential full-tile
+    """One exchange + stencil update carrying the CORE only — the fast
+    path. The padded-carry step pays sequential full-tile
     dynamic_update_slices per exchange — each a full HBM pass; here the
-    padded tile is materialized ONCE by nested concatenation of the 6
-    arrival planes around the core (edge/corner lines are zeros — a
-    7-point stencil never reads them) and the 7 shifted reads fuse into
-    the weighted sum. Measured on v5e at 256x512x512: 5.0 ms/step
-    marginal vs 8.2 for the padded path (1.6x). (A first attempt that
-    built SIX full-size shifted arrays by concat was ~10% SLOWER than
-    padded — XLA materializes each concat — hence the single-padded-tile
-    shape.) Same numbers as the padded path (tests assert equality): on
-    open boundaries the missing arrivals are ppermute zeros, which equal
-    the zero ghosts the padded path keeps.
+    padded tile is materialized ONCE by nested concatenation of the
+    arrival pieces around the core and the shifted reads fuse into the
+    weighted sum. 7-point coeffs ship 6 face planes (edge/corner lines
+    are zeros — never read); 27-point coeffs ship all 26 pieces (faces +
+    12 edge lines + 8 corner points), each one diagonal ppermute hop —
+    the core-carry twin of the padded 26-neighbor path, ``compute='xla'``
+    only (the banded kernels are 7-point). Same numbers as the padded
+    path (tests assert equality): on open boundaries the missing
+    arrivals are ppermute zeros, which equal the zero ghosts the padded
+    path keeps.
     """
-    if len(coeffs) != 7:
-        raise ValueError(f"need 6 face + 1 center coeffs, got {len(coeffs)}")
+    if len(coeffs) not in (7, 27):
+        raise ValueError(
+            f"need 6+1 or 26+1 coeffs (FACES/OFFSETS26 + center), "
+            f"got {len(coeffs)}"
+        )
+    if len(coeffs) == 27 and compute != "xla":
+        raise ValueError(
+            f"27-point compact supports compute='xla' only, got {compute!r} "
+            "(the banded Pallas kernels are 7-point)"
+        )
     if spec.layout.halo != (1, 1, 1):
         raise ValueError(
             f"compact step supports halo (1,1,1), got {spec.layout.halo}"
@@ -293,22 +301,30 @@ def stencil_step3d_compact(
     cz, cy, cx = core.shape
 
     def arrival(d):
-        """The plane my d-neighbor sends (its far side along -d)."""
-        axis = next(a for a in range(3) if d[a])
+        """The sub-block my d-neighbor sends (its far side along -d) —
+        a face plane, edge line, or corner point by d's rank."""
         flow = tuple(-x for x in d)
-        take = (slice(None),) * axis + (
-            slice(-1, None) if flow[axis] > 0 else slice(0, 1),
+        take = tuple(
+            slice(None) if d[a] == 0
+            else (slice(-1, None) if flow[a] > 0 else slice(0, 1))
+            for a in range(3)
         )
-        if topo.dims[axis] == 1 and topo.periodic[axis]:
-            # degenerate periodic axis: the neighbor is myself, so the
-            # ghost plane is my own far plane — skip the collective (6
-            # per-step self-ppermutes measured ~1.2 ms/step of pure
-            # launch overhead at 256x512x512 on v5e; the 3D analogue of
-            # run_stencil_resident's self-wrap)
+        if all(
+            topo.dims[a] == 1 and topo.periodic[a]
+            for a in range(3) if d[a]
+        ):
+            # every nonzero axis degenerate periodic: the neighbor is
+            # myself, the ghost block is my own far block — skip the
+            # collective (6 per-step self-ppermutes measured ~1.2
+            # ms/step of pure launch overhead at 256x512x512 on v5e;
+            # the 3D analogue of run_stencil_resident's self-wrap)
             return core[take]
         return lax.ppermute(
             core[take], axes, list(topo.send_permutation(flow))
         )
+
+    if len(coeffs) == 27:
+        return _compact27(core, coeffs, arrival)
 
     a_mz, a_pz, a_my, a_py, a_mx, a_px = (arrival(d) for d in FACES)
 
@@ -372,6 +388,39 @@ def stencil_step3d_compact(
     return new
 
 
+def _compact27(core: jnp.ndarray, coeffs, arrival) -> jnp.ndarray:
+    """27-point core-carry update: ONE padded tile from all 26 arrival
+    pieces by nested concatenation (corner points seat the corners the
+    7-point build zero-fills), then the 27 shifted reads fuse into the
+    weighted sum."""
+    cz, cy, cx = core.shape
+    A = {d: arrival(d) for d in OFFSETS26}
+
+    def rx(dz, dy):
+        return jnp.concatenate(
+            [A[(dz, dy, -1)], A[(dz, dy, 0)], A[(dz, dy, 1)]], axis=2
+        )
+
+    plane_m = jnp.concatenate([rx(-1, -1), rx(-1, 0), rx(-1, 1)], axis=1)
+    plane_p = jnp.concatenate([rx(1, -1), rx(1, 0), rx(1, 1)], axis=1)
+    mid = jnp.concatenate(
+        [
+            jnp.concatenate([A[(0, -1, -1)], A[(0, -1, 0)], A[(0, -1, 1)]], axis=2),
+            jnp.concatenate([A[(0, 0, -1)], core, A[(0, 0, 1)]], axis=2),
+            jnp.concatenate([A[(0, 1, -1)], A[(0, 1, 0)], A[(0, 1, 1)]], axis=2),
+        ],
+        axis=1,
+    )
+    u = jnp.concatenate([plane_m, mid, plane_p], axis=0)
+    sl = lambda dz, dy, dx: u[  # noqa: E731
+        1 + dz : 1 + dz + cz, 1 + dy : 1 + dy + cy, 1 + dx : 1 + dx + cx
+    ]
+    new = coeffs[-1] * sl(0, 0, 0)
+    for d, w in zip(OFFSETS26, coeffs[:-1]):
+        new = new + w * sl(*d)
+    return new
+
+
 def run_stencil3d_compact(
     core: jnp.ndarray,
     spec: HaloSpec3D,
@@ -431,10 +480,11 @@ def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
     (decompose3d)."""
     if impl not in IMPLS3D:
         raise ValueError(f"unknown 3D stencil impl {impl!r}; have {IMPLS3D}")
-    if impl.startswith("compact") and len(coeffs) != 7:
+    if impl.startswith("compact") and len(coeffs) == 27 and impl != "compact":
         raise ValueError(
-            f"compact impls are 7-point only ({len(coeffs)} coeffs given); "
-            "use impl='padded' for 27-point stencils"
+            f"27-point compact supports compute='xla' only, got {impl!r} "
+            "(the banded Pallas kernels are 7-point); use impl='compact' "
+            "or 'padded'"
         )
     if impl.startswith("compact"):
         compute = _COMPACT_COMPUTE[impl]
@@ -514,7 +564,7 @@ def distributed_stencil3d(
     if impl is None:
         impl = (
             "compact"
-            if tuple(halo) == (1, 1, 1) and len(coeffs) == 7
+            if tuple(halo) == (1, 1, 1) and len(coeffs) in (7, 27)
             else "padded"
         )
     if impl.startswith("compact") and tuple(halo) != (1, 1, 1):
