@@ -193,7 +193,10 @@ class TestFourStep:
     def test_split_balanced_and_prime(self):
         from tpuscratch.parallel.fft import _split
 
-        assert _split(1024) == (32, 32)
+        # >= 1024 with 128 | n: lane-perfect n2=128 (chip-raced winner);
+        # balanced otherwise
+        assert _split(1024) == (8, 128)
+        assert _split(4096) == (32, 128)
         assert _split(8192) == (64, 128)
         assert _split(96) == (8, 12)
         assert _split(13) is None

