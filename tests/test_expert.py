@@ -159,3 +159,89 @@ class TestExpertParallelFFN:
         assert np.isfinite(np.asarray(loss))
         assert np.isfinite(np.asarray(g_in)).all()
         assert np.abs(np.asarray(g_out)).sum() > 0
+
+
+class TestSparseImpl:
+    """sparse (gather/scatter) routing must compute the IDENTICAL
+    assignment as the one-hot einsum formulation — forward and
+    gradients."""
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_sparse_equals_einsum_forward(self, devices, k):
+        from tpuscratch.comm import run_spmd
+        from tpuscratch.parallel.expert import expert_parallel_ffn
+        from tpuscratch.runtime.mesh import make_mesh_1d
+
+        n = 4
+        mesh = make_mesh_1d("ep", n)
+        rng = np.random.default_rng(40)
+        T, D, F = 8 * n, 16, 32
+        x = jnp.asarray(rng.standard_normal((T, D)).astype(np.float32))
+        gw = jnp.asarray(rng.standard_normal((D, n)).astype(np.float32))
+        wi = jnp.asarray(
+            (rng.standard_normal((n, D, F)) * 0.1).astype(np.float32)
+        )
+        wo = jnp.asarray(
+            (rng.standard_normal((n, F, D)) * 0.1).astype(np.float32)
+        )
+        outs = {}
+        for impl in ("sparse", "einsum"):
+            prog = run_spmd(
+                mesh,
+                lambda x_, g, a, b, impl=impl: expert_parallel_ffn(
+                    x_, g, a, b, "ep", capacity_factor=1.5, k=k, impl=impl
+                ),
+                (P("ep"), P(), P("ep"), P("ep")),
+                (P("ep"), P()),
+            )
+            out, aux = prog(x, gw, wi, wo)
+            outs[impl] = (np.asarray(out), float(aux))
+        np.testing.assert_allclose(
+            outs["sparse"][0], outs["einsum"][0], rtol=1e-5, atol=1e-6
+        )
+        assert abs(outs["sparse"][1] - outs["einsum"][1]) < 1e-6
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_sparse_equals_einsum_gradients(self, devices, k):
+        import jax
+
+        from tpuscratch.comm import run_spmd
+        from tpuscratch.parallel.expert import expert_parallel_ffn
+        from tpuscratch.runtime.mesh import make_mesh_1d
+
+        n = 4
+        mesh = make_mesh_1d("ep", n)
+        rng = np.random.default_rng(41)
+        T, D, F = 8 * n, 16, 32
+        x = jnp.asarray(rng.standard_normal((T, D)).astype(np.float32))
+        gw = jnp.asarray(rng.standard_normal((D, n)).astype(np.float32))
+        wi = jnp.asarray(
+            (rng.standard_normal((n, D, F)) * 0.1).astype(np.float32)
+        )
+        wo = jnp.asarray(
+            (rng.standard_normal((n, F, D)) * 0.1).astype(np.float32)
+        )
+        grads = {}
+        for impl in ("sparse", "einsum"):
+            def loss(x_, g, a, b, impl=impl):
+                body = jax.shard_map(
+                    lambda xx, gg, aa, bb: expert_parallel_ffn(
+                        xx, gg, aa, bb, "ep", capacity_factor=1.5,
+                        k=k, impl=impl
+                    )[0],
+                    mesh=mesh,
+                    in_specs=(P("ep"), P(), P("ep"), P("ep")),
+                    out_specs=P("ep"),
+                    check_vma=False,
+                )
+                return (body(x_, g, a, b) ** 2).sum()
+
+            # all four inputs, gate_w included: the gate-weight backward
+            # goes through take_along_axis in both paths
+            grads[impl] = jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3)))(
+                x, gw, wi, wo
+            )
+        for a, b in zip(grads["sparse"], grads["einsum"]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            )
