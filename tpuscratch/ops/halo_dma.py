@@ -536,11 +536,26 @@ def _run_stencil_dma_deep(tile, spec, steps, coeffs9, depth, vmem_limit_bytes):
     Wp = -(-W // 128) * 128
     H2, W2 = H + 2 * k, W + 2 * k
 
-    need = (2 * H2 * W2 + 2 * H * W) * dt.itemsize
+    # the two padded buffers + pallas in/out dominate, but the recv/stage
+    # scratch (2-slot k-deep edge strips at lane-padded Wp/Hp, 8 corner
+    # recv blocks, 8 send stages) grows with k and must be counted or
+    # Mosaic fails with an opaque scoped-vmem error instead of this
+    # ValueError. Count every buffer at its (8, 128)-tile footprint —
+    # Mosaic allocates sublane-by-lane tiles, so a (H2, W2) buffer
+    # occupies roundup(H2, 8) x roundup(W2, 128) and a k-row strip
+    # occupies roundup(k, 8) rows: recv rows/cols 4k(Wp+Hp) + stages
+    # 2k(Wp+Hp) + corner recv 8*k*128 + corner stages 4*k*128
+    r8 = lambda x: -(-x // 8) * 8
+    r128 = lambda x: -(-x // 128) * 128
+    kp = r8(k)
+    scratch = 6 * kp * (Wp + Hp) + 12 * kp * 128
+    need = (
+        2 * r8(H2) * r128(W2) + 2 * r8(H) * r128(W) + scratch
+    ) * dt.itemsize
     if need > vmem_limit_bytes:
         raise ValueError(
-            f"padded core {H2}x{W2} x2 needs ~{need >> 20} MB VMEM "
-            f"(> limit {vmem_limit_bytes >> 20} MB)"
+            f"padded core {H2}x{W2} x2 + depth-{k} strip scratch needs "
+            f"~{need >> 20} MB VMEM (> limit {vmem_limit_bytes >> 20} MB)"
         )
 
     core = tile[lay.halo_y : lay.halo_y + H, lay.halo_x : lay.halo_x + W]
