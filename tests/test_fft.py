@@ -212,6 +212,43 @@ class TestFourStep:
         want = np.fft.fft(np.asarray(xr), axis=1)
         np.testing.assert_allclose(np.asarray(yr), want.real, atol=1e-4)
 
+    @pytest.mark.parametrize("axis", [0, 1])
+    @pytest.mark.parametrize("inverse", [False, True])
+    def test_eight_step_recursion_matches_numpy(self, monkeypatch, axis,
+                                                inverse):
+        # force the n1-side recursion at a small size (n=1024 -> n1=8 ->
+        # (2,4)) and check the full transform against numpy on both axes
+        from tpuscratch.parallel import fft as F
+
+        monkeypatch.setattr(F, "EIGHT_STEP_MIN", 4)
+        assert F._sub_split(8) == (2, 4)
+        rng = np.random.default_rng(9)
+        shape = (4, 1024) if axis == 1 else (1024, 4)
+        xr = rng.standard_normal(shape).astype(np.float32)
+        xi = rng.standard_normal(shape).astype(np.float32)
+        yr, yi = F._four_step_axis(
+            jnp.asarray(xr), jnp.asarray(xi), axis, inverse
+        )
+        z = xr + 1j * xi
+        want = (np.fft.ifft if inverse else np.fft.fft)(z, axis=axis)
+        scale = np.abs(want).max()
+        assert np.allclose(np.asarray(yr), want.real,
+                           atol=1e-5 * max(scale, 1.0))
+        assert np.allclose(np.asarray(yi), want.imag,
+                           atol=1e-5 * max(scale, 1.0))
+
+    def test_sub_split_threshold(self):
+        from tpuscratch.parallel import fft as F
+
+        # the chip race disabled the recursion by default...
+        assert F.EIGHT_STEP_MIN == 0
+        assert F._sub_split(64) is None
+        # ...but an explicit threshold re-enables it
+        assert F._sub_split(64, min_n=64) == (8, 8)
+        assert F._sub_split(128, min_n=64) == (8, 16)
+        assert F._sub_split(63, min_n=64) is None
+        assert F._sub_split(13, min_n=4) is None  # prime
+
 
 class TestFFT3:
     """3D pencil FFT (complex + pair paths) and the spectral 3D solver."""

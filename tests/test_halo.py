@@ -448,14 +448,58 @@ class TestDeepImpl:
         out_deep = np.asarray(deep(tiles_for(layk)))[:, :, k:-k, k:-k]
         np.testing.assert_allclose(out_deep, out_plain, rtol=1e-5, atol=1e-6)
 
-    def test_deep_rejects_open_boundary(self):
+    def test_deep_pallas_rejects_open_boundary(self):
+        # the in-kernel trapezoid stays periodic-only; the error names
+        # the open-boundary-aware xla fallback
         from tpuscratch.halo.stencil import run_stencil_deep
 
         topo = CartTopology((2, 4), (True, False))
         lay = TileLayout(4, 4, 2, 2)
         spec = HaloSpec(layout=lay, topology=topo)
-        with pytest.raises(ValueError, match="periodic"):
-            run_stencil_deep(jnp.zeros(lay.padded_shape), spec, 4)
+        with pytest.raises(ValueError, match="periodic-only"):
+            run_stencil_deep(jnp.zeros(lay.padded_shape), spec, 4,
+                             impl="pallas")
+
+    @pytest.mark.parametrize("periodic", [(False, False), (True, False),
+                                          (False, True)])
+    @pytest.mark.parametrize("depth,steps", [(2, 4), (2, 5), (3, 7)])
+    def test_deep_open_boundary_matches_plain(self, periodic, depth, steps):
+        # open edges keep MPI_PROC_NULL semantics (ghosts pinned at
+        # zero every substep): the trapezoid trajectory must equal the
+        # one-exchange-per-step path on the same open topology
+        from tpuscratch.halo.driver import decompose
+        from tpuscratch.halo.stencil import run_stencil_deep
+
+        R, C, TH, TW = 2, 4, 6, 5
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), periodic)
+        rng = np.random.default_rng(23)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+
+        def tiles_for(lay):
+            return jnp.asarray(decompose(world, topo, lay))
+
+        lay1 = TileLayout(TH, TW, 1, 1)
+        spec1 = HaloSpec(layout=lay1, topology=topo)
+        plain = run_spmd(
+            mesh,
+            lambda x: run_stencil(x[0, 0], spec1, steps)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        out_plain = np.asarray(plain(tiles_for(lay1)))[:, :, 1:-1, 1:-1]
+
+        layk = TileLayout(TH, TW, depth, depth)
+        speck = HaloSpec(layout=layk, topology=topo)
+        deep = run_spmd(
+            mesh,
+            lambda x: run_stencil_deep(x[0, 0], speck, steps)[None, None],
+            P("row", "col", None, None),
+            P("row", "col", None, None),
+        )
+        k = depth
+        out_deep = np.asarray(deep(tiles_for(layk)))[:, :, k:-k, k:-k]
+        np.testing.assert_allclose(out_deep, out_plain, rtol=1e-5, atol=1e-6)
 
     def test_deep_rejects_asymmetric_halo(self):
         from tpuscratch.halo.stencil import run_stencil_deep

@@ -218,6 +218,94 @@ class TestCompactImpl:
         assert np.allclose(got, expect, atol=1e-5)
 
 
+class TestStreamImpl:
+    """The deep-z streamed kernel (ops/stencil_stream.py): k substeps
+    fold into one manual-DMA pass; z-slab meshes only."""
+
+    @pytest.mark.parametrize("mesh_dims", [(1, 1, 1), (2, 1, 1), (4, 1, 1)])
+    @pytest.mark.parametrize("impl,steps", [
+        ("stream:2", 4), ("stream:4", 4), ("stream:3", 7), ("stream:2", 5),
+    ])
+    def test_stream_equals_compact_periodic(self, devices, mesh_dims,
+                                            impl, steps):
+        rng = np.random.default_rng(11)
+        # 32 deep: the per-rank core at mz=4 still fits depth 4
+        # (band >= depth needs cz >= 2 * depth)
+        world = rng.standard_normal((32, 8, 8)).astype(np.float32)
+        mesh = make_mesh(mesh_dims, ("z", "row", "col"))
+        a = distributed_stencil3d(world, steps, mesh, impl=impl)
+        b = distributed_stencil3d(world, steps, mesh, impl="compact")
+        assert np.allclose(a, b, atol=1e-5)
+
+    @pytest.mark.parametrize("mesh_dims", [(1, 1, 1), (2, 1, 1)])
+    def test_stream_open_z_equals_padded(self, devices, mesh_dims):
+        # an OPEN z end re-imposes its zero ghosts every folded substep
+        rng = np.random.default_rng(12)
+        world = rng.standard_normal((16, 8, 8)).astype(np.float32)
+        mesh = make_mesh(mesh_dims, ("z", "row", "col"))
+        a = distributed_stencil3d(world, 5, mesh, impl="stream:2",
+                                  periodic=(False, True, True))
+        b = distributed_stencil3d(world, 5, mesh, impl="padded",
+                                  periodic=(False, True, True))
+        assert np.allclose(a, b, atol=1e-5)
+
+    @pytest.mark.parametrize("carry", [False, True])
+    def test_stream_explicit_band_two_bands(self, devices, carry):
+        # nb == 2: first and last band are the only bands; both the
+        # re-read and the carry-tail read schedules must agree
+        from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
+
+        rng = np.random.default_rng(13)
+        core = jnp.asarray(rng.standard_normal((8, 8, 8)).astype(np.float32))
+        coeffs = (1 / 6,) * 6 + (0.0,)
+        got = seven_point_streamed_pallas(
+            core, core[-2:], core[:2], (8, 8, 8), coeffs, 2, band=4,
+            carry_tail=carry,
+        )
+        e = np.asarray(core, np.float64)
+        for _ in range(2):
+            e = sum(np.roll(e, s, a) for a in range(3) for s in (1, -1)) / 6
+        assert np.allclose(np.asarray(got), e, atol=1e-5)
+
+    def test_stream_carry_rejects_band_not_over_depth(self, devices):
+        from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
+
+        core = jnp.zeros((8, 8, 8), jnp.float32)
+        coeffs = (1 / 6,) * 6 + (0.0,)
+        with pytest.raises(ValueError, match="carry_tail"):
+            seven_point_streamed_pallas(
+                core, jnp.zeros((4, 8, 8)), jnp.zeros((4, 8, 8)),
+                (8, 8, 8), coeffs, 4, band=4, carry_tail=True,
+            )
+
+    def test_stream_rejects_distributed_yx(self, devices):
+        rng = np.random.default_rng(14)
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
+        mesh = make_mesh((1, 2, 1), ("z", "row", "col"))
+        with pytest.raises(ValueError, match="self-wrapping"):
+            distributed_stencil3d(world, 2, mesh, impl="stream:2")
+
+    def test_stream_rejects_27_point(self, devices):
+        rng = np.random.default_rng(15)
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
+        mesh = make_mesh((1, 1, 1), ("z", "row", "col"))
+        c27 = tuple(np.linspace(0.01, 0.26, 26)) + (0.3,)
+        with pytest.raises(ValueError, match="7-point only"):
+            distributed_stencil3d(world, 2, mesh, impl="stream:2",
+                                  coeffs=c27)
+
+    def test_stream_rejects_depth_over_band(self, devices):
+        from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
+
+        core = jnp.zeros((8, 8, 8), jnp.float32)
+        coeffs = (1 / 6,) * 6 + (0.0,)
+        with pytest.raises(ValueError, match="depth"):
+            seven_point_streamed_pallas(
+                core, jnp.zeros((6, 8, 8)), jnp.zeros((6, 8, 8)),
+                (8, 8, 8), coeffs, 6, band=4
+            )
+
+
 class Test26Neighbors:
     def test_rank_id_golden_all_26_regions(self, devices):
         from tpuscratch.halo.halo3d import OFFSETS26

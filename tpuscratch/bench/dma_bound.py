@@ -1,0 +1,300 @@
+"""The DMA-fabric bound race behind BASELINE row 9 (run on a chip:
+``python -m tpuscratch.bench.dma_bound [anchors] [manual] [main2]``).
+
+Question: can ANY Pallas DMA form stream 256x512x512 f32 faster than the
+standard BlockSpec pipeline's ~320 GB/s?  Answer (v5e, marginal
+ms/step): no — one monolithic HBM->HBM DMA 1.64 (327 GB/s rd+wr), K=2/4/8
+concurrent slab DMAs 1.59-1.77, manual double-buffered VMEM bounce at
+every band/buffer shape 1.58-1.70, multi-lane concurrent streams
+1.62-1.79, vs the XLA non-DMA vector path 0.94 (568 GB/s).  ~330 GB/s is
+the chip's total DMA-fabric copy rate; the lever past it is arithmetic
+intensity (ops/stencil_stream.py folds k substeps per pass).
+
+Run ON THE CHIP (default env).  One long-lived process; marginal rates by
+step-count differencing inside compiled scans.
+
+Schedule (per slot s = b % nbuf, separate read + write buffers so no
+DMA/DMA buffer conflicts):
+  wait rd(s, b); wait wr(s, b-nbuf); compute wbuf[s] from rbuf[s];
+  start wr(s, b); start rd(s, b+nbuf).
+Reads run nbuf bands ahead; writes lag, on their own semaphores.
+"""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuscratch.bench.timing import time_device
+
+NZ, CY, CX = 256, 512, 512
+DT = jnp.float32
+BYTES = NZ * CY * CX * 4
+
+
+def manual_stream(band: int, nbuf: int, mode: str):
+    """mode: 'copy' = VMEM bounce (wbuf[s] = rbuf[s]); 'touch' = *c."""
+    nb = NZ // band
+    assert NZ % band == 0
+
+    def kernel(c_ref, in_hbm, out_hbm, rbuf, wbuf, rsem, wsem):
+        def rd(slot, b):
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(b * band, band)], rbuf.at[slot],
+                rsem.at[slot])
+
+        def wr(slot, b):
+            return pltpu.make_async_copy(
+                wbuf.at[slot], out_hbm.at[pl.ds(b * band, band)],
+                wsem.at[slot])
+
+        for i in range(min(nbuf, nb)):
+            rd(i, i).start()
+
+        def body(b, carry):
+            slot = jax.lax.rem(b, nbuf)
+            rd(slot, b).wait()
+
+            @pl.when(b >= nbuf)
+            def _():
+                wr(slot, b - nbuf).wait()
+
+            if mode == "touch":
+                wbuf[slot] = rbuf[slot] * c_ref[0]
+            else:
+                wbuf[slot] = rbuf[slot]
+            wr(slot, b).start()
+
+            @pl.when(b + nbuf < nb)
+            def _():
+                rd(slot, b + nbuf).start()
+
+            return carry
+
+        jax.lax.fori_loop(0, nb, body, 0)
+        for i in range(max(0, nb - nbuf), nb):
+            wr(i % nbuf, i).wait()
+
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        out_shape=jax.ShapeDtypeStruct((NZ, CY, CX), DT),
+        scratch_shapes=[
+            pltpu.VMEM((nbuf, band, CY, CX), DT),
+            pltpu.VMEM((nbuf, band, CY, CX), DT),
+            pltpu.SemaphoreType.DMA((nbuf,)),
+            pltpu.SemaphoreType.DMA((nbuf,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=120 << 20,
+        ),
+    )
+
+    def step(x, c):
+        return call(c, x)
+
+    return step
+
+
+def hbm2hbm():
+    """Direct HBM->HBM DMA, no VMEM bounce — the raw engine rate."""
+
+    def kernel(in_hbm, out_hbm, sem):
+        cp = pltpu.make_async_copy(in_hbm, out_hbm, sem)
+        cp.start()
+        cp.wait()
+
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        out_shape=jax.ShapeDtypeStruct((NZ, CY, CX), DT),
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+
+    def step(x, c):
+        return call(x)
+
+    return step
+
+
+def xla_touch():
+    def step(x, c):
+        return x * c
+
+    return step
+
+
+def scanned(step, nsteps):
+    @jax.jit
+    def run(x, c):
+        def body(carry, _):
+            return step(carry, c), None
+
+        y, _ = jax.lax.scan(body, x, None, length=nsteps)
+        return y[0, 0, 0]
+
+    return run
+
+
+def race(name, step, steps_lo=50, steps_hi=250, iters=3):
+    x = jnp.ones((NZ, CY, CX), DT)
+    c = jnp.full((1,), 1.0 + 2 ** -20, DT)
+    try:
+        lo = time_device(scanned(step, steps_lo), x, c, iters=iters,
+                         warmup=1, fence="readback", name=f"{name}@{steps_lo}")
+        hi = time_device(scanned(step, steps_hi), x, c, iters=iters,
+                         warmup=1, fence="readback", name=f"{name}@{steps_hi}")
+    except Exception as e:
+        msg = str(e).replace("\n", " | ")[:400]
+        print(f"{name}: FAILED {msg}", flush=True)
+        return
+    marg = (hi.p50 - lo.p50) / (steps_hi - steps_lo)
+    gbps = 2 * BYTES / marg / 1e9
+    print(f"{name}: marginal {marg * 1e3:.3f} ms/step  "
+          f"({gbps:.0f} GB/s rd+wr)", flush=True)
+
+
+def main():
+    which = sys.argv[1:] or ["anchors", "manual"]
+    print(f"devices: {jax.devices()}", flush=True)
+    if "anchors" in which:
+        race("xla-touch", xla_touch())
+        race("hbm2hbm-dma", hbm2hbm())
+    if "manual" in which:
+        for band, nbuf, mode in [
+            (8, 2, "copy"), (8, 3, "copy"), (16, 2, "copy"),
+            (8, 2, "touch"), (8, 3, "touch"), (16, 2, "touch"),
+        ]:
+            race(f"manual-{mode}-band{band}-nbuf{nbuf}",
+                 manual_stream(band, nbuf, mode))
+
+
+if __name__ == "__main__" and "main2" not in sys.argv:
+    main()
+
+
+def kway_hbm2hbm(K: int):
+    """K concurrent HBM->HBM DMAs on disjoint z-slabs, own semaphores."""
+    slab = NZ // K
+
+    def kernel(in_hbm, out_hbm, sem):
+        cps = [
+            pltpu.make_async_copy(
+                in_hbm.at[pl.ds(i * slab, slab)],
+                out_hbm.at[pl.ds(i * slab, slab)],
+                sem.at[i],
+            )
+            for i in range(K)
+        ]
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        out_shape=jax.ShapeDtypeStruct((NZ, CY, CX), DT),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((K,))],
+    )
+
+    def step(x, c):
+        return call(x)
+
+    return step
+
+
+def lanes_stream(band: int, nbuf: int, L: int, mode: str = "touch"):
+    """L independent double-buffered streams over disjoint z-halves —
+    DMAs across lanes run concurrently on separate semaphores."""
+    nb_lane = NZ // L // band
+    assert NZ % (L * band) == 0
+
+    def kernel(c_ref, in_hbm, out_hbm, rbuf, wbuf, rsem, wsem):
+        def rd(lane, slot, b):
+            z = (lane * nb_lane + b) * band
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(z, band)], rbuf.at[lane, slot],
+                rsem.at[lane, slot])
+
+        def wr(lane, slot, b):
+            z = (lane * nb_lane + b) * band
+            return pltpu.make_async_copy(
+                wbuf.at[lane, slot], out_hbm.at[pl.ds(z, band)],
+                wsem.at[lane, slot])
+
+        for lane in range(L):
+            for i in range(min(nbuf, nb_lane)):
+                rd(lane, i, i).start()
+
+        def body(b, carry):
+            slot = jax.lax.rem(b, nbuf)
+            for lane in range(L):
+                rd(lane, slot, b).wait()
+
+                @pl.when(b >= nbuf)
+                def _(lane=lane):
+                    wr(lane, slot, b - nbuf).wait()
+
+                if mode == "touch":
+                    wbuf[lane, slot] = rbuf[lane, slot] * c_ref[0]
+                else:
+                    wbuf[lane, slot] = rbuf[lane, slot]
+                wr(lane, slot, b).start()
+
+                @pl.when(b + nbuf < nb_lane)
+                def _(lane=lane, slot=slot):
+                    rd(lane, slot, b + nbuf).start()
+
+            return carry
+
+        jax.lax.fori_loop(0, nb_lane, body, 0)
+        for lane in range(L):
+            for i in range(max(0, nb_lane - nbuf), nb_lane):
+                wr(lane, i % nbuf, i).wait()
+
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        out_shape=jax.ShapeDtypeStruct((NZ, CY, CX), DT),
+        scratch_shapes=[
+            pltpu.VMEM((L, nbuf, band, CY, CX), DT),
+            pltpu.VMEM((L, nbuf, band, CY, CX), DT),
+            pltpu.SemaphoreType.DMA((L, nbuf)),
+            pltpu.SemaphoreType.DMA((L, nbuf)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=120 << 20,
+        ),
+    )
+
+    def step(x, c):
+        return call(c, x)
+
+    return step
+
+
+def main2():
+    print(f"devices: {jax.devices()}", flush=True)
+    for K in (2, 4, 8):
+        race(f"hbm2hbm-{K}way", kway_hbm2hbm(K))
+    for band, nbuf, L in [(8, 2, 2), (8, 2, 4), (4, 2, 4), (8, 3, 2)]:
+        race(f"lanes{L}-touch-band{band}-nbuf{nbuf}",
+             lanes_stream(band, nbuf, L))
+
+
+if __name__ == "__main__" and "main2" in sys.argv:
+    main2()

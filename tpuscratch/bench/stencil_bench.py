@@ -98,7 +98,7 @@ def bench_stencil3d(
     program = make_stencil3d_program(mesh, spec, steps, impl=impl)
     rng = np.random.default_rng(0)
     world = rng.standard_normal(grid).astype(np.float32)
-    if impl.startswith("compact"):
+    if impl.startswith(("compact", "stream")):
         tiles = jnp.asarray(decompose3d_cores(world, dims))
     else:
         tiles = jnp.asarray(decompose3d(world, topo, layout))

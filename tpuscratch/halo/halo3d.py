@@ -441,6 +441,95 @@ def run_stencil3d_compact(
     return out
 
 
+def run_stencil3d_stream(
+    core: jnp.ndarray,
+    spec: HaloSpec3D,
+    steps: int,
+    coeffs=JACOBI7,
+    depth: int = 4,
+    band: Optional[int] = None,
+    nbuf: int = 2,
+) -> jnp.ndarray:
+    """``steps`` iterations via the deep-z streamed kernel: ``depth``
+    substeps fold into each manual-DMA pass, dividing per-step HBM
+    traffic by ``depth`` — the only lever past the measured ~330 GB/s
+    DMA-fabric copy bound (ops/stencil_stream.py docstring carries the
+    bound race).  Serves z-slab decompositions: y/x must self-wrap
+    (degenerate periodic); z ghosts travel as (depth, cy, cx) slabs,
+    one exchange per ``depth`` steps — the 2D ``deep:k`` trapezoid one
+    dimension up (reference lineage: stencil2D.h:116-117, ghost depth
+    as a parameter).  Open z boundaries get zero ghosts, matching the
+    plain path's ppermute semantics.
+    """
+    from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
+
+    if len(coeffs) != 7:
+        raise ValueError(
+            f"stream impl is 7-point only (got {len(coeffs)} coeffs); "
+            "use impl='compact' for 27-point"
+        )
+    topo = spec.topology
+    for a, name in ((1, "y"), (2, "x")):
+        if not (topo.dims[a] == 1 and topo.periodic[a]):
+            raise ValueError(
+                f"stream impl needs a self-wrapping {name} axis (z-slab "
+                f"decomposition), got dims={topo.dims} "
+                f"periodic={topo.periodic}; use impl='compact-asm' for "
+                "distributed y/x axes"
+            )
+    cz, cy, cx = core.shape
+    wrap_z = topo.dims[0] == 1 and topo.periodic[0]
+
+    def ghosts(c, d):
+        if wrap_z:
+            return c[cz - d:], c[:d]
+        if topo.dims[0] == 1:  # single rank, open z: zero ghosts
+            z = jnp.zeros((d, cy, cx), c.dtype)
+            return z, z
+        # depth-d slab exchange; non-periodic ends receive ppermute
+        # zeros, identical to the plain path's ghost semantics
+        a_mz = lax.ppermute(
+            c[cz - d:], spec.axes, list(topo.send_permutation((1, 0, 0)))
+        )
+        a_pz = lax.ppermute(
+            c[:d], spec.axes, list(topo.send_permutation((-1, 0, 0)))
+        )
+        return a_mz, a_pz
+
+    def open_flags():
+        # per-rank traced flags: an OPEN physical end must re-impose its
+        # zero ghosts every folded substep (shard_map traces one program
+        # for every rank, so this cannot be a static property)
+        if topo.periodic[0]:
+            return None
+        if topo.dims[0] == 1:
+            return jnp.ones((2,), jnp.int32)
+        zc = lax.axis_index(spec.axes[0])
+        return jnp.stack(
+            [(zc == 0).astype(jnp.int32),
+             (zc == topo.dims[0] - 1).astype(jnp.int32)]
+        )
+
+    flags = open_flags()
+
+    def pass_fn(c, d):
+        a_mz, a_pz = ghosts(c, d)
+        return seven_point_streamed_pallas(
+            c, a_mz, a_pz, (cz, cy, cx), tuple(coeffs), d, band, nbuf,
+            open_flags=flags,
+        )
+
+    q, r = divmod(steps, depth)
+    out = core
+    if q:
+        out, _ = lax.scan(
+            lambda c, _: (pass_fn(c, depth), ()), out, None, length=q
+        )
+    if r:
+        out = pass_fn(out, r)
+    return out
+
+
 def decompose3d(
     world: np.ndarray, topo: CartTopology, layout: TileLayout3D
 ) -> np.ndarray:
@@ -461,7 +550,7 @@ def decompose3d(
 
 
 IMPLS3D = ("compact", "compact-pallas", "compact-strips", "compact-asm",
-           "padded")
+           "padded", "stream")  # "stream" takes an optional ":depth"
 
 #: impl name -> compact compute backend (BASELINE.md row 9 races them)
 _COMPACT_COMPUTE = {
@@ -478,7 +567,8 @@ def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
     tiles after ``steps`` iterations. Compact impls take/return CORE
     tiles (decompose3d_cores), 'padded' takes ghost-padded tiles
     (decompose3d)."""
-    if impl not in IMPLS3D:
+    base = impl.split(":", 1)[0]
+    if base not in IMPLS3D:
         raise ValueError(f"unknown 3D stencil impl {impl!r}; have {IMPLS3D}")
     if impl.startswith("compact") and len(coeffs) == 27 and impl != "compact":
         raise ValueError(
@@ -486,7 +576,16 @@ def make_stencil3d_program(mesh: Mesh, spec: HaloSpec3D, steps: int,
             "(the banded Pallas kernels are 7-point); use impl='compact' "
             "or 'padded'"
         )
-    if impl.startswith("compact"):
+    if base == "stream":
+        depth = int(impl.split(":", 1)[1]) if ":" in impl else 4
+        if depth < 1:
+            raise ValueError(
+                f"stream depth must be >= 1, got {impl!r}"
+            )
+        body = lambda t: run_stencil3d_stream(  # noqa: E731
+            t[0, 0, 0], spec, steps, coeffs, depth
+        )[None, None, None]
+    elif impl.startswith("compact"):
         compute = _COMPACT_COMPUTE[impl]
         body = lambda t: run_stencil3d_compact(  # noqa: E731
             t[0, 0, 0], spec, steps, coeffs, compute
@@ -567,7 +666,7 @@ def distributed_stencil3d(
             if tuple(halo) == (1, 1, 1) and len(coeffs) in (7, 27)
             else "padded"
         )
-    if impl.startswith("compact") and tuple(halo) != (1, 1, 1):
+    if impl.startswith(("compact", "stream")) and tuple(halo) != (1, 1, 1):
         raise ValueError(
             f"impl={impl!r} supports halo (1,1,1) only, got {halo}; "
             "use impl='padded' for deeper ghosts"
@@ -586,7 +685,7 @@ def distributed_stencil3d(
         neighbors=26 if len(coeffs) == 27 else 6,
     )
     program = make_stencil3d_program(mesh, spec, steps, coeffs, impl)
-    if impl.startswith("compact"):
+    if impl.startswith(("compact", "stream")):
         out = np.asarray(program(jnp.asarray(decompose3d_cores(world, dims))))
         return assemble3d_cores(out)
     out = program(jnp.asarray(decompose3d(world, topo, layout)))

@@ -251,10 +251,17 @@ def run_stencil_deep(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 
     ``stencil/2`` cells (stencil2D.h:116-117) — here the width is an
     optimization knob rather than a stencil property.
 
-    Requires a periodic topology: with open boundaries the scheme would
-    evolve boundary ghost rings that MPI_PROC_NULL semantics keep fixed.
-    ``depth`` defaults to the layout halo width; steps need not divide
-    evenly (the remainder runs as a shallower trailing trapezoid).
+    Open (non-periodic) boundaries are supported on the ``xla`` impl:
+    a physical edge's ghost rings must stay ZERO at every substep (the
+    MPI_PROC_NULL semantics of the reference, mpi5.cpp:47-75 1D ends,
+    mpi10.cpp:27 non-periodic cart grid), so after each substep the
+    rings still acting as ghosts on an open side are re-zeroed — via
+    per-rank traced flags, since shard_map traces one program for every
+    rank. The ``pallas`` trapezoid kernel remains periodic-only (use
+    ``impl='xla'`` deep, or the plain per-step paths, on open
+    topologies). ``depth`` defaults to the layout halo width; steps
+    need not divide evenly (the remainder runs as a shallower trailing
+    trapezoid).
 
     ``impl='xla'`` runs the substep pyramid as compiler-scheduled ops
     (about one HBM pass per substep); ``impl='pallas'`` runs the whole
@@ -268,14 +275,47 @@ def run_stencil_deep(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 
         raise ValueError("deep stencil needs a square halo (halo_y == halo_x)")
     if not (1 <= k <= lay.halo_y):
         raise ValueError(f"depth {k} must be in [1, halo {lay.halo_y}]")
-    if not all(spec.topology.periodic):
-        raise ValueError("deep stencil requires a periodic topology")
+    topo = spec.topology
+    open_any = not all(topo.periodic)
+    if open_any and impl == "pallas":
+        raise ValueError(
+            "the pallas trapezoid kernel is periodic-only; use "
+            "impl='xla' deep (open-boundary aware) or a per-step impl"
+        )
     if min(lay.core_h, lay.core_w) < k:
         raise ValueError(
             f"core {lay.core_h}x{lay.core_w} smaller than depth {k}"
         )
     if impl not in ("xla", "pallas"):
         raise ValueError(f"unknown deep stencil impl {impl!r}")
+
+    def open_side_flags():
+        # 1.0 marks a side whose ghosts are a physical open edge for
+        # THIS rank (traced: one program serves every rank)
+        flags = []
+        for axis in (0, 1):
+            if topo.periodic[axis]:
+                flags += [0.0, 0.0]
+            elif topo.dims[axis] == 1:
+                flags += [1.0, 1.0]
+            else:
+                c = lax.axis_index(spec.axes[axis])
+                flags += [(c == 0).astype(tile.dtype),
+                          (c == topo.dims[axis] - 1).astype(tile.dtype)]
+        return [jnp.asarray(f, tile.dtype) for f in flags]
+
+    flags = open_side_flags() if open_any else None
+
+    def zero_open_margins(a, g):
+        # the g outermost rings still acting as ghosts must stay zero
+        # on open sides (they are real evolving data on periodic or
+        # interior sides)
+        f_my, f_py, f_mx, f_px = flags
+        a = a.at[:g, :].multiply(1 - f_my)
+        a = a.at[-g:, :].multiply(1 - f_py)
+        a = a.at[:, :g].multiply(1 - f_mx)
+        a = a.at[:, -g:].multiply(1 - f_px)
+        return a
 
     def trapezoid(t, substeps):
         t = halo_exchange(t, spec)
@@ -285,8 +325,11 @@ def run_stencil_deep(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.25, 
             core = deep_trapezoid_pallas(t, lay, substeps, tuple(coeffs))
         else:
             a = t
-            for _ in range(substeps):
+            for j in range(1, substeps + 1):
                 a = shrink_step(a, coeffs)
+                g = lay.halo_y - j
+                if open_any and g > 0 and j < substeps:
+                    a = zero_open_margins(a, g)
             crop = lay.halo_y - substeps
             core = a[crop:-crop, crop:-crop] if crop else a
         return rebuild(t, core, lay)

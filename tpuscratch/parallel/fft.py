@@ -175,11 +175,7 @@ def _split(n: int):
     the other."""
     if n >= 1024 and n % 128 == 0:
         return (n // 128, 128)
-    best = None
-    for d in range(2, int(n**0.5) + 1):
-        if n % d == 0:
-            best = d
-    return (best, n // best) if best else None
+    return _balanced_factor(n)
 
 
 @functools.lru_cache(maxsize=None)
@@ -202,12 +198,83 @@ def _cplx_einsum(spec: str, c, s, xr, xi, inverse: bool):
     return yr, yi
 
 
+#: n1-side sub-DFT lengths at or above this are themselves four-step
+#: decomposed (the "eight-step" recursion).  0 = DISABLED, which the
+#: chip race decided (BASELINE row 8, v5e): the recursion LOSES at every
+#: raced size — 4096² 19.2 vs 12.65 ms/round, 8192² 84.6 vs 58.3,
+#: 16384² 319 vs 226 — because the dense F_n1 contraction is one
+#: 32/64/128-deep MXU pass while the m1+m2 sub-contractions are 8/16
+#: deep and underfill the array; the MAC savings never pay for the fill
+#: loss.  Same physics as the n2=128 split rule beating the balanced
+#: split.  The path stays correct and force-enabled in tests.
+EIGHT_STEP_MIN = 0
+
+
+def _balanced_factor(n: int):
+    """(d, n // d) with d the largest divisor <= sqrt(n), or None for a
+    prime/too-small n (shared by _split's sub-1024 rule and the
+    eight-step recursion)."""
+    best = None
+    for d in range(2, int(n**0.5) + 1):
+        if n % d == 0:
+            best = d
+    return (best, n // best) if best else None
+
+
+def _sub_split(n1: int, min_n: int | None = None):
+    """Balanced (m1, m2) factoring of the n1 side for the eight-step
+    recursion, or None when n1 is below the threshold (default
+    :data:`EIGHT_STEP_MIN`; 0 means never), or prime."""
+    m = EIGHT_STEP_MIN if min_n is None else min_n
+    if not m or n1 < m:
+        return None
+    return _balanced_factor(n1)
+
+
+def _sub_dft_n1(xr, xi, n1: int, inverse: bool, axis: int):
+    """DFT of length n1 over the n1 axis of the reshaped four-step
+    tensor — (h, n1, n2) for axis==1, (n1, n2, w) for axis==0.  Dense
+    F_n1 contraction, or its own four-step split when n1 is composite
+    and >= EIGHT_STEP_MIN (the eight-step recursion: same decimation,
+    one level down, unscaled — the outer caller owns the 1/n)."""
+    sub = _sub_split(n1)
+    if sub is None:
+        c1, s1 = (jnp.asarray(t) for t in _dft_tables(n1))
+        spec = "ab,hbw->haw" if axis == 1 else "ab,bcw->acw"
+        return _cplx_einsum(spec, c1, s1, xr, xi, inverse)
+    m1, m2 = sub
+    cm1, sm1 = (jnp.asarray(t) for t in _dft_tables(m1))
+    cm2, sm2 = (jnp.asarray(t) for t in _dft_tables(m2))
+    tc, ts = (jnp.asarray(t) for t in _twiddle_tables(m1, m2, n1))
+    sgn = -1.0 if inverse else 1.0
+    if axis == 1:
+        h, _, n2 = xr.shape
+        ur = xr.reshape(h, m1, m2, n2)
+        ui = xi.reshape(h, m1, m2, n2)
+        tr, ti = _cplx_einsum("pu,huvw->hpvw", cm1, sm1, ur, ui, inverse)
+        tw_r = tr * tc[:, :, None] + sgn * ti * ts[:, :, None]
+        tw_i = ti * tc[:, :, None] - sgn * tr * ts[:, :, None]
+        # k1 = p + m1*q: emitting (q, p) C-order seats the digits
+        br, bi = _cplx_einsum("qv,hpvw->hqpw", cm2, sm2, tw_r, tw_i,
+                              inverse)
+        return br.reshape(h, n1, n2), bi.reshape(h, n1, n2)
+    _, n2, w = xr.shape
+    ur = xr.reshape(m1, m2, n2, w)
+    ui = xi.reshape(m1, m2, n2, w)
+    tr, ti = _cplx_einsum("pu,uvcw->pvcw", cm1, sm1, ur, ui, inverse)
+    tw_r = tr * tc[:, :, None, None] + sgn * ti * ts[:, :, None, None]
+    tw_i = ti * tc[:, :, None, None] - sgn * tr * ts[:, :, None, None]
+    br, bi = _cplx_einsum("qv,pvcw->qpcw", cm2, sm2, tw_r, tw_i, inverse)
+    return br.reshape(n1, n2, w), bi.reshape(n1, n2, w)
+
+
 def _four_step_axis(re, im, axis: int, inverse: bool):
     """Transform one axis of the (re, im) pair by the four-step matmul
-    FFT. Requires a composite axis length (see :func:`_split`)."""
+    FFT. Requires a composite axis length (see :func:`_split`).  The
+    n1-side sub-DFT recurses one level (eight-step) when
+    :func:`_sub_split` allows."""
     n = re.shape[axis]
     n1, n2 = _split(n)
-    c1, s1 = (jnp.asarray(t) for t in _dft_tables(n1))
     c2, s2 = (jnp.asarray(t) for t in _dft_tables(n2))
     tc, ts = (jnp.asarray(t) for t in _twiddle_tables(n1, n2, n))
     sgn = -1.0 if inverse else 1.0
@@ -216,7 +283,7 @@ def _four_step_axis(re, im, axis: int, inverse: bool):
         h = re.shape[0]
         xr = re.reshape(h, n1, n2)
         xi = im.reshape(h, n1, n2)
-        br, bi = _cplx_einsum("ab,hbw->haw", c1, s1, xr, xi, inverse)
+        br, bi = _sub_dft_n1(xr, xi, n1, inverse, axis)
         # twiddle: (br + i bi) * (tc -+ i ts), broadcast over rows
         cr = br * tc + sgn * bi * ts
         ci = bi * tc - sgn * br * ts
@@ -227,7 +294,7 @@ def _four_step_axis(re, im, axis: int, inverse: bool):
         w = re.shape[1]
         xr = re.reshape(n1, n2, w)
         xi = im.reshape(n1, n2, w)
-        br, bi = _cplx_einsum("ab,bcw->acw", c1, s1, xr, xi, inverse)
+        br, bi = _sub_dft_n1(xr, xi, n1, inverse, axis)
         cr = br * tc[:, :, None] + sgn * bi * ts[:, :, None]
         ci = bi * tc[:, :, None] - sgn * br * ts[:, :, None]
         yr, yi = _cplx_einsum("jm,ajw->maw", c2, s2, cr, ci, inverse)
