@@ -664,6 +664,55 @@ class TestDmaImpl:
             outs[name] = np.asarray(f(tiles))[:, :, 1:-1, 1:-1]
         np.testing.assert_allclose(outs["dma"], outs["xla"], rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("dims", [(2, 4), (1, 4), (2, 1), (1, 1)])
+    @pytest.mark.parametrize("steps", [1, 3, 5])
+    def test_hbm_banded_matches_plain_core(self, dims, steps):
+        # the HBM-resident banded variant (round 4): core streams in
+        # row bands, strips still on the DMA engine, one invocation per
+        # step with entry-barrier ordering; column stages carried
+        # between steps
+        from tpuscratch.halo.driver import decompose
+        from tpuscratch.ops.halo_dma import run_stencil_dma_hbm
+
+        R, C = dims
+        TH, TW = 8, 8
+        mesh = make_mesh_2d((R, C))
+        topo = CartTopology((R, C), (True, True))
+        lay = TileLayout(TH, TW, 1, 1)
+        spec = HaloSpec(layout=lay, topology=topo)
+        rng = np.random.default_rng(63)
+        world = rng.standard_normal((R * TH, C * TW)).astype(np.float32)
+        tiles = jnp.asarray(decompose(world, topo, lay))
+
+        outs = {}
+        for name, fn in (
+            ("xla", lambda t: run_stencil(t, spec, steps)),
+            ("hbm", lambda t: run_stencil_dma_hbm(t, spec, steps, band=4)),
+        ):
+            f = run_spmd(
+                mesh,
+                lambda x, fn=fn: fn(x[0, 0])[None, None],
+                P("row", "col", None, None),
+                P("row", "col", None, None),
+            )
+            outs[name] = np.asarray(f(tiles))[:, :, 1:-1, 1:-1]
+        np.testing.assert_allclose(outs["hbm"], outs["xla"], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_hbm_banded_rejects_nine_point_and_open(self):
+        from tpuscratch.ops.halo_dma import run_stencil_dma_hbm
+
+        lay = TileLayout(8, 8, 1, 1)
+        spec = HaloSpec(layout=lay, topology=CartTopology((1, 1), (True, True)))
+        with pytest.raises(ValueError, match="5-point only"):
+            run_stencil_dma_hbm(jnp.zeros(lay.padded_shape), spec, 2,
+                                coeffs=(0.1,) * 9)
+        open_spec = HaloSpec(
+            layout=lay, topology=CartTopology((1, 1), (True, False))
+        )
+        with pytest.raises(ValueError, match="periodic-only"):
+            run_stencil_dma_hbm(jnp.zeros(lay.padded_shape), open_spec, 2)
+
     def test_halo_refreshed_like_exchange(self):
         # The returned padded tile carries a POST-run exchange (the
         # resident-impl convention): halo == exchange of the final cores.

@@ -267,6 +267,32 @@ class TestStreamImpl:
             e = sum(np.roll(e, s, a) for a in range(3) for s in (1, -1)) / 6
         assert np.allclose(np.asarray(got), e, atol=1e-5)
 
+    @pytest.mark.parametrize("mesh_dims", [(1, 1, 1), (2, 1, 1)])
+    @pytest.mark.parametrize("periodic", [True, (False, True, True)])
+    def test_stream_27_point_equals_compact(self, devices, mesh_dims,
+                                            periodic):
+        # 27 coefficients ride the SAME streamed kernel: three
+        # dz-shifted 9-point ring decompositions per substep; on z-slab
+        # meshes the full-extent ghost slabs carry the edge/corner
+        # neighbor data implicitly
+        rng = np.random.default_rng(17)
+        world = rng.standard_normal((16, 8, 16)).astype(np.float32)
+        c27 = tuple(np.linspace(0.01, 0.26, 26)) + (0.3,)
+        mesh = make_mesh(mesh_dims, ("z", "row", "col"))
+        a = distributed_stencil3d(world, 5, mesh, coeffs=c27,
+                                  impl="stream:2", periodic=periodic)
+        b = distributed_stencil3d(world, 5, mesh, coeffs=c27,
+                                  impl="compact", periodic=periodic)
+        assert np.allclose(a, b, atol=1e-4)
+
+    def test_stream_rejects_bad_coeff_count(self, devices):
+        rng = np.random.default_rng(18)
+        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
+        mesh = make_mesh((1, 1, 1), ("z", "row", "col"))
+        with pytest.raises(ValueError, match="7 or 27"):
+            distributed_stencil3d(world, 2, mesh, impl="stream:2",
+                                  coeffs=(0.1,) * 9, halo=(1, 1, 1))
+
     def test_stream_carry_rejects_band_not_over_depth(self, devices):
         from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
 
@@ -284,15 +310,6 @@ class TestStreamImpl:
         mesh = make_mesh((1, 2, 1), ("z", "row", "col"))
         with pytest.raises(ValueError, match="self-wrapping"):
             distributed_stencil3d(world, 2, mesh, impl="stream:2")
-
-    def test_stream_rejects_27_point(self, devices):
-        rng = np.random.default_rng(15)
-        world = rng.standard_normal((8, 8, 8)).astype(np.float32)
-        mesh = make_mesh((1, 1, 1), ("z", "row", "col"))
-        c27 = tuple(np.linspace(0.01, 0.26, 26)) + (0.3,)
-        with pytest.raises(ValueError, match="7-point only"):
-            distributed_stencil3d(world, 2, mesh, impl="stream:2",
-                                  coeffs=c27)
 
     def test_stream_rejects_depth_over_band(self, devices):
         from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas

@@ -218,3 +218,100 @@ def test_adam_step_sharding_invariance(devices):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=3e-3, atol=1e-4
         )
+
+
+class TestPipelineComposedStep:
+    """The 3-axis (dp x sp x stage) trainer: GPipe microbatching over
+    the stage axis wrapping the dp x sp block — PP composed with the
+    other three strategies, not tested alone."""
+
+    def test_pp_stage1_micro1_equals_plain_step(self, devices):
+        # degenerate schedule (1 stage, 1 microbatch) must reproduce the
+        # plain dp x sp step exactly — same ops modulo the stack reshape
+        from tpuscratch.models.transformer import (
+            stack_layers, train_step_pp, unstack_layers,
+        )
+
+        cfg = cfg_for(n_layers=2)
+        x, y = data()
+        params = init_params(5, cfg)
+        plain = train_step(
+            make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1]), cfg
+        )
+        pp = train_step_pp(
+            make_mesh((1, 1, 1), ("dp", "sp", "stage"), jax.devices()[:1]),
+            cfg, n_micro=1,
+        )
+        p1, l1 = plain(params, x, y)
+        ps, ls = pp(stack_layers(params), x, y)
+        assert abs(float(l1) - float(ls)) < 1e-6
+        pu = unstack_layers(jax.tree.map(np.asarray, ps))
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pu)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )
+
+    @pytest.mark.parametrize("dims", [(2, 2, 2), (1, 2, 2), (2, 1, 2)])
+    def test_pp_sharding_invariance(self, devices, dims):
+        # same global batch, same microbatch count: the 1x1x1 and the
+        # dp x sp x stage meshes must land the same loss and params
+        from tpuscratch.models.transformer import stack_layers, train_step_pp
+
+        cfg = cfg_for(n_layers=2)
+        x, y = data(2)
+        stacked = stack_layers(init_params(6, cfg))
+        single = train_step_pp(
+            make_mesh((1, 1, 1), ("dp", "sp", "stage"), jax.devices()[:1]),
+            cfg, n_micro=2,
+        )
+        n = dims[0] * dims[1] * dims[2]
+        multi = train_step_pp(
+            make_mesh(dims, ("dp", "sp", "stage"), jax.devices()[:n]),
+            cfg, n_micro=2,
+        )
+        p1, l1 = single(stacked, x, y)
+        pn, ln = multi(stacked, x, y)
+        # the stage axis is BIT-identical invariant (measured: 1x1x1 ==
+        # 1x1x2, 2x2x1 == 2x2x2); the residual is the dp/sp
+        # routing-group nonlinearity of the MoE aux loss (smaller token
+        # groups per router call), the same effect the plain step's
+        # invariance test absorbs at 1e-4 — microbatching halves the
+        # groups again, hence the slightly wider band
+        assert abs(float(l1) - float(ln)) < 5e-4, (float(l1), float(ln))
+        # atol 5e-4: the gate's aux-loss gradient differentiates through
+        # per-group token fractions, so smaller routing groups shift it
+        # by a few 1e-4 in absolute terms (tiny vs the 0.02-scale gate).
+        # The stage axis itself is BIT-identical invariant (asserted by
+        # the dryrun check at atol 1e-5); the band here absorbs only the
+        # dp/sp group effects the plain invariance test also absorbs
+        for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(pn)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-4
+            )
+
+    def test_pp_loss_decreases(self, devices):
+        from tpuscratch.models.transformer import stack_layers, train_step_pp
+
+        cfg = cfg_for(n_layers=2)
+        x, y = data(3)
+        stacked = stack_layers(init_params(7, cfg))
+        step = train_step_pp(
+            make_mesh((2, 2, 2), ("dp", "sp", "stage"), jax.devices()[:8]),
+            cfg, lr=0.05, n_micro=2,
+        )
+        losses = []
+        for _ in range(4):
+            stacked, loss = step(stacked, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
+
+    def test_pp_rejects_indivisible_layers(self, devices):
+        from tpuscratch.models.transformer import train_step_pp
+
+        cfg = cfg_for(n_layers=3)
+        with pytest.raises(ValueError, match="n_layers"):
+            train_step_pp(
+                make_mesh((1, 1, 2), ("dp", "sp", "stage"),
+                          jax.devices()[:2]), cfg,
+            )

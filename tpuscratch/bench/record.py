@@ -267,10 +267,13 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     n = 16 if avail >= 16 else 1 << (avail.bit_length() - 1)
     dims = (4, 4) if n == 16 else factor2d(n)
     mesh = make_mesh_2d(dims, devices=jax.devices()[:n])
-    # the remote-DMA kernel is a real contender on chips; under the CPU
-    # proxy it would run in the Mosaic interpreter (hours at this size)
+    # the remote-DMA kernels are real contenders on chips; under the
+    # CPU proxy they would run in the Mosaic interpreter (hours at this
+    # size).  'dma' (VMEM-resident) correctly refuses the 1 GB core and
+    # records the structural loss; 'dma-hbm' (round 4) streams the core
+    # in row bands and is the mechanism's answer to exactly this config
     impls = ("xla", "overlap", "deep:4") + (
-        ("dma",) if jax.default_backend() == "tpu" else ()
+        ("dma", "dma-hbm") if jax.default_backend() == "tpu" else ()
     )
     best, _ = _best_stencil(impls, 4, (8192, 8192), 10, mesh, iters)
     _emit(
@@ -640,6 +643,30 @@ def config11_train(out: list, iters: int = 3) -> None:
         emitted += 1
     if not emitted:
         raise RuntimeError("all config-11 dtypes failed")
+
+    # the 3-axis composed step (dp x sp x stage GPipe, round 4): the
+    # degenerate 1x1x1 row records the schedule's single-chip overhead
+    # vs the plain step above (stage-axis invariance itself is gated by
+    # the dryrun's bit-exactness check)
+    try:
+        from tpuscratch.bench.train_ablation import pp_row_bench
+
+        r = pp_row_bench(base, batch=batch, seq=seq,
+                         steps=20 if on_tpu else 2,
+                         n_micro=4 if on_tpu else 2, iters=iters,
+                         fence="readback" if on_tpu else "block")
+        print(f"# {r.summary()} -> {r.items_per_s:.3e} tok/s",
+              file=sys.stderr)
+        _emit(
+            out,
+            config=11,
+            metric="train_pp_tokens_per_s",
+            value=r.items_per_s,
+            p50_s=r.p50,
+            detail=r.name,
+        )
+    except Exception as e:
+        print(f"# config 11 pp failed: {e}", file=sys.stderr)
 
 
 CONFIGS = {

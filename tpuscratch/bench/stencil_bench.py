@@ -72,6 +72,7 @@ def bench_stencil3d(
     impl: str = "compact",
     iters: int = 5,
     fence: str = "block",
+    coeffs=None,
 ) -> BenchResult:
     """cell-updates/s for the 3D face-halo 7-point pipeline
     (halo.halo3d) on a ``grid`` world over a 3-axis mesh."""
@@ -94,8 +95,15 @@ def bench_stencil3d(
         raise ValueError(f"grid {grid} not divisible by mesh {dims}")
     topo = CartTopology(dims, (True,) * 3)
     layout = TileLayout3D(tuple(g // d for g, d in zip(grid, dims)))
-    spec = HaloSpec3D(layout=layout, topology=topo, axes=tuple(mesh.axis_names))
-    program = make_stencil3d_program(mesh, spec, steps, impl=impl)
+    spec = HaloSpec3D(
+        layout=layout, topology=topo, axes=tuple(mesh.axis_names),
+        neighbors=26 if coeffs is not None and len(coeffs) == 27 else 6,
+    )
+    if coeffs is None:
+        program = make_stencil3d_program(mesh, spec, steps, impl=impl)
+    else:
+        program = make_stencil3d_program(mesh, spec, steps, tuple(coeffs),
+                                         impl)
     rng = np.random.default_rng(0)
     world = rng.standard_normal(grid).astype(np.float32)
     if impl.startswith(("compact", "stream")):
@@ -106,6 +114,7 @@ def bench_stencil3d(
     return time_device(
         program, tiles, iters=iters, warmup=2, fence=fence,
         name=f"stencil3d {grid[0]}x{grid[1]}x{grid[2]} x{steps} on "
-             f"{dims[0]}x{dims[1]}x{dims[2]} ({impl})",
+             f"{dims[0]}x{dims[1]}x{dims[2]} "
+             f"({impl}{'' if coeffs is None else f',{len(coeffs)}pt'})",
         items=cells * steps,
     )

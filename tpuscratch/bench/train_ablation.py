@@ -1,0 +1,131 @@
+"""Row-11 ablation harness (BASELINE row 11, round 4): where the
+composed train step spends its time under bf16, and the degenerate
+pipeline-parallel rows.
+
+Run on a chip: ``python -m tpuscratch.bench.train_ablation``.
+Findings (v5e, 20-step scans, ms/step): f32 116.0 / bf16 110.6 —
+fwd-only 39.1 vs 33.6 (bf16's whole gain; DEFAULT f32 matmuls already
+run single-pass bf16 on the MXU), backward dtype-insensitive, MoE
+backward 4.6x its forward (scatter transpose + cap-padded dW),
+pp 1x1x1 M=1 117.6 (+1.4% schedule overhead), M=4 121.7.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.bench.train_bench import bench_train
+from tpuscratch.bench.timing import time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.models.transformer import (
+    TransformerConfig, _loss, init_params, param_spec, param_spec_pp,
+    stack_layers, train_step_pp_fn,
+)
+from tpuscratch.runtime.mesh import make_mesh
+
+BASE = TransformerConfig(
+    d_model=1024, n_heads=8, n_experts=4, d_ff=4096, n_layers=4,
+    capacity_factor=2.0, attn_impl="pallas",
+)
+B, S, STEPS = 8, 2048, 20
+
+
+def run(label, cfg, optimizer="sgd"):
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    try:
+        r = bench_train(mesh, cfg, batch=B, seq=S, steps=STEPS, iters=3,
+                        optimizer=optimizer)
+        ms = r.p50 / STEPS * 1e3
+        print(f"{label}: {ms:.1f} ms/step  {r.items_per_s:.3e} tok/s",
+              flush=True)
+        return ms
+    except Exception as e:
+        print(f"{label}: FAILED {str(e)[:300]}", flush=True)
+        return None
+
+
+def fwd_only(label, cfg):
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    pspec = param_spec(cfg)
+
+    def body(params, x, y):
+        def one(xc, _):
+            loss = _loss(params, xc, y, cfg, "sp", "dp")
+            return xc + loss.astype(xc.dtype) * 1e-6, loss
+
+        xf, losses = lax.scan(one, x, None, length=STEPS)
+        return xf[0, 0, 0] + losses[-1]
+
+    prog = run_spmd(mesh, body, (pspec, P("dp", "sp"), P("dp", "sp")), P())
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((B, S, BASE.d_model)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((B, S, BASE.d_model)).astype(np.float32))
+    params = init_params(0, cfg)
+    r = time_device(prog, params, x, y, iters=3, warmup=1, fence="readback",
+                    name=label)
+    print(f"{label}: {r.p50 / STEPS * 1e3:.1f} ms/step", flush=True)
+
+
+def pp_row_bench(cfg, batch, seq, steps, n_micro, iters=3,
+                 fence="readback"):
+    """tokens/s of the 3-axis train step on the degenerate 1x1x1 mesh
+    (schedule-overhead row; the recorder's config 11 calls this)."""
+    mesh = make_mesh((1, 1, 1), ("dp", "sp", "stage"))
+    pspec = param_spec_pp(cfg)
+    step = train_step_pp_fn(cfg, lr=1e-3, n_micro=n_micro)
+
+    def body(params, x, y):
+        def one(p, _):
+            p, loss = step(p, x, y)
+            return p, loss
+
+        params, losses = lax.scan(one, params, None, length=steps)
+        return params, losses[-1]
+
+    prog = run_spmd(mesh, body, (pspec, P("dp", "sp"), P("dp", "sp")),
+                    (pspec, P()))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(
+        rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32)
+    )
+    y = jnp.asarray(
+        rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32)
+    )
+    stacked = stack_layers(init_params(0, cfg))
+    _, loss = prog(stacked, x, y)
+    assert np.isfinite(float(loss)), float(loss)
+    return time_device(
+        prog, stacked, x, y, iters=iters, warmup=1, fence=fence,
+        name=(f"train-pp d{cfg.d_model} L{cfg.n_layers} M={n_micro} "
+              f"b{batch} s{seq} x{steps} on 1x1x1"),
+        items=batch * seq * steps,
+    )
+
+
+def pp_row(n_micro):
+    r = pp_row_bench(BASE, batch=B, seq=S, steps=STEPS, n_micro=n_micro)
+    ms = r.p50 / STEPS * 1e3
+    print(f"pp degenerate 1x1x1 M={n_micro}: {ms:.1f} ms/step  "
+          f"{r.items_per_s:.3e} tok/s", flush=True)
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}", flush=True)
+    bf = dataclasses.replace(BASE, compute_dtype="bfloat16")
+    run("f32 full (row-11 anchor)", BASE)
+    run("bf16 full", bf)
+    run("bf16 attn=xla (dense hops)", dataclasses.replace(bf, attn_impl="xla"))
+    run("bf16 e=1 cap=1 (MoE share)", dataclasses.replace(
+        bf, n_experts=1, capacity_factor=1.0))
+    run("bf16 adam", bf, optimizer="adam")
+    fwd_only("bf16 fwd-only (loss scan)", bf)
+    fwd_only("f32 fwd-only (loss scan)", BASE)
+    fwd_only("bf16 e=1 cap=1 fwd-only", dataclasses.replace(
+        bf, n_experts=1, capacity_factor=1.0))
+    run("f32 adam", BASE, optimizer="adam")
+    pp_row(1)
+    pp_row(4)

@@ -78,7 +78,10 @@ def make_stencil_program(
     ``impl='dma'`` the double-buffered remote-DMA Pallas kernel
     (ops.halo_dma — core VMEM-resident, halo strips by async DMA; takes
     9-point coeffs too, corners riding the DMA); ``impl='dma-deep:k'``
-    the same kernel folding k substeps per exchange in-kernel.
+    the same kernel folding k substeps per exchange in-kernel;
+    ``impl='dma-hbm'`` the HBM-resident banded variant for cores beyond
+    VMEM (the core streams through in row bands, strips still on the
+    DMA engine — serves the 8192^2-class tiles ``dma`` must refuse).
     ``unroll`` is the scan unroll factor for the per-step impls and the
     kernel's inner unroll for 'resident' (defaults 1 and 8)."""
     if len(coeffs) == 9 and impl != "xla" and not impl.startswith("dma"):
@@ -87,6 +90,10 @@ def make_stencil_program(
         )
     if impl == "resident":
         step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=8 if unroll is None else unroll)[None, None]  # noqa: E731
+    elif impl == "dma-hbm":
+        from tpuscratch.ops.halo_dma import run_stencil_dma_hbm
+
+        step_fn = lambda t: run_stencil_dma_hbm(t[0, 0], spec, steps, coeffs)[None, None]  # noqa: E731
     elif impl == "dma" or impl.startswith("dma-deep:"):
         from tpuscratch.ops.halo_dma import run_stencil_dma
 

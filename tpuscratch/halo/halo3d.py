@@ -463,10 +463,9 @@ def run_stencil3d_stream(
     """
     from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
 
-    if len(coeffs) != 7:
+    if len(coeffs) not in (7, 27):
         raise ValueError(
-            f"stream impl is 7-point only (got {len(coeffs)} coeffs); "
-            "use impl='compact' for 27-point"
+            f"stream impl takes 7 or 27 coefficients, got {len(coeffs)}"
         )
     topo = spec.topology
     for a, name in ((1, "y"), (2, "x")):

@@ -211,7 +211,16 @@ def run_stencil_resident(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.
             f"resident stencil is single-device only, got mesh {spec.topology.dims}"
         )
     if not all(spec.topology.periodic):
-        raise ValueError("resident stencil requires a periodic topology")
+        # design decision: the kernel's whole economy is modular
+        # indexing of the core (wrap == free); zero-ghost open edges
+        # would reintroduce the border bookkeeping it exists to shed.
+        # Open boundaries run on run_stencil or run_stencil_deep
+        # impl='xla' (open-aware trapezoid).
+        raise ValueError(
+            "resident stencil requires a periodic topology; use "
+            "run_stencil or run_stencil_deep(impl='xla') for open "
+            "boundaries"
+        )
     from tpuscratch.ops.stencil_kernel import resident_periodic_pallas
 
     hy, hx = lay.halo_y, lay.halo_x

@@ -100,6 +100,10 @@ def init_params(seed: int, cfg: TransformerConfig) -> dict:
 
 
 EXPERT_LEAVES = ("w_in", "w_out")  # the leaves sharded over "dp"
+#: every per-layer parameter name (param_spec and param_spec_pp build
+#: their spec pytrees from this one list so they can never drift)
+LAYER_LEAVES = ("wq", "wk", "wv", "wo", "ln1", "ln2",
+                "gate", "w_in", "w_out")
 
 
 def _is_expert_leaf(path) -> bool:
@@ -113,8 +117,7 @@ def param_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
     shape would cost RNG time and device memory)."""
     layer = {
         name: P(dp) if name in EXPERT_LEAVES else P()
-        for name in ("wq", "wk", "wv", "wo", "ln1", "ln2",
-                     "gate", "w_in", "w_out")
+        for name in LAYER_LEAVES
     }
     return {"layers": [dict(layer) for _ in range(cfg.n_layers)]}
 
@@ -309,6 +312,162 @@ def _validate_step_config(mesh, cfg: TransformerConfig, dp: str, sp: str):
             f"ulysses-pallas needs n_heads {cfg.n_heads} divisible by "
             f"sp size {mesh.shape[sp]}"
         )
+
+
+def stack_layers(params: dict) -> dict:
+    """Stack the per-layer dicts into one dict of (n_layers, ...) arrays
+    — the layout the stage axis shards (leading axis = layer = stage
+    ownership)."""
+    layers = params["layers"]
+    return {
+        "layers": {
+            k: jnp.stack([p[k] for p in layers]) for k in layers[0]
+        }
+    }
+
+
+def unstack_layers(stacked: dict) -> dict:
+    """Inverse of :func:`stack_layers`."""
+    sl = stacked["layers"]
+    n = next(iter(sl.values())).shape[0]
+    return {"layers": [{k: sl[k][i] for k in sl} for i in range(n)]}
+
+
+def param_spec_pp(cfg: TransformerConfig, stage: str = "stage",
+                  dp: str = "dp") -> dict:
+    """PartitionSpec pytree for :func:`stack_layers`' output: every leaf
+    sharded over ``stage`` on the layer axis; expert leaves additionally
+    over ``dp`` on their expert axis."""
+    return {
+        "layers": {
+            name: P(stage, dp) if name in EXPERT_LEAVES else P(stage)
+            for name in LAYER_LEAVES
+        }
+    }
+
+
+def train_step_pp_fn(cfg: TransformerConfig, lr: float = 1e-2,
+                     n_micro: int = 2, sp: str = "sp", dp: str = "dp",
+                     stage: str = "stage"):
+    """The 3-axis shard_map body: GPipe microbatching over ``stage``
+    wrapping the dp x sp block (ring attention over sp, expert MoE over
+    dp) — all four strategies composed in ONE program.
+
+    Each stage rank owns ``n_layers / |stage|`` consecutive layers
+    (stacked leaves, :func:`param_spec_pp`); the local batch splits into
+    ``n_micro`` microbatches streaming through the open ppermute chain
+    on the GPipe schedule (parallel/pipeline.py); every tick every stage
+    runs its layers' full dp x sp block.  The MoE aux loss accumulates
+    per (tick, stage) masked by schedule validity and is averaged over
+    microbatches, so its scale matches the sequential step's.  Gradient
+    reduction is :func:`_grad_reduce` unchanged: ``stage`` is an
+    ownership axis (different layers), never a copy axis — the same
+    reason expert leaves skip the ``dp`` psum.  Reference lineage: the
+    lock-step stage circulation of mpi4.cpp:24-44, made trainable.
+    """
+
+    def loss_fn(stacked, x, y):
+        cd = jnp.dtype(cfg.compute_dtype)
+        if cd != jnp.float32:
+            stacked = jax.tree.map(lambda w: w.astype(cd), stacked)
+            x = x.astype(cd)
+        n_stage = lax.axis_size(stage)
+        me = lax.axis_index(stage)
+        sl = stacked["layers"]
+        ls = next(iter(sl.values())).shape[0]  # layers per stage
+        B, S, d = x.shape
+        M = n_micro
+        if B % M:
+            raise ValueError(f"local batch {B} not divisible by {M} microbatches")
+        micro = x.reshape(M, B // M, S, d)
+
+        def stage_apply(act):
+            aux = jnp.float32(0.0)
+            for i in range(ls):
+                p = {k: sl[k][i] for k in sl}
+                act, a = _block(p, act, cfg, sp, dp)
+                aux = aux + a
+            return act, aux
+
+        ticks = M + n_stage - 1
+        shift = [(i, i + 1) for i in range(n_stage - 1)]
+        out0 = jnp.zeros_like(micro)
+        act0 = jnp.zeros_like(micro[0])
+
+        def tick(state, t):
+            act, out, aux_acc = state
+            if n_stage > 1:
+                incoming = lax.ppermute(act, stage, shift)
+            else:
+                incoming = act
+            inject = jnp.where(t < M, micro[jnp.clip(t, 0, M - 1)], 0.0)
+            a_in = jnp.where(me == 0, inject, incoming)
+            y_out, aux = stage_apply(a_in)
+            valid = jnp.logical_and(t - me >= 0, t - me < M)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            emit = t - (n_stage - 1)
+            upd = lax.dynamic_update_slice(
+                out, y_out[None],
+                (jnp.clip(emit, 0, M - 1),) + (0,) * y_out.ndim,
+            )
+            out = jnp.where((me == n_stage - 1) & (emit >= 0), upd, out)
+            return (y_out, out, aux_acc), ()
+
+        (_, out, aux_acc), _ = lax.scan(
+            tick, (act0, out0, jnp.float32(0.0)), jnp.arange(ticks)
+        )
+        out = lax.psum(jnp.where(me == n_stage - 1, out, 0.0), stage)
+        out = out.reshape(B, S, d)
+        aux = lax.psum(aux_acc, stage) / M
+        mse = jnp.mean(
+            jnp.square(out.astype(jnp.float32) - y.astype(jnp.float32))
+        )
+        return lax.pmean(mse + cfg.aux_coef * aux, (dp, sp))
+
+    def step(stacked, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(stacked, x, y)
+        grads = _grad_reduce(grads, dp, sp)
+        # every stage rank seeds its own replica of the (stage-
+        # replicated) loss, and the stage-psum/ppermute-chain transposes
+        # deliver ALL |stage| seeds to every leaf — a uniform
+        # |stage|-fold overcount on top of the dp x sp accounting
+        # (_grad_reduce's n covers only the axes it psums over)
+        n_stage = lax.axis_size(stage)
+        if n_stage > 1:
+            grads = jax.tree.map(lambda g: g / n_stage, grads)
+        new_params = jax.tree.map(lambda w, g: w - lr * g, stacked, grads)
+        return new_params, loss
+
+    return step
+
+
+def train_step_pp(
+    mesh: Mesh,
+    cfg: TransformerConfig,
+    lr: float = 1e-2,
+    n_micro: int = 2,
+    dp: str = "dp",
+    sp: str = "sp",
+    stage: str = "stage",
+):
+    """Compiled 3-axis training step over ``mesh`` (dp x sp x stage):
+    jit'd fn(stacked_params, x, y) -> (stacked_params, loss) with the
+    stacked layout from :func:`stack_layers` sharded by
+    :func:`param_spec_pp` and x, y (batch, seq, d_model) sharded
+    P(dp, sp)."""
+    _validate_step_config(mesh, cfg, dp, sp)
+    n_stage = mesh.shape[stage]
+    if cfg.n_layers % n_stage:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by stage size {n_stage}"
+        )
+    pspec = param_spec_pp(cfg, stage, dp)
+    return run_spmd(
+        mesh,
+        train_step_pp_fn(cfg, lr, n_micro, sp=sp, dp=dp, stage=stage),
+        (pspec, P(dp, sp), P(dp, sp)),
+        (pspec, P()),
+    )
 
 
 def train_step(

@@ -73,6 +73,8 @@ NW, NE, SW, SE = range(4, 8)
 _COLLECTIVE_ID = 11
 #: ...and for the generalized (depth-k, corner-carrying) kernel.
 _COLLECTIVE_ID_DEEP = 12
+#: ...and for the HBM-resident banded kernel (one invocation per step).
+_COLLECTIVE_ID_HBM = 13
 
 #: (dy, dx) per coefficient, in halo.stencil.nine_point coeff order
 #: (n, s, w, e, nw, ne, sw, se, center).
@@ -605,6 +607,340 @@ def _run_stencil_dma_deep(tile, spec, steps, coeffs9, depth, vmem_limit_bytes):
     return halo_exchange(rebuild(tile, new_core, lay), spec)
 
 
+def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
+                     band: int, nb: int, H: int, W: int, Hp: int, Wp: int,
+                     coeffs: Coeffs):
+    """One STEP of the HBM-resident banded halo stencil (invoked once
+    per step; the scan lives outside).  The core never enters VMEM whole:
+    it streams through in ``band``-row windows (double-buffered manual
+    DMA, the ops/stencil_stream schedule) while the four ghost strips
+    travel by remote DMA under the stream.  Columns are carried between
+    invocations as (Hp, 1) stage arrays so no strided HBM access ever
+    happens (the reference moves the same strided subarrays without
+    materializing them, stencil2D.h:210-228).
+
+    Cross-invocation safety needs no credit handshake, but it DOES need
+    per-sender entry gates rather than one counted barrier: a counted
+    barrier can be satisfied by a fast neighbor's next-invocation signal
+    while a lagging neighbor is still consuming the previous strips.
+    Instead, each rank signals (per channel) the neighbor that sends TO
+    it, and a sender transmits only after the signal from its
+    DESTINATION — so a strip can never land before its receiver entered
+    the invocation (hence finished the previous one, hence consumed its
+    strips), and the signal chain bounds skew to one invocation.
+    Semaphore state persists across invocations (the family's standard
+    assumption: kernels drain their semaphores rather than rely on
+    re-zeroing), so an early next-invocation signal waits its turn.
+    """
+    R, C = dims
+    ns_remote = R > 1
+    ew_remote = C > 1
+    cn, cs, cw, ce, cc = coeffs
+
+    def kernel(in_hbm, colL_ref, colR_ref, out_hbm, ncolL_ref, ncolR_ref,
+               rbuf, wbuf, gL, gR, r_top, r_bot, r_left, r_right,
+               s_top, s_bot, s_left, s_right,
+               rsem, wsem, esem, send_sem, recv_sem, entry_sem):
+        row = lax.axis_index(axes[0])
+        col = lax.axis_index(axes[1])
+        north = lax.rem(row + R - 1, R) * C + col
+        south = lax.rem(row + 1, R) * C + col
+        west = row * C + lax.rem(col + C - 1, C)
+        east = row * C + lax.rem(col + 1, C)
+        dests = {TOP: south, BOTTOM: north, LEFT: east, RIGHT: west}
+        senders = {TOP: north, BOTTOM: south, LEFT: west, RIGHT: east}
+        bufs = {TOP: r_top, BOTTOM: r_bot, LEFT: r_left, RIGHT: r_right}
+        remote = {TOP: ns_remote, BOTTOM: ns_remote,
+                  LEFT: ew_remote, RIGHT: ew_remote}
+
+        for ch in (TOP, BOTTOM, LEFT, RIGHT):
+            if remote[ch]:
+                # tell the rank that sends my ch strip that I am ready
+                # to receive it (its entry gate for this channel)
+                pltpu.semaphore_signal(
+                    entry_sem.at[ch], inc=1, device_id=senders[ch],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+        for ch in (TOP, BOTTOM, LEFT, RIGHT):
+            if remote[ch]:
+                # wait for MY destination's readiness before sending
+                pltpu.semaphore_wait(entry_sem.at[ch], 1)
+
+        # edge rows: HBM -> VMEM stages (contiguous, addressable)
+        e_top = pltpu.make_async_copy(
+            in_hbm.at[pl.ds(H - 1, 1)], s_top.at[:, pl.ds(0, W)],
+            esem.at[0])
+        e_bot = pltpu.make_async_copy(
+            in_hbm.at[pl.ds(0, 1)], s_bot.at[:, pl.ds(0, W)], esem.at[1])
+        e_top.start()
+        e_bot.start()
+        # column stages: carried in as (Hp, 1), transposed to lane-major
+        s_left[:, 0:H] = jnp.swapaxes(colR_ref[0:H, :], 0, 1)
+        s_right[:, 0:H] = jnp.swapaxes(colL_ref[0:H, :], 0, 1)
+        e_top.wait()
+        e_bot.wait()
+
+        stages = {TOP: s_top, BOTTOM: s_bot, LEFT: s_left, RIGHT: s_right}
+        copies = []
+        for ch in (TOP, BOTTOM, LEFT, RIGHT):
+            if remote[ch]:
+                dma = pltpu.make_async_remote_copy(
+                    src_ref=stages[ch].at[:],
+                    dst_ref=bufs[ch].at[:],
+                    send_sem=send_sem.at[ch],
+                    recv_sem=recv_sem.at[ch],
+                    device_id=dests[ch],
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+            else:
+                dma = pltpu.make_async_copy(
+                    stages[ch].at[:], bufs[ch].at[:], recv_sem.at[ch])
+            copies.append((ch, dma))
+            dma.start()
+
+        def rd(slot, b):
+            # window rows [b*band - 1, b*band + band + 1) of the core
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(b * band - 1, band + 2)], rbuf.at[slot],
+                rsem.at[slot])
+
+        def rd_first(slot):
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(0, band + 1)],
+                rbuf.at[slot, pl.ds(1, band + 1)], rsem.at[slot])
+
+        def rd_last(slot):
+            return pltpu.make_async_copy(
+                in_hbm.at[pl.ds(H - band - 1, band + 1)],
+                rbuf.at[slot, pl.ds(0, band + 1)], rsem.at[slot])
+
+        def wr(slot, b):
+            return pltpu.make_async_copy(
+                wbuf.at[slot], out_hbm.at[pl.ds(b * band, band)],
+                wsem.at[slot])
+
+        rd_first(0).start()
+        if nb == 2:
+            rd_last(1).start()
+        else:
+            rd(1, 1).start()
+
+        # the strips arrive under the first window reads; ghost columns
+        # transpose once to sublane-major for per-band slicing
+        for ch, dma in copies:
+            dma.wait_recv() if remote[ch] else dma.wait()
+        gL[0:H, :] = jnp.swapaxes(r_left[:, 0:H], 0, 1)
+        gR[0:H, :] = jnp.swapaxes(r_right[:, 0:H], 0, 1)
+
+        def body(b, carry):
+            slot = lax.rem(b, 2)
+
+            @pl.when(b == 0)
+            def _():
+                rd_first(slot).wait()
+                rbuf[slot, 0:1, 0:W] = r_top[:, 0:W]
+
+            @pl.when(b == nb - 1)
+            def _():
+                rd_last(slot).wait()
+                rbuf[slot, band + 1 : band + 2, 0:W] = r_bot[:, 0:W]
+
+            @pl.when(jnp.logical_and(b > 0, b < nb - 1))
+            def _():
+                rd(slot, b).wait()
+
+            @pl.when(b >= 2)
+            def _():
+                wr(slot, b - 2).wait()
+
+            t = rbuf[slot]            # (band + 2, W)
+            c = t[1 : band + 1]
+            gl = gL[pl.ds(b * band, band)]   # (band, 1) ghost cols
+            gr = gR[pl.ds(b * band, band)]
+            wbuf[slot, :, 1 : W - 1] = (
+                cn * t[0:band, 1 : W - 1]
+                + cs * t[2 : band + 2, 1 : W - 1]
+                + cw * c[:, 0 : W - 2]
+                + ce * c[:, 2:W]
+                + cc * c[:, 1 : W - 1]
+            )
+            wbuf[slot, :, 0:1] = (
+                cn * t[0:band, 0:1] + cs * t[2 : band + 2, 0:1]
+                + cw * gl + ce * c[:, 1:2] + cc * c[:, 0:1]
+            )
+            wbuf[slot, :, W - 1 : W] = (
+                cn * t[0:band, W - 1 : W] + cs * t[2 : band + 2, W - 1 : W]
+                + cw * c[:, W - 2 : W - 1] + ce * gr + cc * c[:, W - 1 : W]
+            )
+            # stage the new edge columns for the NEXT invocation's sends
+            ncolL_ref[pl.ds(b * band, band)] = wbuf[slot, :, 0:1]
+            ncolR_ref[pl.ds(b * band, band)] = wbuf[slot, :, W - 1 : W]
+            wr(slot, b).start()
+
+            @pl.when(b + 2 < nb - 1)
+            def _():
+                rd(slot, b + 2).start()
+
+            @pl.when(b + 2 == nb - 1)
+            def _():
+                rd_last(slot).start()
+
+            return carry
+
+        lax.fori_loop(0, nb, body, 0)
+        for i in range(max(0, nb - 2), nb):
+            wr(i % 2, i).wait()
+        for ch, dma in copies:
+            if remote[ch]:
+                dma.wait_send()
+        if Hp > H:
+            z = jnp.zeros((Hp - H, 1), ncolL_ref.dtype)
+            ncolL_ref[pl.ds(H, Hp - H)] = z
+            ncolR_ref[pl.ds(H, Hp - H)] = z
+
+    return kernel
+
+
+def hbm_band(H: int, W: int, itemsize: int,
+             budget_bytes: int) -> int:
+    """Largest divisor band of ``H`` (preferring sublane-aligned
+    multiples of 8) whose window/write double-buffers fit the budget,
+    with >= 2 bands."""
+    def cost(b):
+        return (2 * (b + 2) + 2 * b) * W * itemsize + 4 * W * itemsize
+
+    cands = [d for d in range(H // 2, 0, -1) if H % d == 0]
+    aligned = [d for d in cands if d % 8 == 0]
+    for d in (aligned or cands):
+        if cost(d) <= budget_bytes:
+            return d
+    raise ValueError(
+        f"no band of H={H} fits {budget_bytes >> 20} MB VMEM"
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("spec", "steps", "coeffs", "band", "vmem_limit_bytes"),
+)
+def run_stencil_dma_hbm(
+    tile: jax.Array,
+    spec: HaloSpec,
+    steps: int,
+    coeffs: Coeffs = JACOBI,
+    band: int | None = None,
+    vmem_limit_bytes: int = 100 << 20,
+) -> jax.Array:
+    """``run_stencil_dma`` for cores that do NOT fit VMEM: the core
+    stays in HBM and streams through the kernel in ``band``-row windows
+    while the ghost strips ride the (remote) DMA engine under the
+    stream — one kernel invocation per step, entry-barrier ordered (see
+    ``_make_kernel_hbm``).  Columns carry between steps as small VMEM
+    stage arrays, so the strided column access the VMEM-resident kernel
+    pays per step never touches HBM.  This serves the config the
+    resident kernel must refuse (8192 ** 2 is a 1 GB core/2,
+    BASELINE row 4).  5-point, periodic topologies (the open-boundary
+    fallback is ``run_stencil``/``run_stencil_deep``).
+    """
+    lay = spec.layout
+    if tuple(tile.shape) != lay.padded_shape:
+        raise ValueError(f"tile {tile.shape} != padded {lay.padded_shape}")
+    if not all(spec.topology.periodic):
+        raise ValueError(
+            "the HBM-resident DMA kernel is periodic-only (design "
+            "decision: open edges would need per-rank ghost pinning in "
+            "every band); use run_stencil or run_stencil_deep for open "
+            "boundaries"
+        )
+    if len(coeffs) != 5:
+        raise ValueError(
+            "the HBM-resident DMA kernel is 5-point only; 9-point "
+            "corner traffic rides run_stencil_dma (VMEM-resident)"
+        )
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    H, W = lay.core_h, lay.core_w
+    dt = tile.dtype
+    if band is None:
+        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes)
+    if H % band or H // band < 2:
+        raise ValueError(
+            f"band {band} must divide H {H} with at least 2 bands"
+        )
+    nb = H // band
+    Hp = -(-H // 128) * 128
+    Wp = -(-W // 128) * 128
+    hy, hx = lay.halo_y, lay.halo_x
+    core = tile[hy : hy + H, hx : hx + W]
+    pad_h = Hp - H
+
+    def col_stage(c):
+        return jnp.pad(c, ((0, pad_h), (0, 0))) if pad_h else c
+
+    colL = col_stage(core[:, 0:1])
+    colR = col_stage(core[:, W - 1 : W])
+    kernel = _make_kernel_hbm(
+        spec.topology.dims, tuple(spec.axes), band, nb, H, W, Hp, Wp,
+        tuple(coeffs),
+    )
+    interpret = pltpu.InterpretParams() if use_interpret() else False
+    R, C = spec.topology.dims
+    collective_kw = (
+        {"collective_id": _COLLECTIVE_ID_HBM} if (R > 1 or C > 1) else {}
+    )
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((H, W), dt),
+            jax.ShapeDtypeStruct((Hp, 1), dt),
+            jax.ShapeDtypeStruct((Hp, 1), dt),
+        ),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((2, band + 2, W), dt),  # read windows
+            pltpu.VMEM((2, band, W), dt),      # write bands
+            pltpu.VMEM((Hp, 1), dt),           # ghost col L, sublane-major
+            pltpu.VMEM((Hp, 1), dt),           # ghost col R
+            pltpu.VMEM((1, Wp), dt),           # recv: top ghost row
+            pltpu.VMEM((1, Wp), dt),           # recv: bottom ghost row
+            pltpu.VMEM((1, Hp), dt),           # recv: left ghost col
+            pltpu.VMEM((1, Hp), dt),           # recv: right ghost col
+            pltpu.VMEM((1, Wp), dt),           # stage: my bottom row
+            pltpu.VMEM((1, Wp), dt),           # stage: my top row
+            pltpu.VMEM((1, Hp), dt),           # stage: my right col
+            pltpu.VMEM((1, Hp), dt),           # stage: my left col
+            pltpu.SemaphoreType.DMA((2,)),     # read slots
+            pltpu.SemaphoreType.DMA((2,)),     # write slots
+            pltpu.SemaphoreType.DMA((2,)),     # edge-row fetches
+            pltpu.SemaphoreType.DMA((4,)),     # send completion
+            pltpu.SemaphoreType.DMA((4,)),     # arrivals
+            pltpu.SemaphoreType.REGULAR((4,)),  # per-channel entry gates
+        ],
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=vmem_limit_bytes,
+            has_side_effects=True,
+            **collective_kw,
+        ),
+    )
+
+    def one(carry, _):
+        c, cl, cr = carry
+        return call(c, cl, cr), ()
+
+    (core, _, _), _ = lax.scan(one, (core, colL, colR), None, length=steps)
+    return halo_exchange(rebuild(tile, core, lay), spec)
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "steps", "coeffs", "depth", "vmem_limit_bytes"))
 def run_stencil_dma(
     tile: jax.Array,
@@ -641,7 +977,17 @@ def run_stencil_dma(
     if lay.halo_y < 1 or lay.halo_x < 1:
         raise ValueError("stencil needs halo >= 1 on both axes")
     if not all(spec.topology.periodic):
-        raise ValueError("DMA halo stencil requires a periodic topology")
+        # design decision, not a TODO: an open edge would need per-rank
+        # traced channel masks threaded through the credit handshake
+        # (different ranks have different live channels, but shard_map
+        # traces ONE program), for a path whose value is benchmarks on
+        # periodic tori. Open boundaries run on run_stencil (per-step)
+        # or run_stencil_deep impl='xla' (trapezoid, open-aware).
+        raise ValueError(
+            "DMA halo stencil requires a periodic topology; use "
+            "run_stencil or run_stencil_deep(impl='xla') for open "
+            "boundaries"
+        )
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
     if len(coeffs) == 9 and spec.neighbors != 8:

@@ -48,6 +48,87 @@ from tpuscratch.ops.common import mosaic_params, use_interpret
 from tpuscratch.ops.stencil_kernel import _asm3d_compute, _largest_divisor_band
 
 _VMEM_CEILING = 100 << 20
+#: the 27-point substep's temp pressure adds to the buffer footprint:
+#: at 512^2 planes band=8 is a Mosaic remote-compile DNF while band=4
+#: compiles and runs (chip-probed) — this tighter default budget makes
+#: the band chooser land on the working configuration
+_VMEM_CEILING_27 = 48 << 20
+
+
+def weight_cube(coeffs27, offsets26) -> tuple:
+    """Map OFFSETS26-ordered coefficients (+ center last) to a nested
+    (3, 3, 3) tuple W[dz+1][dy+1][dx+1] — the static layout the kernel's
+    27-point substep unrolls over."""
+    W = [[[0.0] * 3 for _ in range(3)] for _ in range(3)]
+    for (dz, dy, dx), cw in zip(offsets26, coeffs27[:-1]):
+        W[dz + 1][dy + 1][dx + 1] = float(cw)
+    W[1][1][1] = float(coeffs27[-1])
+    return tuple(tuple(tuple(r) for r in p) for p in W)
+
+
+def _substep27(o_ref, t, P: int, cy: int, cx: int, W):
+    """One 27-point substep on a (P, cy, cx) window value: for each
+    output plane, the three dz-shifted planes each contribute a 9-point
+    with periodic y/x wrap — ring-decomposed exactly like the 7-point
+    (_asm3d_compute): pure shifted slices in the interior, line-sized
+    wrapped concats on the four borders.  On z-slab meshes the
+    full-extent ghost slabs carry the edge/corner neighbor data
+    implicitly, which is why 26-neighbor exchange machinery is not
+    needed on this path."""
+    slabs = (t[0 : P - 2], t[1 : P - 1], t[2:P])  # dz = -1, 0, +1
+
+    def shx(line, dx):
+        # x-shift with periodic wrap on a (n, 1, cx) line
+        if dx == 0:
+            return line
+        if dx < 0:
+            return jnp.concatenate([line[:, :, -1:], line[:, :, :-1]], axis=2)
+        return jnp.concatenate([line[:, :, 1:], line[:, :, :1]], axis=2)
+
+    # interior: pure shifted slices.  One accumulating STORE per dz slab
+    # (not one 27-term fused expression): at 512^2 planes the fused form
+    # blows the Mosaic allocator's temp budget (observed remote-compile
+    # failure); the store boundaries cap live temps at one 9-term sum
+    for iz, u in enumerate(slabs):
+        acc = None
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                cw = W[iz][dy + 1][dx + 1]
+                term = cw * u[:, 1 + dy : cy - 1 + dy, 1 + dx : cx - 1 + dx]
+                acc = term if acc is None else acc + term
+        if iz == 0:
+            o_ref[:, 1 : cy - 1, 1 : cx - 1] = acc
+        else:
+            o_ref[:, 1 : cy - 1, 1 : cx - 1] = (
+                o_ref[:, 1 : cy - 1, 1 : cx - 1] + acc
+            )
+
+    # top / bottom rows: y wraps to the slab's own far rows, x wrap by
+    # line concat (the corner cells fall out of the wrapped shifts)
+    for row, ys in ((0, (cy - 1, 0, 1)), (cy - 1, (cy - 2, cy - 1, 0))):
+        acc = None
+        for iz, u in enumerate(slabs):
+            for dy, ysrc in zip((-1, 0, 1), ys):
+                line = u[:, ysrc : ysrc + 1, :]
+                for dx in (-1, 0, 1):
+                    term = W[iz][dy + 1][dx + 1] * shx(line, dx)
+                    acc = term if acc is None else acc + term
+        o_ref[:, row : row + 1, :] = acc
+
+    # left / right columns (interior rows only): y by plain slices, x
+    # wraps to the slab's own far columns
+    for col, xs in ((0, (cx - 1, 0, 1)), (cx - 1, (cx - 2, cx - 1, 0))):
+        acc = None
+        for iz, u in enumerate(slabs):
+            for dx, xsrc in zip((-1, 0, 1), xs):
+                colv = u[:, :, xsrc : xsrc + 1]
+                for dy in (-1, 0, 1):
+                    term = (
+                        W[iz][dy + 1][dx + 1]
+                        * colv[:, 1 + dy : cy - 1 + dy, :]
+                    )
+                    acc = term if acc is None else acc + term
+        o_ref[:, 1 : cy - 1, col : col + 1] = acc
 
 
 def _stream_kernel(flags_ref, mz_ref, pz_ref, in_hbm, out_hbm, rbuf, ping,
@@ -137,14 +218,18 @@ def _stream_kernel(flags_ref, mz_ref, pz_ref, in_hbm, out_hbm, rbuf, ping,
             src = rbuf.at[slot] if s == 0 else (ping if s % 2 else pong)
             dst = wbuf.at[slot] if s == k - 1 else (pong if s % 2 else ping)
             t = src[pl.ds(0, P)] if s else src[:]
-            c = t[1 : P - 1]
-            _asm3d_compute(
-                dst.at[pl.ds(0, P - 2)] if s != k - 1 else dst,
-                t[0 : P - 2], t[2:P], c,
-                c[:, cy - 1 : cy, :], c[:, 0:1, :],
-                c[:, :, cx - 1 : cx], c[:, :, 0:1],
-                cy, cx, w,
-            )
+            o_ref = dst.at[pl.ds(0, P - 2)] if s != k - 1 else dst
+            if len(w) == 3:  # (3,3,3) weight cube: the 27-point form
+                _substep27(o_ref, t, P, cy, cx, w)
+            else:
+                c = t[1 : P - 1]
+                _asm3d_compute(
+                    o_ref,
+                    t[0 : P - 2], t[2:P], c,
+                    c[:, cy - 1 : cy, :], c[:, 0:1, :],
+                    c[:, :, cx - 1 : cx], c[:, :, 0:1],
+                    cy, cx, w,
+                )
             # OPEN z boundaries re-impose the zero-ghost condition every
             # substep: the k-s-1 planes still acting as ghosts after
             # substep s+1 must stay zero on the physical-end bands (the
@@ -235,9 +320,34 @@ def seven_point_streamed_pallas(
     band by VMEM copy instead of re-reading it — HBM read traffic drops
     from (band+2k)/band x to 1x core per pass.  Default (None) enables
     it whenever the structure allows (nbuf == 2, band > depth).
+
+    ``coeffs7`` may also be 27 OFFSETS26-ordered coefficients (+ center
+    last): each substep then runs three dz-shifted 9-point ring
+    decompositions — the 27-point stencil on the fast streamed path.
+    On z-slab meshes the full-extent ghost slabs already carry every
+    edge/corner neighbor value, so no extra exchange machinery rides
+    along (the reference treats stencil width as a parameter of the
+    same exchange, stencil2D.h:116-117).
     """
     cz, cy, cx = core_shape
     k = depth
+    # the chooser budget decides the band; the Mosaic vmem limit stays
+    # at the full ceiling (the 27-point band must shrink to leave the
+    # allocator room for its substep temps, NOT because the buffers
+    # stop fitting — chip-probed: band=4 at 512^2 planes compiles under
+    # the 120 MB limit, band=8 does not, and band=4 under a 58 MB limit
+    # does not either)
+    chooser_budget = budget_bytes
+    if len(coeffs7) == 27:
+        from tpuscratch.halo.halo3d import OFFSETS26
+
+        coeffs7 = weight_cube(tuple(coeffs7), OFFSETS26)
+        if budget_bytes == _VMEM_CEILING:
+            chooser_budget = _VMEM_CEILING_27
+    elif len(coeffs7) != 7:
+        raise ValueError(
+            f"need 7 or 27 coefficients, got {len(coeffs7)}"
+        )
     if tuple(core.shape) != core_shape:
         raise ValueError(f"core {core.shape} != {core_shape}")
     if a_mz.shape != (k, cy, cx) or a_pz.shape != (k, cy, cx):
@@ -249,7 +359,7 @@ def seven_point_streamed_pallas(
         raise ValueError(f"depth must be >= 1, got {k}")
     if band is None:
         band = stream_band(cz, cy, cx, k, core.dtype.itemsize, nbuf,
-                           budget_bytes)
+                           chooser_budget)
     if cz % band or cz // band < 2:
         raise ValueError(
             f"band {band} must divide cz {cz} with at least 2 bands"
