@@ -675,7 +675,10 @@ class TestDmaImpl:
         from tpuscratch.ops.halo_dma import run_stencil_dma_hbm
 
         R, C = dims
-        TH, TW = 8, 8
+        # TH=32 with band=8 gives nb=4: the steady-state branches (slot
+        # repost under compute, b>=2 write waits, interior carry+next
+        # rows) all execute — nb=2 alone would leave them untested
+        TH, TW = 32, 8
         mesh = make_mesh_2d((R, C))
         topo = CartTopology((R, C), (True, True))
         lay = TileLayout(TH, TW, 1, 1)
@@ -687,7 +690,7 @@ class TestDmaImpl:
         outs = {}
         for name, fn in (
             ("xla", lambda t: run_stencil(t, spec, steps)),
-            ("hbm", lambda t: run_stencil_dma_hbm(t, spec, steps, band=4)),
+            ("hbm", lambda t: run_stencil_dma_hbm(t, spec, steps, band=8)),
         ):
             f = run_spmd(
                 mesh,

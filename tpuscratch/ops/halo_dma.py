@@ -639,16 +639,17 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
 
     def kernel(in_hbm, colL_ref, colR_ref, out_hbm, ncolL_ref, ncolR_ref,
                rbuf, wbuf, gL, gR, r_top, r_bot, r_left, r_right,
-               s_top, s_bot, s_left, s_right,
+               s_top, s_bot, s_left, s_right, erow_t, erow_b,
                rsem, wsem, esem, send_sem, recv_sem, entry_sem):
-        row = lax.axis_index(axes[0])
-        col = lax.axis_index(axes[1])
-        north = lax.rem(row + R - 1, R) * C + col
-        south = lax.rem(row + 1, R) * C + col
-        west = row * C + lax.rem(col + C - 1, C)
-        east = row * C + lax.rem(col + 1, C)
-        dests = {TOP: south, BOTTOM: north, LEFT: east, RIGHT: west}
-        senders = {TOP: north, BOTTOM: south, LEFT: west, RIGHT: east}
+        if ns_remote or ew_remote:
+            row = lax.axis_index(axes[0])
+            col = lax.axis_index(axes[1])
+            north = lax.rem(row + R - 1, R) * C + col
+            south = lax.rem(row + 1, R) * C + col
+            west = row * C + lax.rem(col + C - 1, C)
+            east = row * C + lax.rem(col + 1, C)
+            dests = {TOP: south, BOTTOM: north, LEFT: east, RIGHT: west}
+            senders = {TOP: north, BOTTOM: south, LEFT: west, RIGHT: east}
         bufs = {TOP: r_top, BOTTOM: r_bot, LEFT: r_left, RIGHT: r_right}
         remote = {TOP: ns_remote, BOTTOM: ns_remote,
                   LEFT: ew_remote, RIGHT: ew_remote}
@@ -666,12 +667,16 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
                 # wait for MY destination's readiness before sending
                 pltpu.semaphore_wait(entry_sem.at[ch], 1)
 
-        # edge rows: HBM -> VMEM stages (contiguous, addressable)
+        # edge rows: HBM -> VMEM. DMA windows must be 8-row (sublane
+        # tile) aligned and 8-row multiples (chip-probed: 1-row windows
+        # are a Mosaic remote-compile DNF even at offset 0), so fetch
+        # the 8-row tiles holding the edges and VPU-copy the edge row
+        # into the lane-padded send stage
         e_top = pltpu.make_async_copy(
-            in_hbm.at[pl.ds(H - 1, 1)], s_top.at[:, pl.ds(0, W)],
+            in_hbm.at[pl.ds(H - 8, 8)], erow_t.at[:, pl.ds(0, W)],
             esem.at[0])
         e_bot = pltpu.make_async_copy(
-            in_hbm.at[pl.ds(0, 1)], s_bot.at[:, pl.ds(0, W)], esem.at[1])
+            in_hbm.at[pl.ds(0, 8)], erow_b.at[:, pl.ds(0, W)], esem.at[1])
         e_top.start()
         e_bot.start()
         # column stages: carried in as (Hp, 1), transposed to lane-major
@@ -679,6 +684,8 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
         s_right[:, 0:H] = jnp.swapaxes(colL_ref[0:H, :], 0, 1)
         e_top.wait()
         e_bot.wait()
+        s_top[:, 0:W] = erow_t[7:8, 0:W]
+        s_bot[:, 0:W] = erow_b[0:1, 0:W]
 
         stages = {TOP: s_top, BOTTOM: s_bot, LEFT: s_left, RIGHT: s_right}
         copies = []
@@ -698,32 +705,25 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
             copies.append((ch, dma))
             dma.start()
 
+        # band reads are EXACT band-row windows (8-row-tile aligned,
+        # affine offsets, ONE descriptor geometry — the chip compiler
+        # rejects clip/where offsets and branch-selected descriptor
+        # shapes, chip-bisected): no overlap is re-read; band b's top
+        # halo row travels as a loop-carried VALUE (its own window's
+        # last row, saved before the slot is reused) and its bottom
+        # halo row comes from band b+1's window, waited one band ahead
         def rd(slot, b):
-            # window rows [b*band - 1, b*band + band + 1) of the core
             return pltpu.make_async_copy(
-                in_hbm.at[pl.ds(b * band - 1, band + 2)], rbuf.at[slot],
+                in_hbm.at[pl.ds(b * band, band)], rbuf.at[slot],
                 rsem.at[slot])
-
-        def rd_first(slot):
-            return pltpu.make_async_copy(
-                in_hbm.at[pl.ds(0, band + 1)],
-                rbuf.at[slot, pl.ds(1, band + 1)], rsem.at[slot])
-
-        def rd_last(slot):
-            return pltpu.make_async_copy(
-                in_hbm.at[pl.ds(H - band - 1, band + 1)],
-                rbuf.at[slot, pl.ds(0, band + 1)], rsem.at[slot])
 
         def wr(slot, b):
             return pltpu.make_async_copy(
                 wbuf.at[slot], out_hbm.at[pl.ds(b * band, band)],
                 wsem.at[slot])
 
-        rd_first(0).start()
-        if nb == 2:
-            rd_last(1).start()
-        else:
-            rd(1, 1).start()
+        rd(0, 0).start()
+        rd(1, 1).start()
 
         # the strips arrive under the first window reads; ghost columns
         # transpose once to sublane-major for per-band slicing
@@ -732,62 +732,62 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
         gL[0:H, :] = jnp.swapaxes(r_left[:, 0:H], 0, 1)
         gR[0:H, :] = jnp.swapaxes(r_right[:, 0:H], 0, 1)
 
-        def body(b, carry):
+        rd(0, 0).wait()
+
+        def body(b, up_row):
             slot = lax.rem(b, 2)
+            nxt = lax.rem(b + 1, 2)
 
-            @pl.when(b == 0)
+            @pl.when(b + 1 < nb)
             def _():
-                rd_first(slot).wait()
-                rbuf[slot, 0:1, 0:W] = r_top[:, 0:W]
-
-            @pl.when(b == nb - 1)
-            def _():
-                rd_last(slot).wait()
-                rbuf[slot, band + 1 : band + 2, 0:W] = r_bot[:, 0:W]
-
-            @pl.when(jnp.logical_and(b > 0, b < nb - 1))
-            def _():
-                rd(slot, b).wait()
+                rd(nxt, b + 1).wait()
 
             @pl.when(b >= 2)
             def _():
                 wr(slot, b - 2).wait()
 
-            t = rbuf[slot]            # (band + 2, W)
-            c = t[1 : band + 1]
-            gl = gL[pl.ds(b * band, band)]   # (band, 1) ghost cols
+            t = rbuf[slot]                      # (band, W) own rows
+            t_next0 = rbuf[nxt][0:1]            # band b+1's first row
+            dn_row = jnp.where(b == nb - 1, r_bot[:, 0:W], t_next0)
+            up = jnp.concatenate([up_row, t[0 : band - 1]], axis=0)
+            dn = jnp.concatenate([t[1:band], dn_row], axis=0)
+            gl = gL[pl.ds(b * band, band)]      # (band, 1) ghost cols
             gr = gR[pl.ds(b * band, band)]
-            wbuf[slot, :, 1 : W - 1] = (
-                cn * t[0:band, 1 : W - 1]
-                + cs * t[2 : band + 2, 1 : W - 1]
-                + cw * c[:, 0 : W - 2]
-                + ce * c[:, 2:W]
-                + cc * c[:, 1 : W - 1]
+            interior = (
+                cn * up[:, 1 : W - 1] + cs * dn[:, 1 : W - 1]
+                + cw * t[:, 0 : W - 2] + ce * t[:, 2:W]
+                + cc * t[:, 1 : W - 1]
             )
-            wbuf[slot, :, 0:1] = (
-                cn * t[0:band, 0:1] + cs * t[2 : band + 2, 0:1]
-                + cw * gl + ce * c[:, 1:2] + cc * c[:, 0:1]
+            left = (
+                cn * up[:, 0:1] + cs * dn[:, 0:1]
+                + cw * gl + ce * t[:, 1:2] + cc * t[:, 0:1]
             )
-            wbuf[slot, :, W - 1 : W] = (
-                cn * t[0:band, W - 1 : W] + cs * t[2 : band + 2, W - 1 : W]
-                + cw * c[:, W - 2 : W - 1] + ce * gr + cc * c[:, W - 1 : W]
+            right = (
+                cn * up[:, W - 1 : W] + cs * dn[:, W - 1 : W]
+                + cw * t[:, W - 2 : W - 1] + ce * gr + cc * t[:, W - 1 : W]
             )
+            new = jnp.concatenate([left, interior, right], axis=1)
+            # save the halo row band b+1 needs BEFORE this slot's buffer
+            # is reposted for band b+2
+            carry_row = t[band - 1 : band]
+            wbuf[slot] = new
             # stage the new edge columns for the NEXT invocation's sends
-            ncolL_ref[pl.ds(b * band, band)] = wbuf[slot, :, 0:1]
-            ncolR_ref[pl.ds(b * band, band)] = wbuf[slot, :, W - 1 : W]
+            ncolL_ref[pl.ds(b * band, band)] = left
+            ncolR_ref[pl.ds(b * band, band)] = right
             wr(slot, b).start()
 
-            @pl.when(b + 2 < nb - 1)
+            # repost at END of body (chip-raced: hoisting this above the
+            # compute measured 2.67 vs 2.39 ms/step at 8192^2 — the
+            # wait-one-ahead structure already overlaps reads with the
+            # previous band's compute, and an early repost contends with
+            # the in-flight next-band read)
+            @pl.when(b + 2 < nb)
             def _():
                 rd(slot, b + 2).start()
 
-            @pl.when(b + 2 == nb - 1)
-            def _():
-                rd_last(slot).start()
+            return carry_row
 
-            return carry
-
-        lax.fori_loop(0, nb, body, 0)
+        lax.fori_loop(0, nb, body, r_top[:, 0:W])
         for i in range(max(0, nb - 2), nb):
             wr(i % 2, i).wait()
         for ch, dma in copies:
@@ -803,19 +803,19 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
 
 def hbm_band(H: int, W: int, itemsize: int,
              budget_bytes: int) -> int:
-    """Largest divisor band of ``H`` (preferring sublane-aligned
-    multiples of 8) whose window/write double-buffers fit the budget,
-    with >= 2 bands."""
+    """Largest 8-multiple divisor band of ``H`` whose window/write
+    double-buffers fit the budget, with >= 2 bands (the DMA windows are
+    8-row-tile aligned, so bands must be too)."""
     def cost(b):
-        return (2 * (b + 2) + 2 * b) * W * itemsize + 4 * W * itemsize
+        return 4 * b * W * itemsize + 4 * W * itemsize
 
-    cands = [d for d in range(H // 2, 0, -1) if H % d == 0]
-    aligned = [d for d in cands if d % 8 == 0]
-    for d in (aligned or cands):
-        if cost(d) <= budget_bytes:
+    for d in range(H // 2, 7, -1):
+        if H % d == 0 and d % 8 == 0 and cost(d) <= budget_bytes:
             return d
     raise ValueError(
-        f"no band of H={H} fits {budget_bytes >> 20} MB VMEM"
+        f"no 8-aligned band of H={H} gives >= 2 bands within "
+        f"{budget_bytes >> 20} MB VMEM (need H >= 16 with 8 | H, and "
+        "the four band-sized buffers to fit the budget)"
     )
 
 
@@ -861,11 +861,20 @@ def run_stencil_dma_hbm(
         raise ValueError(f"steps must be >= 1, got {steps}")
     H, W = lay.core_h, lay.core_w
     dt = tile.dtype
-    if band is None:
-        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes)
-    if H % band or H // band < 2:
+    if H % 8:
         raise ValueError(
-            f"band {band} must divide H {H} with at least 2 bands"
+            f"core height {H} must be a multiple of 8 (the DMA windows "
+            "are 8-row-tile aligned)"
+        )
+    if band is None:
+        # half the vmem limit: the compute temps (band-sized concat
+        # pieces) need allocator headroom — band=512 at 8192^2 is an
+        # opaque remote-compile DNF under the full limit, band=256 runs
+        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes // 2)
+    if H % band or H // band < 2 or band % 8:
+        raise ValueError(
+            f"band {band} must be an 8-multiple divisor of H {H} with "
+            "at least 2 bands"
         )
     nb = H // band
     Hp = -(-H // 128) * 128
@@ -906,7 +915,7 @@ def run_stencil_dma_hbm(
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
-            pltpu.VMEM((2, band + 2, W), dt),  # read windows
+            pltpu.VMEM((2, band, W), dt),      # read windows (exact bands)
             pltpu.VMEM((2, band, W), dt),      # write bands
             pltpu.VMEM((Hp, 1), dt),           # ghost col L, sublane-major
             pltpu.VMEM((Hp, 1), dt),           # ghost col R
@@ -918,6 +927,8 @@ def run_stencil_dma_hbm(
             pltpu.VMEM((1, Wp), dt),           # stage: my top row
             pltpu.VMEM((1, Hp), dt),           # stage: my right col
             pltpu.VMEM((1, Hp), dt),           # stage: my left col
+            pltpu.VMEM((8, Wp), dt),           # edge-row tile: bottom
+            pltpu.VMEM((8, Wp), dt),           # edge-row tile: top
             pltpu.SemaphoreType.DMA((2,)),     # read slots
             pltpu.SemaphoreType.DMA((2,)),     # write slots
             pltpu.SemaphoreType.DMA((2,)),     # edge-row fetches
