@@ -84,9 +84,12 @@ def make_stencil_program(
     DMA engine — serves the 8192^2-class tiles ``dma`` must refuse).
     ``unroll`` is the scan unroll factor for the per-step impls and the
     kernel's inner unroll for 'resident' (defaults 1 and 8)."""
-    if len(coeffs) == 9 and impl != "xla" and not impl.startswith("dma"):
+    if len(coeffs) == 9 and impl != "xla" and not impl.startswith(
+        ("dma", "stream")
+    ):
         raise ValueError(
-            f"9-point coeffs need impl='xla' or a dma impl, got {impl!r}"
+            f"9-point coeffs need impl='xla', a dma impl, or 'stream:k', "
+            f"got {impl!r}"
         )
     if impl == "resident":
         step_fn = lambda t: run_stencil_resident(t[0, 0], spec, steps, coeffs, unroll=8 if unroll is None else unroll)[None, None]  # noqa: E731
@@ -94,6 +97,13 @@ def make_stencil_program(
         from tpuscratch.ops.halo_dma import run_stencil_dma_hbm
 
         step_fn = lambda t: run_stencil_dma_hbm(t[0, 0], spec, steps, coeffs)[None, None]  # noqa: E731
+    elif impl == "stream" or impl.startswith("stream:"):
+        from tpuscratch.halo.stencil import run_stencil_stream
+
+        sdepth = int(impl.split(":", 1)[1]) if ":" in impl else 8
+        if sdepth < 1:
+            raise ValueError(f"stream depth must be >= 1, got {impl!r}")
+        step_fn = lambda t: run_stencil_stream(t[0, 0], spec, steps, coeffs, sdepth)[None, None]  # noqa: E731
     elif impl == "dma" or impl.startswith("dma-deep:"):
         from tpuscratch.ops.halo_dma import run_stencil_dma
 

@@ -229,6 +229,89 @@ def run_stencil_resident(tile: jax.Array, spec: HaloSpec, steps: int, coeffs=(0.
     return halo_exchange(rebuild(tile, new_core, lay), spec)
 
 
+def run_stencil_stream(
+    tile: jax.Array,
+    spec: HaloSpec,
+    steps: int,
+    coeffs=(0.25, 0.25, 0.25, 0.25, 0.0),
+    depth: int = 8,
+    band: int | None = None,
+) -> jax.Array:
+    """``steps`` iterations via the row-banded streamed kernel
+    (ops/stencil_stream.nine_point_streamed_2d): ``depth`` substeps fold
+    into each manual-DMA pass, dividing per-step HBM traffic by
+    ``depth`` — the 2D form of the deep-z streamed kernel, for grids
+    beyond VMEM (where ``resident`` refuses).  Serves row-slab
+    decompositions: x must self-wrap (column axis degenerate periodic);
+    row ghosts travel as (depth, W) slabs, one exchange per ``depth``
+    steps.  5-point AND 9-point coefficients (full-extent rows carry the
+    diagonal neighbors implicitly).  Open row ends re-impose zero ghosts
+    per substep via per-rank traced flags.  Takes/returns a padded tile
+    (trailing exchange), interchangeable with the other impls.
+    """
+    from tpuscratch.ops.stencil_stream import nine_point_streamed_2d
+
+    lay = spec.layout
+    topo = spec.topology
+    if tuple(tile.shape) != lay.padded_shape:
+        raise ValueError(f"tile {tile.shape} != padded {lay.padded_shape}")
+    if not (topo.dims[1] == 1 and topo.periodic[1]):
+        raise ValueError(
+            "stream impl needs a self-wrapping column axis (row-slab "
+            f"decomposition), got dims={topo.dims} "
+            f"periodic={topo.periodic}; use impl='deep:k' or the "
+            "per-step impls for distributed columns"
+        )
+    H, W = lay.core_h, lay.core_w
+    hy, hx = lay.halo_y, lay.halo_x
+    core = tile[hy : hy + H, hx : hx + W]
+    wrap_y = topo.dims[0] == 1 and topo.periodic[0]
+
+    def ghosts(c, d):
+        if wrap_y:
+            return c[H - d :], c[:d]
+        if topo.dims[0] == 1:  # single rank, open rows: zero ghosts
+            z = jnp.zeros((d, W), c.dtype)
+            return z, z
+        a_top = lax.ppermute(
+            c[H - d :], spec.axes, list(topo.send_permutation((1, 0)))
+        )
+        a_bot = lax.ppermute(
+            c[:d], spec.axes, list(topo.send_permutation((-1, 0)))
+        )
+        return a_top, a_bot
+
+    def open_flags():
+        if topo.periodic[0]:
+            return None
+        if topo.dims[0] == 1:
+            return jnp.ones((2,), jnp.int32)
+        rc = lax.axis_index(spec.axes[0])
+        return jnp.stack(
+            [(rc == 0).astype(jnp.int32),
+             (rc == topo.dims[0] - 1).astype(jnp.int32)]
+        )
+
+    flags = open_flags()
+
+    def pass_fn(c, d):
+        a_top, a_bot = ghosts(c, d)
+        return nine_point_streamed_2d(
+            c, a_top, a_bot, (H, W), tuple(coeffs), d, band,
+            open_flags=flags,
+        )
+
+    q, r = divmod(steps, depth)
+    out = core
+    if q:
+        out, _ = lax.scan(
+            lambda c, _: (pass_fn(c, depth), ()), out, None, length=q
+        )
+    if r:
+        out = pass_fn(out, r)
+    return halo_exchange(rebuild(tile, out, lay), spec)
+
+
 def shrink_step(a: jax.Array, coeffs) -> jax.Array:
     """One valid-region Jacobi step: (H, W) -> (H-2, W-2), every output
     cell computed from fully-valid neighbors. The building block of the

@@ -409,3 +409,223 @@ def seven_point_streamed_pallas(
         interpret=interpret,
         **mosaic_params(vmem_limit_bytes=int(budget_bytes * 1.2)),
     )(open_flags.astype(jnp.int32), a_mz, a_pz, core)
+
+
+# ---------------------------------------------------------------------------
+# The 2D twin: row-banded deep streaming for (H, W) grids.
+#
+# Same economics as the 3D kernel (k substeps per manual-DMA pass divide
+# the per-step HBM traffic by k past the ~330 GB/s DMA-fabric bound), but
+# the 2D row dimension IS the sublane dimension, so the 3D kernel's
+# overlapping ghost-extended windows would violate the chip DMA rules
+# BASELINE row 4 records (8-row alignment, affine provably-in-bounds
+# offsets, one descriptor geometry).  This kernel therefore reads EXACT
+# band-row windows (offset b*band, length band — aligned and in-bounds by
+# construction) and assembles the (band + 2k)-row compute window at VALUE
+# level: the top k halo rows ride the fori carry (each band's pass-start
+# rows [band-k, band)), the bottom k rows come from the NEXT band's
+# window (waited one band ahead), and the grid ends splice in the ghost
+# slabs.  x self-wraps in-kernel (full-extent rows), so the kernel serves
+# row-slab decompositions — and 9-point coefficients cost nothing extra:
+# the full-extent rows carry the diagonal neighbors implicitly.
+# ---------------------------------------------------------------------------
+
+
+def _substep2d(o_ref, t, P: int, W: int, w9, rows_out: int):
+    """One 9-point substep on a (P, W) window value: rows shrink by one
+    per side, x wraps periodically (ring decomposition: interior columns
+    by shifted slices, the two edge columns by wrapped line concats).
+    ``w9``: (3, 3) weight grid w9[dy+1][dx+1]; zero weights are skipped
+    statically, so 5-point coefficients pay no diagonal work."""
+    rows = {-1: t[0 : P - 2], 0: t[1 : P - 1], 1: t[2:P]}
+
+    def shifted(u, dx, lo, hi):
+        # u restricted to columns [lo, hi) shifted by dx with wrap
+        if dx == 0:
+            return u[:, lo:hi]
+        if lo == 1 and hi == W - 1:  # interior: pure slice
+            return u[:, 1 + dx : W - 1 + dx]
+        # edge column: wrapped single-column read
+        col = (lo + dx) % W
+        return u[:, col : col + 1]
+
+    for lo, hi in ((1, W - 1), (0, 1), (W - 1, W)):
+        acc = None
+        for dy in (-1, 0, 1):
+            u = rows[dy]
+            for dx in (-1, 0, 1):
+                cw = w9[dy + 1][dx + 1]
+                if cw == 0.0:
+                    continue
+                term = cw * shifted(u, dx, lo, hi)
+                acc = term if acc is None else acc + term
+        o_ref[0:rows_out, lo:hi] = acc
+
+
+def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
+                     rbuf, ping, pong, wbuf, rsem, wsem, *,
+                     band: int, depth: int, nb: int, W: int, w9):
+    k = depth
+    P0 = band + 2 * k
+
+    def rd(slot, b):
+        return pltpu.make_async_copy(
+            in_hbm.at[pl.ds(b * band, band)], rbuf.at[slot], rsem.at[slot])
+
+    def wr(slot, b):
+        return pltpu.make_async_copy(
+            wbuf.at[slot], out_hbm.at[pl.ds(b * band, band)], wsem.at[slot])
+
+    rd(0, 0).start()
+    if nb > 1:
+        rd(1, 1).start()
+    rd(0, 0).wait()
+
+    def body(b, carry_k):
+        slot = jax.lax.rem(b, 2)
+        nxt = jax.lax.rem(b + 1, 2)
+
+        @pl.when(b + 1 < nb)
+        def _():
+            rd(nxt, b + 1).wait()
+
+        @pl.when(b >= 2)
+        def _():
+            wr(slot, b - 2).wait()
+
+        t = rbuf[slot]                     # (band, W) pass-start rows
+        next_k = rbuf[nxt][0:k]
+        bot_k = jnp.where(b == nb - 1, mb_ref[:], next_k)
+        V = jnp.concatenate([carry_k, t, bot_k], axis=0)  # (P0, W)
+        new_carry = t[band - k : band]
+
+        # the substep chain sheds one row per side per substep; ping and
+        # pong are static refs, so their stores are plain static ranges
+        src_val = V
+        for s in range(k):
+            P = P0 - 2 * s
+            dst = wbuf.at[slot] if s == k - 1 else (pong if s % 2 else ping)
+            rows_out = band if s == k - 1 else P - 2
+            _substep2d(dst, src_val, P, W, w9, rows_out)
+            # OPEN y ends: the rows still acting as ghosts after substep
+            # s+1 must stay zero on the physical-end bands
+            g = k - s - 1
+            if g > 0:
+                z = jnp.zeros((g, W), mt_ref.dtype)
+
+                @pl.when(jnp.logical_and(flags_ref[0] == 1, b == 0))
+                def _(dst=dst, z=z, g=g):
+                    dst[pl.ds(0, g)] = z
+
+                @pl.when(jnp.logical_and(flags_ref[1] == 1, b == nb - 1))
+                def _(dst=dst, z=z, g=g, P=P):
+                    dst[pl.ds(P - 2 - g, g)] = z
+            if s != k - 1:
+                buf = pong if s % 2 else ping
+                src_val = buf[pl.ds(0, P - 2)]
+
+        wr(slot, b).start()
+
+        @pl.when(b + 2 < nb)
+        def _():
+            rd(slot, b + 2).start()
+
+        return new_carry
+
+    jax.lax.fori_loop(0, nb, body, mt_ref[:])
+    for i in range(max(0, nb - 2), nb):
+        wr(i % 2, i).wait()
+
+
+def weight_grid(coeffs9) -> tuple:
+    """nine_point coeff order (n, s, w, e, nw, ne, sw, se, center) ->
+    (3, 3) grid W[dy+1][dx+1]; 5-point coeffs get zero diagonals."""
+    c = tuple(float(x) for x in coeffs9)
+    if len(c) == 5:
+        c = c[:4] + (0.0,) * 4 + c[4:]
+    if len(c) != 9:
+        raise ValueError(f"need 5 or 9 coefficients, got {len(c)}")
+    n, s, w, e, nw, ne, sw, se, cc = c
+    return ((nw, n, ne), (w, cc, e), (sw, s, se))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("core_shape", "coeffs", "depth", "band",
+                     "budget_bytes"),
+)
+def nine_point_streamed_2d(
+    core: jax.Array,
+    a_top: jax.Array,
+    a_bot: jax.Array,
+    core_shape: tuple[int, int],
+    coeffs,
+    depth: int,
+    band: int | None = None,
+    budget_bytes: int = _VMEM_CEILING,
+    open_flags: jax.Array | None = None,
+) -> jax.Array:
+    """``depth`` 5/9-point Jacobi substeps in ONE streaming pass over an
+    (H, W) grid — the 2D twin of :func:`seven_point_streamed_pallas`
+    (see the section comment for why its window scheme differs).
+
+    ``a_top``/``a_bot``: (depth, W) ghost-row slabs (the row-slab
+    neighbors' far rows, or the core's own wrap slices).  x self-wraps.
+    ``open_flags``: (2,) int32 marking physical open top/bottom ends.
+    """
+    H, W = core_shape
+    k = depth
+    if tuple(core.shape) != core_shape:
+        raise ValueError(f"core {core.shape} != {core_shape}")
+    if a_top.shape != (k, W) or a_bot.shape != (k, W):
+        raise ValueError(
+            f"ghost slabs must be ({k}, {W}), got {a_top.shape}/{a_bot.shape}"
+        )
+    if k < 1:
+        raise ValueError(f"depth must be >= 1, got {k}")
+    w9 = weight_grid(coeffs)
+    if band is None:
+        plane = W * core.dtype.itemsize
+
+        def cost(b):
+            return (2 * b + 4 * (b + 2 * k) + 2 * b) * plane
+
+        band = _largest_divisor_band(H, cost, budget_bytes // 2, strict=True)
+        while H // band < 2:
+            band = next(d for d in range(band - 1, 0, -1) if H % d == 0)
+    if H % band or H // band < 2:
+        raise ValueError(f"band {band} must divide H {H} with >= 2 bands")
+    if k > band:
+        raise ValueError(f"depth {k} > band {band}")
+    if W < 3:
+        raise ValueError(f"W must be >= 3, got {W}")
+    nb = H // band
+    P0 = band + 2 * k
+    dt = core.dtype
+    if open_flags is None:
+        open_flags = jnp.zeros((2,), jnp.int32)
+    kern = functools.partial(
+        _stream2d_kernel, band=band, depth=k, nb=nb, W=W, w9=w9,
+    )
+    interpret = pltpu.InterpretParams() if use_interpret() else False
+    return pl.pallas_call(
+        kern,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
+        out_shape=jax.ShapeDtypeStruct((H, W), dt),
+        scratch_shapes=[
+            pltpu.VMEM((2, band, W), dt),            # read windows
+            pltpu.VMEM((max(P0 - 2, 1), W), dt),     # ping
+            pltpu.VMEM((max(P0 - 2, 1), W), dt),     # pong
+            pltpu.VMEM((2, band, W), dt),            # write bands
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+        **mosaic_params(vmem_limit_bytes=budget_bytes),
+    )(open_flags.astype(jnp.int32), a_top, a_bot, core)
