@@ -62,8 +62,8 @@ def fwd_only(label, cfg):
 
     prog = run_spmd(mesh, body, (pspec, P("dp", "sp"), P("dp", "sp")), P())
     rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((B, S, BASE.d_model)).astype(np.float32))
-    y = jnp.asarray(rng.standard_normal((B, S, BASE.d_model)).astype(np.float32))
+    x = jnp.asarray(rng.standard_normal((B, S, cfg.d_model)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal((B, S, cfg.d_model)).astype(np.float32))
     params = init_params(0, cfg)
     r = time_device(prog, params, x, y, iters=3, warmup=1, fence="readback",
                     name=label)

@@ -272,11 +272,16 @@ def stream_band(cz: int, cy: int, cx: int, depth: int, itemsize: int,
 
     def cost(b):
         P0 = b + 2 * depth
-        return (nbuf * P0 + 2 * (P0 - 2) + nbuf * b) * plane + 2 * plane
+        # nbuf read slots + ping/pong intermediates + nbuf write slots
+        # + the two (depth, cy, cx) ghost-slab VMEM inputs
+        return (
+            (nbuf * P0 + 2 * (P0 - 2) + nbuf * b) * plane
+            + 2 * depth * plane
+        )
 
     band = _largest_divisor_band(cz, cost, budget_bytes, strict=True)
-    while cz // band < 2:
-        band = next(d for d in range(band - 1, 0, -1) if cz % d == 0)
+    while band > 1 and cz // band < 2:
+        band = next((d for d in range(band - 1, 0, -1) if cz % d == 0), 1)
     if cost(band) > budget_bytes or band < depth:
         raise ValueError(
             f"no band of cz={cz} gives >= 2 bands of >= depth={depth} "
@@ -591,8 +596,10 @@ def nine_point_streamed_2d(
             return (2 * b + 4 * (b + 2 * k) + 2 * b) * plane
 
         band = _largest_divisor_band(H, cost, budget_bytes // 2, strict=True)
-        while H // band < 2:
-            band = next(d for d in range(band - 1, 0, -1) if H % d == 0)
+        while band > 1 and H // band < 2:
+            band = next(
+                (d for d in range(band - 1, 0, -1) if H % d == 0), 1
+            )
     if H % band or H // band < 2:
         raise ValueError(f"band {band} must divide H {H} with >= 2 bands")
     if k > band:
