@@ -282,7 +282,7 @@ def stream_band(cz: int, cy: int, cx: int, depth: int, itemsize: int,
     band = _largest_divisor_band(cz, cost, budget_bytes, strict=True)
     while band > 1 and cz // band < 2:
         band = next((d for d in range(band - 1, 0, -1) if cz % d == 0), 1)
-    if cost(band) > budget_bytes or band < depth:
+    if cost(band) > budget_bytes or band < depth or cz // band < 2:
         raise ValueError(
             f"no band of cz={cz} gives >= 2 bands of >= depth={depth} "
             f"planes within {budget_bytes >> 20} MB VMEM (the window "
