@@ -241,6 +241,24 @@ def adam_state_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
     }
 
 
+def _adam_update(params, opt, grads, lr, b1, b2, eps):
+    """The shared Adam math (elementwise, sharding-agnostic): returns
+    (new_params, new_opt).  Bias correction is folded into the step
+    size (scalar, traced once)."""
+    t = opt["t"] + 1
+    mu = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, opt["mu"], grads)
+    nu = jax.tree.map(
+        lambda v, g: b2 * v + (1.0 - b2) * g * g, opt["nu"], grads
+    )
+    tf = t.astype(jnp.float32)
+    alpha = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+    new_params = jax.tree.map(
+        lambda w, m, v: w - alpha * m / (jnp.sqrt(v) + eps),
+        params, mu, nu,
+    )
+    return new_params, {"mu": mu, "nu": nu, "t": t}
+
+
 def train_step_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
                        b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                        sp: str = "sp", dp: str = "dp"):
@@ -253,21 +271,9 @@ def train_step_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
     def step(params, opt, x, y):
         loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
         grads = _grad_reduce(grads, dp, sp)
-        t = opt["t"] + 1
-        mu = jax.tree.map(
-            lambda m, g: b1 * m + (1.0 - b1) * g, opt["mu"], grads
-        )
-        nu = jax.tree.map(
-            lambda v, g: b2 * v + (1.0 - b2) * g * g, opt["nu"], grads
-        )
-        # bias correction folded into the step size (scalar, traced once)
-        tf = t.astype(jnp.float32)
-        alpha = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
-        new_params = jax.tree.map(
-            lambda w, m, v: w - alpha * m / (jnp.sqrt(v) + eps),
-            params, mu, nu,
-        )
-        return new_params, {"mu": mu, "nu": nu, "t": t}, loss
+        new_params, new_opt = _adam_update(params, opt, grads, lr, b1, b2,
+                                           eps)
+        return new_params, new_opt, loss
 
     return step
 
@@ -346,12 +352,12 @@ def param_spec_pp(cfg: TransformerConfig, stage: str = "stage",
     }
 
 
-def train_step_pp_fn(cfg: TransformerConfig, lr: float = 1e-2,
-                     n_micro: int = 2, sp: str = "sp", dp: str = "dp",
-                     stage: str = "stage"):
-    """The 3-axis shard_map body: GPipe microbatching over ``stage``
-    wrapping the dp x sp block (ring attention over sp, expert MoE over
-    dp) — all four strategies composed in ONE program.
+def _pp_loss_fn(cfg: TransformerConfig, n_micro: int, sp: str, dp: str,
+                stage: str):
+    """The 3-axis pipeline loss both step builders share: GPipe
+    microbatching over ``stage`` wrapping the dp x sp block (ring
+    attention over sp, expert MoE over dp) — all four strategies
+    composed in ONE program.
 
     Each stage rank owns ``n_layers / |stage|`` consecutive layers
     (stacked leaves, :func:`param_spec_pp`); the local batch splits into
@@ -424,21 +430,107 @@ def train_step_pp_fn(cfg: TransformerConfig, lr: float = 1e-2,
         )
         return lax.pmean(mse + cfg.aux_coef * aux, (dp, sp))
 
+    return loss_fn
+
+
+def train_step_pp_fn(cfg: TransformerConfig, lr: float = 1e-2,
+                     n_micro: int = 2, sp: str = "sp", dp: str = "dp",
+                     stage: str = "stage"):
+    """The 3-axis shard_map body with SGD: (stacked, x, y) ->
+    (stacked, loss).  See :func:`_pp_loss_fn` for the pipeline."""
+    loss_fn = _pp_loss_fn(cfg, n_micro, sp, dp, stage)
+
     def step(stacked, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(stacked, x, y)
-        grads = _grad_reduce(grads, dp, sp)
-        # every stage rank seeds its own replica of the (stage-
-        # replicated) loss, and the stage-psum/ppermute-chain transposes
-        # deliver ALL |stage| seeds to every leaf — a uniform
-        # |stage|-fold overcount on top of the dp x sp accounting
-        # (_grad_reduce's n covers only the axes it psums over)
-        n_stage = lax.axis_size(stage)
-        if n_stage > 1:
-            grads = jax.tree.map(lambda g: g / n_stage, grads)
+        loss, grads = _pp_loss_and_grads(loss_fn, stacked, x, y, dp, sp,
+                                         stage)
         new_params = jax.tree.map(lambda w, g: w - lr * g, stacked, grads)
         return new_params, loss
 
     return step
+
+
+def _validate_pp(mesh, cfg: TransformerConfig, dp: str, sp: str,
+                 stage: str):
+    """The pipeline step builders' shared preconditions."""
+    _validate_step_config(mesh, cfg, dp, sp)
+    if cfg.n_layers % mesh.shape[stage]:
+        raise ValueError(
+            f"n_layers {cfg.n_layers} not divisible by stage size "
+            f"{mesh.shape[stage]}"
+        )
+
+
+def _pp_loss_and_grads(loss_fn, stacked, x, y, dp, sp, stage):
+    """value_and_grad + the 3-axis reduction: :func:`_grad_reduce` for
+    the dp/sp copy axes, then ÷|stage| — every stage rank seeds its own
+    replica of the (stage-replicated) loss and the stage-psum/
+    ppermute-chain transposes deliver ALL |stage| seeds to every leaf, a
+    uniform overcount on top of the dp x sp accounting (caught by the
+    dryrun's bit-exactness gate)."""
+    loss, grads = jax.value_and_grad(loss_fn)(stacked, x, y)
+    grads = _grad_reduce(grads, dp, sp)
+    n_stage = lax.axis_size(stage)
+    if n_stage > 1:
+        grads = jax.tree.map(lambda g: g / n_stage, grads)
+    return loss, grads
+
+
+def train_step_pp_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
+                          b1: float = 0.9, b2: float = 0.999,
+                          eps: float = 1e-8, n_micro: int = 2,
+                          sp: str = "sp", dp: str = "dp",
+                          stage: str = "stage"):
+    """The 3-axis body with Adam: (stacked, opt, x, y) -> (stacked, opt,
+    loss).  Moments are stacked exactly like the params (stage-sharded,
+    expert leaves also over dp), so the elementwise update composes with
+    the 3-axis sharding the same way the dp x sp Adam does."""
+    loss_fn = _pp_loss_fn(cfg, n_micro, sp, dp, stage)
+
+    def step(stacked, opt, x, y):
+        loss, grads = _pp_loss_and_grads(loss_fn, stacked, x, y, dp, sp,
+                                         stage)
+        new_params, new_opt = _adam_update(stacked, opt, grads, lr, b1, b2,
+                                           eps)
+        return new_params, new_opt, loss
+
+    return step
+
+
+def adam_state_spec_pp(cfg: TransformerConfig, stage: str = "stage",
+                       dp: str = "dp") -> dict:
+    """PartitionSpec pytree for the stacked Adam moments."""
+    return {
+        "mu": param_spec_pp(cfg, stage, dp),
+        "nu": param_spec_pp(cfg, stage, dp),
+        "t": P(),
+    }
+
+
+def train_step_pp_adam(
+    mesh: Mesh,
+    cfg: TransformerConfig,
+    lr: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    n_micro: int = 2,
+    dp: str = "dp",
+    sp: str = "sp",
+    stage: str = "stage",
+):
+    """:func:`train_step_pp` with Adam: jit'd fn(stacked, opt, x, y) ->
+    (stacked, opt, loss); ``opt`` from :func:`init_adam_state` applied
+    to the STACKED params."""
+    _validate_pp(mesh, cfg, dp, sp, stage)
+    pspec = param_spec_pp(cfg, stage, dp)
+    ospec = adam_state_spec_pp(cfg, stage, dp)
+    return run_spmd(
+        mesh,
+        train_step_pp_adam_fn(cfg, lr, b1, b2, eps, n_micro, sp=sp, dp=dp,
+                              stage=stage),
+        (pspec, ospec, P(dp, sp), P(dp, sp)),
+        (pspec, ospec, P()),
+    )
 
 
 def train_step_pp(
@@ -455,12 +547,7 @@ def train_step_pp(
     stacked layout from :func:`stack_layers` sharded by
     :func:`param_spec_pp` and x, y (batch, seq, d_model) sharded
     P(dp, sp)."""
-    _validate_step_config(mesh, cfg, dp, sp)
-    n_stage = mesh.shape[stage]
-    if cfg.n_layers % n_stage:
-        raise ValueError(
-            f"n_layers {cfg.n_layers} not divisible by stage size {n_stage}"
-        )
+    _validate_pp(mesh, cfg, dp, sp, stage)
     pspec = param_spec_pp(cfg, stage, dp)
     return run_spmd(
         mesh,
