@@ -257,10 +257,11 @@ def run_stencil_stream(
         raise ValueError(f"tile {tile.shape} != padded {lay.padded_shape}")
     if not (topo.dims[1] == 1 and topo.periodic[1]):
         raise ValueError(
-            "stream impl needs a self-wrapping column axis (row-slab "
-            f"decomposition), got dims={topo.dims} "
-            f"periodic={topo.periodic}; use impl='deep:k' or the "
-            "per-step impls for distributed columns"
+            "stream impl needs a self-wrapping column axis: the kernel "
+            "always wraps x periodically in-VMEM, so columns can be "
+            "neither distributed nor open-ended (got dims="
+            f"{topo.dims} periodic={topo.periodic}); use impl='deep:k' "
+            "or the per-step impls for those layouts"
         )
     H, W = lay.core_h, lay.core_w
     hy, hx = lay.halo_y, lay.halo_x

@@ -436,7 +436,7 @@ def seven_point_streamed_pallas(
 # ---------------------------------------------------------------------------
 
 
-def _substep2d(o_ref, t, P: int, W: int, w9, rows_out: int):
+def _substep2d(o_ref, t, P: int, W: int, w9):
     """One 9-point substep on a (P, W) window value: rows shrink by one
     per side, x wraps periodically (ring decomposition: interior columns
     by shifted slices, the two edge columns by wrapped line concats).
@@ -464,7 +464,7 @@ def _substep2d(o_ref, t, P: int, W: int, w9, rows_out: int):
                     continue
                 term = cw * shifted(u, dx, lo, hi)
                 acc = term if acc is None else acc + term
-        o_ref[0:rows_out, lo:hi] = acc
+        o_ref[0 : P - 2, lo:hi] = acc
 
 
 def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
@@ -509,9 +509,10 @@ def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
         src_val = V
         for s in range(k):
             P = P0 - 2 * s
+            # at s == k-1, P - 2 == band: the final substep fills the
+            # write buffer exactly
             dst = wbuf.at[slot] if s == k - 1 else (pong if s % 2 else ping)
-            rows_out = band if s == k - 1 else P - 2
-            _substep2d(dst, src_val, P, W, w9, rows_out)
+            _substep2d(dst, src_val, P, W, w9)
             # OPEN y ends: the rows still acting as ghosts after substep
             # s+1 must stay zero on the physical-end bands
             g = k - s - 1

@@ -235,18 +235,20 @@ def _sparse_combine(flat, tok_gate, tok_flat, slot_token, slot_gate):
 
 def _sparse_combine_fwd(flat, tok_gate, tok_flat, slot_token, slot_gate):
     out = jnp.sum(tok_gate[:, :, None] * flat[tok_flat], axis=1)
-    return out, (flat, tok_gate, tok_flat, slot_token, slot_gate)
+    # tok_gate is NOT a residual: dgate recomputes from flat and ct
+    # (the routing always builds f32 gates)
+    return out, (flat, tok_flat, slot_token, slot_gate)
 
 
 def _sparse_combine_bwd(res, ct):
-    flat, tok_gate, tok_flat, slot_token, slot_gate = res
+    flat, tok_flat, slot_token, slot_gate = res
     dflat = (
         ct[slot_token.reshape(-1)] * slot_gate.reshape(-1)[:, None]
     ).astype(flat.dtype)
     dgate = jnp.einsum("tkd,td->tk", flat[tok_flat], ct)
     return (
         dflat,
-        dgate.astype(tok_gate.dtype),
+        dgate.astype(jnp.float32),
         jnp.zeros(tok_flat.shape, jax.dtypes.float0),
         jnp.zeros(slot_token.shape, jax.dtypes.float0),
         jnp.zeros(slot_gate.shape, slot_gate.dtype),
