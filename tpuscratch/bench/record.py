@@ -271,21 +271,28 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
     # CPU proxy they would run in the Mosaic interpreter (hours at this
     # size).  'dma' (VMEM-resident) correctly refuses the 1 GB core and
     # records the structural loss; 'dma-hbm' (round 4) streams the core
-    # in row bands; 'stream:8' folds 8 substeps per streaming pass (the
-    # 2D deep-streamed kernel) and is the expected winner
+    # in row bands
     impls = ("xla", "overlap", "deep:4") + (
         ("dma", "dma-hbm") if jax.default_backend() == "tpu" else ()
     )
-    best, _ = _best_stencil(impls, 4, (8192, 8192), 10, mesh, iters)
+    # 100 steps on chip: at 10 the ~190 ms fixed tunnel cost dominated
+    # every candidate and the screen ranked on noise (observed: xla
+    # "winning" over paths 19x faster marginally)
+    steps4 = 100 if jax.default_backend() == "tpu" else 10
+    best, _ = _best_stencil(impls, 4, (8192, 8192), steps4, mesh, iters)
     if jax.default_backend() == "tpu":
         # the 2D deep-streamed kernel needs a self-wrapping column axis,
         # so it races on the ROW-SLAB decomposition of the same devices
-        # — a legitimate layout choice for the same 8192^2 config (and
-        # the expected overall winner: 1.74e11 cells/s degenerate)
+        # — a legitimate layout choice for the same 8192^2 config and
+        # the expected overall winner (stream:32 1.89e11 cells/s
+        # degenerate, BASELINE row 4).  32 steps so each candidate
+        # actually executes its labeled fold depth (at 10 steps a
+        # 'stream:16' would run one depth-10 remainder pass and the
+        # recorded label would lie)
         rmesh = make_mesh_2d((n, 1), devices=jax.devices()[:n])
         try:
             sbest, _ = _best_stencil(
-                ("stream:8", "stream:16"), 4, (8192, 8192), 10, rmesh,
+                ("stream:16", "stream:32"), 4, (8192, 8192), 320, rmesh,
                 iters,
             )
             if sbest.items_per_s > best.items_per_s:
