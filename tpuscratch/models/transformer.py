@@ -520,7 +520,8 @@ def train_step_pp_adam(
 ):
     """:func:`train_step_pp` with Adam: jit'd fn(stacked, opt, x, y) ->
     (stacked, opt, loss); ``opt`` from :func:`init_adam_state` applied
-    to the STACKED params."""
+    to the STACKED params.  The MoE aux term depends on ``n_micro``
+    (see :func:`train_step_pp`)."""
     _validate_pp(mesh, cfg, dp, sp, stage)
     pspec = param_spec_pp(cfg, stage, dp)
     ospec = adam_state_spec_pp(cfg, stage, dp)
@@ -546,7 +547,15 @@ def train_step_pp(
     jit'd fn(stacked_params, x, y) -> (stacked_params, loss) with the
     stacked layout from :func:`stack_layers` sharded by
     :func:`param_spec_pp` and x, y (batch, seq, d_model) sharded
-    P(dp, sp)."""
+    P(dp, sp).
+
+    Numerical note: the MoE load-balance aux term is averaged over
+    microbatches, and because that loss is nonlinear in routing-group
+    size, the ``n_micro > 1`` step is NOT bit-equivalent to the
+    sequential (``n_micro == 1``) step — the aux value (and its
+    gradient) depends on ``n_micro``, with drift growing as microbatches
+    shrink. Compare losses across schedules at fixed ``n_micro`` only.
+    """
     _validate_pp(mesh, cfg, dp, sp, stage)
     pspec = param_spec_pp(cfg, stage, dp)
     return run_spmd(

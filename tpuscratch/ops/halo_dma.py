@@ -801,21 +801,36 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
     return kernel
 
 
+def _hbm_cost(b: int, H: int, W: int, itemsize: int) -> int:
+    """Tile-accurate VMEM footprint of the HBM-banded kernel at band
+    ``b``: the four (b, W) read/write double-buffers plus ~3 band-width
+    compute temporaries (the left/interior/right pieces of one band's
+    update — chip-calibrated: at 8192^2 under a 100 MB limit band=512
+    [7bW ~ 117 MB + fixed] is a Mosaic remote-compile DNF while
+    band=256 [~87 MB total] runs), plus the FIXED scratch the band does
+    not scale, at its (8, 128)-tile allocation granularity: six (Hp, 1)
+    column buffers (gL/gR scratch, colL/colR inputs, ncolL/ncolR
+    outputs — each lane-padded to 128), eight (1, Wp)/(1, Hp) strips
+    (sublane-padded to 8 rows), and two (8, Wp) edge-row tiles."""
+    Wp = -(-W // 128) * 128
+    Hp = -(-H // 128) * 128
+    fixed = 6 * Hp * 128 + 32 * (Wp + Hp) + 16 * Wp
+    return (7 * b * W + fixed) * itemsize
+
+
 def hbm_band(H: int, W: int, itemsize: int,
              budget_bytes: int) -> int:
-    """Largest 8-multiple divisor band of ``H`` whose window/write
-    double-buffers fit the budget, with >= 2 bands (the DMA windows are
-    8-row-tile aligned, so bands must be too)."""
-    def cost(b):
-        return 4 * b * W * itemsize + 4 * W * itemsize
-
+    """Largest 8-multiple divisor band of ``H`` whose FULL kernel
+    footprint (``_hbm_cost``: band buffers + compute temps + the fixed
+    column/strip scratch) fits the budget, with >= 2 bands (the DMA
+    windows are 8-row-tile aligned, so bands must be too)."""
     for d in range(H // 2, 7, -1):
-        if H % d == 0 and d % 8 == 0 and cost(d) <= budget_bytes:
+        if H % d == 0 and d % 8 == 0 and _hbm_cost(d, H, W, itemsize) <= budget_bytes:
             return d
     raise ValueError(
         f"no 8-aligned band of H={H} gives >= 2 bands within "
         f"{budget_bytes >> 20} MB VMEM (need H >= 16 with 8 | H, and "
-        "the four band-sized buffers to fit the budget)"
+        "the kernel footprint to fit the budget)"
     )
 
 
@@ -867,14 +882,18 @@ def run_stencil_dma_hbm(
             "are 8-row-tile aligned)"
         )
     if band is None:
-        # half the vmem limit: the compute temps (band-sized concat
-        # pieces) need allocator headroom — band=512 at 8192^2 is an
-        # opaque remote-compile DNF under the full limit, band=256 runs
-        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes // 2)
+        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes)
     if H % band or H // band < 2 or band % 8:
         raise ValueError(
             f"band {band} must be an 8-multiple divisor of H {H} with "
             "at least 2 bands"
+        )
+    if _hbm_cost(band, H, W, dt.itemsize) > vmem_limit_bytes:
+        raise ValueError(
+            f"band {band} needs ~{_hbm_cost(band, H, W, dt.itemsize) >> 20}"
+            f" MB VMEM (> limit {vmem_limit_bytes >> 20} MB): the band "
+            "buffers + compute temps + fixed column/strip scratch must "
+            "fit (see _hbm_cost)"
         )
     nb = H // band
     Hp = -(-H // 128) * 128
