@@ -1121,11 +1121,11 @@ class TestStream2D:
 
     def test_stream2d_open_rows(self, ):
         # open row ends re-impose zero ghosts each folded substep;
-        # columns stay periodic (the kernel's self-wrap requirement)
+        # columns stay periodic (the wrap-mode column axis)
         from tpuscratch.halo.driver import distributed_stencil
 
         rng = np.random.default_rng(73)
-        world = rng.standard_normal((32, 32)).astype(np.float32)
+        world = rng.standard_normal((64, 32)).astype(np.float32)
         mesh = make_mesh_2d((4, 1))
         a = distributed_stencil(world, 5, mesh=mesh, impl="stream:2",
                                 periodic=(False, True))
@@ -1133,11 +1133,76 @@ class TestStream2D:
                                 periodic=(False, True))
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
-    def test_stream2d_rejects_distributed_columns(self):
+    # ---- ghost mode: distributed / open COLUMNS (round 5) -------------
+
+    @pytest.mark.parametrize("dims", [(1, 2), (2, 2), (1, 4), (2, 4)])
+    @pytest.mark.parametrize("impl,steps", [
+        ("stream:2", 5), ("stream:4", 9),
+    ])
+    def test_stream2d_ghost_columns_equals_plain(self, dims, impl, steps):
+        # distributed columns ride the (H+2k, k) ghost-column slabs
+        # (x-neighbor edge columns + diagonal corner blocks)
         from tpuscratch.halo.driver import distributed_stencil
 
-        rng = np.random.default_rng(74)
-        world = rng.standard_normal((16, 32)).astype(np.float32)
-        with pytest.raises(ValueError, match="self-wrapping column"):
-            distributed_stencil(world, 2, mesh=make_mesh_2d((1, 4)),
+        rng = np.random.default_rng(75)
+        world = rng.standard_normal((64, 64)).astype(np.float32)
+        mesh = make_mesh_2d(dims)
+        a = distributed_stencil(world, steps, mesh=mesh, impl=impl)
+        b = distributed_stencil(world, steps, mesh=mesh, impl="xla")
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("dims", [(1, 2), (2, 2)])
+    def test_stream2d_ghost_columns_nine_point(self, dims):
+        # the corner blocks carry the diagonal neighbor values the
+        # 9-point stencil actually reads
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(76)
+        world = rng.standard_normal((64, 64)).astype(np.float32)
+        c9 = (0.15, 0.15, 0.1, 0.1, 0.05, 0.05, 0.08, 0.07, 0.25)
+        mesh = make_mesh_2d(dims)
+        a = distributed_stencil(world, 5, mesh=mesh, impl="stream:2",
+                                coeffs=c9)
+        b = distributed_stencil(world, 5, mesh=mesh, impl="xla", coeffs=c9)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("periodic", [
+        (True, False), (False, False),
+    ])
+    def test_stream2d_ghost_columns_open(self, periodic):
+        # open column (and row) ends: ppermute zero-fill supplies the
+        # initial zero ghosts, per-substep flag zeroing keeps them zero
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(77)
+        world = rng.standard_normal((64, 64)).astype(np.float32)
+        mesh = make_mesh_2d((2, 2))
+        a = distributed_stencil(world, 5, mesh=mesh, impl="stream:2",
+                                periodic=periodic)
+        b = distributed_stencil(world, 5, mesh=mesh, impl="xla",
+                                periodic=periodic)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_stream2d_single_rank_open_columns(self):
+        # 1x1 fully open: zero ghosts on every side, no ppermutes
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(78)
+        world = rng.standard_normal((32, 32)).astype(np.float32)
+        a = distributed_stencil(world, 4, mesh=make_mesh_2d((1, 1)),
+                                impl="stream:2", periodic=False)
+        b = distributed_stencil(world, 4, mesh=make_mesh_2d((1, 1)),
+                                impl="xla", periodic=False)
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+    def test_stream2d_rejects_unaligned_h(self):
+        # H must be 8-aligned (chip DMA-window rule, BASELINE row 4) —
+        # enforced on the CPU path too so interpret-mode tests catch
+        # what silicon would reject
+        from tpuscratch.halo.driver import distributed_stencil
+
+        rng = np.random.default_rng(79)
+        world = rng.standard_normal((12, 32)).astype(np.float32)
+        with pytest.raises(ValueError, match="multiple of 8"):
+            distributed_stencil(world, 2, mesh=make_mesh_2d((1, 1)),
                                 impl="stream:2")

@@ -241,13 +241,18 @@ def run_stencil_stream(
     (ops/stencil_stream.nine_point_streamed_2d): ``depth`` substeps fold
     into each manual-DMA pass, dividing per-step HBM traffic by
     ``depth`` — the 2D form of the deep-z streamed kernel, for grids
-    beyond VMEM (where ``resident`` refuses).  Serves row-slab
-    decompositions: x must self-wrap (column axis degenerate periodic);
-    row ghosts travel as (depth, W) slabs, one exchange per ``depth``
-    steps.  5-point AND 9-point coefficients (full-extent rows carry the
-    diagonal neighbors implicitly).  Open row ends re-impose zero ghosts
-    per substep via per-rank traced flags.  Takes/returns a padded tile
-    (trailing exchange), interchangeable with the other impls.
+    beyond VMEM (where ``resident`` refuses).  Serves ANY cartesian
+    layout (the reference's exchange generality, stencil2D.h:232-244,
+    mpi10.cpp:27): a periodic column axis of size 1 self-wraps in-kernel
+    (wrap mode, zero ghost machinery); distributed or open columns ride
+    ghost-column slabs — x-neighbor edge columns with the diagonal
+    neighbors' corner blocks, the 8-channel transfer set at ghost depth
+    ``depth`` — patched into each band's window (ghost mode).  Row
+    ghosts travel as (depth, W) slabs either way; ONE exchange per
+    ``depth`` steps.  5-point AND 9-point coefficients.  Open ends
+    re-impose zero ghosts per substep via per-rank traced flags.
+    Takes/returns a padded tile (trailing exchange), interchangeable
+    with the other impls.
     """
     from tpuscratch.ops.stencil_stream import nine_point_streamed_2d
 
@@ -255,51 +260,63 @@ def run_stencil_stream(
     topo = spec.topology
     if tuple(tile.shape) != lay.padded_shape:
         raise ValueError(f"tile {tile.shape} != padded {lay.padded_shape}")
-    if not (topo.dims[1] == 1 and topo.periodic[1]):
-        raise ValueError(
-            "stream impl needs a self-wrapping column axis: the kernel "
-            "always wraps x periodically in-VMEM, so columns can be "
-            "neither distributed nor open-ended (got dims="
-            f"{topo.dims} periodic={topo.periodic}); use impl='deep:k' "
-            "or the per-step impls for those layouts"
-        )
     H, W = lay.core_h, lay.core_w
     hy, hx = lay.halo_y, lay.halo_x
     core = tile[hy : hy + H, hx : hx + W]
-    wrap_y = topo.dims[0] == 1 and topo.periodic[0]
+    wrap_x = topo.dims[1] == 1 and topo.periodic[1]
 
-    def ghosts(c, d):
-        if wrap_y:
-            return c[H - d :], c[:d]
-        if topo.dims[0] == 1:  # single rank, open rows: zero ghosts
-            z = jnp.zeros((d, W), c.dtype)
-            return z, z
-        a_top = lax.ppermute(
-            c[H - d :], spec.axes, list(topo.send_permutation((1, 0)))
-        )
-        a_bot = lax.ppermute(
-            c[:d], spec.axes, list(topo.send_permutation((-1, 0)))
-        )
-        return a_top, a_bot
+    def gather(block, off):
+        # the off-neighbor's block: local when the permutation is pure
+        # self-wrap (self-ppermutes cost real launch time on chip,
+        # BASELINE row 9), zeros when nobody sends (fully open), else a
+        # (diagonal-capable) ppermute — open-edge ranks are zero-filled
+        # by ppermute semantics, the MPI_PROC_NULL analogue
+        pairs = list(topo.send_permutation(off))
+        if not pairs:
+            return jnp.zeros_like(block)
+        if len(pairs) == topo.size and all(s == d for s, d in pairs):
+            return block
+        return lax.ppermute(block, spec.axes, pairs)
 
     def open_flags():
-        if topo.periodic[0]:
+        # [top, bottom, left, right]; None when fully periodic
+        if all(topo.periodic):
             return None
-        if topo.dims[0] == 1:
-            return jnp.ones((2,), jnp.int32)
-        rc = lax.axis_index(spec.axes[0])
-        return jnp.stack(
-            [(rc == 0).astype(jnp.int32),
-             (rc == topo.dims[0] - 1).astype(jnp.int32)]
-        )
+        parts = []
+        for axis in (0, 1):
+            if topo.periodic[axis]:
+                parts += [jnp.zeros((), jnp.int32)] * 2
+            elif topo.dims[axis] == 1:
+                parts += [jnp.ones((), jnp.int32)] * 2
+            else:
+                rc = lax.axis_index(spec.axes[axis])
+                parts += [(rc == 0).astype(jnp.int32),
+                          (rc == topo.dims[axis] - 1).astype(jnp.int32)]
+        return jnp.stack(parts)
 
     flags = open_flags()
 
     def pass_fn(c, d):
-        a_top, a_bot = ghosts(c, d)
+        a_top = gather(c[H - d :], (1, 0))
+        a_bot = gather(c[:d], (-1, 0))
+        if wrap_x:
+            gl = gr = None
+        else:
+            # (H + 2d, d) column slabs spanning global rows [-d, H + d):
+            # [diag corner | x-neighbor edge columns | diag corner]
+            gl = jnp.concatenate(
+                [gather(c[H - d :, W - d :], (1, 1)),
+                 gather(c[:, W - d :], (0, 1)),
+                 gather(c[:d, W - d :], (-1, 1))], axis=0
+            )
+            gr = jnp.concatenate(
+                [gather(c[H - d :, :d], (1, -1)),
+                 gather(c[:, :d], (0, -1)),
+                 gather(c[:d, :d], (-1, -1))], axis=0
+            )
         return nine_point_streamed_2d(
             c, a_top, a_bot, (H, W), tuple(coeffs), d, band,
-            open_flags=flags,
+            open_flags=flags, gl=gl, gr=gr,
         )
 
     q, r = divmod(steps, depth)

