@@ -75,13 +75,21 @@ def _raw_scores(q_ref, k_ref, scale):
     )
 
 
-def _causal_mask(s, row0, col0, block_q: int, block_k: int):
-    """Mask ``s`` below the causal diagonal whose block origin is
-    (row0, col0) — origins may be traced (SMEM offsets) or static ints;
-    THE one masking definition for every kernel in this module."""
+def _causal_keep(row0, col0, block_q: int, block_k: int):
+    """The (block_q, block_k) boolean causal predicate (True = kept) for
+    the block at origin (row0, col0) — origins may be traced (SMEM
+    offsets) or static ints.  THE one mask-geometry definition for every
+    kernel in this module: the forward masks scores to NEG_INF through
+    it (:func:`_causal_mask`), the compact backward kernels select
+    p -> 0 through it directly (the post-exp equivalent)."""
     rows = row0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = col0 + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-    return jnp.where(rows >= cols, s, NEG_INF)
+    return rows >= cols
+
+
+def _causal_mask(s, row0, col0, block_q: int, block_k: int):
+    """Mask ``s`` below the causal diagonal (see :func:`_causal_keep`)."""
+    return jnp.where(_causal_keep(row0, col0, block_q, block_k), s, NEG_INF)
 
 
 def _score_block(
@@ -497,6 +505,245 @@ def _dkv_kernel(
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _causal_pairs_kv(nq, nk, bq, bk, dq_off: int):
+    """Static (j, i, flags) schedule for the CAUSAL dkv backward — the
+    kv-major mirror of :func:`_causal_pairs`: for each kv block j, only
+    the q blocks at or below its diagonal (i >= first) contribute.
+    Returns None when some kv block has no contributing q block (the
+    dense grid handles that case)."""
+    pairs = []
+    for j in range(nk):
+        first = max(0, (-dq_off + j * bk) // bq)
+        if first >= nq:
+            return None
+        for i in range(first, nq):
+            full = (j + 1) * bk - 1 <= dq_off + i * bq
+            flags = (0 if full else _FLAG_MASKED) | (
+                _FLAG_EMIT if i == nq - 1 else 0
+            )
+            pairs.append((j, i, flags))
+    return pairs
+
+
+def _dq_kernel_compact(
+    i_tab, j_tab, flag_tab, q_ref, k_ref, v_ref, do_ref, lse_ref,
+    delta_ref, dq_ref, dq_scr,
+    *, scale: float, qoff: int, koff: int, block_q: int, block_k: int,
+):
+    """Compact-causal-grid dq: grid (H, n_pairs) over exactly the
+    (q block, kv block) pairs at or below the diagonal (the forward's
+    splash-style schedule, applied to the backward — masked-out pairs
+    cost neither grid steps nor DMA, and interior pairs skip the mask
+    arithmetic entirely)."""
+    p_ = pl.program_id(1)
+    i, j, flags = i_tab[p_], j_tab[p_], flag_tab[p_]
+    masked = flags & _FLAG_MASKED != 0
+
+    def compute(apply_mask: bool, first: bool):
+        s = _raw_scores(q_ref, k_ref, scale)
+        mmdt = _mm_dtype(k_ref)
+        lse = lse_ref[0][:, 0]
+        p = jnp.exp(s - lse[:, None])
+        if apply_mask:
+            # p -> 0 through the shared geometry (also zeroes
+            # fully-masked rows, whose lse is the -inf sentinel)
+            p = jnp.where(
+                _causal_keep(qoff + i * block_q, koff + j * block_k,
+                             block_q, block_k),
+                p, 0.0,
+            )
+        do = do_ref[0].astype(mmdt)
+        v = v_ref[0].astype(mmdt)
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0][:, None])
+        contrib = lax.dot(
+            ds.astype(mmdt), k_ref[0].astype(mmdt) * mmdt(scale),
+            preferred_element_type=jnp.float32,
+        )
+        if first:  # first KV pair fused with init (no zero-store)
+            dq_scr[...] = contrib
+        else:
+            dq_scr[...] += contrib
+
+    @pl.when(jnp.logical_and(j == 0, masked))
+    def _fm():
+        compute(True, True)
+
+    @pl.when(jnp.logical_and(j == 0, jnp.logical_not(masked)))
+    def _ff():
+        compute(False, True)
+
+    @pl.when(jnp.logical_and(j > 0, masked))
+    def _m():
+        compute(True, False)
+
+    @pl.when(jnp.logical_and(j > 0, jnp.logical_not(masked)))
+    def _f():
+        compute(False, False)
+
+    @pl.when(flags & _FLAG_EMIT != 0)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel_compact(
+    j_tab, i_tab, flag_tab, first_tab, k_ref, v_ref, q_ref, do_ref,
+    lse_ref, delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+    *, scale: float, qoff: int, koff: int, block_q: int, block_k: int,
+):
+    """Compact-causal-grid dk/dv: the kv-major mirror (pairs from
+    :func:`_causal_pairs_kv`).  ``first_tab[p] == 1`` marks each kv
+    block's first contributing q pair (init fuses into it)."""
+    p_ = pl.program_id(1)
+    i, j = i_tab[p_], j_tab[p_]
+    flags = flag_tab[p_]
+    first = first_tab[p_] == 1
+    masked = flags & _FLAG_MASKED != 0
+
+    def compute(apply_mask: bool, is_first: bool):
+        s = _raw_scores(q_ref, k_ref, scale)
+        mmdt = _mm_dtype(q_ref)
+        lse = lse_ref[0][:, 0]
+        p = jnp.exp(s - lse[:, None])
+        if apply_mask:
+            p = jnp.where(
+                _causal_keep(qoff + i * block_q, koff + j * block_k,
+                             block_q, block_k),
+                p, 0.0,
+            )
+        do = do_ref[0].astype(mmdt)
+        v = v_ref[0].astype(mmdt)
+        q = q_ref[0].astype(mmdt)
+        dv_c = lax.dot_general(
+            p.astype(mmdt), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, 0][:, None])
+        dk_c = lax.dot_general(
+            ds.astype(mmdt), q * mmdt(scale), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if is_first:
+            dv_scr[...] = dv_c
+            dk_scr[...] = dk_c
+        else:
+            dv_scr[...] += dv_c
+            dk_scr[...] += dk_c
+
+    @pl.when(jnp.logical_and(first, masked))
+    def _fm():
+        compute(True, True)
+
+    @pl.when(jnp.logical_and(first, jnp.logical_not(masked)))
+    def _ff():
+        compute(False, True)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(first), masked))
+    def _m():
+        compute(True, False)
+
+    @pl.when(
+        jnp.logical_and(jnp.logical_not(first), jnp.logical_not(masked))
+    )
+    def _f():
+        compute(False, False)
+
+    @pl.when(flags & _FLAG_EMIT != 0)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_compact(q, k, v, do, lse, delta, qoff: int, koff: int,
+                       bq, bk, out_dtype=None):
+    """Compact-causal-grid backward (static int offsets).  Returns None
+    when either schedule does not apply — the caller falls back to the
+    dense-grid :func:`_flash_bwd_call`."""
+    H, S, D = q.shape
+    T = k.shape[1]
+    nq, nk = S // bq, T // bk
+    dq_off = qoff - koff
+    pairs_q = _causal_pairs(nq, nk, bq, bk, dq_off)
+    pairs_kv = _causal_pairs_kv(nq, nk, bq, bk, dq_off)
+    if pairs_q is None or pairs_kv is None:
+        return None
+    scale = 1.0 / float(D) ** 0.5
+    interpret = use_interpret()
+    params = mosaic_params(dimension_semantics=("parallel", "arbitrary"))
+    lse_p, delta_p = _plane(lse), _plane(delta)
+
+    it_q = jnp.asarray([p[0] for p in pairs_q], jnp.int32)
+    jt_q = jnp.asarray([p[1] for p in pairs_q], jnp.int32)
+    ft_q = jnp.asarray([p[2] for p in pairs_q], jnp.int32)
+    qspec = pl.BlockSpec((1, bq, D), lambda h, p, it, jt, ft: (h, it[p], 0))
+    kvspec = pl.BlockSpec((1, bk, D), lambda h, p, it, jt, ft: (h, jt[p], 0))
+    rowspec = pl.BlockSpec((1, bq, 8), lambda h, p, it, jt, ft: (h, it[p], 0))
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel_compact, scale=scale, qoff=qoff, koff=koff,
+            block_q=bq, block_k=bk,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(H, len(pairs_q)),
+            in_specs=[qspec, kvspec, kvspec, qspec, rowspec, rowspec],
+            out_specs=qspec,
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((H, S, D), out_dtype or q.dtype),
+        interpret=interpret,
+        **params,
+    )(it_q, jt_q, ft_q, q, k, v, do, lse_p, delta_p)
+
+    jt_k = jnp.asarray([p[0] for p in pairs_kv], jnp.int32)
+    it_k = jnp.asarray([p[1] for p in pairs_kv], jnp.int32)
+    ft_k = jnp.asarray([p[2] for p in pairs_kv], jnp.int32)
+    # first contributing pair per kv block: position 0 or a j change
+    first_k = jnp.asarray(
+        [1 if (n == 0 or pairs_kv[n - 1][0] != p[0]) else 0
+         for n, p in enumerate(pairs_kv)], jnp.int32,
+    )
+    kspec2 = pl.BlockSpec(
+        (1, bk, D), lambda h, p, jt, it, ft, fi: (h, jt[p], 0)
+    )
+    qspec2 = pl.BlockSpec(
+        (1, bq, D), lambda h, p, jt, it, ft, fi: (h, it[p], 0)
+    )
+    rowspec2 = pl.BlockSpec(
+        (1, bq, 8), lambda h, p, jt, it, ft, fi: (h, it[p], 0)
+    )
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel_compact, scale=scale, qoff=qoff, koff=koff,
+            block_q=bq, block_k=bk,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(H, len(pairs_kv)),
+            in_specs=[kspec2, kspec2, qspec2, qspec2, rowspec2, rowspec2],
+            out_specs=[kspec2, kspec2],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((H, T, D), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((H, T, D), out_dtype or v.dtype),
+        ],
+        interpret=interpret,
+        **params,
+    )(jt_k, it_k, ft_k, first_k, k, v, q, do, lse_p, delta_p)
+    return dq, dk, dv
+
+
 def _plane(x):  # (H, S) -> (H, S, 8) lane-broadcast input plane
     return jnp.broadcast_to(x[:, :, None], (*x.shape, 8))
 
@@ -687,8 +934,9 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 def _flash_diff_compact(qh, kh, vh, qoff, koff, bq, bk):
     """Differentiable compact-causal-grid flash attention. ``qoff``/
     ``koff`` are static ints; forward takes the compact grid, backward
-    reuses the dense-grid kernels (whose own clamp maps skip masked
-    blocks' DMA)."""
+    takes the compact backward grids (:func:`_flash_bwd_compact` —
+    round 5), falling back to the dense-grid kernels when either pair
+    schedule does not apply."""
     return _flash_fwd_compact(qh, kh, vh, qoff, koff, bq, bk, False)
 
 
@@ -703,12 +951,19 @@ def _flash_diff_compact_fwd(qh, kh, vh, qoff, koff, bq, bk):
 def _flash_diff_compact_bwd(qoff, koff, bq, bk, res, do):
     qh, kh, vh, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    dq, dk, dv = _flash_bwd_call(
-        qh, kh, vh, do, lse, delta,
-        jnp.asarray(qoff, jnp.int32).reshape(1),
-        jnp.asarray(koff, jnp.int32).reshape(1),
-        True, bq, bk,
-    )
+    # static offsets -> the compact-causal backward grids (round 5:
+    # masked-out pairs cost neither grid steps nor DMA, interior pairs
+    # skip the mask arithmetic — the forward's schedule applied to the
+    # backward); dense-grid fallback when the schedule does not apply
+    r = _flash_bwd_compact(qh, kh, vh, do, lse, delta, qoff, koff, bq, bk)
+    if r is None:
+        r = _flash_bwd_call(
+            qh, kh, vh, do, lse, delta,
+            jnp.asarray(qoff, jnp.int32).reshape(1),
+            jnp.asarray(koff, jnp.int32).reshape(1),
+            True, bq, bk,
+        )
+    dq, dk, dv = r
     return dq, dk, dv
 
 
