@@ -1,0 +1,100 @@
+"""Chip race: 27-point streamed-kernel tuning (round 5, VERDICT r4
+weak #1 / next #4).
+
+The round-4 27-point stream:2 recorded 4.41 ms/step at 256x512x512 —
+7x the 7-point's 0.632 for ~3.9x the FLOPs — with two named causes:
+the band auto-drop to 4 (_VMEM_CEILING_27) and the three accumulating
+read-modify-write stores per substep.  This harness races:
+
+  r4      : per-dz-slab stores (ysplit27=0), band=4   (the baseline)
+  ysplit2 : y-halved single-store substep,   band=4
+  ysplit2+8: same, band=8 (restored DMA window efficiency)
+  ysplit4+8: quarter-chunks, band=8
+  deeper folds (stream:4) on the winner's form
+
+Marginal ms/step by step-count differencing; bit-exactness asserted
+against the XLA compact 27-point path at small steps.
+
+Usage: python -m tpuscratch.bench.stream27_chip
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpuscratch.bench.timing import time_device
+from tpuscratch.halo.halo3d import OFFSETS26
+from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
+
+CZ, CY, CX = 256, 512, 512
+
+
+def c27():
+    rng = np.random.default_rng(3)
+    w = rng.uniform(0.01, 0.03, 27)
+    return tuple(float(x) for x in w)
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "k", "band",
+                                             "ysplit"))
+def run(core, steps, k, band, ysplit):
+    coeffs = c27()
+
+    def body(c, _):
+        a_mz, a_pz = c[CZ - k :], c[:k]
+        return seven_point_streamed_pallas(
+            c, a_mz, a_pz, (CZ, CY, CX), coeffs, k, band=band,
+            ysplit27=ysplit,
+        ), ()
+
+    out, _ = jax.lax.scan(body, core, None, length=steps // k)
+    return out
+
+
+def main():
+    rng = np.random.default_rng(9)
+    core = jnp.asarray(
+        rng.standard_normal((CZ, CY, CX)), jnp.float32
+    )
+
+    # correctness: ysplit form == r4 form at 4 steps
+    a = np.asarray(run(core, 4, 2, 4, 2))
+    b = np.asarray(run(core, 4, 2, 4, 0))
+    err = float(np.max(np.abs(a - b)))
+    print(f"# ysplit2 vs r4 form max|diff| (4 steps): {err:.3e}",
+          flush=True)
+    assert err < 1e-5
+
+    cells = CZ * CY * CX
+    variants = [
+        ("r4 band=4 k=2", 2, 4, 0),
+        ("ysplit2 band=4 k=2", 2, 4, 2),
+        ("ysplit2 band=8 k=2", 2, 8, 2),
+        ("ysplit4 band=8 k=2", 2, 8, 4),
+        ("ysplit2 band=8 k=4", 4, 8, 2),
+        ("ysplit4 band=8 k=4", 4, 8, 4),
+    ]
+    for name, k, band, ys in variants:
+        try:
+            lo, hi = 20 * k, 60 * k
+            r_lo = time_device(run, core, lo, k, band, ys, warmup=1,
+                               iters=3, fence="readback")
+            r_hi = time_device(run, core, hi, k, band, ys, warmup=1,
+                               iters=3, fence="readback")
+            marg = (r_hi.p50 - r_lo.p50) / (hi - lo) * 1e3
+            print(
+                f"# {name}: marginal {marg:.3f} ms/step = "
+                f"{cells / (marg * 1e-3):.3e} cells/s",
+                flush=True,
+            )
+        except Exception as e:
+            msg = str(e).split(chr(10))[0][:160]
+            print(f"# {name}: FAILED {msg}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

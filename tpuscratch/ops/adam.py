@@ -1,0 +1,111 @@
+"""Fused single-pass Adam update — one kernel reads (w, g, m, v) and
+writes (w', m', v') per element, the 7-access/element HBM roofline for
+the optimizer step.
+
+Why: BASELINE row 11 measured the Adam premium at ~13.8 ms/step over
+SGD for ~180M params — two elementwise moment passes plus the update,
+about 2x the 7-access roofline (~7 ms at v5e HBM rates) because XLA
+schedules the three tree-mapped passes as separate loop nests over
+each leaf (VERDICT r4 weak #4).  The reference has no optimizer at all
+(SURVEY §2.7 — this surface is beyond parity); the kernel follows the
+framework's standard one-source dual-backend policy (Mosaic interpret
+off-TPU).
+
+Two variants:
+- :func:`fused_adam_tree` — f32 moments, drop-in for the tree-mapped
+  update (bit-comparable modulo fma reassociation);
+- ``moment_dtype=bfloat16`` — halves the moment traffic (20 B/element
+  instead of 28); the moments quantize to bf16 but the params stay f32
+  master copies (the usual mixed-precision optimizer layout).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpuscratch.ops.common import mosaic_params, use_interpret
+
+_COLS = 1024
+_BAND = 512  # rows per grid step: 7 x (512, 1024) f32 buffers = 14 MB
+
+
+def _adam_kernel(alpha_ref, w_ref, g_ref, m_ref, v_ref,
+                 nw_ref, nm_ref, nv_ref, *, b1: float, b2: float,
+                 eps: float):
+    g = g_ref[...]
+    m = b1 * m_ref[...].astype(jnp.float32) + (1.0 - b1) * g
+    v = b2 * v_ref[...].astype(jnp.float32) + (1.0 - b2) * (g * g)
+    nm_ref[...] = m.astype(nm_ref.dtype)
+    nv_ref[...] = v.astype(nv_ref.dtype)
+    nw_ref[...] = w_ref[...] - alpha_ref[0] * m / (jnp.sqrt(v) + eps)
+
+
+@functools.partial(jax.jit, static_argnames=("b1", "b2", "eps"))
+def _fused_adam_flat(w, g, m, v, alpha, b1, b2, eps):
+    """(rows, _COLS) f32 arrays -> (w', m', v'), one pass."""
+    rows = w.shape[0]
+    band = min(_BAND, rows)
+    while rows % band:
+        band //= 2
+    grid = rows // band
+    spec = pl.BlockSpec((band, _COLS), lambda i: (i, 0))
+    interpret = use_interpret()
+    return pl.pallas_call(
+        functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec, spec, spec, spec,
+        ],
+        out_specs=(spec, spec, spec),
+        out_shape=(
+            jax.ShapeDtypeStruct(w.shape, w.dtype),
+            jax.ShapeDtypeStruct(m.shape, m.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        interpret=interpret,
+        **mosaic_params(),
+    )(alpha.reshape(1), w, g, m, v)
+
+
+def _to_flat(x):
+    n = x.size
+    rows = -(-n // _COLS)
+    rows8 = -(-rows // 8) * 8
+    pad = rows8 * _COLS - n
+    fx = x.reshape(-1)
+    if pad:
+        fx = jnp.concatenate([fx, jnp.zeros((pad,), x.dtype)])
+    return fx.reshape(rows8, _COLS)
+
+
+def fused_adam_tree(params, grads, mu, nu, alpha, b1=0.9, b2=0.999,
+                    eps=1e-8):
+    """Per-leaf fused Adam: returns (new_params, new_mu, new_nu) pytrees.
+    ``alpha`` is the bias-corrected step size (traced scalar).  Moments
+    may be bf16 (storage) — accumulation is always f32."""
+    flat, treedef = jax.tree.flatten(params)
+    gflat = jax.tree.leaves(grads)
+    mflat = jax.tree.leaves(mu)
+    vflat = jax.tree.leaves(nu)
+    nw, nm, nv = [], [], []
+    alpha = jnp.asarray(alpha, jnp.float32)
+    for w, g, m, v in zip(flat, gflat, mflat, vflat):
+        w2, m2, v2 = _fused_adam_flat(
+            _to_flat(w), _to_flat(g.astype(jnp.float32)), _to_flat(m),
+            _to_flat(v), alpha, b1, b2, eps,
+        )
+        n = w.size
+        nw.append(w2.reshape(-1)[:n].reshape(w.shape))
+        nm.append(m2.reshape(-1)[:n].reshape(m.shape))
+        nv.append(v2.reshape(-1)[:n].reshape(v.shape))
+    return (
+        jax.tree.unflatten(treedef, nw),
+        jax.tree.unflatten(treedef, nm),
+        jax.tree.unflatten(treedef, nv),
+    )
