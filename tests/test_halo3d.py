@@ -304,12 +304,46 @@ class TestStreamImpl:
                 (8, 8, 8), coeffs, 4, band=4, carry_tail=True,
             )
 
-    def test_stream_rejects_distributed_yx(self, devices):
+    # ---- ghost-strip y/x modes (round 5) ------------------------------
+
+    @pytest.mark.parametrize("mesh_dims", [
+        (1, 2, 1), (1, 1, 2), (1, 2, 2), (2, 2, 1), (2, 1, 2), (2, 2, 2),
+    ])
+    @pytest.mark.parametrize("impl,steps", [("stream:2", 5), ("stream:3", 3)])
+    def test_stream_ghost_yx_equals_compact(self, devices, mesh_dims,
+                                            impl, steps):
+        # distributed y/x axes ride ghost strips aged in-kernel — the
+        # 2D ghost-column scheme one dimension up
         rng = np.random.default_rng(14)
+        world = rng.standard_normal((16, 16, 16)).astype(np.float32)
+        mesh = make_mesh(mesh_dims, ("z", "row", "col"))
+        a = distributed_stencil3d(world, steps, mesh, impl=impl)
+        b = distributed_stencil3d(world, steps, mesh, impl="compact")
+        assert np.allclose(a, b, atol=1e-5)
+
+    @pytest.mark.parametrize("periodic", [
+        (True, False, True), (True, True, False), (False, False, False),
+    ])
+    def test_stream_ghost_yx_open(self, devices, periodic):
+        # open y/x faces: ppermute zero-fill supplies the initial zero
+        # ghosts, per-substep flag zeroing keeps strip cells zero
+        rng = np.random.default_rng(15)
+        world = rng.standard_normal((16, 16, 16)).astype(np.float32)
+        mesh = make_mesh((2, 2, 2), ("z", "row", "col"))
+        a = distributed_stencil3d(world, 5, mesh, impl="stream:2",
+                                  periodic=periodic)
+        b = distributed_stencil3d(world, 5, mesh, impl="padded",
+                                  periodic=periodic)
+        assert np.allclose(a, b, atol=1e-5)
+
+    def test_stream_27_rejects_distributed_yx(self, devices):
+        rng = np.random.default_rng(16)
         world = rng.standard_normal((8, 8, 8)).astype(np.float32)
         mesh = make_mesh((1, 2, 1), ("z", "row", "col"))
-        with pytest.raises(ValueError, match="self-wrapping"):
-            distributed_stencil3d(world, 2, mesh, impl="stream:2")
+        c27 = tuple(np.linspace(0.01, 0.26, 26)) + (0.3,)
+        with pytest.raises(ValueError, match="z-slab"):
+            distributed_stencil3d(world, 2, mesh, coeffs=c27,
+                                  impl="stream:2")
 
     def test_stream_rejects_depth_over_band(self, devices):
         from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas

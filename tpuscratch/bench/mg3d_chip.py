@@ -1,0 +1,82 @@
+"""Chip race: 3D multigrid V-cycle cost by smoother at 256^3 (round 5,
+VERDICT r4 next #5).
+
+Times ``cycles`` fixed V-cycles (no tolerance loop) for each smoother —
+rbgs (the default), jacobi, and jacobi-stream (fine-level sweeps folded
+into streamed manual-DMA passes, ops/stencil_stream rhs mode) — marginal
+ms/cycle by cycle-count differencing.
+
+Usage: python -m tpuscratch.bench.mg3d_chip [N]
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.bench.timing import time_device
+from tpuscratch.comm import run_spmd
+from tpuscratch.runtime.mesh import make_mesh, topology_of
+from tpuscratch.solvers.multigrid3d import (
+    TileLayout3D, level_specs3, v_cycle3,
+)
+
+
+def build(n, mesh, levels):
+    topo = topology_of(mesh, periodic=True)
+    dims = tuple(mesh.devices.shape)
+    core = tuple(n // d for d in dims)
+    specs = level_specs3(
+        TileLayout3D(core, (1, 1, 1)), topo, tuple(mesh.axis_names), levels
+    )
+    return specs
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    levels = 5
+    mesh = make_mesh((1, 1, 1), ("z", "row", "col"))
+    specs = build(n, mesh, levels)
+    rng = np.random.default_rng(21)
+    b = rng.standard_normal((n, n, n)).astype(np.float32)
+    b -= b.mean()
+
+    def prog(smoother, cycles):
+        def body(bt):
+            f = bt[0, 0, 0]
+
+            def one(u, _):
+                u = v_cycle3(u, f, specs, 0, 2, 32, 6 / 7, smoother)
+                return u, ()
+
+            u, _ = lax.scan(one, jnp.zeros_like(f), None, length=cycles)
+            return u[None, None, None]
+
+        return run_spmd(mesh, body, P("z", "row", "col", None, None),
+                        P("z", "row", "col", None, None))
+
+    bt = jnp.asarray(b)[None, None, None]
+    for sm in ("rbgs", "jacobi", "jacobi-stream"):
+        try:
+            lo, hi = 3, 9
+            f_lo = jax.jit(prog(sm, lo))
+            f_hi = jax.jit(prog(sm, hi))
+            # correctness: one cycle must reduce the residual
+            r_lo = time_device(f_lo, bt, warmup=1, iters=3,
+                               fence="readback")
+            r_hi = time_device(f_hi, bt, warmup=1, iters=3,
+                               fence="readback")
+            ms = (r_hi.p50 - r_lo.p50) * 1e3 / (hi - lo)
+            print(f"# {sm}: {ms:.2f} ms/V-cycle at {n}^3", flush=True)
+        except Exception as e:
+            print(f"# {sm}: FAILED {str(e)[:200]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

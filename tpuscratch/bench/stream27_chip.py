@@ -56,29 +56,35 @@ def run(core, steps, k, band, ysplit):
 
 
 def main():
+    import sys
+
+    sel = set(sys.argv[1].split(",")) if len(sys.argv) > 1 else None
     rng = np.random.default_rng(9)
     core = jnp.asarray(
         rng.standard_normal((CZ, CY, CX)), jnp.float32
     )
 
-    # correctness: ysplit form == r4 form at 4 steps
-    a = np.asarray(run(core, 4, 2, 4, 2))
-    b = np.asarray(run(core, 4, 2, 4, 0))
-    err = float(np.max(np.abs(a - b)))
-    print(f"# ysplit2 vs r4 form max|diff| (4 steps): {err:.3e}",
-          flush=True)
-    assert err < 1e-5
+    if sel is None or "eq" in sel:
+        # correctness: ysplit form == r4 form at 4 steps
+        a = np.asarray(run(core, 4, 2, 4, 2))
+        b = np.asarray(run(core, 4, 2, 4, 0))
+        err = float(np.max(np.abs(a - b)))
+        print(f"# ysplit2 vs r4 form max|diff| (4 steps): {err:.3e}",
+              flush=True)
+        assert err < 1e-5
 
     cells = CZ * CY * CX
     variants = [
-        ("r4 band=4 k=2", 2, 4, 0),
-        ("ysplit2 band=4 k=2", 2, 4, 2),
-        ("ysplit2 band=8 k=2", 2, 8, 2),
-        ("ysplit4 band=8 k=2", 2, 8, 4),
-        ("ysplit2 band=8 k=4", 4, 8, 2),
-        ("ysplit4 band=8 k=4", 4, 8, 4),
+        ("v0: r4 band=4 k=2", 2, 4, 0),
+        ("v1: ysplit2 band=4 k=2", 2, 4, 2),
+        ("v2: ysplit2 band=8 k=2", 2, 8, 2),
+        ("v3: ysplit4 band=8 k=2", 2, 8, 4),
+        ("v4: ysplit2 band=8 k=4", 4, 8, 2),
+        ("v5: ysplit4 band=8 k=4", 4, 8, 4),
     ]
     for name, k, band, ys in variants:
+        if sel is not None and name.split(":")[0] not in sel:
+            continue
         try:
             lo, hi = 20 * k, 60 * k
             r_lo = time_device(run, core, lo, k, band, ys, warmup=1,

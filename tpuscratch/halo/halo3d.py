@@ -454,12 +454,19 @@ def run_stencil3d_stream(
     substeps fold into each manual-DMA pass, dividing per-step HBM
     traffic by ``depth`` — the only lever past the measured ~330 GB/s
     DMA-fabric copy bound (ops/stencil_stream.py docstring carries the
-    bound race).  Serves z-slab decompositions: y/x must self-wrap
-    (degenerate periodic); z ghosts travel as (depth, cy, cx) slabs,
-    one exchange per ``depth`` steps — the 2D ``deep:k`` trapezoid one
+    bound race).  z ghosts travel as (depth, cy, cx) slabs, one
+    exchange per ``depth`` steps — the 2D ``deep:k`` trapezoid one
     dimension up (reference lineage: stencil2D.h:116-117, ghost depth
-    as a parameter).  Open z boundaries get zero ghosts, matching the
-    plain path's ppermute semantics.
+    as a parameter).
+
+    y/x axes (round 5): a periodic size-1 axis self-wraps in-kernel
+    (z-slab mode); a DISTRIBUTED (or open) y or x axis rides ghost
+    strips — the neighbors' edge slabs with the diagonal neighbors'
+    corner segments, the 26-neighbor transfer set at ghost depth
+    ``depth`` — aged in-kernel alongside the window (7-point only; the
+    27-point form keeps the z-slab requirement and falls back to
+    ``compact-asm`` elsewhere).  Open boundaries get zero ghosts,
+    matching the plain path's ppermute semantics.
     """
     from tpuscratch.ops.stencil_stream import seven_point_streamed_pallas
 
@@ -468,54 +475,101 @@ def run_stencil3d_stream(
             f"stream impl takes 7 or 27 coefficients, got {len(coeffs)}"
         )
     topo = spec.topology
-    for a, name in ((1, "y"), (2, "x")):
-        if not (topo.dims[a] == 1 and topo.periodic[a]):
-            raise ValueError(
-                f"stream impl needs a self-wrapping {name} axis (z-slab "
-                f"decomposition), got dims={topo.dims} "
-                f"periodic={topo.periodic}; use impl='compact-asm' for "
-                "distributed y/x axes"
-            )
     cz, cy, cx = core.shape
-    wrap_z = topo.dims[0] == 1 and topo.periodic[0]
+    wrap_y = topo.dims[1] == 1 and topo.periodic[1]
+    wrap_x = topo.dims[2] == 1 and topo.periodic[2]
+    if len(coeffs) == 27 and not (wrap_y and wrap_x):
+        raise ValueError(
+            "the 27-point stream impl needs a z-slab decomposition "
+            f"(self-wrapping y and x), got dims={topo.dims} "
+            f"periodic={topo.periodic}; use impl='compact-asm' for "
+            "distributed y/x axes"
+        )
 
-    def ghosts(c, d):
-        if wrap_z:
-            return c[cz - d:], c[:d]
-        if topo.dims[0] == 1:  # single rank, open z: zero ghosts
-            z = jnp.zeros((d, cy, cx), c.dtype)
-            return z, z
-        # depth-d slab exchange; non-periodic ends receive ppermute
-        # zeros, identical to the plain path's ghost semantics
-        a_mz = lax.ppermute(
-            c[cz - d:], spec.axes, list(topo.send_permutation((1, 0, 0)))
-        )
-        a_pz = lax.ppermute(
-            c[:d], spec.axes, list(topo.send_permutation((-1, 0, 0)))
-        )
-        return a_mz, a_pz
+    def gather(block, off):
+        # the off-neighbor's block: local when the permutation is pure
+        # self-wrap (self-ppermutes cost ~1.2 ms/step of launch
+        # overhead, BASELINE row 9), zeros when nobody sends (fully
+        # open), else a diagonal-capable ppermute with zero-fill at
+        # open edges (the MPI_PROC_NULL analogue)
+        pairs = list(topo.send_permutation(off))
+        if not pairs:
+            return jnp.zeros_like(block)
+        if len(pairs) == topo.size and all(s == d for s, d in pairs):
+            return block
+        return lax.ppermute(block, spec.axes, pairs)
+
+    def strip_z(block_top, block_mid, block_bot, off_yx):
+        """A ghost strip spanning global planes [-d, cz+d): the
+        off_yx-neighbor's mid block plus the z-diagonal neighbors'
+        corner segments."""
+        dy, dx = off_yx
+        return jnp.concatenate([
+            gather(block_top, (1, dy, dx)),
+            gather(block_mid, (0, dy, dx)),
+            gather(block_bot, (-1, dy, dx)),
+        ], axis=0)
 
     def open_flags():
-        # per-rank traced flags: an OPEN physical end must re-impose its
-        # zero ghosts every folded substep (shard_map traces one program
-        # for every rank, so this cannot be a static property)
-        if topo.periodic[0]:
+        # per-rank traced flags [z-, z+, y-, y+, x-, x+]: an OPEN
+        # physical end must re-impose its zero ghosts every folded
+        # substep (shard_map traces one program for every rank, so
+        # this cannot be a static property)
+        if all(topo.periodic):
             return None
-        if topo.dims[0] == 1:
-            return jnp.ones((2,), jnp.int32)
-        zc = lax.axis_index(spec.axes[0])
-        return jnp.stack(
-            [(zc == 0).astype(jnp.int32),
-             (zc == topo.dims[0] - 1).astype(jnp.int32)]
-        )
+        parts = []
+        for axis in range(3):
+            if topo.periodic[axis]:
+                parts += [jnp.zeros((), jnp.int32)] * 2
+            elif topo.dims[axis] == 1:
+                parts += [jnp.ones((), jnp.int32)] * 2
+            else:
+                rc = lax.axis_index(spec.axes[axis])
+                parts += [(rc == 0).astype(jnp.int32),
+                          (rc == topo.dims[axis] - 1).astype(jnp.int32)]
+        return jnp.stack(parts)
 
     flags = open_flags()
 
     def pass_fn(c, d):
-        a_mz, a_pz = ghosts(c, d)
+        a_mz = gather(c[cz - d :], (1, 0, 0))
+        a_pz = gather(c[:d], (-1, 0, 0))
+        gy = gx = gc = None
+        if not wrap_y:
+            # [plus | minus] rows: south neighbors' top d rows, then
+            # north neighbors' bottom d rows, each z-extended
+            gy = jnp.concatenate([
+                strip_z(c[cz - d :, :d, :], c[:, :d, :], c[:d, :d, :],
+                        (-1, 0)),
+                strip_z(c[cz - d :, cy - d :, :], c[:, cy - d :, :],
+                        c[:d, cy - d :, :], (1, 0)),
+            ], axis=1)
+        if not wrap_x:
+            gx = jnp.concatenate([
+                strip_z(c[cz - d :, :, :d], c[:, :, :d], c[:d, :, :d],
+                        (0, -1)),
+                strip_z(c[cz - d :, :, cx - d :], c[:, :, cx - d :],
+                        c[:d, :, cx - d :], (0, 1)),
+            ], axis=2)
+        if not wrap_y and not wrap_x:
+            # xy-corner strip: quadrants [y-plus | y-minus] x
+            # [x-plus | x-minus], each from the matching diagonal
+            # neighbor's opposite corner block, z-extended
+            def quad(oy, ox):
+                ys = slice(0, d) if oy == -1 else slice(cy - d, cy)
+                xs = slice(0, d) if ox == -1 else slice(cx - d, cx)
+                return strip_z(
+                    c[cz - d :, ys, xs], c[:, ys, xs], c[:d, ys, xs],
+                    (oy, ox),
+                )
+
+            gc = jnp.concatenate([
+                jnp.concatenate([quad(-1, -1), quad(-1, 1)], axis=2),
+                jnp.concatenate([quad(1, -1), quad(1, 1)], axis=2),
+            ], axis=1)
         return seven_point_streamed_pallas(
             c, a_mz, a_pz, (cz, cy, cx), tuple(coeffs), d, band, nbuf,
-            open_flags=flags,
+            open_flags=flags, gy=gy, gx=gx, gc=gc,
         )
 
     q, r = divmod(steps, depth)

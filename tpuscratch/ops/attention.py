@@ -914,13 +914,31 @@ def _flash_diff_fwd(qh, kh, vh, qoff, koff, causal, bq, bk):
     return o, (qh, kh, vh, qoff, koff, o, lse)
 
 
+def _bwd_block_k(dtype, T: int, bk: int) -> int:
+    """Backward KV-block retune (round-5 chip race, BASELINE row 6):
+    the dense backward kernels run fastest with bk=512 in f32 — at
+    bk=1024 the dkv kernel's (bk, D) scratch pair sits at the
+    scoped-vmem edge and measured UNSTABLE (1.4-2.4 ms across runs;
+    bk=2048 is an outright compile DNF) — and bk=2048 in bf16 (half
+    the bytes: 127.7 vs 109.2 TFLOP/s non-causal).  The backward
+    kernels are block-independent of the forward (lse/delta are
+    per-row), so the retune differs from the forward's — but ONLY when
+    the caller used the default ``block_k`` (1024); a non-default value
+    is an explicit resource bound and is respected in the backward
+    too."""
+    if bk != 1024:
+        return bk
+    return _pick_block(T, 2048 if dtype == jnp.bfloat16 else 512, "T")
+
+
 def _flash_diff_bwd(causal, bq, bk, res, do):
     qh, kh, vh, qoff, koff, o, lse = res
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # (H, S)
     dq, dk, dv = _flash_bwd_call(
-        qh, kh, vh, do, lse, delta, qoff, koff, causal, bq, bk
+        qh, kh, vh, do, lse, delta, qoff, koff, causal, bq,
+        _bwd_block_k(qh.dtype, kh.shape[1], bk),
     )
     # integer offsets are non-differentiable: float0 cotangents
     zero = np.zeros(qoff.shape, dtype=jax.dtypes.float0)
@@ -961,7 +979,7 @@ def _flash_diff_compact_bwd(qoff, koff, bq, bk, res, do):
             qh, kh, vh, do, lse, delta,
             jnp.asarray(qoff, jnp.int32).reshape(1),
             jnp.asarray(koff, jnp.int32).reshape(1),
-            True, bq, bk,
+            True, bq, _bwd_block_k(qh.dtype, kh.shape[1], bk),
         )
     dq, dk, dv = r
     return dq, dk, dv

@@ -415,16 +415,29 @@ def seven_point_strips_pallas(
     )(zpad, a_my, a_py, a_mx, a_px)
 
 
-def _asm3d_compute(o_ref, up, dn, c, my, py, mx, px, cy: int, cx: int, w):
+def _asm3d_compute(o_ref, up, dn, c, my, py, mx, px, cy: int, cx: int, w,
+                   fterm=None, fc: float = 0.0):
     """Ring-decomposed 7-point band update: the interior is pure shifted
     slices (no temporaries beyond the fused sum), and only the four
     boundary LINES pay concats — (band,1,cx)/(band,cy-2,1) sized, ~cy/2
-    times smaller than the full-plane concats of _strips3d_kernel."""
+    times smaller than the full-plane concats of _strips3d_kernel.
+
+    ``fterm``/``fc``: optional pointwise affine term — each output cell
+    additionally gets ``fc * fterm`` at its own coordinates (the damped
+    Jacobi smoother's rhs contribution, folded into each region's fused
+    sum so no extra output pass happens)."""
+
+    def f_at(r0, r1, c0, c1):
+        if fterm is None:
+            return 0.0
+        return fc * fterm[:, r0:r1, c0:c1]
+
     o_ref[:, 1 : cy - 1, 1 : cx - 1] = (
         w[0] * up[:, 1:-1, 1:-1] + w[1] * dn[:, 1:-1, 1:-1]
         + w[2] * c[:, 0:-2, 1:-1] + w[3] * c[:, 2:, 1:-1]
         + w[4] * c[:, 1:-1, 0:-2] + w[5] * c[:, 1:-1, 2:]
         + w[6] * c[:, 1:-1, 1:-1]
+        + f_at(1, cy - 1, 1, cx - 1)
     )
     o_ref[:, 0:1, :] = (
         w[0] * up[:, 0:1, :] + w[1] * dn[:, 0:1, :]
@@ -432,6 +445,7 @@ def _asm3d_compute(o_ref, up, dn, c, my, py, mx, px, cy: int, cx: int, w):
         + w[4] * jnp.concatenate([mx[:, 0:1, :], c[:, 0:1, :-1]], axis=2)
         + w[5] * jnp.concatenate([c[:, 0:1, 1:], px[:, 0:1, :]], axis=2)
         + w[6] * c[:, 0:1, :]
+        + f_at(0, 1, 0, cx)
     )
     o_ref[:, cy - 1 : cy, :] = (
         w[0] * up[:, -1:, :] + w[1] * dn[:, -1:, :]
@@ -439,18 +453,21 @@ def _asm3d_compute(o_ref, up, dn, c, my, py, mx, px, cy: int, cx: int, w):
         + w[4] * jnp.concatenate([mx[:, -1:, :], c[:, -1:, :-1]], axis=2)
         + w[5] * jnp.concatenate([c[:, -1:, 1:], px[:, -1:, :]], axis=2)
         + w[6] * c[:, -1:, :]
+        + f_at(cy - 1, cy, 0, cx)
     )
     o_ref[:, 1 : cy - 1, 0:1] = (
         w[0] * up[:, 1:-1, 0:1] + w[1] * dn[:, 1:-1, 0:1]
         + w[2] * c[:, 0:-2, 0:1] + w[3] * c[:, 2:, 0:1]
         + w[4] * mx[:, 1:-1, :] + w[5] * c[:, 1:-1, 1:2]
         + w[6] * c[:, 1:-1, 0:1]
+        + f_at(1, cy - 1, 0, 1)
     )
     o_ref[:, 1 : cy - 1, cx - 1 : cx] = (
         w[0] * up[:, 1:-1, -1:] + w[1] * dn[:, 1:-1, -1:]
         + w[2] * c[:, 0:-2, -1:] + w[3] * c[:, 2:, -1:]
         + w[4] * c[:, 1:-1, -2:-1] + w[5] * px[:, 1:-1, :]
         + w[6] * c[:, 1:-1, -1:]
+        + f_at(1, cy - 1, cx - 1, cx)
     )
 
 
