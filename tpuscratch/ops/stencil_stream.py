@@ -48,11 +48,14 @@ from tpuscratch.ops.common import mosaic_params, use_interpret
 from tpuscratch.ops.stencil_kernel import _asm3d_compute, _largest_divisor_band
 
 _VMEM_CEILING = 100 << 20
-#: the 27-point substep's temp pressure adds to the buffer footprint:
-#: at 512^2 planes band=8 is a Mosaic remote-compile DNF while band=4
-#: compiles and runs (chip-probed) — this tighter default budget makes
-#: the band chooser land on the working configuration
-_VMEM_CEILING_27 = 48 << 20
+#: the 27-point substep's temp pressure adds to the buffer footprint.
+#: Round 4 (per-dz accumulating stores): band=8 at 512^2 planes was a
+#: Mosaic remote-compile DNF and a 48 MB ceiling forced band=4.  Round
+#: 5's y-split single-store substep (ysplit27=4) halves-and-halves the
+#: live temps: band=8 compiles and runs on chip at 3.510 ms/step vs the
+#: round-4 form's 4.861 (256x512x512, k=2) — this ceiling now lands the
+#: chooser on band=8 for k=2 at 512^2 planes
+_VMEM_CEILING_27 = 72 << 20
 
 
 def weight_cube(coeffs27, offsets26) -> tuple:
@@ -66,7 +69,7 @@ def weight_cube(coeffs27, offsets26) -> tuple:
     return tuple(tuple(tuple(r) for r in p) for p in W)
 
 
-def _substep27(o_ref, t, P: int, cy: int, cx: int, W, ysplit: int = 0):
+def _substep27(o_ref, t, P: int, cy: int, cx: int, W, ysplit: int = 4):
     """One 27-point substep on a (P, cy, cx) window value: for each
     output plane, the three dz-shifted planes each contribute a 9-point
     with periodic y/x wrap — ring-decomposed exactly like the 7-point
@@ -244,7 +247,7 @@ def _stream_kernel(flags_ref, mz_ref, pz_ref, gy_ref, gx_ref, gc_ref,
                    rsem, fsem, wsem, *,
                    band: int, depth: int, nb: int,
                    nbuf: int, cy: int, cx: int, coeffs7, carry_tail: bool,
-                   ysplit27: int = 0, ghost_y: bool = False,
+                   ysplit27: int = 4, ghost_y: bool = False,
                    ghost_x: bool = False, has_rhs: bool = False,
                    rhs_coeff: float = 0.0):
     k, P0 = depth, band + 2 * depth
@@ -566,7 +569,7 @@ def seven_point_streamed_pallas(
     budget_bytes: int = _VMEM_CEILING,
     open_flags: jax.Array | None = None,
     carry_tail: bool | None = None,
-    ysplit27: int = 0,
+    ysplit27: int = 4,
     gy: jax.Array | None = None,
     gx: jax.Array | None = None,
     gc: jax.Array | None = None,
