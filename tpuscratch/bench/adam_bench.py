@@ -58,11 +58,13 @@ def run(params, grads, mu, nu, form, rounds):
             opt = {"mu": mu, "nu": nu, "t": t.astype(jnp.int32) - 1}
             params, opt = _adam_update(params, opt, g, lr, b1, b2, eps)
             mu, nu = opt["mu"], opt["nu"]
-        elif form == "1-map":
+        elif form.startswith("1-map"):
             def upd(w, gg, m, v):
-                m2 = b1 * m + (1.0 - b1) * gg
-                v2 = b2 * v + (1.0 - b2) * gg * gg
-                return w - alpha * m2 / (jnp.sqrt(v2) + eps), m2, v2
+                # bf16-moment storage: accumulate f32, store back quantized
+                m2 = b1 * m.astype(jnp.float32) + (1.0 - b1) * gg
+                v2 = b2 * v.astype(jnp.float32) + (1.0 - b2) * gg * gg
+                return (w - alpha * m2 / (jnp.sqrt(v2) + eps),
+                        m2.astype(m.dtype), v2.astype(v.dtype))
 
             out = jax.tree.map(upd, params, g, mu, nu)
             params = jax.tree.map(lambda o: o[0], out,
@@ -101,6 +103,7 @@ def main():
     assert err < 1e-5, "fused Adam kernel disagrees with the oracle"
 
     for form, mdt in (("3-map", jnp.float32), ("1-map", jnp.float32),
+                      ("1-map-bf16m", jnp.bfloat16),
                       ("pallas", jnp.float32),
                       ("pallas-bf16m", jnp.bfloat16)):
         mu = make_tree(rng, mdt)
