@@ -302,7 +302,10 @@ class TestMultigrid3D:
         from tpuscratch.solvers.multigrid3d import mg_poisson3d_solve
 
         rng = np.random.default_rng(7)
-        b = rng.standard_normal((32, 16, 16)).astype(np.float32)
+        # cx = 128: the streamed smoother needs full-lane-tile planes
+        # (chip rule — see _stream_smoothable), so the finest level
+        # must be wide enough to actually exercise the streamed path
+        b = rng.standard_normal((64, 16, 128)).astype(np.float32)
         b -= b.mean()
         mesh = make_mesh(mesh_dims, ("z", "row", "col"))
         xj, cj, rj = mg_poisson3d_solve(b, mesh, tol=1e-6,

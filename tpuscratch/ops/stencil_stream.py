@@ -27,7 +27,12 @@ Scheme: the core streams through VMEM in z-bands.  Each band's read
 window carries ``depth`` extra planes per side (G-coords over the
 ghosted array [a_mz | core | a_pz]); ``depth`` ring-decomposed 7-point
 substeps shrink the window by one plane per side each, landing exactly
-the band's final planes, which stream back out.  The z ghosts arrive as
+the band's final planes, which stream back out.
+
+Chip rule (round-5, chip-probed): the kernel family is a Mosaic
+remote-compile DNF for plane widths cx < 128 on silicon (sub-lane-tile
+planes; the CPU interpreter accepts them) — callers that may see small
+cores (the multigrid coarse levels) must gate on cx >= 128.  The z ghosts arrive as
 small (depth, cy, cx) VMEM inputs patched into the first/last windows —
 never a separate DMA channel.  y/x must self-wrap (degenerate periodic
 axes): their ghost lines are read from the band's own planes, the same

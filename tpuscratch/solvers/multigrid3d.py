@@ -169,8 +169,14 @@ def jacobi_smooth3_stream(u, f, spec: HaloSpec3D, omega: float,
 
 def _stream_smoothable(spec: HaloSpec3D, sweeps: int) -> bool:
     """True when the streamed smoother serves this level: a z-slab
-    periodic mesh and a core deep enough for >= 2 bands of >= the fold
-    depth (the kernel's window structure)."""
+    periodic mesh, a core deep enough for >= 2 bands of >= the fold
+    depth (the kernel's window structure), and a FULL-LANE-TILE plane
+    width — chip-probed (round 5): the 3D streamed kernel family is a
+    Mosaic remote-compile DNF for cx < 128 on silicon (sub-lane-tile
+    planes; the CPU interpreter accepts them), so only the finest
+    levels stream and coarser levels use plain Jacobi — which is also
+    where the fold buys nothing (coarse sweeps are launch-bound, not
+    HBM-bound)."""
     topo = spec.topology
     cz = spec.layout.core[0]
     k = min(4, sweeps)
@@ -178,7 +184,7 @@ def _stream_smoothable(spec: HaloSpec3D, sweeps: int) -> bool:
         topo.dims[1] == 1 and topo.dims[2] == 1
         and all(topo.periodic)
         and cz >= 2 * k
-        and spec.layout.core[1] >= 3 and spec.layout.core[2] >= 3
+        and spec.layout.core[1] >= 8 and spec.layout.core[2] >= 128
     )
 
 
