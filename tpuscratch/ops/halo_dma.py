@@ -650,10 +650,15 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
     ew_remote = C > 1
     cn, cs, cw, ce, cnw, cne, csw, cse, cc = coeffs9
     diag = any(c != 0.0 for c in (cnw, cne, csw, cse))
-    roff = 1 if diag else 0  # row payload offset in the row stages
+    Wp2 = -(-(W + 2) // 128) * 128 if diag else Wp
+    # diag row stages pack [row(W) | cornerW | cornerE]: the row stays
+    # at lane offset 0 (aligned wide slices on both ends; [1:W+1]-style
+    # offset-1 wide reads are suspected chip DNFs) and the two corner
+    # cells ride at offsets W, W+1 (the 128-aligned tail tile)
 
     def kernel(in_hbm, colL_ref, colR_ref, out_hbm, ncolL_ref, ncolR_ref,
-               rbuf, wbuf, gL, gR, glx, grx, r_top, r_bot, r_left, r_right,
+               rbuf, wbuf, gL, gR, glxu, glxd, grxu, grxd,
+               r_top, r_bot, r_left, r_right,
                s_top, s_bot, s_left, s_right, erow_t, erow_b,
                rsem, wsem, esem, send_sem, recv_sem, entry_sem):
         if ns_remote or ew_remote:
@@ -727,12 +732,20 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
                 recv_wait(ch, dma)
             e_top.wait()
             e_bot.wait()
-            s_top[:, 1 : W + 1] = erow_t[7:8, 0:W]
-            s_top[:, 0:1] = r_left[:, H - 1 : H]
-            s_top[:, W + 1 : W + 2] = r_right[:, H - 1 : H]
-            s_bot[:, 1 : W + 1] = erow_b[0:1, 0:W]
-            s_bot[:, 0:1] = r_left[:, 0:1]
-            s_bot[:, W + 1 : W + 2] = r_right[:, 0:1]
+            # ONE aligned full-width store per row stage (chip-probed:
+            # misaligned single-lane stores like s_top[:, W+1:W+2] are
+            # a Mosaic remote-compile DNF); the corner cells are the
+            # received ghost columns' end cells, read as sublane slices
+            # of the transposed columns (legal at any offset)
+            glT = jnp.swapaxes(r_left[:, 0:H], 0, 1)    # (H, 1)
+            grT = jnp.swapaxes(r_right[:, 0:H], 0, 1)
+            pad = jnp.zeros((1, Wp2 - W - 2), erow_t.dtype)
+            s_top[:, 0:Wp2] = jnp.concatenate(
+                [erow_t[7:8, 0:W], jnp.swapaxes(glT[H - 1 : H], 0, 1),
+                 jnp.swapaxes(grT[H - 1 : H], 0, 1), pad], axis=1)
+            s_bot[:, 0:Wp2] = jnp.concatenate(
+                [erow_b[0:1, 0:W], jnp.swapaxes(glT[0:1], 0, 1),
+                 jnp.swapaxes(grT[0:1], 0, 1), pad], axis=1)
             copies = col_copies + [start_ch(TOP), start_ch(BOTTOM)]
         else:
             e_top.wait()
@@ -771,15 +784,27 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
         gL[0:H, :] = jnp.swapaxes(r_left[:, 0:H], 0, 1)
         gR[0:H, :] = jnp.swapaxes(r_right[:, 0:H], 0, 1)
         if diag:
-            # corner-extended ghost columns, rows [-1, H]: index i is
-            # global row i - 1; the corner cells are the received
-            # extended rows' end cells
-            glx[0:1] = r_top[:, 0:1]
-            glx[pl.ds(1, H)] = jnp.swapaxes(r_left[:, 0:H], 0, 1)
-            glx[pl.ds(H + 1, 1)] = r_bot[:, 0:1]
-            grx[0:1] = r_top[:, W + 1 : W + 2]
-            grx[pl.ds(1, H)] = jnp.swapaxes(r_right[:, 0:H], 0, 1)
-            grx[pl.ds(H + 1, 1)] = r_bot[:, W + 1 : W + 2]
+            # PRE-SHIFTED corner-extended ghost columns: glxu[r] = ghost
+            # at row r-1, glxd[r] = row r+1 (gL itself is row r), so the
+            # per-band diagonal slices stay 8-aligned at pl.ds(b*band)
+            # — dynamic sublane slices at +1/+2 offsets (and offset-1
+            # sublane stores) are chip DNFs; the corner cells are the
+            # received extended rows' end cells, read as single-lane
+            # value slices and sublane-concatenated (small values)
+            glT2 = jnp.swapaxes(r_left[:, 0:H], 0, 1)
+            grT2 = jnp.swapaxes(r_right[:, 0:H], 0, 1)
+            glxu[0:H] = jnp.concatenate(
+                [jnp.swapaxes(r_top[:, W : W + 1], 0, 1),
+                 glT2[0 : H - 1]], axis=0)
+            glxd[0:H] = jnp.concatenate(
+                [glT2[1:H], jnp.swapaxes(r_bot[:, W : W + 1], 0, 1)],
+                axis=0)
+            grxu[0:H] = jnp.concatenate(
+                [jnp.swapaxes(r_top[:, W + 1 : W + 2], 0, 1),
+                 grT2[0 : H - 1]], axis=0)
+            grxd[0:H] = jnp.concatenate(
+                [grT2[1:H], jnp.swapaxes(r_bot[:, W + 1 : W + 2], 0, 1)],
+                axis=0)
 
         rd(0, 0).wait()
 
@@ -797,9 +822,7 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
 
             t = rbuf[slot]                      # (band, W) own rows
             t_next0 = rbuf[nxt][0:1]            # band b+1's first row
-            dn_row = jnp.where(
-                b == nb - 1, r_bot[:, roff : roff + W], t_next0
-            )
+            dn_row = jnp.where(b == nb - 1, r_bot[:, 0:W], t_next0)
             up = jnp.concatenate([up_row, t[0 : band - 1]], axis=0)
             dn = jnp.concatenate([t[1:band], dn_row], axis=0)
             interior = (
@@ -813,15 +836,15 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
                     + cnw * up[:, 0 : W - 2] + cne * up[:, 2:W]
                     + csw * dn[:, 0 : W - 2] + cse * dn[:, 2:W]
                 )
-                # (band+2, 1) corner-extended ghost slices: glx index
-                # i = global row i - 1, so rows [r0-1, r0+band] are
-                # glx[b*band : b*band + band + 2] — affine, in-bounds
-                glu = glx[pl.ds(b * band, band)]        # rows r-1
-                gl = glx[pl.ds(b * band + 1, band)]     # rows r
-                gld = glx[pl.ds(b * band + 2, band)]    # rows r+1
-                gru = grx[pl.ds(b * band, band)]
-                gr = grx[pl.ds(b * band + 1, band)]
-                grd = grx[pl.ds(b * band + 2, band)]
+                # (band, 1) corner-extended ghost slices — all three
+                # shifts pre-applied at assembly, so every dynamic
+                # sublane slice is 8-aligned at b*band
+                glu = glxu[pl.ds(b * band, band)]       # rows r-1
+                gl = gL[pl.ds(b * band, band)]          # rows r
+                gld = glxd[pl.ds(b * band, band)]       # rows r+1
+                gru = grxu[pl.ds(b * band, band)]
+                gr = gR[pl.ds(b * band, band)]
+                grd = grxd[pl.ds(b * band, band)]
                 left = (
                     cn * up[:, 0:1] + cs * dn[:, 0:1]
                     + cw * gl + ce * t[:, 1:2] + cc * t[:, 0:1]
@@ -868,7 +891,7 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
 
             return carry_row
 
-        lax.fori_loop(0, nb, body, r_top[:, roff : roff + W])
+        lax.fori_loop(0, nb, body, r_top[:, 0:W])
         for i in range(max(0, nb - 2), nb):
             wr(i % 2, i).wait()
         for ch, dma in copies:
@@ -882,7 +905,8 @@ def _make_kernel_hbm(dims: tuple[int, int], axes: tuple[str, str],
     return kernel
 
 
-def _hbm_cost(b: int, H: int, W: int, itemsize: int) -> int:
+def _hbm_cost(b: int, H: int, W: int, itemsize: int,
+              diag: bool = False) -> int:
     """Tile-accurate VMEM footprint of the HBM-banded kernel at band
     ``b``: the four (b, W) read/write double-buffers plus ~3 band-width
     compute temporaries (the left/interior/right pieces of one band's
@@ -896,17 +920,20 @@ def _hbm_cost(b: int, H: int, W: int, itemsize: int) -> int:
     Wp = -(-W // 128) * 128
     Hp = -(-H // 128) * 128
     fixed = 6 * Hp * 128 + 32 * (Wp + Hp) + 16 * Wp
+    if diag:  # the four pre-shifted corner-extended ghost columns
+        fixed += 4 * Hp * 128
     return (7 * b * W + fixed) * itemsize
 
 
 def hbm_band(H: int, W: int, itemsize: int,
-             budget_bytes: int) -> int:
+             budget_bytes: int, diag: bool = False) -> int:
     """Largest 8-multiple divisor band of ``H`` whose FULL kernel
     footprint (``_hbm_cost``: band buffers + compute temps + the fixed
     column/strip scratch) fits the budget, with >= 2 bands (the DMA
     windows are 8-row-tile aligned, so bands must be too)."""
     for d in range(H // 2, 7, -1):
-        if H % d == 0 and d % 8 == 0 and _hbm_cost(d, H, W, itemsize) <= budget_bytes:
+        if (H % d == 0 and d % 8 == 0
+                and _hbm_cost(d, H, W, itemsize, diag) <= budget_bytes):
             return d
     raise ValueError(
         f"no 8-aligned band of H={H} gives >= 2 bands within "
@@ -968,15 +995,16 @@ def run_stencil_dma_hbm(
             "are 8-row-tile aligned)"
         )
     if band is None:
-        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes)
+        band = hbm_band(H, W, dt.itemsize, vmem_limit_bytes, diag)
     if H % band or H // band < 2 or band % 8:
         raise ValueError(
             f"band {band} must be an 8-multiple divisor of H {H} with "
             "at least 2 bands"
         )
-    if _hbm_cost(band, H, W, dt.itemsize) > vmem_limit_bytes:
+    if _hbm_cost(band, H, W, dt.itemsize, diag) > vmem_limit_bytes:
         raise ValueError(
-            f"band {band} needs ~{_hbm_cost(band, H, W, dt.itemsize) >> 20}"
+            f"band {band} needs "
+            f"~{_hbm_cost(band, H, W, dt.itemsize, diag) >> 20}"
             f" MB VMEM (> limit {vmem_limit_bytes >> 20} MB): the band "
             "buffers + compute temps + fixed column/strip scratch must "
             "fit (see _hbm_cost)"
@@ -987,7 +1015,6 @@ def run_stencil_dma_hbm(
     # 9-point: row stages carry [cornerW | row | cornerE] (W+2 cells),
     # and the corner-extended ghost columns span rows [-1, H]
     Wp2 = -(-(W + 2) // 128) * 128 if diag else Wp
-    Hp2 = -(-(H + 2) // 8) * 8
     hy, hx = lay.halo_y, lay.halo_x
     core = tile[hy : hy + H, hx : hx + W]
     pad_h = Hp - H
@@ -1028,9 +1055,11 @@ def run_stencil_dma_hbm(
             pltpu.VMEM((2, band, W), dt),      # write bands
             pltpu.VMEM((Hp, 1), dt),           # ghost col L, sublane-major
             pltpu.VMEM((Hp, 1), dt),           # ghost col R
-            # corner-extended ghost cols (rows [-1, H]) — 9-point only
-            pltpu.VMEM((Hp2, 1) if diag else (1, 1), dt),
-            pltpu.VMEM((Hp2, 1) if diag else (1, 1), dt),
+            # pre-shifted corner-extended ghost cols — 9-point only
+            pltpu.VMEM((Hp, 1) if diag else (1, 1), dt),
+            pltpu.VMEM((Hp, 1) if diag else (1, 1), dt),
+            pltpu.VMEM((Hp, 1) if diag else (1, 1), dt),
+            pltpu.VMEM((Hp, 1) if diag else (1, 1), dt),
             pltpu.VMEM((1, Wp2), dt),          # recv: top ghost row
             pltpu.VMEM((1, Wp2), dt),          # recv: bottom ghost row
             pltpu.VMEM((1, Hp), dt),           # recv: left ghost col
