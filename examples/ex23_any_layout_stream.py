@@ -1,0 +1,86 @@
+"""Any-cartesian-layout streamed stencils: ghost-strip modes (round 5).
+
+Round 4's deep-streamed kernels served only self-wrapping column axes
+(2D row-slab / 3D z-slab meshes); round 5 removed the restriction
+(≙ the reference's exchange serving any cartesian layout,
+/root/reference/stencil2d/mpi10.cpp:27, stencil2D.h:232-244).
+Distributed (or open) columns ride ghost slabs — the x/y neighbors'
+edge data with the DIAGONAL neighbors' corner blocks, the 8-channel
+(2D) / 26-neighbor (3D) transfer set at ghost depth k — kept OFF the
+core window in narrow strips that age by their own small substeps each
+fold (lane-concatenating ghosts onto the window cost 0.33 ms/step in
+Mosaic relayouts, chip-raced and rejected; the strip form runs 1.29e11
+cells/s at 8192^2 on v5e, 4.6x the best previously-admissible kernel
+for 2D-decomposed meshes — BASELINE row 4).
+
+Self-checks: 2D ghost-column mode (2x2 mesh, 9-point, periodic + fully
+open) and 3D ghost-strip mode ((2,2,2) mesh, 7-point) against the
+plain exchange paths.
+
+argv tier:  ex23_any_layout_stream.py [--steps=S] [--impl=stream:K]
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main(argv=None) -> None:
+    ensure_devices()
+    import numpy as np
+
+    from tpuscratch.halo.driver import distributed_stencil
+    from tpuscratch.halo.halo3d import distributed_stencil3d
+    from tpuscratch.runtime.config import Config
+    from tpuscratch.runtime.mesh import make_mesh, make_mesh_2d
+
+    cfg = Config.load(argv)
+    steps = cfg.steps if "steps" in cfg.explicit else 5
+    impl = cfg.impl if "impl" in cfg.explicit else "stream:2"
+    banner(
+        f"any-layout streamed stencils: 2D ghost columns on 2x2, 3D "
+        f"ghost strips on (2,2,2), {steps} steps, impl {impl}"
+    )
+
+    rng = np.random.default_rng(23)
+    ok = True
+
+    # 2D: distributed columns, 9-point (the corner blocks are read)
+    world2 = rng.standard_normal((64, 64)).astype(np.float32)
+    mesh2 = make_mesh_2d((2, 2))
+    c9 = (0.15, 0.15, 0.1, 0.1, 0.05, 0.05, 0.08, 0.07, 0.25)
+    a = distributed_stencil(world2, steps, mesh=mesh2, impl=impl,
+                            coeffs=c9)
+    b = distributed_stencil(world2, steps, mesh=mesh2, impl="xla",
+                            coeffs=c9)
+    err = np.abs(a - b).max()
+    ok &= err < 1e-4
+    print(f"2D 9-point, 2x2 periodic:   ghost-columns vs xla max err "
+          f"{err:.2e}")
+
+    a = distributed_stencil(world2, steps, mesh=mesh2, impl=impl,
+                            coeffs=c9, periodic=False)
+    b = distributed_stencil(world2, steps, mesh=mesh2, impl="xla",
+                            coeffs=c9, periodic=False)
+    err = np.abs(a - b).max()
+    ok &= err < 1e-4
+    print(f"2D 9-point, 2x2 fully-open: ghost-columns vs xla max err "
+          f"{err:.2e} (ppermute zero-fill + per-substep flag zeroing)")
+
+    # 3D: y AND x distributed — the full 26-neighbor strip set
+    world3 = rng.standard_normal((16, 16, 16)).astype(np.float32)
+    mesh3 = make_mesh((2, 2, 2), ("z", "row", "col"))
+    a = distributed_stencil3d(world3, steps, mesh3, impl=impl)
+    b = distributed_stencil3d(world3, steps, mesh3, impl="compact")
+    err = np.abs(a - b).max()
+    ok &= err < 1e-4
+    print(f"3D 7-point, (2,2,2):        ghost-strips vs compact max "
+          f"err {err:.2e} (gy + gx + xy-corner strips aged in-kernel)")
+
+    print("PASSED" if ok else "FAILED")
+
+
+if __name__ == "__main__":
+    main()
