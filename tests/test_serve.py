@@ -1,0 +1,394 @@
+"""tpuscratch.serve: paged KV cache, cached decode, continuous batching.
+
+The correctness anchors:
+- allocator invariants: unique in-range ids, all-or-nothing grants,
+  double-free rejection, free list restored after drain;
+- decode-vs-full equivalence: prefill + cached single-token decode
+  reproduce ``model_apply``'s output at EVERY position, on the 1x1 mesh
+  and on a dp x sp mesh (pages sharded over dp, heads over sp), with
+  ragged per-slot lengths exercising the true-length masking;
+- engine: staggered arrival/completion with more requests than slots,
+  free-page-watermark admission, no page leaks after drain, and ZERO
+  decode recompiles after warmup (the CompileCounter hook);
+- sampling determinism under fixed per-request keys.
+
+Equivalence holds in the no-token-dropped MoE regime (capacity_factor
+== n_experts, as in test_models), since capacity-bound routing is the
+one component whose per-token output depends on batch composition.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.models.transformer import (
+    TransformerConfig,
+    init_params,
+    model_apply,
+    param_spec,
+)
+from tpuscratch.ops.attention import decode_attention
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import (
+    CacheGeometry,
+    PageAllocator,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    init_kv_cache,
+    request_key,
+    sample_batch,
+    sample_logits,
+)
+from tpuscratch.serve.decode import CompileCounter, build_decode_step, build_prefill
+
+D = 32
+
+
+def cfg_for(**kw):
+    # capacity_factor == n_experts: nothing dropped, so cached-decode
+    # outputs are batch-composition-independent (same rule as test_models)
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=D, n_heads=4, n_experts=4, d_ff=48, n_layers=2, **kw
+    )
+
+
+class TestPageAllocator:
+    def test_ids_unique_and_in_range(self):
+        a = PageAllocator(6)
+        got = a.alloc(6)
+        assert sorted(got) == list(range(6))
+        assert a.n_free == 0 and a.n_live == 6
+
+    def test_all_or_nothing(self):
+        a = PageAllocator(4)
+        assert a.alloc(3) is not None
+        assert a.alloc(2) is None          # only 1 free: grant nothing
+        assert a.n_free == 1               # the failed request took nothing
+        assert a.alloc(1) is not None
+
+    def test_double_free_and_foreign_free_raise(self):
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.free(pages)
+        with pytest.raises(ValueError):
+            a.free([pages[0]])             # double free
+        b = a.alloc(1)
+        with pytest.raises(ValueError):
+            a.free([(b[0] + 1) % 4])       # not a live id
+
+    def test_drain_restores_free_list(self):
+        a = PageAllocator(8)
+        held = [a.alloc(2) for _ in range(3)]
+        a.free(held[1])
+        held[1] = a.alloc(2)
+        for h in held:
+            a.free(h)
+        assert a.n_free == 8 and a.n_live == 0
+
+
+class TestDecodeAttention:
+    def test_matches_dense_reference_with_ragged_lengths(self):
+        rng = np.random.default_rng(0)
+        n_pages, page, H, Dh = 6, 4, 2, 8
+        B, max_pages = 3, 4
+        k_pages = rng.standard_normal((n_pages, page, H, Dh)).astype(np.float32)
+        v_pages = rng.standard_normal((n_pages, page, H, Dh)).astype(np.float32)
+        # scrambled page order per sequence; sentinel tail entries
+        table = np.array([[2, 0, 5, n_pages],
+                          [1, 4, n_pages, n_pages],
+                          [3, n_pages, n_pages, n_pages]], np.int32)
+        lens = np.array([9, 6, 2], np.int32)
+        q = rng.standard_normal((B, H, Dh)).astype(np.float32)
+        out = np.asarray(decode_attention(
+            jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(table), jnp.asarray(lens),
+        ))
+        for b in range(B):
+            n_pg = -(-int(lens[b]) // page)
+            ks = k_pages[table[b, :n_pg]].reshape(-1, H, Dh)[: lens[b]]
+            vs = v_pages[table[b, :n_pg]].reshape(-1, H, Dh)[: lens[b]]
+            s = np.einsum("hd,thd->ht", q[b], ks) / np.sqrt(Dh)
+            p = np.exp(s - s.max(-1, keepdims=True))
+            ref = np.einsum("ht,thd->hd", p / p.sum(-1, keepdims=True), vs)
+            np.testing.assert_allclose(out[b], ref, atol=1e-5)
+
+    def test_empty_slot_returns_zeros(self):
+        z = decode_attention(
+            jnp.ones((1, 2, 8)), jnp.ones((2, 4, 2, 8)), jnp.ones((2, 4, 2, 8)),
+            jnp.full((1, 2), 2, jnp.int32), jnp.zeros((1,), jnp.int32),
+        )
+        assert float(jnp.abs(z).max()) == 0.0
+
+
+class TestDecodeEquivalence:
+    @pytest.mark.parametrize("dims", [(1, 1), (2, 2)])
+    def test_prefill_and_decode_match_model_apply(self, dims):
+        cfg = cfg_for()
+        n = dims[0] * dims[1]
+        mesh = make_mesh(dims, ("dp", "sp"), jax.devices()[:n])
+        m1 = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        full = run_spmd(
+            m1, lambda p, x: model_apply(p, x, cfg)[0],
+            (param_spec(cfg), P("dp", "sp")), P("dp", "sp"),
+        )
+        params = init_params(1, cfg)
+        geom = CacheGeometry(cfg.n_layers, n_pages=16, page_size=4,
+                             n_heads=cfg.n_heads, d_head=cfg.d_head)
+        dp_size = dims[0]
+        kv = init_kv_cache(geom, dp_size)
+        counter = CompileCounter()
+        decode = build_decode_step(mesh, cfg, geom, counter=counter)
+        prefill = build_prefill(mesh, cfg, geom)
+
+        rng = np.random.default_rng(0)
+        B, T = 2, 3
+        lens = [3, 5]                     # ragged prompts
+        max_pages = 4
+        seq = rng.standard_normal((B, max(lens) + T, D)).astype(np.float32)
+        pages = {0: [0, 1], 1: [0, 1] if dp_size == 2 else [2, 3]}
+        slots_per_group = B // dp_size
+
+        for b in range(B):
+            s0 = lens[b]
+            x = np.zeros((8, D), np.float32)
+            x[:s0] = seq[b, :s0]
+            rows = np.full((dp_size, max_pages), geom.n_pages, np.int32)
+            rows[b // slots_per_group, : len(pages[b])] = pages[b]
+            out, kv = prefill(params, kv, jnp.asarray(x), jnp.asarray(rows),
+                              jnp.int32(s0))
+            ref = np.asarray(full(params, jnp.asarray(seq[b:b + 1, :s0])))[0]
+            # every prompt position, not just the last
+            np.testing.assert_allclose(np.asarray(out)[:s0], ref, atol=2e-4)
+
+        for t in range(T):
+            positions = [lens[b] + t for b in range(B)]
+            x = np.stack([seq[b, positions[b]] for b in range(B)])
+            tables = np.full((B, max_pages), geom.n_pages, np.int32)
+            wp = np.zeros((B,), np.int32)
+            wo = np.zeros((B,), np.int32)
+            sl = np.zeros((B,), np.int32)
+            for b in range(B):
+                tables[b, : len(pages[b])] = pages[b]
+                wp[b] = pages[b][positions[b] // geom.page_size]
+                wo[b] = positions[b] % geom.page_size
+                sl[b] = positions[b] + 1
+            out, kv = decode(params, kv, jnp.asarray(x), jnp.asarray(tables),
+                             jnp.asarray(wp), jnp.asarray(wo), jnp.asarray(sl))
+            out = np.asarray(out)
+            for b in range(B):
+                pos = positions[b]
+                ref = np.asarray(
+                    full(params, jnp.asarray(seq[b:b + 1, : pos + 1]))
+                )[0, pos]
+                np.testing.assert_allclose(out[b], ref, atol=2e-4)
+        # one compiled decode program covered every step
+        assert counter.count == 1
+
+
+class TestIdleSlotIsolation:
+    def test_idle_slots_never_perturb_real_tokens(self):
+        # capacity_factor=2.0 < n_experts: MoE capacity BINDS.  Idle
+        # slots' zero vectors must not consume expert capacity ahead of
+        # real tokens — the same token in slot 0 (no idles ahead) and
+        # slot 7 (seven idles ahead) must produce identical outputs.
+        cfg = cfg_for(capacity_factor=2.0)
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        geom = CacheGeometry(cfg.n_layers, n_pages=8, page_size=4,
+                             n_heads=cfg.n_heads, d_head=cfg.d_head)
+        decode = build_decode_step(mesh, cfg, geom)
+        params = init_params(0, cfg)
+        vec = np.random.default_rng(1).standard_normal((D,)).astype(np.float32)
+        B, MP = 8, 2
+
+        def run(slot):
+            kv = init_kv_cache(geom, 1)
+            x = np.zeros((B, D), np.float32)
+            x[slot] = vec
+            tables = np.full((B, MP), geom.n_pages, np.int32)
+            tables[slot, 0] = 0
+            wp = np.full((B,), geom.n_pages, np.int32)
+            wp[slot] = 0
+            wo = np.zeros((B,), np.int32)
+            lens = np.zeros((B,), np.int32)
+            lens[slot] = 1
+            out, _ = decode(params, kv, jnp.asarray(x), jnp.asarray(tables),
+                            jnp.asarray(wp), jnp.asarray(wo),
+                            jnp.asarray(lens))
+            return np.asarray(out)[slot]
+
+        np.testing.assert_allclose(run(0), run(7), atol=1e-6)
+
+
+class TestEngine:
+    def make(self, scfg=None, dims=(2, 2), **cfg_kw):
+        cfg = cfg_for(**cfg_kw)
+        n = dims[0] * dims[1]
+        mesh = make_mesh(dims, ("dp", "sp"), jax.devices()[:n])
+        scfg = scfg or ServeConfig(n_slots=4, n_pages=16, page_size=4,
+                                   max_seq=24, vocab=16)
+        return ServeEngine(mesh, cfg, scfg)
+
+    def test_staggered_drain_no_leaks_no_recompiles(self):
+        eng = self.make()
+        free0 = eng.free_pages()
+        reqs = [
+            Request(rid=i, prompt=tuple(range(1, 2 + i % 5)),
+                    max_new=1 + (i * 3) % 6)
+            for i in range(7)          # > n_slots: queueing is exercised
+        ]
+        rep = eng.run(reqs)
+        assert rep.completed == 7
+        by_rid = dict(rep.outputs)
+        for r in reqs:
+            assert len(by_rid[r.rid]) == r.max_new
+            assert all(0 <= t < 16 for t in by_rid[r.rid])
+        assert eng.free_pages() == free0               # no page leaks
+        assert rep.decode_compiles == 1                # zero steady-state recompiles
+        assert rep.prefill_compiles <= 2               # one per shape bucket
+        assert rep.tokens_generated == sum(r.max_new for r in reqs)
+
+    def test_midstream_submission_backfills_slots(self):
+        eng = self.make()
+        eng.submit(Request(rid=0, prompt=(1, 2), max_new=8))
+        eng.submit(Request(rid=1, prompt=(3,), max_new=2))
+        for _ in range(3):
+            eng.step()
+        compiles_warm = eng.decode_compiles
+        # rid=1 finished and its slot is free again; feed new work mid-run
+        eng.submit(Request(rid=2, prompt=(4, 5, 6), max_new=3))
+        rep = eng.run([])
+        assert {rid for rid, _ in rep.outputs} >= {2}
+        assert eng.n_active == 0 and eng.n_queued == 0
+        assert eng.decode_compiles == compiles_warm    # warm == forever
+        assert eng.free_pages() == [16, 16]
+
+    def test_watermark_serializes_when_pool_is_tight(self):
+        # one request's footprint == one group's WHOLE pool: each group
+        # has 2 slots but pages for only 1 request, so admission must
+        # hold half the slots idle (free slot, no pages) yet still drain
+        scfg = ServeConfig(n_slots=4, n_pages=4, page_size=4, max_seq=16,
+                           vocab=16)
+        eng = self.make(scfg=scfg)
+        reqs = [Request(rid=i, prompt=(1, 2, 3), max_new=13) for i in range(5)]
+        for r in reqs:
+            eng.submit(r)
+        peak = 0
+        outputs = {}
+        for _ in range(200):
+            if not (eng.n_queued or eng.n_active):
+                break
+            for rid, toks in eng.step():
+                outputs[rid] = toks
+            peak = max(peak, eng.n_active)
+        assert sorted(outputs) == [0, 1, 2, 3, 4]
+        assert peak == 2          # 1 per dp group, never the 4 slots
+        assert eng.free_pages() == [4, 4]
+
+    def test_failed_prefill_returns_pages_and_requeues(self):
+        eng = self.make()
+        free0 = eng.free_pages()
+
+        class Boom(RuntimeError):
+            pass
+
+        def exploding_prefill(*a, **k):
+            raise Boom("transient device error")
+
+        # pre-seed the bucket cache so _admit uses the exploding program
+        eng._prefills = {8: exploding_prefill}
+        eng.submit(Request(rid=0, prompt=(1, 2), max_new=2))
+        with pytest.raises(Boom):
+            eng.step()
+        assert eng.free_pages() == free0     # the grant came back
+        assert eng.n_queued == 1             # the request is retryable
+        assert eng.n_active == 0
+
+    def test_failed_decode_recovers_and_replays_identically(self):
+        # a raising compiled decode may have consumed the DONATED cache:
+        # recovery must reset the pool, requeue in-flight requests, and
+        # the replay must reproduce the uninterrupted run bit-for-bit
+        scfg = ServeConfig(n_slots=4, n_pages=16, page_size=4, max_seq=24,
+                           vocab=16)
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4)
+                for i in range(3)]
+        clean = self.make(scfg=scfg).run(reqs)
+
+        eng = self.make(scfg=scfg)
+        for r in reqs:
+            eng.submit(r)
+        eng.step()                           # slots active mid-stream
+
+        class Boom(RuntimeError):
+            pass
+
+        real_decode = eng._decode
+
+        def exploding_decode(*a, **k):
+            raise Boom("mid-flight device error")
+
+        eng._decode = exploding_decode
+        with pytest.raises(Boom):
+            eng.step()
+        assert eng.n_active == 0 and eng.n_queued == 3
+        assert eng.free_pages() == [16, 16]
+        eng._decode = real_decode
+        rep = eng.run([])
+        assert rep.outputs == clean.outputs  # deterministic replay
+
+    def test_deterministic_replay(self):
+        scfg = ServeConfig(n_slots=4, n_pages=16, page_size=4, max_seq=24,
+                           vocab=16, temperature=0.8, top_k=5, seed=7)
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4) for i in range(5)]
+        rep1 = self.make(scfg=scfg).run(reqs)
+        rep2 = self.make(scfg=scfg).run(reqs)
+        assert rep1.outputs == rep2.outputs
+
+    def test_request_validation(self):
+        eng = self.make()
+        with pytest.raises(ValueError):
+            eng.submit(Request(rid=0, prompt=(), max_new=2))
+        with pytest.raises(ValueError):
+            eng.submit(Request(rid=0, prompt=(1,), max_new=0))
+        with pytest.raises(ValueError):
+            eng.submit(Request(rid=0, prompt=(99,), max_new=2))  # vocab
+        with pytest.raises(ValueError):
+            eng.submit(Request(rid=0, prompt=(1,) * 23, max_new=2))  # max_seq
+        with pytest.raises(ValueError):
+            eng.submit(Request(rid=-1, prompt=(1,), max_new=2))  # rid sign
+        eng.submit(Request(rid=5, prompt=(1,), max_new=2))
+        with pytest.raises(ValueError):
+            # rids key PRNG streams and the outputs map: reuse is rejected
+            eng.submit(Request(rid=5, prompt=(2,), max_new=2))
+
+
+class TestSampling:
+    def test_greedy_is_argmax(self):
+        logits = jnp.asarray([[0.1, 3.0, -1.0], [2.0, 0.0, 1.0]])
+        keys = jnp.stack([request_key(0, 0, 0), request_key(0, 1, 0)])
+        toks = sample_batch(keys, logits, 0.0, 0)
+        assert toks.tolist() == [1, 0]
+
+    def test_fixed_keys_are_deterministic(self):
+        logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 8)),
+                             jnp.float32)
+        keys = jnp.stack([request_key(3, i, 2) for i in range(4)])
+        a = sample_batch(keys, logits, 0.9, 0)
+        b = sample_batch(keys, logits, 0.9, 0)
+        assert a.tolist() == b.tolist()
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.asarray([5.0, 4.0, -10.0, -10.0, -10.0])
+        draws = {
+            int(sample_logits(request_key(0, 0, i), logits, 1.0, 2))
+            for i in range(32)
+        }
+        assert draws <= {0, 1} and len(draws) == 2
+
+    def test_negative_temperature_rejected(self):
+        with pytest.raises(ValueError):
+            sample_logits(request_key(0, 0, 0), jnp.zeros((4,)), -1.0)

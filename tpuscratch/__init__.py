@@ -33,6 +33,7 @@ reference validates multi-node behavior with many ranks on one box.
 
 __version__ = "0.1.0"
 
+from tpuscratch.runtime import compat as _compat  # noqa: F401  (version gates first)
 from tpuscratch.runtime.topology import CartTopology, Direction  # noqa: F401
 from tpuscratch.runtime.mesh import make_mesh, make_mesh_1d, make_mesh_2d  # noqa: F401
 from tpuscratch.runtime.config import Config  # noqa: F401

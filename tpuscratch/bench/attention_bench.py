@@ -24,7 +24,8 @@ from tpuscratch.ops.attention import flash_attention
 
 
 def attention_program(
-    causal: bool, rounds: int, block_q: int = 1024, block_k: int = 1024,
+    causal: bool, rounds: int, block_q: int = 1024,
+    block_k: int | None = None,
 ):
     """jit'd fn(q, k, v) running ``rounds`` flash calls in one scan.
 
@@ -65,7 +66,9 @@ def bench_attention(
     fence: str = "readback",
     dtype=jnp.float32,
     block_q: int = 1024,
-    block_k: int = 1024,
+    # None = the kernel's own tuned defaults (an explicit value — even
+    # 1024 — is a resource bound honored in forward AND backward)
+    block_k: int | None = None,
     max_tflops: float = 250.0,
 ) -> BenchResult:
     """``max_tflops`` is the same implausibility defense as dot_bench's

@@ -1,0 +1,187 @@
+"""Serving-side decode throughput and per-token latency (BASELINE row 12).
+
+``python -m tpuscratch.bench.decode_bench [--json PATH]``
+
+Every training-side row measures steps/s of a compiled program; serving
+is judged on different axes — sustained tokens/s at a batch size, and
+the per-token latency DISTRIBUTION (a p99 an SLO can hold), which the
+batch size trades against.  This bench drives the real engine (host
+scheduling included: that loop is part of serving latency, exactly as
+the reference's timing brackets include its rank-0 driver), steady
+state: every slot busy, one engine tick == one token per slot.
+
+Methodology: submit ``n_slots`` requests with max_new large enough to
+hold all slots busy through the measured window, warm up past prefill +
+the single decode compile, then time each engine tick individually.
+Per-token latency IS the tick time (each slot advances one token per
+tick); tokens/s = n_slots / p50.  Sampled tokens are pulled to host
+every tick (the engine's own np.asarray), so each timing is fenced by
+construction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+from tpuscratch.bench.timing import BenchResult, percentile
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeBenchResult:
+    """BenchResult plus the latency percentiles a serving SLO reads."""
+
+    result: BenchResult
+    n_slots: int
+
+    @property
+    def tokens_per_s(self) -> float:
+        return self.result.items_per_s
+
+    @property
+    def p50_s(self) -> float:
+        return self.result.p50
+
+    @property
+    def p99_s(self) -> float:
+        return percentile(self.result.times_s, 99)
+
+    def summary(self) -> str:
+        return (
+            f"{self.result.name}: {self.tokens_per_s:.3e} tok/s, "
+            f"per-token p50 {self.p50_s * 1e3:.3f} ms / "
+            f"p99 {self.p99_s * 1e3:.3f} ms"
+        )
+
+
+def bench_decode(
+    mesh,
+    cfg,
+    scfg,
+    prompt_len: int = 8,
+    measure_steps: int = 32,
+    warmup_steps: int = 4,
+) -> DecodeBenchResult:
+    """Steady-state decode: all ``scfg.n_slots`` slots busy, per-tick
+    timings over ``measure_steps`` ticks after ``warmup_steps`` warm
+    ticks (prefill + the one decode compile land in warmup)."""
+    from tpuscratch.serve import Request, ServeEngine
+
+    scfg = dataclasses.replace(
+        scfg, max_seq=max(scfg.max_seq,
+                          prompt_len + warmup_steps + measure_steps + 2),
+    )
+    engine = ServeEngine(mesh, cfg, scfg)
+    # +1: prefill emits a token; the extra +1 keeps every slot ALIVE
+    # through the last measured tick — finishing exactly on it would put
+    # the all-slot eviction/free teardown inside the timed window, and
+    # with 64 samples p99 interpolates at the max
+    budget = warmup_steps + measure_steps + 2
+    for i in range(scfg.n_slots):
+        engine.submit(Request(
+            rid=i, prompt=tuple(t % scfg.vocab for t in range(1, prompt_len + 1)),
+            max_new=budget,
+        ))
+    for _ in range(warmup_steps):
+        engine.step()
+    if engine.n_active != scfg.n_slots:
+        raise RuntimeError(
+            f"warmup left {engine.n_active}/{scfg.n_slots} slots busy — "
+            "raise the page pool or lower the batch"
+        )
+    compiles_before = engine.decode_compiles
+    times = []
+    for _ in range(measure_steps):
+        t0 = time.perf_counter()
+        engine.step()  # pulls sampled tokens to host: fenced
+        times.append(time.perf_counter() - t0)
+    if engine.decode_compiles != compiles_before:
+        raise RuntimeError(
+            "decode recompiled inside the measured window "
+            f"({compiles_before} -> {engine.decode_compiles})"
+        )
+    res = BenchResult(
+        name=f"decode b={scfg.n_slots} prompt={prompt_len} "
+             f"page={scfg.page_size}",
+        times_s=tuple(times),
+        items=scfg.n_slots,  # tokens per tick
+    )
+    return DecodeBenchResult(res, scfg.n_slots)
+
+
+def sweep(mesh, cfg, scfg, batch_sizes, **kw) -> list[DecodeBenchResult]:
+    """``bench_decode`` across batch (slot-count) sizes — the
+    throughput/latency trade curve."""
+    out = []
+    for b in batch_sizes:
+        sc = dataclasses.replace(scfg, n_slots=b)
+        r = bench_decode(mesh, cfg, sc, **kw)
+        print(f"# {r.summary()}", file=sys.stderr)
+        out.append(r)
+    return out
+
+
+def default_decode_setup(on_tpu: bool):
+    """The BASELINE row-12 workload: (model cfg, serve cfg, batch sizes,
+    bench kwargs).  ONE definition shared by this module's CLI and
+    ``bench.record`` config 12, so the standalone bench and the recorder
+    can never silently measure different shapes."""
+    from tpuscratch.models.transformer import TransformerConfig
+    from tpuscratch.serve import ServeConfig
+
+    cfg = (
+        TransformerConfig(d_model=1024, n_heads=8, n_experts=4, d_ff=4096,
+                          n_layers=4, capacity_factor=2.0)
+        if on_tpu
+        else TransformerConfig(d_model=32, n_heads=2, n_experts=2, d_ff=64,
+                               n_layers=1)
+    )
+    scfg = ServeConfig(n_pages=512 if on_tpu else 64,
+                       page_size=16 if on_tpu else 4,
+                       vocab=1024 if on_tpu else 32)
+    batches = (1, 8, 32) if on_tpu else (1, 4)
+    kwargs = dict(prompt_len=64 if on_tpu else 4,
+                  measure_steps=64 if on_tpu else 8)
+    return cfg, scfg, batches, kwargs
+
+
+def main(argv=None) -> int:
+    import jax
+
+    from tpuscratch.runtime.mesh import make_mesh
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--cpu-devices", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.cpu_devices:
+        from tpuscratch.runtime.hostenv import force_cpu_devices
+
+        force_cpu_devices(args.cpu_devices)
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
+    rows = []
+    for r in sweep(mesh, cfg, scfg, batches, **kwargs):
+        rows.append({
+            "batch": r.n_slots,
+            "tokens_per_s": r.tokens_per_s,
+            "p50_s_per_token": r.p50_s,
+            "p99_s_per_token": r.p99_s,
+        })
+    payload = {"platform": jax.default_backend(), "sweep": rows}
+    print(json.dumps(payload))
+    if args.json:
+        # the file gets the platform too — a CPU-proxy number must never
+        # masquerade as a chip number (record.py's own discipline)
+        with open(args.json, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,8 @@ the ones this repo establishes. Configs follow BASELINE.md:
    with the mesh; real chip when present)
 10. remote-DMA halo kernel, 1024^2 self-wrap     (real chip when present)
 11. composed-training tokens/s, f32 + bf16       (real chip when present)
+12. serve decode tokens/s + per-token p50/p99 over a batch-size sweep
+    (real chip when present)
 
 Each config prints one JSON line with the platform recorded, so CPU-proxy
 numbers can never masquerade as chip numbers.
@@ -120,7 +122,7 @@ def config1_stencil_single(out: list, iters: int = 3) -> None:
     from tpuscratch.runtime.mesh import make_mesh_2d
 
     on_tpu = jax.default_backend() == "tpu"
-    best, _, _ = two_phase_stencil(
+    best, _, final_ok = two_phase_stencil(
         ("xla", "deep:16", "deep-pallas:16", "resident:8"), 1,
         (1024, 1024), make_mesh_2d((1, 1)), iters,
         screen_steps=20000 if on_tpu else 50,
@@ -131,7 +133,7 @@ def config1_stencil_single(out: list, iters: int = 3) -> None:
         metric="stencil2d_1024x1024_cell_updates_per_s",
         value=best.items_per_s,
         p50_s=best.p50,
-        detail=best.name,
+        detail=best.name + ("" if final_ok else ":screen-only"),
     )
 
 
@@ -285,7 +287,7 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
         ("dma", "dma-hbm", "stream:16", "stream:32") if on_tpu else ()
     )
     steps4 = 320 if on_tpu else 10
-    best, _, _ = two_phase_stencil(
+    best, _, final_ok = two_phase_stencil(
         impls, 4, (8192, 8192), mesh, iters,
         screen_steps=steps4, final_steps=2048 if on_tpu else 10)
     _emit(
@@ -293,8 +295,13 @@ def config4_stencil_mesh(out: list, iters: int = 5) -> None:
         config=4,
         metric="stencil2d_8192x8192_4x4_cell_updates_per_s_per_chip",
         value=best.items_per_s / n,
+        # ':screen-only' = every long re-measure failed and this value is
+        # the screen-phase number, whose fixed-cost share understates the
+        # chip rate — BASELINE rows must show which discipline produced
+        # the number (ADVICE r5)
         p50_s=best.p50,
         detail=best.name
+        + ("" if final_ok else ":screen-only")
         + (f" [degenerate {dims[0]}x{dims[1]} mesh]" if n < 16 else ""),
         n_devices=n,
     )
@@ -681,6 +688,47 @@ def config11_train(out: list, iters: int = 3) -> None:
         print(f"# config 11 pp failed: {e}", file=sys.stderr)
 
 
+def config12_decode(out: list) -> None:
+    """Serving decode throughput/latency (tpuscratch.serve): steady-state
+    engine ticks — continuous batching, paged KV cache, one compiled
+    decode program — tokens/s and the per-token latency tail across a
+    batch-size sweep (the throughput/SLO trade curve serving lives on).
+
+    No ``iters`` knob: the latency percentiles come from per-tick
+    samples within one continuous steady-state window
+    (``default_decode_setup``'s ``measure_steps``), not from repeated
+    invocations — repetitions would restart the engine and re-pay
+    prefill, measuring admission rather than decode."""
+    import jax
+
+    from tpuscratch.bench.decode_bench import default_decode_setup, sweep
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
+    results = sweep(mesh, cfg, scfg, batches, **kwargs)
+    best = max(results, key=lambda r: r.tokens_per_s)
+    _emit(
+        out,
+        config=12,
+        metric="serve_decode_tokens_per_s",
+        value=best.tokens_per_s,
+        p50_s=best.p50_s,
+        p99_s=best.p99_s,
+        sweep=[
+            {
+                "batch": r.n_slots,
+                "tokens_per_s": r.tokens_per_s,
+                "p50_s_per_token": r.p50_s,
+                "p99_s_per_token": r.p99_s,
+            }
+            for r in results
+        ],
+        detail=best.summary(),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -693,12 +741,13 @@ CONFIGS = {
     9: config9_stencil3d,
     10: config10_dma_halo,
     11: config11_train,
+    12: config12_decode,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh first (dev path)")

@@ -24,12 +24,17 @@ def run_spmd(
     in_specs,
     out_specs,
     check_vma: bool = False,
+    donate_argnums=(),
 ) -> Callable[..., Any]:
     """jit(shard_map(fn)) over ``mesh`` — the compiled SPMD program.
 
     ``check_vma=False`` by default because several parity patterns
     (root extraction, masked gathers) intentionally produce values that are
-    not uniform across an axis.
+    not uniform across an axis.  ``donate_argnums`` passes through to jit
+    (state-carrying loops — the decode step's KV cache — reuse the input
+    buffer instead of copying it every step).  On jax releases predating
+    ``jax.shard_map``, ``runtime.compat`` (imported at package init)
+    installs it over the ``jax.experimental`` spelling.
     """
     return jax.jit(
         jax.shard_map(
@@ -38,7 +43,8 @@ def run_spmd(
             in_specs=in_specs,
             out_specs=out_specs,
             check_vma=check_vma,
-        )
+        ),
+        donate_argnums=donate_argnums,
     )
 
 

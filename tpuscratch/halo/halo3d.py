@@ -24,6 +24,7 @@ import dataclasses
 import functools
 from typing import Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -485,6 +486,19 @@ def run_stencil3d_stream(
             f"periodic={topo.periodic}; use impl='compact-asm' for "
             "distributed y/x axes"
         )
+    if jax.default_backend() == "tpu" and (cy < 8 or cx < 128):
+        # chip rule the kernel's module docstring states (and until now
+        # only the multigrid chooser gated on): plane extents below the
+        # (8, 128) vector-tile pass the CPU interpreter but are a Mosaic
+        # remote-compile DNF on silicon.  Mirror nine_point_streamed_2d's
+        # H % 8 guard — but here the compact per-step path serves any
+        # extent with identical semantics, so fall back instead of
+        # raising (ADVICE r5).  Compute stays 'xla' — the banded Pallas
+        # kernels block the same sub-tile planes, so they are not a safe
+        # harbor (the multigrid chooser makes the same call for its
+        # small coarse levels).
+        return run_stencil3d_compact(core, spec, steps, coeffs,
+                                     compute="xla")
 
     def gather(block, off):
         # the off-neighbor's block: local when the permutation is pure

@@ -76,7 +76,18 @@ def _fused_adam_flat(w, g, m, v, alpha, b1, b2, eps):
 def _to_flat(x):
     n = x.size
     rows = -(-n // _COLS)
-    rows8 = -(-rows // 8) * 8
+    # pad rows to the sublane quantum (8) by default, but to a full _BAND
+    # when the waste stays under 1/16th of the leaf: an awkward row count
+    # (the 50257x1024 embedding flattens to 50257 rows) would otherwise
+    # collapse the band chooser in _fused_adam_flat to band=8 — thousands
+    # of tiny grid steps on the kernel's own headline benchmark (ADVICE
+    # r5; +0.9% memory there).  The waste bound keeps mid-size leaves
+    # honest — e.g. 576 rows would pad to 1024 (+78%) under an
+    # unconditional quantum, while the halving chooser already gives
+    # them band=64.
+    band_pad = (-rows) % _BAND
+    quantum = _BAND if rows >= _BAND and band_pad * 16 <= rows else 8
+    rows8 = -(-rows // quantum) * quantum
     pad = rows8 * _COLS - n
     fx = x.reshape(-1)
     if pad:

@@ -47,7 +47,7 @@ from jax.experimental.pallas import tpu as pltpu
 import numpy as np
 
 from tpuscratch.ops.common import mosaic_params, use_interpret
-from tpuscratch.parallel.scores import NEG_INF
+from tpuscratch.parallel.scores import NEG_INF, masked_softmax
 
 #: Lane width of the m/l running-state planes. 8 is the narrowest layout
 #: Mosaic accepts for lane-complete stores; vs the 128-lane broadcast it
@@ -350,6 +350,7 @@ def _flash_fwd_compact(qh, kh, vh, qoff: int, koff: int, bq, bk,
     apply (caller falls back to the dense grid)."""
     H, S, D = qh.shape
     T = kh.shape[1]
+    bk = _fwd_block_k(T, bk)
     nq, nk = S // bq, T // bk
     pairs = _causal_pairs(nq, nk, bq, bk, qoff - koff)
     if pairs is None:
@@ -668,6 +669,10 @@ def _flash_bwd_compact(q, k, v, do, lse, delta, qoff: int, koff: int,
     dense-grid :func:`_flash_bwd_call`."""
     H, S, D = q.shape
     T = k.shape[1]
+    # the compact backward reuses the forward's block resolution (its
+    # grids are pair tables, not the scratch-bound dense sweep the
+    # _bwd_block_k retune exists for)
+    bk = _fwd_block_k(T, bk)
     nq, nk = S // bq, T // bk
     dq_off = qoff - koff
     pairs_q = _causal_pairs(nq, nk, bq, bk, dq_off)
@@ -852,6 +857,7 @@ def _flash_fwd_call(qh, kh, vh, qoff, koff, causal, bq, bk, return_state):
     Plain: out (H, S, D). State: (acc (H, S, D) f32, m (H, S), l (H, S))."""
     H, S, D = qh.shape
     T = kh.shape[1]
+    bk = _fwd_block_k(T, bk)
     nq, nk = S // bq, T // bk
     scale = 1.0 / float(D) ** 0.5
     kern = functools.partial(
@@ -914,7 +920,18 @@ def _flash_diff_fwd(qh, kh, vh, qoff, koff, causal, bq, bk):
     return o, (qh, kh, vh, qoff, koff, o, lse)
 
 
-def _bwd_block_k(dtype, T: int, bk: int) -> int:
+#: forward KV-block tuning target when the caller leaves ``block_k=None``
+_DEFAULT_BLOCK_K = 1024
+
+
+def _fwd_block_k(T: int, bk) -> int:
+    """Resolve the public ``block_k`` for the forward kernels: ``None``
+    (the caller said nothing) takes the tuned default; an explicit value
+    is a resource bound and is used as-is."""
+    return _pick_block(T, _DEFAULT_BLOCK_K, "T") if bk is None else bk
+
+
+def _bwd_block_k(dtype, T: int, bk) -> int:
     """Backward KV-block retune (round-5 chip race, BASELINE row 6):
     the dense backward kernels run fastest with bk=512 in f32 — at
     bk=1024 the dkv kernel's (bk, D) scratch pair sits at the
@@ -922,11 +939,11 @@ def _bwd_block_k(dtype, T: int, bk: int) -> int:
     bk=2048 is an outright compile DNF) — and bk=2048 in bf16 (half
     the bytes: 127.7 vs 109.2 TFLOP/s non-causal).  The backward
     kernels are block-independent of the forward (lse/delta are
-    per-row), so the retune differs from the forward's — but ONLY when
-    the caller used the default ``block_k`` (1024); a non-default value
-    is an explicit resource bound and is respected in the backward
-    too."""
-    if bk != 1024:
+    per-row), so the retune differs from the forward's — but ONLY on a
+    true default: ``bk`` arrives as ``None`` when the caller left
+    ``block_k`` unset, and anything else (including an explicit 1024)
+    is a resource bound respected in the backward too (ADVICE r5)."""
+    if bk is not None:
         return bk
     return _pick_block(T, 2048 if dtype == jnp.bfloat16 else 512, "T")
 
@@ -1041,7 +1058,7 @@ def flash_attention(
     q_offset=0,
     kv_offset=0,
     block_q: int = 1024,
-    block_k: int = 1024,
+    block_k: int | None = None,
     return_state: bool = False,
 ):
     """Exact attention with O(S·D) memory per head: q (S, H, D),
@@ -1068,13 +1085,20 @@ def flash_attention(
     running max / normalizer, each (H, S) fp32. The caller merges blocks
     with ``acc*exp(m-m')`` algebra and divides by the merged ``l`` once
     at the end — exact, with no per-hop normalize/un-normalize round
-    trip through the input dtype. The state mode is forward-only."""
+    trip through the input dtype. The state mode is forward-only.
+
+    ``block_k=None`` (the default) picks the tuned KV block per kernel —
+    1024 forward, the per-dtype :func:`_bwd_block_k` retune backward; an
+    explicit value (even 1024) is an explicit resource bound honored by
+    BOTH directions."""
     if q.ndim != 3 or k.shape != v.shape or q.shape[1:] != k.shape[1:]:
         raise ValueError(f"bad attention shapes {q.shape}/{k.shape}/{v.shape}")
     S, H, D = q.shape
     T = k.shape[0]
     bq = _pick_block(S, block_q, "S")
-    bk = _pick_block(T, block_k, "T")
+    # None rides through dispatch so the backward can tell a true default
+    # from an explicit 1024 (ADVICE r5); explicit values validate here
+    bk = None if block_k is None else _pick_block(T, block_k, "T")
 
     static_offsets = isinstance(q_offset, (int, np.integer)) and isinstance(
         kv_offset, (int, np.integer)
@@ -1090,3 +1114,62 @@ def flash_attention(
     return _flash_dense(
         q, k, v, causal, q_offset, kv_offset, bq, bk, return_state
     )
+
+
+# ---- cached decode attention ---------------------------------------------
+
+
+def decode_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    seq_lens: jax.Array,
+) -> jax.Array:
+    """Single-token attention over a block-paged KV cache (serve path).
+
+    q (B, H, D) — each sequence's current-token query; k_pages/v_pages
+    (P, page_size, H, D) — one layer's page pool (``tpuscratch.serve.
+    kvcache`` layout); page_table (B, max_pages) int32 — each sequence's
+    page ids in sequence order, with out-of-range ids (the allocator's
+    sentinel) marking unallocated tail entries; seq_lens (B,) int32 —
+    each sequence's true cached length INCLUDING the current token
+    (its K/V must already be written). Returns (B, H, D).
+
+    Each sequence gathers its pages into a contiguous (max_pages *
+    page_size, H, D) view and masks key positions at or beyond its true
+    length — the ragged-batch analogue of the flash kernel's causal
+    offset masking, sharing its scale (1/sqrt(D)) and mask sentinel so
+    the cached path cannot drift from the training-side score math.
+    Decode moves one query row against the whole cache, so the step is
+    gather-bandwidth-bound, not MXU-bound: the dense XLA formulation IS
+    the roofline shape, and fp32 softmax accumulation matches
+    ``parallel.scores.masked_scores``. Sequences with ``seq_len == 0``
+    (empty decode slots) return zeros rather than NaN.
+    """
+    if q.ndim != 3 or k_pages.ndim != 4 or k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"bad decode shapes q={q.shape} k={k_pages.shape} "
+            f"v={v_pages.shape}"
+        )
+    B, H, D = q.shape
+    n_pages, page_size, Hp, Dp = k_pages.shape
+    if (Hp, Dp) != (H, D) or page_table.shape[0] != B or seq_lens.shape != (B,):
+        raise ValueError(
+            f"mismatched decode operands: q={q.shape} pages={k_pages.shape} "
+            f"table={page_table.shape} lens={seq_lens.shape}"
+        )
+    # clip BEFORE gathering (unallocated sentinel entries land on page 0;
+    # the length mask keeps their scores out of the softmax)
+    table = jnp.clip(page_table, 0, n_pages - 1)
+    T = page_table.shape[1] * page_size
+    k = k_pages[table].reshape(B, T, H, D)
+    v = v_pages[table].reshape(B, T, H, D)
+    scale = 1.0 / float(D) ** 0.5
+    s = jnp.einsum(
+        "bhd,bthd->bht", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    valid = jnp.arange(T)[None, None, :] < seq_lens[:, None, None]  # (B,1,T)
+    p = masked_softmax(jnp.where(valid, s, NEG_INF), valid)
+    out = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

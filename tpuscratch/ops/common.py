@@ -32,6 +32,18 @@ def to_lanes(x: jax.Array, sublanes_multiple: int = 8) -> jax.Array:
     return x.reshape(-1, LANES)
 
 
+def interpret_params():
+    """TPU-simulating interpret mode for the DMA/semaphore kernels:
+    ``pltpu.InterpretParams`` where this jax has it, plain
+    ``interpret=True`` on older releases (which may reject the
+    DMA/semaphore primitives at run time — same failure surface as
+    before, minus the import-time crash)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    ip = getattr(pltpu, "InterpretParams", None)
+    return ip() if ip is not None else True
+
+
 def mosaic_params(**kw) -> dict:
     """``{"compiler_params": CompilerParams(**kw)}`` on TPU, ``{}`` in
     interpret mode (where Mosaic compiler knobs don't exist). Spread into

@@ -59,7 +59,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpuscratch.halo.exchange import HaloSpec, halo_exchange
 from tpuscratch.halo.stencil import rebuild
-from tpuscratch.ops.common import use_interpret
+from tpuscratch.ops.common import interpret_params, use_interpret
+
+
 
 Coeffs = tuple[float, float, float, float, float]
 JACOBI: Coeffs = (0.25, 0.25, 0.25, 0.25, 0.0)
@@ -564,7 +566,7 @@ def _run_stencil_dma_deep(tile, spec, steps, coeffs9, depth, vmem_limit_bytes):
     kernel = _make_kernel_deep(
         spec.topology.dims, tuple(spec.axes), steps, coeffs9, k, H, W
     )
-    interpret = pltpu.InterpretParams() if use_interpret() else False
+    interpret = interpret_params() if use_interpret() else False
     R, C = spec.topology.dims
     collective_kw = (
         {"collective_id": _COLLECTIVE_ID_DEEP} if (R > 1 or C > 1) else {}
@@ -1028,7 +1030,7 @@ def run_stencil_dma_hbm(
         spec.topology.dims, tuple(spec.axes), band, nb, H, W, Hp, Wp,
         tuple(coeffs),
     )
-    interpret = pltpu.InterpretParams() if use_interpret() else False
+    interpret = interpret_params() if use_interpret() else False
     R, C = spec.topology.dims
     collective_kw = (
         {"collective_id": _COLLECTIVE_ID_HBM} if (R > 1 or C > 1) else {}
@@ -1174,7 +1176,7 @@ def run_stencil_dma(
         )
 
     kernel = _make_kernel(spec.topology.dims, tuple(spec.axes), steps, tuple(coeffs))
-    interpret = pltpu.InterpretParams() if use_interpret() else False
+    interpret = interpret_params() if use_interpret() else False
     R, C = spec.topology.dims
     # collective_id names the cross-device barrier; a 1x1 mesh has no
     # remote channels, hence no barrier, and Mosaic rejects the id.

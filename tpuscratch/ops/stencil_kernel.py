@@ -27,7 +27,17 @@ from jax.experimental import pallas as pl
 try:  # Element block dims: element-indexed (overlapping) blocks
     from jax.experimental.pallas import Element  # type: ignore[attr-defined]
 except ImportError:  # not re-exported in this jax version
-    from jax._src.pallas.core import Element
+    try:
+        from jax._src.pallas.core import Element
+    except ImportError:
+        # jax predates Element entirely: keep the module (and the whole
+        # ``tpuscratch.ops`` package) importable — only the overlapping-
+        # block kernels below need it, and they raise at call time
+        def Element(*_a, **_k):  # noqa: N802 - stands in for the class
+            raise NotImplementedError(
+                "this jax version has no pallas Element block dims; the "
+                "overlapping-block stencil kernels need a newer jax"
+            )
 
 from tpuscratch.halo.layout import TileLayout
 from tpuscratch.halo.stencil import rebuild

@@ -49,8 +49,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpuscratch.ops.common import mosaic_params, use_interpret
+from tpuscratch.ops.common import interpret_params, mosaic_params, use_interpret
 from tpuscratch.ops.stencil_kernel import _asm3d_compute, _largest_divisor_band
+
 
 _VMEM_CEILING = 100 << 20
 #: the 27-point substep's temp pressure adds to the buffer footprint.
@@ -749,7 +750,7 @@ def seven_point_streamed_pallas(
         ghost_y=ghost_y, ghost_x=ghost_x, has_rhs=has_rhs,
         rhs_coeff=float(rhs_coeff),
     )
-    interpret = pltpu.InterpretParams() if use_interpret() else False
+    interpret = interpret_params() if use_interpret() else False
     return pl.pallas_call(
         kern,
         in_specs=[
@@ -1147,7 +1148,7 @@ def nine_point_streamed_2d(
         _stream2d_kernel, band=band, depth=k, nb=nb, W=W, w9=w9,
         ghost_x=ghost_x,
     )
-    interpret = pltpu.InterpretParams() if use_interpret() else False
+    interpret = interpret_params() if use_interpret() else False
     return pl.pallas_call(
         kern,
         in_specs=[

@@ -22,3 +22,20 @@ def masked_scores(q: jax.Array, k: jax.Array, mask: jax.Array) -> jax.Array:
         "shd,thd->hst", q.astype(jnp.float32), k.astype(jnp.float32)
     ) / jnp.sqrt(jnp.asarray(d, jnp.float32))
     return jnp.where(mask[None, :, :], s, NEG_INF)
+
+
+def masked_softmax(s: jax.Array, mask: jax.Array) -> jax.Array:
+    """fp32 attention weights over the last axis of masked scores.
+
+    ``mask`` (True = attend) must broadcast to ``s``.  THE one
+    normalize-with-guard definition for the non-online paths (the serve
+    prefill and ``ops.attention.decode_attention``): masked entries are
+    re-zeroed AFTER exponentiation (a fully-masked row has max NEG_INF,
+    making s - m == 0 there), and fully-masked rows come back as all-zero
+    weights instead of NaN.
+    """
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    return p / jnp.maximum(l, 1e-30)

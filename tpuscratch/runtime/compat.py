@@ -1,0 +1,70 @@
+"""Version gates for older jax releases (imported for side effects).
+
+The framework is written against current jax spellings — ``jax.shard_map``
+with its ``check_vma`` keyword, ``lax.axis_size`` — but some images pin an
+older jax where the same capabilities live under earlier names
+(``jax.experimental.shard_map`` with ``check_rep``; no ``axis_size``
+helper).  Rather than scatter try/except at every call site, this module
+installs the new spellings when absent, once, at package import
+(``tpuscratch/__init__`` imports it before anything else).  On a current
+jax it is a no-op.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+
+def _install() -> None:
+    if not hasattr(lax, "axis_size"):
+
+        def axis_size(axis_name):
+            """``lax.axis_size`` backfill: psum of the unit *constant*
+            folds to the static axis size inside shard_map (a Python int,
+            not a tracer), so schedule math built on it stays trace-time."""
+            return lax.psum(1, axis_name)
+
+        lax.axis_size = axis_size  # type: ignore[attr-defined]
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        @functools.wraps(_legacy)
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+            # the keyword was renamed check_rep -> check_vma when shard_map
+            # graduated from jax.experimental; semantics are unchanged
+            return _legacy(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=check_vma, **kw,
+            )
+
+        jax.shard_map = shard_map  # type: ignore[attr-defined]
+
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # no pallas at all: the kernels gate themselves
+        return
+    # pallas-TPU renames (TPU* prefixes dropped when pallas stabilized)
+    if not hasattr(pltpu, "MemorySpace") and hasattr(pltpu, "TPUMemorySpace"):
+        pltpu.MemorySpace = pltpu.TPUMemorySpace
+    if not hasattr(pltpu, "CompilerParams") and hasattr(
+        pltpu, "TPUCompilerParams"
+    ):
+        import inspect
+
+        _tcp = pltpu.TPUCompilerParams
+        _known = set(inspect.signature(_tcp.__init__).parameters)
+
+        def _compiler_params(**kw):
+            # fields the old class predates (e.g. has_side_effects) are
+            # dropped: on a jax this old the Mosaic path only ever runs
+            # in interpret mode, where they have no effect anyway
+            return _tcp(**{k: v for k, v in kw.items() if k in _known})
+
+        pltpu.CompilerParams = _compiler_params
+
+
+_install()

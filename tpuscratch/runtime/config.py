@@ -51,6 +51,10 @@ class Config:
     #                                  stencil: xla/pallas/blocked/overlap/
     #                                  deep/dma/resident, dot: full/partials/
     #                                  xla, attention: pallas/xla
+    # -- serving (serve/engine.py knobs; argv tier like the sizes above) --
+    decode_slots: int = 8            # continuous-batching decode-batch width
+    kv_pages: int = 64               # KV-cache pages per dp group
+    page_size: int = 8               # tokens per KV page
     # -- instrumentation -------------------------------------------------
     log: bool = True                 # NO_LOG parity
     include_setup_time: bool = True  # NO_GPU_MALLOC_TIME parity

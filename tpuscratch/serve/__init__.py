@@ -1,0 +1,33 @@
+"""tpuscratch.serve — sharded autoregressive inference.
+
+The serving layer over the training stack: a block-paged KV cache
+sharded on the SAME (dp, sp) mesh the train step uses (kvcache), a
+cached single-token decode step numerically equivalent to the full
+forward (decode + ops.attention.decode_attention), deterministic
+per-request sampling (sampling), and a continuous-batching engine with
+free-page-watermark admission and zero steady-state recompiles (engine).
+"""
+
+from tpuscratch.serve.decode import (  # noqa: F401
+    CompileCounter,
+    build_decode_step,
+    build_prefill,
+)
+from tpuscratch.serve.engine import (  # noqa: F401
+    GenerateReport,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    init_embed,
+)
+from tpuscratch.serve.kvcache import (  # noqa: F401
+    CacheGeometry,
+    PageAllocator,
+    init_kv_cache,
+    kv_cache_spec,
+)
+from tpuscratch.serve.sampling import (  # noqa: F401
+    request_key,
+    sample_batch,
+    sample_logits,
+)

@@ -1,0 +1,260 @@
+"""Single-token decode + prompt prefill over the paged KV cache.
+
+The inference twin of ``models/transformer.model_apply``: the same
+parameter pytree, the same ``_rms_norm``/projection/MoE math, but
+attention reads (and extends) the block-paged cache instead of
+recomputing the whole prefix — turning the O(S) per-token forward into
+O(1) compute plus an O(S) cache *gather* (``ops.attention.
+decode_attention``).  Numerical equivalence to the full forward at every
+position is test-gated (tests/test_serve.py) under the no-token-dropped
+MoE capacity regime (capacity_factor >= n_experts), since routing is the
+one component whose output can depend on which OTHER tokens share the
+batch when capacity binds.
+
+Mesh mapping (see serve/kvcache.py for the cache side):
+
+- decode slots shard over **"dp"** (each group decodes its own slots
+  against its own page pool);
+- heads shard over **"sp"**: every rank projects the full q/k/v from the
+  replicated weights, keeps its head slice, attends against its cached
+  head slice, and the output projection psums row-blocks of ``wo`` over
+  sp — Megatron-style tensor parallelism for the attention sublayer,
+  which is what sequence parallelism degenerates to when the sequence
+  axis is one token long;
+- the MoE FFN runs the training stack's ``expert_parallel_ffn`` over
+  "dp" unchanged.
+
+Each builder returns ONE jitted program per batch shape, with a
+:class:`CompileCounter` hook that increments on trace — the engine's
+zero-recompile-after-warmup assertion hangs off it.  The decode step
+donates the cache buffers, so steady-state decode updates pages in place
+instead of copying the pool every token.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.models.transformer import (
+    TransformerConfig,
+    _rms_norm,
+    param_spec,
+)
+from tpuscratch.ops.attention import decode_attention
+from tpuscratch.parallel.expert import expert_parallel_ffn
+from tpuscratch.parallel.scores import masked_scores, masked_softmax
+from tpuscratch.serve.kvcache import CacheGeometry, kv_cache_spec
+
+
+class CompileCounter:
+    """Counts traces of a jitted program body.  jax retraces exactly on
+    compilation-cache misses, so the count IS the compile count — the
+    hook the engine's steady-state zero-recompile assertion reads."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def wrap(self, fn):
+        def counted(*args):
+            self.count += 1
+            return fn(*args)
+
+        return counted
+
+
+def check_serve_mesh(mesh: Mesh, cfg: TransformerConfig,
+                     dp: str = "dp", sp: str = "sp") -> None:
+    """The serve-side mesh preconditions (decode and prefill share them)."""
+    if cfg.n_experts % mesh.shape[dp]:
+        raise ValueError(
+            f"n_experts {cfg.n_experts} not divisible by dp size "
+            f"{mesh.shape[dp]}"
+        )
+    if cfg.n_heads % mesh.shape[sp]:
+        raise ValueError(
+            f"serving shards heads over sp: n_heads {cfg.n_heads} not "
+            f"divisible by sp size {mesh.shape[sp]}"
+        )
+
+
+def _check_geometry(cfg: TransformerConfig, geom: CacheGeometry) -> None:
+    """A cache built for a different model fails loudly at build time,
+    not as a shape error inside the compiled step."""
+    if (geom.n_layers, geom.n_heads, geom.d_head) != (
+        cfg.n_layers, cfg.n_heads, cfg.d_head
+    ):
+        raise ValueError(
+            f"cache geometry (layers={geom.n_layers}, heads={geom.n_heads}, "
+            f"d_head={geom.d_head}) does not match the model "
+            f"(layers={cfg.n_layers}, heads={cfg.n_heads}, "
+            f"d_head={cfg.d_head})"
+        )
+
+
+def _head_slice(t, sp: str, n_heads: int):
+    """This sp rank's head slice of a (..., n_heads, d_head) projection."""
+    n = lax.axis_size(sp)
+    h_loc = n_heads // n
+    return lax.dynamic_slice_in_dim(
+        t, lax.axis_index(sp) * h_loc, h_loc, axis=t.ndim - 2
+    )
+
+
+def _attn_residual(p, attn_loc, x, cfg: TransformerConfig, sp: str):
+    """Output projection of this rank's head slice: its row block of the
+    replicated ``wo`` + psum over sp assembles the full projection."""
+    n = lax.axis_size(sp)
+    rows_loc = (cfg.n_heads // n) * cfg.d_head
+    wo_rows = lax.dynamic_slice_in_dim(
+        p["wo"], lax.axis_index(sp) * rows_loc, rows_loc, axis=0
+    )
+    flat = attn_loc.reshape(*attn_loc.shape[:-2], rows_loc)
+    return x + lax.psum(flat @ wo_rows, sp)
+
+
+def _moe_residual(p, x, cfg: TransformerConfig, dp: str):
+    h = _rms_norm(x, p["ln2"])
+    moe, _ = expert_parallel_ffn(
+        h, p["gate"], p["w_in"], p["w_out"], dp,
+        capacity_factor=cfg.capacity_factor,
+    )
+    return x + moe
+
+
+def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp"):
+    """The decode shard_map body:
+    (params, kv, x, page_tables, write_page, write_off, seq_lens)
+    -> (out, kv').
+
+    Local shapes: x (B_loc, d) — each slot's current-token vector;
+    page_tables (B_loc, max_pages) LOCAL page ids; write_page/write_off
+    (B_loc,) — where this token's K/V lands (write_page >= n_pages for
+    idle slots: the scatter's drop mode makes them no-ops); seq_lens
+    (B_loc,) — cached length INCLUDING this token (0 idles the slot).
+    """
+
+    def step(params, kv, x, page_tables, write_page, write_off, seq_lens):
+        kv_k, kv_v = kv["k"], kv["v"]
+        H, Dh = cfg.n_heads, cfg.d_head
+        B = x.shape[0]
+        # idle slots must not compete for MoE expert capacity: routing
+        # priority is positional, so an idle slot's zero vector ahead of
+        # a real token would consume capacity and CHANGE that token's
+        # output whenever capacity binds (capacity_factor < n_experts).
+        # A stable idle-last permutation keeps the compiled shape fixed
+        # while making idle tokens lose every capacity tie; jax sorts
+        # are stable, so active slots keep their relative order.
+        perm = jnp.argsort((seq_lens == 0).astype(jnp.int32))
+        inv = jnp.argsort(perm)
+        for li, p in enumerate(params["layers"]):
+            h = _rms_norm(x, p["ln1"])
+            q = _head_slice((h @ p["wq"]).reshape(B, H, Dh), sp, H)
+            k = _head_slice((h @ p["wk"]).reshape(B, H, Dh), sp, H)
+            v = _head_slice((h @ p["wv"]).reshape(B, H, Dh), sp, H)
+            kv_k = kv_k.at[li, write_page, write_off].set(k, mode="drop")
+            kv_v = kv_v.at[li, write_page, write_off].set(v, mode="drop")
+            attn = decode_attention(
+                q, kv_k[li], kv_v[li], page_tables, seq_lens
+            )
+            x = _attn_residual(p, attn, x, cfg, sp)
+            x = _moe_residual(p, x[perm], cfg, dp)[inv]
+        return x, {"k": kv_k, "v": kv_v}
+
+    return step
+
+
+def build_decode_step(mesh: Mesh, cfg: TransformerConfig,
+                      geom: CacheGeometry, dp: str = "dp", sp: str = "sp",
+                      counter: CompileCounter | None = None):
+    """Compiled decode step over ``mesh``: jit'd
+    fn(params, kv, x, page_tables, write_page, write_off, seq_lens) ->
+    (out (B, d), kv') with slots sharded P(dp) and the cache donated
+    (page pools update in place).  One compile per (B, max_pages)
+    bucket; the engine holds B fixed at its slot count, so steady-state
+    decode never recompiles (``counter`` proves it)."""
+    check_serve_mesh(mesh, cfg, dp, sp)
+    _check_geometry(cfg, geom)
+    body = decode_step_fn(cfg, sp=sp, dp=dp)
+    if counter is not None:
+        body = counter.wrap(body)
+    pspec = param_spec(cfg, dp)
+    kspec = kv_cache_spec(dp, sp)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, kspec, P(dp), P(dp), P(dp), P(dp), P(dp)),
+        (P(dp), kspec),
+        donate_argnums=(1,),
+    )
+
+
+def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
+               sp: str = "sp", dp: str = "dp"):
+    """The prefill shard_map body: (params, kv, x, pages, n_tok) ->
+    (out, kv').
+
+    One sequence per call: x (S_bucket, d) is the prompt padded to its
+    shape bucket, replicated over BOTH axes (prompt compute is identical
+    everywhere — only the cache write is owner-local); pages
+    (1, max_pages) is this dp group's row of the page-id table, real ids
+    on the owning group and the out-of-range sentinel elsewhere (the
+    drop-mode scatter makes non-owners' writes vanish); n_tok is the
+    true prompt length.  Returns the full per-position outputs — the
+    engine samples from position ``n_tok - 1``, tests compare every one
+    against ``model_apply``.
+    """
+
+    def run(params, kv, x, pages, n_tok):
+        kv_k, kv_v = kv["k"], kv["v"]
+        H, Dh = cfg.n_heads, cfg.d_head
+        S = x.shape[0]
+        pages = pages[0]
+        pos = jnp.arange(S)
+        page_of = pages[jnp.clip(pos // geom.page_size, 0, pages.shape[0] - 1)]
+        # padded positions (pos >= n_tok) write nowhere
+        pg = jnp.where(pos < n_tok, page_of, geom.n_pages)
+        off = pos % geom.page_size
+        # causal x true-length mask: padded keys never attend, padded
+        # query rows produce garbage that nothing reads
+        mask = (pos[:, None] >= pos[None, :]) & (pos[None, :] < n_tok)
+        for li, p in enumerate(params["layers"]):
+            h = _rms_norm(x, p["ln1"])
+            q = _head_slice((h @ p["wq"]).reshape(S, H, Dh), sp, H)
+            k = _head_slice((h @ p["wk"]).reshape(S, H, Dh), sp, H)
+            v = _head_slice((h @ p["wv"]).reshape(S, H, Dh), sp, H)
+            kv_k = kv_k.at[li, pg, off].set(k, mode="drop")
+            kv_v = kv_v.at[li, pg, off].set(v, mode="drop")
+            s = masked_scores(q, k, mask)                    # (H_loc, S, S)
+            pr = masked_softmax(s, mask[None])
+            attn = jnp.einsum("hst,thd->shd", pr, v.astype(jnp.float32))
+            x = _attn_residual(p, attn.astype(x.dtype), x, cfg, sp)
+            x = _moe_residual(p, x, cfg, dp)
+        return x, {"k": kv_k, "v": kv_v}
+
+    return run
+
+
+def build_prefill(mesh: Mesh, cfg: TransformerConfig, geom: CacheGeometry,
+                  dp: str = "dp", sp: str = "sp",
+                  counter: CompileCounter | None = None):
+    """Compiled prefill over ``mesh``: jit'd fn(params, kv, x, pages,
+    n_tok) -> (out (S, d), kv'), cache donated.  One compile per prompt
+    shape bucket (the engine pads prompts to power-of-two lengths to
+    bound the bucket count)."""
+    check_serve_mesh(mesh, cfg, dp, sp)
+    _check_geometry(cfg, geom)
+    body = prefill_fn(cfg, geom, sp=sp, dp=dp)
+    if counter is not None:
+        body = counter.wrap(body)
+    pspec = param_spec(cfg, dp)
+    kspec = kv_cache_spec(dp, sp)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, kspec, P(), P(dp), P()),
+        (P(), kspec),
+        donate_argnums=(1,),
+    )

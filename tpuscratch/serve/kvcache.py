@@ -1,0 +1,129 @@
+"""Block-paged KV cache: preallocated page pools + a free-list allocator.
+
+The serving-side memory system (PagedAttention / vLLM, SOSP '23, rebuilt
+for this framework's mesh conventions): K and V live in preallocated
+buffers of shape ``(n_layers, n_pages, page_size, n_heads, d_head)``,
+and a sequence's cache is a list of page ids, not a contiguous slab — so
+mixed-length sequences pack the pool densely and admission control is
+one integer comparison against the free list.
+
+Sharding follows the ``models/transformer.param_spec`` conventions onto
+the same (dp, sp) mesh the training step uses:
+
+- **pages shard over "dp"** the way expert leaves shard their expert
+  axis: each data-parallel group serves its own decode slots out of its
+  own page pool (ids in a page table are LOCAL to the owning group), so
+  per-step cache writes touch only the owning shard and the global
+  array stays consistent without cross-group traffic;
+- **heads shard over "sp"**: at decode there is no sequence axis left to
+  shard, so the sequence-parallel ranks hold head slices instead — the
+  Ulysses layout (parallel/ulysses.py) applied to the cache.
+
+The allocator is deliberately HOST-side Python: page grant/release is
+scheduler work that happens between compiled steps (the engine's
+admission/eviction loop), never inside one — the compiled decode step
+only ever sees page *tables*, which are plain int32 arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable, Optional
+
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheGeometry:
+    """Static shape of one data-parallel group's page pool."""
+
+    n_layers: int
+    n_pages: int          # pages per dp group
+    page_size: int        # tokens per page
+    n_heads: int          # GLOBAL head count (sharded over sp)
+    d_head: int
+
+    def __post_init__(self):
+        for name in ("n_layers", "n_pages", "page_size", "n_heads", "d_head"):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
+
+    @property
+    def max_tokens(self) -> int:
+        """Token capacity of one group's pool."""
+        return self.n_pages * self.page_size
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages needed to hold ``n_tokens``."""
+        return -(-n_tokens // self.page_size)
+
+
+def init_kv_cache(geom: CacheGeometry, dp_size: int = 1,
+                  dtype=jnp.float32) -> dict:
+    """The global cache pytree: ``{"k", "v"}`` buffers of shape
+    ``(n_layers, dp_size * n_pages, page_size, n_heads, d_head)`` — the
+    pages axis carries every group's pool (sharded over dp it splits back
+    to ``n_pages`` per group), heads global (sharded over sp)."""
+    shape = (geom.n_layers, dp_size * geom.n_pages, geom.page_size,
+             geom.n_heads, geom.d_head)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def kv_cache_spec(dp: str = "dp", sp: str = "sp") -> dict:
+    """PartitionSpec pytree for :func:`init_kv_cache`'s output."""
+    s = P(None, dp, None, sp, None)
+    return {"k": s, "v": s}
+
+
+class PageAllocator:
+    """LIFO free-list over one group's ``n_pages`` page ids.
+
+    Invariants (test-gated in tests/test_serve.py):
+    - every id handed out is in ``[0, n_pages)`` and unique among live ids;
+    - :meth:`alloc` is all-or-nothing — a request it cannot fully satisfy
+      grants nothing and returns None (no partial reservations to unwind);
+    - :meth:`free` of an id that is not currently live (double free, or a
+      foreign id) raises instead of corrupting the list;
+    - after every live id is freed, ``n_free`` returns to ``n_pages``.
+
+    LIFO keeps recently-freed (cache-warm, recently-DMA'd) pages hot —
+    the same reuse policy as the native host pool's size-class lists
+    (native/src/host_pool.cpp).
+    """
+
+    def __init__(self, n_pages: int):
+        if n_pages < 1:
+            raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+        self.n_pages = n_pages
+        self._free = list(range(n_pages - 1, -1, -1))  # pop() hands out 0 first
+        self._live: set[int] = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_live(self) -> int:
+        return len(self._live)
+
+    def alloc(self, n: int = 1) -> Optional[list[int]]:
+        """Grant ``n`` pages, or None (and grant nothing) if fewer are free."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._live.update(pages)
+        return pages
+
+    def free(self, pages: Iterable[int]) -> None:
+        """Return pages to the free list; rejects ids not currently live."""
+        for p in pages:
+            if p not in self._live:
+                raise ValueError(
+                    f"page {p} is not live (double free or foreign id; "
+                    f"{len(self._live)} live of {self.n_pages})"
+                )
+            self._live.discard(p)
+            self._free.append(p)
