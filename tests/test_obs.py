@@ -1,0 +1,505 @@
+"""Observability subsystem: metrics registry, cross-rank aggregation on
+1x1 and 2x2 CPU meshes, JSONL sink + report CLI, CompileCounter
+promotion (trainer + engine recompile coverage), profiling trace guard."""
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+from tpuscratch.obs import (
+    CompileCounter,
+    MetricsRegistry,
+    merge_snapshots,
+    mesh_reduce,
+    mesh_span,
+    span_max_min,
+)
+from tpuscratch.obs.sink import NullSink, Sink, open_sink
+from tpuscratch.obs import report
+from tpuscratch.runtime.mesh import make_mesh
+
+
+class TestMetricsRegistry:
+    def test_counter(self):
+        reg = MetricsRegistry()
+        reg.counter("ticks").inc()
+        reg.counter("ticks").inc(3)
+        assert reg.counter("ticks").value == 4
+        assert reg.snapshot()["ticks"] == {"kind": "counter", "value": 4}
+
+    def test_gauge_watermarks(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("free_pages")
+        for v in (8, 3, 5):
+            g.set(v)
+        snap = reg.snapshot()["free_pages"]
+        assert snap["value"] == 5
+        assert snap["min"] == 3  # the watermark admission control reads
+        assert snap["max"] == 8
+
+    def test_histogram(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        for v in (0.1, 0.2, 0.3, 0.4):
+            h.observe(v)
+        assert h.count == 4
+        assert h.mean == pytest.approx(0.25)
+        assert h.percentile(50) == pytest.approx(0.2, abs=0.11)
+        assert h.percentile(100) == pytest.approx(0.4)
+
+    def test_histogram_window_bounded(self):
+        h = MetricsRegistry().histogram("lat")
+        h.window = type(h.window)(maxlen=4)
+        for i in range(100):
+            h.observe(float(i))
+        assert h.count == 100          # exact lifetime count survives
+        assert len(h.window) == 4      # samples stay bounded
+        assert h.percentile(0) == 96.0  # window holds the recent tail
+
+    def test_kind_collision_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_merge_snapshots(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("n").inc(2)
+        b.counter("n").inc(5)
+        a.gauge("q").set(3)
+        b.gauge("q").set(7)
+        a.histogram("h").observe(1.0)
+        b.histogram("h").observe(3.0)
+        m = merge_snapshots([a.snapshot(), b.snapshot()])
+        assert m["n"]["value"] == 7
+        assert m["q"]["value"] == 7 and m["q"]["min"] == 3
+        assert m["h"]["count"] == 2 and m["h"]["mean"] == pytest.approx(2.0)
+
+    def test_merge_kind_mismatch_raises(self):
+        a, b = MetricsRegistry(), MetricsRegistry()
+        a.counter("x")
+        b.gauge("x").set(1)
+        with pytest.raises(ValueError):
+            merge_snapshots([a.snapshot(), b.snapshot()])
+
+
+class TestMeshAggregation:
+    """The mpicuda3 reduce-to-rank-0 convention through comm.collectives."""
+
+    @pytest.mark.parametrize("dims", [(1, 1), (2, 2)])
+    def test_mesh_reduce(self, dims, devices):
+        mesh = make_mesh(dims, ("dp", "sp"))
+        n = dims[0] * dims[1]
+        rows = [[float(i + 1), 10.0 * (i + 1)] for i in range(n)]
+        red = mesh_reduce(mesh, rows, ops=("sum", "max", "min"))
+        assert red["sum"].tolist() == [
+            sum(r[0] for r in rows), sum(r[1] for r in rows)
+        ]
+        assert red["max"].tolist() == [float(n), 10.0 * n]
+        assert red["min"].tolist() == [1.0, 10.0]
+
+    def test_mesh_reduce_scalar_rows(self, devices):
+        mesh = make_mesh((2, 2), ("dp", "sp"))
+        red = mesh_reduce(mesh, [1.0, 2.0, 3.0, 4.0], ops=("sum",))
+        assert float(red["sum"]) == 10.0
+
+    def test_mesh_reduce_wrong_rows(self, devices):
+        mesh = make_mesh((2, 2), ("dp", "sp"))
+        with pytest.raises(ValueError):
+            mesh_reduce(mesh, [[1.0]] * 3)
+
+    @pytest.mark.parametrize("dims", [(1, 1), (2, 2)])
+    def test_mesh_span_matches_host_merge(self, dims, devices):
+        mesh = make_mesh(dims, ("dp", "sp"))
+        n = dims[0] * dims[1]
+        # perf_counter-scale stamps: the f32 device path must survive them
+        begins = [50000.0 + 0.01 * i for i in range(n)]
+        ends = [50000.3 + 0.02 * i for i in range(n)]
+        dev = mesh_span(mesh, "step", begins, ends)
+        host = mesh_span(mesh, "step", begins, ends, use_device=False)
+        assert dev.seconds == pytest.approx(host.seconds, abs=1e-4)
+        assert dev.seconds == pytest.approx(span_max_min(begins, ends),
+                                            abs=1e-4)
+        assert dev.rank_seconds_max == pytest.approx(
+            max(e - b for b, e in zip(begins, ends)), abs=1e-4
+        )
+
+    def test_span_max_min_is_the_mpicuda3_convention(self):
+        # rank 0: [0.0, 1.0], rank 1: [0.5, 1.5] -> 1.5, not max duration
+        assert span_max_min([0.0, 0.5], [1.0, 1.5]) == pytest.approx(1.5)
+        with pytest.raises(ValueError):
+            span_max_min([], [])
+
+    def test_profiling_cross_rank_span_delegates(self):
+        """runtime.profiling's merge is now obs.metrics' merge."""
+        from tpuscratch.runtime.profiling import Span, Timeline, cross_rank_span
+
+        a, b = Timeline(), Timeline()
+        a.spans.append(Span("step", 0.0, 1.0))
+        b.spans.append(Span("step", 0.5, 1.5))
+        assert cross_rank_span([a, b], "step") == pytest.approx(1.5)
+
+
+class TestCompileCounterPromotion:
+    def test_serve_reexports_obs_class(self):
+        from tpuscratch.obs.metrics import CompileCounter as obs_cc
+        from tpuscratch.serve import CompileCounter as serve_cc
+        from tpuscratch.serve.decode import CompileCounter as decode_cc
+
+        assert serve_cc is obs_cc and decode_cc is obs_cc
+
+    def test_trainer_zero_recompiles_after_warmup(self, devices):
+        """N same-shape steps trace exactly once — the serving engine's
+        zero-steady-state-recompile contract, now held by the trainer."""
+        import jax
+        import numpy as np
+
+        from tpuscratch.models.transformer import (
+            TransformerConfig,
+            init_params,
+            train_step,
+        )
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        counter = CompileCounter()
+        fn = train_step(mesh, cfg, counter=counter)
+        params = init_params(0, cfg)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 8, 16)).astype(np.float32)
+        y = rng.standard_normal((2, 8, 16)).astype(np.float32)
+        for _ in range(5):
+            params, loss = fn(params, x, y)
+        jax.block_until_ready(loss)
+        assert counter.count == 1
+
+    def test_grad_norm_output(self, devices):
+        """with_grad_norm appends a replicated positive scalar and leaves
+        loss and params bit-identical to the plain step."""
+        import numpy as np
+
+        from tpuscratch.models.transformer import (
+            TransformerConfig,
+            init_params,
+            train_step,
+        )
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        params = init_params(0, cfg)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 8, 16)).astype(np.float32)
+        y = rng.standard_normal((2, 8, 16)).astype(np.float32)
+        p1, loss1 = train_step(mesh, cfg)(params, x, y)
+        p2, loss2, gnorm = train_step(mesh, cfg, with_grad_norm=True)(
+            params, x, y
+        )
+        assert float(loss1) == float(loss2)
+        assert float(gnorm) > 0.0
+        np.testing.assert_array_equal(
+            np.asarray(p1["layers"][0]["wq"]),
+            np.asarray(p2["layers"][0]["wq"]),
+        )
+
+
+class TestTraceGuard:
+    """profiling.trace degrades to a warned no-op span when the jax
+    profiler is unavailable — instead of killing the instrumented run."""
+
+    def test_degrades_when_api_absent(self, monkeypatch, tmp_path):
+        import jax
+
+        from tpuscratch.runtime import profiling
+
+        monkeypatch.delattr(jax.profiler, "start_trace")
+        ran = False
+        with pytest.warns(RuntimeWarning, match="no-op span"):
+            with profiling.trace(str(tmp_path)):
+                ran = True
+        assert ran
+
+    def test_degrades_when_start_fails(self, monkeypatch, tmp_path):
+        import jax
+
+        from tpuscratch.runtime import profiling
+
+        def boom(*a, **k):
+            raise RuntimeError("no profiler backend on this image")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        ran = False
+        with pytest.warns(RuntimeWarning, match="degraded"):
+            with profiling.trace(str(tmp_path)):
+                ran = True
+        assert ran
+
+    def test_supported_predicate(self):
+        import jax
+
+        from tpuscratch.runtime import compat
+
+        assert compat.profiler_trace_supported() == (
+            hasattr(jax.profiler, "start_trace")
+            and hasattr(jax.profiler, "stop_trace")
+        )
+
+
+class TestSink:
+    def test_jsonl_shape(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        with Sink(p, run={"job": "t"}) as s:
+            s.emit("tick", n=1)
+            s.emit("tick", n=2, note="x")
+        lines = [json.loads(l) for l in open(p) if l.strip()]
+        assert [l["event"] for l in lines] == ["run", "tick", "tick"]
+        assert lines[0]["job"] == "t"  # run metadata is the first event
+        assert lines[2]["note"] == "x"
+        assert all("t" in l for l in lines)
+
+    def test_host_suffix(self, tmp_path):
+        p = str(tmp_path / "run.jsonl")
+        s = Sink(p, host=3)
+        s.close()
+        assert s.path.endswith("run.h3.jsonl")
+        assert os.path.exists(s.path)
+
+    def test_null_sink_and_open_sink(self, tmp_path):
+        ns = open_sink(None)
+        assert isinstance(ns, NullSink) and not ns.enabled
+        ns.emit("anything", x=1)  # no-op, no file
+        s = open_sink(str(tmp_path / "a.jsonl"))
+        assert isinstance(s, Sink) and s.enabled
+        s.close()
+
+    def test_buffered_flush(self, tmp_path):
+        p = str(tmp_path / "buf.jsonl")
+        s = Sink(p, flush_every=1000)
+        s.emit("tick")
+        # buffered: nothing past the opening flush yet
+        n_before = sum(1 for _ in open(p))
+        s.flush()
+        n_after = sum(1 for _ in open(p))
+        assert n_after >= n_before
+        assert sum(1 for _ in open(p)) == 2  # run + tick
+        s.close()
+
+
+@pytest.mark.obs
+class TestReport:
+    @staticmethod
+    def _fixture(tmp_path) -> str:
+        """A canned two-host serving run (what a Sink writes)."""
+        p = str(tmp_path / "run.jsonl")
+        events = [
+            {"event": "run", "t": 0.0, "job": "serve", "host": 0},
+            {"event": "serve/tick", "t": 0.1, "tick": 1, "tick_s": 0.01,
+             "queue_depth": 3, "free_pages_min": 10},
+            {"event": "serve/tick", "t": 0.2, "tick": 2, "tick_s": 0.03,
+             "queue_depth": 1, "free_pages_min": 6},
+            {"event": "metrics", "t": 0.3, "metrics": {
+                "serve/tokens": {"kind": "counter", "value": 8},
+                "serve/free_pages": {"kind": "gauge", "value": 6,
+                                     "min": 6, "max": 12},
+            }},
+        ]
+        with open(p, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return p
+
+    def test_summarize(self, tmp_path):
+        p = self._fixture(tmp_path)
+        s = report.summarize(report.load_events([p]))
+        tick = s["events"]["serve/tick"]
+        assert tick["count"] == 2
+        assert tick["fields"]["tick_s"]["max"] == pytest.approx(0.03)
+        assert tick["fields"]["queue_depth"]["min"] == 1
+        assert s["metrics"]["serve/tokens"]["value"] == 8
+        assert s["runs"][0]["job"] == "serve"
+
+    def test_event_filter(self, tmp_path):
+        p = self._fixture(tmp_path)
+        s = report.summarize(report.load_events([p]), only_event="nope")
+        assert s["events"] == {}
+
+    def test_multi_host_merge(self, tmp_path):
+        p0 = self._fixture(tmp_path)
+        p1 = str(tmp_path / "run.h1.jsonl")
+        with open(p1, "w") as f:
+            f.write(json.dumps({"event": "metrics", "t": 0.5, "metrics": {
+                "serve/tokens": {"kind": "counter", "value": 5}}}) + "\n")
+        s = report.summarize(report.load_events([p0, p1]))
+        assert s["metrics"]["serve/tokens"]["value"] == 13  # summed
+
+    def test_snapshot_scopes(self, tmp_path):
+        """Same registry (same scope): newest snapshot supersedes.
+        Different registries (scopes) in ONE file — e.g. one engine per
+        batch size in a sweep — merge instead of last-wins."""
+        p = str(tmp_path / "sweep.jsonl")
+        tok = {"kind": "counter"}
+        with open(p, "w") as f:
+            for scope, val in (("a", 1), ("a", 4), ("b", 10), (None, 100)):
+                rec = {"event": "metrics",
+                       "metrics": {"tok": dict(tok, value=val)}}
+                if scope:
+                    rec["scope"] = scope
+                f.write(json.dumps(rec) + "\n")
+        s = report.summarize(report.load_events([p]))
+        # a: 4 supersedes 1 (cumulative), then a+b+unscoped merge
+        assert s["metrics"]["tok"]["value"] == 4 + 10 + 100
+
+    def test_engine_sweep_snapshots_all_merge(self, tmp_path):
+        """Two engines writing into one sink file: the report's metrics
+        cover BOTH (the decode_bench sweep shape)."""
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.serve import Request, ServeConfig, ServeEngine
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=32, n_heads=2, n_experts=2,
+                                d_ff=64, n_layers=1)
+        scfg = ServeConfig(n_slots=2, n_pages=16, page_size=4, max_seq=16,
+                           vocab=16)
+        p = str(tmp_path / "sweep.jsonl")
+        with Sink(p) as s:
+            for base_rid in (0, 10):
+                eng = ServeEngine(mesh, cfg, scfg, sink=s)
+                eng.run([Request(rid=base_rid, prompt=(1, 2), max_new=3)])
+        summ = report.summarize(report.load_events([p]))
+        assert summ["metrics"]["serve/tokens"]["value"] == 6  # 3 + 3
+
+    def test_malformed_line_raises_with_location(self, tmp_path):
+        p = str(tmp_path / "bad.jsonl")
+        with open(p, "w") as f:
+            f.write('{"event": "run"}\nnot json\n')
+        with pytest.raises(ValueError, match="bad.jsonl:2"):
+            report.load_events([p])
+
+    def test_cli_smoke(self, tmp_path):
+        """The tier-1-safe CLI gate: ``python -m tpuscratch.obs.report``
+        on a canned fixture must exit 0 and print the table."""
+        p = self._fixture(tmp_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.report", p],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "serve/tick" in r.stdout
+        assert "tick_s" in r.stdout
+        assert "serve/tokens" in r.stdout
+
+    def test_cli_json_mode(self, tmp_path):
+        p = self._fixture(tmp_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.report", p, "--json"],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        parsed = json.loads(r.stdout)
+        assert parsed["events"]["serve/tick"]["count"] == 2
+
+
+class TestEngineObs:
+    @staticmethod
+    def _engine(sink=None):
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.serve import ServeConfig, ServeEngine
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=32, n_heads=2, n_experts=2,
+                                d_ff=64, n_layers=1)
+        scfg = ServeConfig(n_slots=2, n_pages=16, page_size=4, max_seq=16,
+                           vocab=16)
+        return ServeEngine(mesh, cfg, scfg, sink=sink)
+
+    def test_tick_metrics_without_sink(self, devices):
+        from tpuscratch.serve import Request
+
+        eng = self._engine()
+        eng.run([Request(rid=i, prompt=(1, 2, 3), max_new=4)
+                 for i in range(3)])
+        snap = eng.metrics.snapshot()
+        assert snap["serve/inserts"]["value"] == 3
+        assert snap["serve/evictions"]["value"] == 3
+        assert snap["serve/tokens"]["value"] == 12
+        assert snap["serve/tick_s"]["count"] >= 4
+        # watermark: pages were consumed at some point
+        assert snap["serve/free_pages"]["min"] < 16
+        # zero steady-state recompiles, visible in the registry
+        assert snap["serve/decode_compiles"]["value"] == 1
+        assert snap["serve/queue_depth"]["value"] == 0  # drained
+
+    def test_tick_events_through_sink(self, devices, tmp_path):
+        from tpuscratch.serve import Request
+
+        p = str(tmp_path / "serve.jsonl")
+        with Sink(p, run={"job": "t"}) as s:
+            eng = self._engine(sink=s)
+            eng.run([Request(rid=0, prompt=(1, 2), max_new=3)])
+        summ = report.summarize(report.load_events([p]))
+        assert summ["events"]["serve/engine"]["count"] == 1
+        # prefill emits the first token, each tick one more: 2 ticks
+        assert summ["events"]["serve/tick"]["count"] >= 2
+        assert summ["events"]["serve/report"]["count"] == 1
+        fields = summ["events"]["serve/tick"]["fields"]
+        for key in ("tick_s", "queue_depth", "free_pages_min", "inserted",
+                    "evicted", "decode_compiles"):
+            assert key in fields
+        assert summ["metrics"]["serve/tokens"]["value"] == 3
+
+
+class TestTrainerObs:
+    def test_train_emits_chunks_and_zero_recompiles(self, devices, tmp_path):
+        from tpuscratch.models.trainer import train
+        from tpuscratch.models.transformer import TransformerConfig
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        p = str(tmp_path / "train.jsonl")
+        with Sink(p, run={"job": "t"}) as s:
+            _, rep = train(mesh, cfg, steps=6, save_every=3,
+                           ckpt_dir=str(tmp_path / "ck"), obs=s)
+        assert rep.steps_run == 6
+        summ = report.summarize(report.load_events([p]))
+        chunk = summ["events"]["train/chunk"]
+        assert chunk["count"] == 2
+        for key in ("loss", "grad_norm", "tokens_per_s", "step_s",
+                    "compiles"):
+            assert key in chunk["fields"], key
+        # zero recompiles across the run: one trace, ever
+        assert chunk["fields"]["compiles"]["max"] == 1
+        assert summ["events"]["train/run"]["count"] == 1
+        assert summ["metrics"]["train/steps"]["value"] == 6
+
+    def test_train_without_obs_unchanged(self, devices, tmp_path):
+        """No sink -> the step compiles WITHOUT the grad-norm output and
+        training still works (the uninstrumented program is preserved)."""
+        from tpuscratch.models.trainer import train
+        from tpuscratch.models.transformer import TransformerConfig
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        _, rep = train(mesh, cfg, steps=2, save_every=2,
+                       ckpt_dir=str(tmp_path / "ck"))
+        assert rep.steps_run == 2
+
+
+@pytest.mark.slow
+@pytest.mark.obs
+class TestObsOverhead:
+    def test_per_step_overhead_under_budget(self, devices):
+        """Full per-step instrumentation (heavier than the real per-chunk
+        trainer hooks) must cost < 2% of train-bench steps/s."""
+        from tpuscratch.bench.train_bench import bench_obs_overhead
+
+        o = bench_obs_overhead(steps=60, iters=3)
+        assert o.overhead < 0.02, o.summary()

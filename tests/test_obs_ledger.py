@@ -1,0 +1,264 @@
+"""Communication/compute ledger: HLO collective walk validated against
+the analytic wire-byte formulas on known collectives (psum, all-gather,
+all-to-all, reduce-scatter, ppermute), cost_analysis plumbing, and the
+roofline diff."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.obs.ledger import (
+    CollectiveOp,
+    all_gather_wire_bytes,
+    all_to_all_wire_bytes,
+    analyze,
+    parse_collectives,
+    reduce_scatter_wire_bytes,
+    ring_all_reduce_wire_bytes,
+    roofline,
+)
+from tpuscratch.runtime.mesh import make_mesh
+
+
+class TestAnalyticFormulas:
+    def test_ring_all_reduce(self):
+        # the canonical 2*(n-1)/n: at n=4, 1 MB costs 1.5 MB on the wire
+        assert ring_all_reduce_wire_bytes(4, 1 << 20) == pytest.approx(
+            1.5 * (1 << 20)
+        )
+        assert ring_all_reduce_wire_bytes(2, 100) == pytest.approx(100.0)
+
+    def test_all_gather(self):
+        assert all_gather_wire_bytes(4, 128) == 384.0
+
+    def test_reduce_scatter(self):
+        assert reduce_scatter_wire_bytes(4, 128) == 384.0
+
+    def test_all_to_all(self):
+        assert all_to_all_wire_bytes(4, 128) == 96.0
+
+
+class TestParseCollectives:
+    """Parsing straight from canned HLO lines (no jax involved)."""
+
+    def test_sync_form(self):
+        ops = parse_collectives(
+            "  ROOT %all-reduce.1 = f32[4,8]{1,0} all-reduce(f32[4,8]{1,0}"
+            " %p), channel_id=1, replica_groups={{0,1,2,3}},"
+            " use_global_device_ids=true, to_apply=%region_0.4\n"
+        )
+        assert ops == (CollectiveOp("all-reduce", 128, 4),)
+
+    def test_async_start_counts_once(self):
+        text = (
+            "  %ag = f32[16,8]{1,0} all-gather-start(f32[4,8]{1,0} %p),"
+            " replica_groups={{0,1},{2,3}}, dimensions={0}\n"
+            "  %agd = f32[16,8]{1,0} all-gather-done(f32[16,8]{1,0} %ag)\n"
+        )
+        ops = parse_collectives(text)
+        assert len(ops) == 1
+        assert ops[0].kind == "all-gather"
+        assert ops[0].group_size == 2  # two groups of two
+
+    def test_async_tuple_results_not_double_counted(self):
+        """Real TPU async spellings return (operand, result[, contexts]);
+        payload must be the RESULT buffer, not the tuple sum."""
+        ag = parse_collectives(
+            "  %ag = (f32[4,8]{1,0}, f32[16,8]{1,0}) all-gather-start("
+            "f32[4,8]{1,0} %p), replica_groups={{0,1,2,3}}, dimensions={0}\n"
+        )
+        assert ag[0].payload_bytes == 512  # the gathered result, alone
+        rs = parse_collectives(
+            "  %rs = (f32[16,8]{1,0}, f32[4,8]{1,0}) reduce-scatter-start("
+            "f32[16,8]{1,0} %p), replica_groups={{0,1,2,3}}, dimensions={0}\n"
+        )
+        assert rs[0].payload_bytes == 128  # the scattered shard, alone
+        cp = parse_collectives(
+            "  %cp = (f32[4,8]{1,0}, f32[4,8]{1,0}, u32[], u32[])"
+            " collective-permute-start(f32[4,8]{1,0} %p),"
+            " source_target_pairs={{0,1},{1,0}}\n"
+        )
+        assert cp[0].payload_bytes == 128  # contexts are not payload
+        ar = parse_collectives(
+            "  %ar = (f32[4,8]{1,0}, f32[4,8]{1,0}) all-reduce-start("
+            "f32[4,8]{1,0} %p), replica_groups={{0,1,2,3}},"
+            " to_apply=%add\n"
+        )
+        assert ar[0].payload_bytes == 128
+
+    def test_iota_replica_groups(self):
+        ops = parse_collectives(
+            "  %rs = f32[4]{0} reduce-scatter(f32[16]{0} %p),"
+            " replica_groups=[2,4]<=[8], dimensions={0}\n"
+        )
+        assert ops[0].group_size == 4
+
+    def test_tuple_shape_and_gte_not_double_counted(self):
+        text = (
+            "  %all-to-all.2 = (f32[4,2]{1,0}, f32[4,2]{1,0}) all-to-all("
+            "f32[4,2]{1,0} %s0, f32[4,2]{1,0} %s1),"
+            " replica_groups={{0,1}}, dimensions={0}\n"
+            "  %gte = f32[4,2]{1,0} get-tuple-element((f32[4,2]{1,0},"
+            " f32[4,2]{1,0}) %all-to-all.2), index=0\n"
+        )
+        ops = parse_collectives(text)
+        assert len(ops) == 1
+        assert ops[0].payload_bytes == 2 * 4 * 2 * 4
+
+    def test_collective_permute_pairs(self):
+        ops = parse_collectives(
+            "  ROOT %collective-permute.1 = bf16[4,8]{1,0}"
+            " collective-permute(bf16[4,8]{1,0} %p), channel_id=1,"
+            " source_target_pairs={{0,1},{1,2},{2,3},{3,0}}\n"
+        )
+        assert ops[0].kind == "collective-permute"
+        assert ops[0].payload_bytes == 64  # bf16 is 2 bytes
+        assert ops[0].group_size == 4
+        assert ops[0].wire_bytes == 64.0  # one hop, whole buffer
+
+    def test_combined_variadic_all_reduce_sums(self):
+        """XLA's AllReduceCombiner fuses many gradient psums into one
+        variadic instruction; the payload is the SUM of the fused
+        buffers, not the largest."""
+        ops = parse_collectives(
+            "  %ar = (f32[1024]{0}, f32[256]{0}) all-reduce(f32[1024]{0}"
+            " %a, f32[256]{0} %b), replica_groups={{0,1,2,3}},"
+            " to_apply=%add\n"
+        )
+        assert ops[0].payload_bytes == (1024 + 256) * 4
+
+    def test_plain_compute_lines_ignored(self):
+        assert parse_collectives(
+            "  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %a, f32[8,8]{1,0}"
+            " %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}\n"
+        ) == ()
+
+
+class TestAnalyzeOnMesh:
+    """Compiled-program ledgers on the virtual CPU mesh: byte counts must
+    match the analytic formulas exactly."""
+
+    def test_psum_all_reduce(self, devices):
+        mesh = make_mesh((4,), ("x",))
+        f = run_spmd(mesh, lambda v: lax.psum(v, "x"), P("x"), P("x"))
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        assert led.counts() == {"all-reduce": 1}
+        # per-shard payload: (4, 8) f32 = 128 B
+        assert led.payload_bytes() == {"all-reduce": 128}
+        assert led.wire_bytes()["all-reduce"] == pytest.approx(
+            ring_all_reduce_wire_bytes(4, 128)
+        )
+
+    def test_all_gather(self, devices):
+        mesh = make_mesh((4,), ("x",))
+        f = run_spmd(
+            mesh, lambda v: lax.all_gather(v, "x", tiled=True), P("x"), P()
+        )
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        assert led.counts() == {"all-gather": 1}
+        assert led.payload_bytes() == {"all-gather": 512}  # full result
+        assert led.wire_bytes()["all-gather"] == pytest.approx(
+            all_gather_wire_bytes(4, 128)
+        )
+
+    def test_all_to_all(self, devices):
+        mesh = make_mesh((4,), ("x",))
+        f = run_spmd(
+            mesh,
+            lambda v: lax.all_to_all(v, "x", 1, 0, tiled=True),
+            P("x"), P("x"),
+        )
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        assert led.counts() == {"all-to-all": 1}
+        assert led.payload_bytes() == {"all-to-all": 128}
+        assert led.wire_bytes()["all-to-all"] == pytest.approx(
+            all_to_all_wire_bytes(4, 128)
+        )
+
+    def test_reduce_scatter(self, devices):
+        mesh = make_mesh((4,), ("x",))
+        f = run_spmd(
+            mesh, lambda v: lax.psum_scatter(v, "x", tiled=True), P(), P("x")
+        )
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        assert led.counts() == {"reduce-scatter": 1}
+        assert led.payload_bytes() == {"reduce-scatter": 128}  # one shard
+        assert led.wire_bytes()["reduce-scatter"] == pytest.approx(
+            reduce_scatter_wire_bytes(4, 128)
+        )
+
+    def test_psum_2x2_group_size(self, devices):
+        """A both-axes psum on a 2x2 mesh reduces over ONE group of 4."""
+        mesh = make_mesh((2, 2), ("dp", "sp"))
+        f = run_spmd(
+            mesh, lambda v: lax.psum(v, ("dp", "sp")),
+            P(("dp", "sp")), P(("dp", "sp")),
+        )
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        assert [(o.kind, o.group_size) for o in led.collectives] == [
+            ("all-reduce", 4)
+        ]
+
+    def test_single_axis_psum_on_2x2_groups_of_two(self, devices):
+        mesh = make_mesh((2, 2), ("dp", "sp"))
+        f = run_spmd(
+            mesh, lambda v: lax.psum(v, "sp"),
+            P(("dp", "sp")), P(("dp", "sp")),
+        )
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        assert [o.group_size for o in led.collectives] == [2]
+
+    def test_flops_from_cost_analysis(self, devices):
+        led = analyze(
+            jax.jit(lambda a, b: a @ b),
+            jnp.ones((8, 8), jnp.float32), jnp.ones((8, 8), jnp.float32),
+        )
+        assert led.flops == pytest.approx(2 * 8 * 8 * 8)  # 2mnk
+        assert led.bytes_accessed > 0
+        assert led.collectives == ()
+
+    def test_unjitted_callable_accepted(self, devices):
+        led = analyze(lambda a: a + 1.0, jnp.ones((4,), jnp.float32))
+        assert led.collectives == ()
+
+    def test_summary_renders(self, devices):
+        mesh = make_mesh((4,), ("x",))
+        f = run_spmd(mesh, lambda v: lax.psum(v, "x"), P("x"), P("x"))
+        led = analyze(f, jnp.ones((16, 8), jnp.float32))
+        s = led.summary()
+        assert "all-reduce" in s and "wire" in s
+
+
+class TestRoofline:
+    def _ledger(self):
+        return analyze(
+            jax.jit(lambda a, b: a @ b),
+            jnp.ones((64, 64), jnp.float32), jnp.ones((64, 64), jnp.float32),
+        )
+
+    def test_fractions(self):
+        led = self._ledger()
+        # pretend the measured span was 1 ms for 10 executions
+        r = roofline(led, 1e-3, executions=10,
+                     peak_flops_per_s=1e12, peak_hbm_bytes_per_s=1e11)
+        assert r.flops_per_s == pytest.approx(led.flops * 10 / 1e-3)
+        assert r.flops_fraction == pytest.approx(r.flops_per_s / 1e12)
+        assert r.hbm_fraction == pytest.approx(r.hbm_bytes_per_s / 1e11)
+        assert r.wire_fraction is None  # no link peak stated
+        assert r.bound in ("compute", "memory")
+        assert "TFLOP/s" in r.summary()
+
+    def test_network_bound(self, devices):
+        mesh = make_mesh((4,), ("x",))
+        f = run_spmd(mesh, lambda v: lax.psum(v, "x"), P("x"), P("x"))
+        led = analyze(f, jnp.ones((1024, 8), jnp.float32))
+        r = roofline(led, 1e-3, peak_flops_per_s=1e15,
+                     peak_wire_bytes_per_s=1e6)
+        assert r.bound == "network"
+
+    def test_bad_measurement_raises(self):
+        with pytest.raises(ValueError):
+            roofline(self._ledger(), 0.0)

@@ -64,17 +64,24 @@ def bench_decode(
     prompt_len: int = 8,
     measure_steps: int = 32,
     warmup_steps: int = 4,
+    sink=None,
 ) -> DecodeBenchResult:
     """Steady-state decode: all ``scfg.n_slots`` slots busy, per-tick
     timings over ``measure_steps`` ticks after ``warmup_steps`` warm
-    ticks (prefill + the one decode compile land in warmup)."""
+    ticks (prefill + the one decode compile land in warmup).
+
+    ``sink`` (an ``obs.sink.Sink``) attaches to the engine, so the
+    artifact carries per-tick queue depth, free-page watermark, and
+    tick latency alongside this function's tokens/s summary — a serving
+    regression is then diagnosable FROM the artifact (was it admission?
+    page pressure? a recompile?) instead of just visible in it."""
     from tpuscratch.serve import Request, ServeEngine
 
     scfg = dataclasses.replace(
         scfg, max_seq=max(scfg.max_seq,
                           prompt_len + warmup_steps + measure_steps + 2),
     )
-    engine = ServeEngine(mesh, cfg, scfg)
+    engine = ServeEngine(mesh, cfg, scfg, sink=sink)
     # +1: prefill emits a token; the extra +1 keeps every slot ALIVE
     # through the last measured tick — finishing exactly on it would put
     # the all-slot eviction/free teardown inside the timed window, and
@@ -109,7 +116,22 @@ def bench_decode(
         times_s=tuple(times),
         items=scfg.n_slots,  # tokens per tick
     )
-    return DecodeBenchResult(res, scfg.n_slots)
+    out = DecodeBenchResult(res, scfg.n_slots)
+    if sink is not None and sink.enabled:
+        sink.emit(
+            "bench/decode",
+            batch=scfg.n_slots, prompt_len=prompt_len,
+            measure_steps=measure_steps,
+            tokens_per_s=out.tokens_per_s,
+            p50_s_per_token=out.p50_s, p99_s_per_token=out.p99_s,
+        )
+        # scope = this engine's registry: the sweep runs one engine per
+        # batch size into ONE file, and the report must merge them, not
+        # keep only the last engine's snapshot
+        sink.emit_metrics(engine.metrics.snapshot(),
+                          scope=engine.metrics.id)
+        sink.flush()
+    return out
 
 
 def sweep(mesh, cfg, scfg, batch_sizes, **kw) -> list[DecodeBenchResult]:
@@ -155,6 +177,8 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json", default=None)
+    ap.add_argument("--obs", default=None,
+                    help="obs JSONL path (per-tick engine telemetry)")
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args(argv)
     if args.cpu_devices:
@@ -162,17 +186,27 @@ def main(argv=None) -> int:
 
         force_cpu_devices(args.cpu_devices)
 
+    from tpuscratch.obs.sink import open_sink
+
     on_tpu = jax.default_backend() == "tpu"
     mesh = make_mesh((1, 1), ("dp", "sp"))
     cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
     rows = []
-    for r in sweep(mesh, cfg, scfg, batches, **kwargs):
-        rows.append({
-            "batch": r.n_slots,
-            "tokens_per_s": r.tokens_per_s,
-            "p50_s_per_token": r.p50_s,
-            "p99_s_per_token": r.p99_s,
-        })
+    # context-managed: a sweep that dies mid-run (OOM at a large batch)
+    # still flushes the buffered ticks — exactly the telemetry needed to
+    # diagnose the failure
+    with open_sink(
+        args.obs,
+        run={"bench": "decode", "platform": jax.default_backend()},
+        host=jax.process_index(),
+    ) as sink:
+        for r in sweep(mesh, cfg, scfg, batches, sink=sink, **kwargs):
+            rows.append({
+                "batch": r.n_slots,
+                "tokens_per_s": r.tokens_per_s,
+                "p50_s_per_token": r.p50_s,
+                "p99_s_per_token": r.p99_s,
+            })
     payload = {"platform": jax.default_backend(), "sweep": rows}
     print(json.dumps(payload))
     if args.json:

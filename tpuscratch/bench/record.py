@@ -688,7 +688,7 @@ def config11_train(out: list, iters: int = 3) -> None:
         print(f"# config 11 pp failed: {e}", file=sys.stderr)
 
 
-def config12_decode(out: list) -> None:
+def config12_decode(out: list, obs_path=None) -> None:
     """Serving decode throughput/latency (tpuscratch.serve): steady-state
     engine ticks — continuous batching, paged KV cache, one compiled
     decode program — tokens/s and the per-token latency tail across a
@@ -698,16 +698,28 @@ def config12_decode(out: list) -> None:
     samples within one continuous steady-state window
     (``default_decode_setup``'s ``measure_steps``), not from repeated
     invocations — repetitions would restart the engine and re-pay
-    prefill, measuring admission rather than decode."""
+    prefill, measuring admission rather than decode.
+
+    ``obs_path`` attaches an obs JSONL sink to the benched engines, so
+    the recorded artifact carries per-tick queue depth, free-page
+    watermark, and tick latency next to the headline tokens/s — a
+    regression in this row is then diagnosable from the artifact
+    (``python -m tpuscratch.obs.report <obs_path>``)."""
     import jax
 
     from tpuscratch.bench.decode_bench import default_decode_setup, sweep
+    from tpuscratch.obs.sink import open_sink
     from tpuscratch.runtime.mesh import make_mesh
 
     on_tpu = jax.default_backend() == "tpu"
     mesh = make_mesh((1, 1), ("dp", "sp"))
     cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
-    results = sweep(mesh, cfg, scfg, batches, **kwargs)
+    with open_sink(
+        obs_path,
+        run={"bench": "record/config12", "platform": jax.default_backend()},
+        host=jax.process_index(),
+    ) as sink:
+        results = sweep(mesh, cfg, scfg, batches, sink=sink, **kwargs)
     best = max(results, key=lambda r: r.tokens_per_s)
     _emit(
         out,
@@ -725,7 +737,8 @@ def config12_decode(out: list) -> None:
             }
             for r in results
         ],
-        detail=best.summary(),
+        detail=best.summary()
+        + (f" [obs: {obs_path}]" if obs_path else ""),
     )
 
 
@@ -749,6 +762,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12")
     ap.add_argument("--json", default=None, help="append results to this file")
+    ap.add_argument("--obs", default=None,
+                    help="obs JSONL path: config 12 attaches the engine "
+                         "sink and emits per-tick telemetry there "
+                         "(opt-in: the instrumented ticks are labeled in "
+                         "the row's detail, so recorded numbers stay "
+                         "comparable with pre-obs rows by default)")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh first (dev path)")
     args = ap.parse_args(argv)
@@ -761,8 +780,9 @@ def main(argv=None) -> int:
     out: list = []
     rc = 0
     for c in (int(x) for x in args.configs.split(",")):
+        kw = {"obs_path": args.obs} if c == 12 else {}
         try:
-            CONFIGS[c](out)
+            CONFIGS[c](out, **kw)
         except Exception as e:  # keep going; report what failed
             print(f"# config {c} skipped: {e}", file=sys.stderr)
             rc = rc or (0 if isinstance(e, Needs) else 1)

@@ -17,9 +17,13 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Callable, Sequence
+from typing import Callable
 
 import jax
+
+# canonical home is obs.metrics (the observability subsystem owns the
+# timing-merge conventions); these aliases keep bench callers working
+from tpuscratch.obs.metrics import percentile, span_max_min  # noqa: F401
 
 
 @dataclasses.dataclass(frozen=True)
@@ -53,21 +57,6 @@ class BenchResult:
         if self.items:
             parts.append(f"{self.items_per_s:.3e} items/s")
         return ", ".join(parts)
-
-
-def percentile(xs: Sequence[float], q: float) -> float:
-    ys = sorted(xs)
-    if not ys:
-        raise ValueError("empty sample")
-    idx = min(len(ys) - 1, max(0, round(q / 100 * (len(ys) - 1))))
-    return ys[idx]
-
-
-def span_max_min(begins: Sequence[float], ends: Sequence[float]) -> float:
-    """Cross-rank wall time: max(end) - min(begin) (mpicuda3 convention)."""
-    if not begins or not ends:
-        raise ValueError("empty timestamp lists")
-    return max(ends) - min(begins)
 
 
 def _fence(out, mode: str):

@@ -10,6 +10,7 @@ reassembled array.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import jax
@@ -150,10 +151,15 @@ def checkpointed_stencil(
     impl: str = "xla",
     periodic: bool = True,
     keep: int = 3,
+    sink=None,
 ) -> np.ndarray:
     """``distributed_stencil`` with preemption survival: the tile state is
     checkpointed every ``save_every`` steps and the run RESUMES from the
     newest checkpoint in ``ckpt_dir`` when one exists.
+
+    ``sink`` (an ``obs.sink.Sink``) receives one ``halo/chunk`` event
+    per save chunk — step reached, fenced wall seconds, cell-updates/s —
+    the same telemetry the trainer emits per chunk.
 
     The reference runs under scheduler walltime kills with no way to
     continue (per-rank result dumps only, mpi-2d-stencil-subarray.cpp:62;
@@ -164,9 +170,11 @@ def checkpointed_stencil(
     tests/test_checkpoint_resume.py kills a run mid-flight to prove it).
     """
     from tpuscratch.runtime import checkpoint
+    from tpuscratch.obs.sink import NullSink
 
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
+    sink = sink if sink is not None else NullSink()
     mesh, topo, layout, spec = _setup(world.shape, mesh, halo, periodic)
 
     tiles = decompose(world, topo, layout)
@@ -181,18 +189,33 @@ def checkpointed_stencil(
             )
     state = jnp.asarray(tiles)
 
+    sink.emit(
+        "halo/config",
+        world_h=world.shape[0], world_w=world.shape[1], steps=steps,
+        impl=impl, mesh=f"{topo.dims[0]}x{topo.dims[1]}",
+        resumed_at=start,
+    )
+    cells = world.shape[0] * world.shape[1]
     programs: dict[int, object] = {}  # chunk size -> compiled program
     while start < steps:
         chunk = min(save_every, steps - start)
         if chunk not in programs:
             programs[chunk] = make_stencil_program(mesh, spec, chunk, coeffs, impl)
-        state = programs[chunk](state)
+        t0 = time.perf_counter()
+        state = jax.block_until_ready(programs[chunk](state))
+        chunk_s = time.perf_counter() - t0
         start += chunk
+        sink.emit(
+            "halo/chunk",
+            step=start, chunk=chunk, wall_s=round(chunk_s, 6),
+            cell_updates_per_s=round(cells * chunk / chunk_s, 3),
+        )
         checkpoint.save(
             ckpt_dir, start, np.asarray(state),
             metadata={"steps_total": steps, "impl": impl},
         )
         checkpoint.prune(ckpt_dir, keep)
+    sink.flush()
     return assemble(np.asarray(state), topo, layout)
 
 
@@ -204,11 +227,27 @@ def distributed_stencil(
     coeffs=(0.25, 0.25, 0.25, 0.25, 0.0),
     impl: str = "xla",
     periodic: bool = True,
+    sink=None,
 ) -> np.ndarray:
     """End-to-end convenience: decompose over the mesh (default: all
     devices, most-square), iterate, reassemble. A 1x1 mesh gives the
-    single-device periodic stencil (the self-wrap halo exchange)."""
+    single-device periodic stencil (the self-wrap halo exchange).
+    ``sink`` receives one ``halo/run`` event (fenced wall seconds,
+    cell-updates/s — compile included: this entry point runs once)."""
     mesh, topo, layout, spec = _setup(world.shape, mesh, halo, periodic)
     program = make_stencil_program(mesh, spec, steps, coeffs, impl)
-    out = program(jnp.asarray(decompose(world, topo, layout)))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(program(jnp.asarray(decompose(world, topo, layout))))
+    if sink is not None:
+        wall = time.perf_counter() - t0
+        sink.emit(
+            "halo/run",
+            world_h=world.shape[0], world_w=world.shape[1], steps=steps,
+            impl=impl, mesh=f"{topo.dims[0]}x{topo.dims[1]}",
+            wall_s=round(wall, 6),
+            cell_updates_per_s=round(
+                world.shape[0] * world.shape[1] * steps / wall, 3
+            ),
+        )
+        sink.flush()
     return assemble(np.asarray(out), topo, layout)

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Callable, Optional
 
 import jax
@@ -36,6 +37,8 @@ from tpuscratch.models.transformer import (
     train_step,
     train_step_adam,
 )
+from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
+from tpuscratch.obs.sink import NullSink
 from tpuscratch.runtime import checkpoint
 
 
@@ -88,12 +91,21 @@ def train(
     seed: int = 0,
     keep: int = 3,
     log: Callable[[str], None] = lambda s: None,
+    obs=None,
 ) -> tuple[dict, TrainReport]:
     """Run (or resume) ``steps`` training steps, checkpointing every
     ``save_every``. Returns (params, report). ``optimizer`` is 'sgd' or
     'adam'; Adam's moment state is checkpointed WITH the params (the
     full training state, sharded like the params), so resume is
-    bit-identical for both."""
+    bit-identical for both.
+
+    ``obs`` (an ``obs.sink.Sink``) turns on telemetry: one
+    ``train/chunk`` event per save chunk — loss, grad-norm, tokens/s,
+    step device time, compile count — plus a final ``train/run`` +
+    metrics snapshot.  The grad-norm output is only compiled into the
+    step when a sink is attached, so an uninstrumented run's program is
+    unchanged; either way a ``CompileCounter`` hooks the step body, so
+    retrace-freedom across a run is observable (tests assert == 1)."""
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if optimizer not in ("sgd", "adam"):
@@ -154,25 +166,56 @@ def train(
             params = state
         log(f"resumed at step {start} (meta {meta})")
 
+    sink = obs if obs is not None else NullSink()
+    want_gnorm = sink.enabled
+    metrics = MetricsRegistry()
+    counter = CompileCounter()
+    sink.emit(
+        "train/config",
+        steps=steps, lr=lr, optimizer=optimizer, batch=batch, seq=seq,
+        seed=seed, resumed_at=start, cfg=_cfg_fingerprint(cfg),
+    )
     if optimizer == "adam":
-        adam_fn = train_step_adam(mesh, cfg, lr=lr)
+        adam_fn = train_step_adam(mesh, cfg, lr=lr, counter=counter,
+                                  with_grad_norm=want_gnorm)
     else:
-        sgd_fn = train_step(mesh, cfg, lr=lr)
+        sgd_fn = train_step(mesh, cfg, lr=lr, counter=counter,
+                            with_grad_norm=want_gnorm)
     losses = []
     ran = 0
+    run_t0 = time.perf_counter()
     while start < steps:
         chunk = min(save_every, steps - start)
-        loss = None
+        loss = gnorm = None
+        t0 = time.perf_counter()
         for i in range(chunk):
             x, y = synthetic_batch(seed, start + i, batch, seq, cfg.d_model)
             if optimizer == "adam":
-                params, opt, loss = adam_fn(params, opt, x, y)
+                params, opt, loss, *rest = adam_fn(params, opt, x, y)
             else:
-                params, loss = sgd_fn(params, x, y)
+                params, loss, *rest = sgd_fn(params, x, y)
+            gnorm = rest[0] if rest else None
         start += chunk
         ran += chunk
         loss_f = float(jax.block_until_ready(loss))
+        chunk_s = time.perf_counter() - t0  # fenced by the loss readback
         losses.append(loss_f)
+        metrics.counter("train/steps").inc(chunk)
+        metrics.gauge("train/loss").set(loss_f)
+        metrics.histogram("train/step_s").observe(chunk_s / chunk)
+        metrics.gauge("train/compiles").set(counter.count)
+        chunk_ev = {
+            "step": start, "loss": loss_f,
+            "step_s": round(chunk_s / chunk, 6),
+            "steps_per_s": round(chunk / chunk_s, 3),
+            "tokens_per_s": round(chunk * batch * seq / chunk_s, 3),
+            "compiles": counter.count,
+        }
+        if gnorm is not None:
+            gnorm_f = float(gnorm)
+            chunk_ev["grad_norm"] = gnorm_f
+            metrics.gauge("train/grad_norm").set(gnorm_f)
+        sink.emit("train/chunk", **chunk_ev)
         state = (
             {"params": params, "opt": opt} if opt is not None else params
         )
@@ -186,4 +229,12 @@ def train(
         )
         checkpoint.prune(ckpt_dir, keep)
         log(f"step {start}/{steps}: loss {loss_f:.5f}")
+    sink.emit(
+        "train/run",
+        steps_run=ran, final_step=start,
+        wall_s=round(time.perf_counter() - run_t0, 6),
+        compiles=counter.count,
+    )
+    sink.emit_metrics(metrics.snapshot(), scope=metrics.id)
+    sink.flush()
     return params, TrainReport(ran, start, tuple(losses))

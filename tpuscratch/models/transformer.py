@@ -205,14 +205,37 @@ def _grad_reduce(grads, dp: str, sp: str):
     return jax.tree_util.tree_map_with_path(reduce_leaf, grads)
 
 
+def _grad_norm(grads, dp: str):
+    """Global L2 norm of the REDUCED (logical) gradient.  Non-expert
+    leaves are replicated after ``_grad_reduce``, so their local square
+    sum already is the logical one; expert leaves live dp-sharded (each
+    rank holds only its experts), so their square sums psum over dp.
+    The result is identical on every rank — the trainer's per-step
+    health signal (loss says whether learning works, grad-norm says
+    whether it is about to stop working)."""
+
+    def leaf_sq(path, g):
+        s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        return lax.psum(s, dp) if _is_expert_leaf(path) else s
+
+    sq = jax.tree_util.tree_map_with_path(leaf_sq, grads)
+    return jnp.sqrt(sum(jax.tree_util.tree_leaves(sq)))
+
+
 def train_step_fn(cfg: TransformerConfig, lr: float = 1e-2,
-                  sp: str = "sp", dp: str = "dp"):
-    """The shard_map body: (params, x, y) -> (new_params, loss)."""
+                  sp: str = "sp", dp: str = "dp",
+                  with_grad_norm: bool = False):
+    """The shard_map body: (params, x, y) -> (new_params, loss) — or
+    (new_params, loss, grad_norm) when ``with_grad_norm`` (the obs
+    trainer hook; a separate trace, so the uninstrumented program is
+    byte-identical to before)."""
 
     def step(params, x, y):
         loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
         grads = _grad_reduce(grads, dp, sp)
         new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
+        if with_grad_norm:
+            return new_params, loss, _grad_norm(grads, dp)
         return new_params, loss
 
     return step
@@ -261,8 +284,10 @@ def _adam_update(params, opt, grads, lr, b1, b2, eps):
 
 def train_step_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
                        b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
-                       sp: str = "sp", dp: str = "dp"):
-    """The shard_map body: (params, opt, x, y) -> (params, opt, loss).
+                       sp: str = "sp", dp: str = "dp",
+                       with_grad_norm: bool = False):
+    """The shard_map body: (params, opt, x, y) -> (params, opt, loss)
+    (+ grad_norm when ``with_grad_norm``).
 
     Adam is elementwise, so the per-shard update composes with any
     sharding as long as the moments shard like the params (they do, by
@@ -273,6 +298,8 @@ def train_step_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
         grads = _grad_reduce(grads, dp, sp)
         new_params, new_opt = _adam_update(params, opt, grads, lr, b1, b2,
                                            eps)
+        if with_grad_norm:
+            return new_params, new_opt, loss, _grad_norm(grads, dp)
         return new_params, new_opt, loss
 
     return step
@@ -287,18 +314,28 @@ def train_step_adam(
     eps: float = 1e-8,
     dp: str = "dp",
     sp: str = "sp",
+    with_grad_norm: bool = False,
+    counter=None,
 ):
     """:func:`train_step` with Adam: jit'd fn(params, opt_state, x, y)
     -> (params, opt_state, loss); ``opt_state`` from
-    :func:`init_adam_state`, moments sharded like their params."""
+    :func:`init_adam_state`, moments sharded like their params.
+    ``with_grad_norm`` appends the replicated grad-norm scalar;
+    ``counter`` (an ``obs.CompileCounter``) counts traces of the body —
+    the trainer's recompile detector."""
     _validate_step_config(mesh, cfg, dp, sp)
     pspec = param_spec(cfg, dp)
     ospec = adam_state_spec(cfg, dp)
+    body = train_step_adam_fn(cfg, lr, b1, b2, eps, sp=sp, dp=dp,
+                              with_grad_norm=with_grad_norm)
+    if counter is not None:
+        body = counter.wrap(body)
+    out = (pspec, ospec, P(), P()) if with_grad_norm else (pspec, ospec, P())
     return run_spmd(
         mesh,
-        train_step_adam_fn(cfg, lr, b1, b2, eps, sp=sp, dp=dp),
+        body,
         (pspec, ospec, P(dp, sp), P(dp, sp)),
-        (pspec, ospec, P()),
+        out,
     )
 
 
@@ -572,6 +609,8 @@ def train_step(
     lr: float = 1e-2,
     dp: str = "dp",
     sp: str = "sp",
+    with_grad_norm: bool = False,
+    counter=None,
 ):
     """Compiled training step over ``mesh`` (axes ``dp`` x ``sp``).
 
@@ -579,13 +618,20 @@ def train_step(
     (batch, seq, d_model) sharded P(dp, sp) and params laid out by
     ``param_spec``. The full composed surface — ring attention over sp,
     expert all_to_all over dp, grad, psum totals, SGD — is ONE XLA
-    program.
+    program.  ``with_grad_norm`` appends the replicated grad-norm
+    scalar to the outputs; ``counter`` (an ``obs.CompileCounter``)
+    counts traces of the body, the trainer's recompile detector.
     """
     _validate_step_config(mesh, cfg, dp, sp)
     pspec = param_spec(cfg, dp)
+    body = train_step_fn(cfg, lr, sp=sp, dp=dp,
+                         with_grad_norm=with_grad_norm)
+    if counter is not None:
+        body = counter.wrap(body)
+    out = (pspec, P(), P()) if with_grad_norm else (pspec, P())
     return run_spmd(
         mesh,
-        train_step_fn(cfg, lr, sp=sp, dp=dp),
+        body,
         (pspec, P(dp, sp), P(dp, sp)),
-        (pspec, P()),
+        out,
     )

@@ -1,0 +1,53 @@
+"""tpuscratch.obs — mesh-wide observability.
+
+The reference instruments everything by hand: clock() spans gathered to
+rank 0 under the max-min convention (mpicuda3.cu:176-179,315-325),
+MPI_Wtime segment brackets separating network from copy
+(mpi-pingpong-gpu.cpp:51-57), and explicit carve-outs for one-time setup
+cost (NO_GPU_MALLOC_TIME, mpicuda3.cu:221-240).  This package is that
+discipline grown into a subsystem, the operational loop production
+training fleets run (per-step device metrics, communication accounting,
+recompile detection):
+
+- **metrics** — a low-overhead host-side registry of counters / gauges /
+  histograms with mesh-aware cross-rank aggregation (reductions run
+  through ``comm.collectives`` on the mesh itself) and the max-min span
+  merge absorbed from ``runtime/profiling``; plus :class:`CompileCounter`,
+  the zero-steady-state-recompile hook promoted out of ``serve/decode``.
+- **ledger** — a static communication/compute ledger: walk a jitted
+  program's compiled HLO and ``cost_analysis()`` to report per-collective
+  counts and payload bytes, FLOPs and HBM traffic; analytic wire-byte
+  formulas (ring all-reduce moves ``2*(n-1)/n * bytes``) and an
+  achieved-fraction-of-roofline diff against measured span times.
+- **sink** — a per-host JSONL event sink with run metadata; every
+  instrumented layer (trainer, ServeEngine, halo drivers, benches)
+  writes through it.
+- **report** — ``python -m tpuscratch.obs.report run.jsonl`` collapses a
+  run's JSONL into a summary table.
+"""
+
+from tpuscratch.obs.metrics import (  # noqa: F401
+    CompileCounter,
+    Counter,
+    Gauge,
+    Histogram,
+    MeshSpan,
+    MetricsRegistry,
+    merge_snapshots,
+    mesh_reduce,
+    mesh_span,
+    span_max_min,
+)
+from tpuscratch.obs.ledger import (  # noqa: F401
+    CollectiveOp,
+    Ledger,
+    RooflineReport,
+    all_gather_wire_bytes,
+    all_to_all_wire_bytes,
+    analyze,
+    parse_collectives,
+    reduce_scatter_wire_bytes,
+    ring_all_reduce_wire_bytes,
+    roofline,
+)
+from tpuscratch.obs.sink import NullSink, Sink, open_sink  # noqa: F401

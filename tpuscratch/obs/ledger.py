@@ -1,0 +1,355 @@
+"""Static communication/compute ledger over compiled XLA programs.
+
+The reference REASONS about its communication cost in comments and
+derives bandwidth by hand from bytes it knows it sent
+(mpi-pingpong-gpu.cpp:51-57); here the compiled program itself is the
+source of truth.  ``analyze`` walks a jitted function's optimized HLO —
+every ``all-reduce`` / ``all-gather`` / ``all-to-all`` /
+``reduce-scatter`` / ``collective-permute`` the partitioner actually
+emitted, with payload bytes from the instruction's result shape and the
+participant count from its replica groups — plus XLA's
+``cost_analysis()`` for FLOPs and bytes-accessed (HBM traffic).
+
+Wire-byte accounting uses the standard analytic forms (validated
+against known collectives in tests/test_obs_ledger.py):
+
+- ring all-reduce moves ``2*(n-1)/n * payload`` per device
+  (reduce-scatter pass + all-gather pass);
+- all-gather ``(n-1)/n * result`` (each device receives all shards but
+  its own);
+- reduce-scatter ``(n-1) * shard`` (each device sends all but its own
+  share of its input);
+- all-to-all ``(n-1)/n * payload`` (everything except the self-block);
+- collective-permute ``payload`` (one hop, whole buffer).
+
+``roofline`` diffs the ledger against a MEASURED span time into an
+achieved-fraction report: what share of peak FLOP/s, HBM bandwidth, and
+link bandwidth the measured run reached, and which bound binds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence
+
+__all__ = [
+    "CollectiveOp",
+    "Ledger",
+    "RooflineReport",
+    "all_gather_wire_bytes",
+    "all_to_all_wire_bytes",
+    "analyze",
+    "parse_collectives",
+    "reduce_scatter_wire_bytes",
+    "ring_all_reduce_wire_bytes",
+    "roofline",
+]
+
+#: bytes per element for HLO shape dtypes
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]+)\[([0-9,]*)\]")
+
+#: one collective instruction: ``%name = <shape(s)> <op>(...)`` — the
+#: async ``-start`` spelling counts once, its ``-done`` not at all
+_COLL_RE = re.compile(
+    r"=\s*(?P<shape>\([^)]*\)|\S+)\s+"
+    r"(?P<kind>all-reduce|all-gather|all-to-all|reduce-scatter|"
+    r"collective-permute)(?P<start>-start)?\("
+)
+
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([0-9,]+)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[\s*\d+\s*,\s*(\d+)\s*\]")
+_PAIR_RE = re.compile(r"\{\d+\s*,\s*\d+\}")
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective instruction in the compiled program."""
+
+    kind: str          # all-reduce | all-gather | all-to-all |
+    #                    reduce-scatter | collective-permute
+    payload_bytes: int  # result payload (tuple results summed)
+    group_size: int    # ranks per replica group (pair count for permute)
+
+    @property
+    def wire_bytes(self) -> float:
+        """Analytic per-device wire traffic for this op (see module
+        docstring for the formulas and what each payload refers to)."""
+        n, b = self.group_size, self.payload_bytes
+        if n <= 1:
+            return 0.0
+        if self.kind == "all-reduce":
+            return ring_all_reduce_wire_bytes(n, b)
+        if self.kind == "all-gather":
+            # result is the FULL gathered array: n shards of b/n each
+            return all_gather_wire_bytes(n, b // n)
+        if self.kind == "reduce-scatter":
+            # result is one shard
+            return reduce_scatter_wire_bytes(n, b)
+        if self.kind == "all-to-all":
+            return all_to_all_wire_bytes(n, b)
+        return float(b)  # collective-permute: one hop, whole buffer
+
+
+def ring_all_reduce_wire_bytes(n: int, payload: int) -> float:
+    """Ring all-reduce per-device traffic: ``2*(n-1)/n * payload``
+    (a reduce-scatter pass then an all-gather pass, each moving
+    ``(n-1)/n`` of the buffer)."""
+    return 2.0 * (n - 1) / n * payload
+
+
+def all_gather_wire_bytes(n: int, shard_bytes: int) -> float:
+    """All-gather per-device traffic: ``(n-1) * shard`` (receive every
+    shard but your own)."""
+    return float((n - 1) * shard_bytes)
+
+
+def reduce_scatter_wire_bytes(n: int, shard_bytes: int) -> float:
+    """Reduce-scatter per-device traffic: ``(n-1) * shard`` (send all
+    but your own share)."""
+    return float((n - 1) * shard_bytes)
+
+
+def all_to_all_wire_bytes(n: int, payload: int) -> float:
+    """All-to-all per-device traffic: ``(n-1)/n * payload`` (every block
+    except the one staying home)."""
+    return (n - 1) / n * payload
+
+
+def _data_shapes(token: str) -> list[int]:
+    """Byte sizes of every non-scalar data shape in an HLO shape token
+    (``f32[4,8]{1,0}`` or a tuple); layouts ignored, scalar shapes
+    dropped (async ops carry ``u32[]`` context scalars that are not
+    payload)."""
+    sizes = []
+    for dtype, dims in _SHAPE_RE.findall(token):
+        if dtype not in _DTYPE_BYTES or not dims:
+            continue  # token-shaped operand or context scalar: not data
+        elems = 1
+        for d in dims.split(","):
+            elems *= int(d)
+        sizes.append(elems * _DTYPE_BYTES[dtype])
+    return sizes
+
+
+def _payload_bytes(kind: str, start: bool, token: str) -> int:
+    """Result-payload bytes of one collective instruction.
+
+    Sync spellings SUM the result shapes: a plain shape is its own sum,
+    all-to-all tuples are per-peer pieces, and combined variadic
+    collectives (XLA's AllReduceCombiner fusing many gradient psums into
+    one instruction) are the concatenation of their operands' results.
+    Async ``-start`` spellings return ``(operands..., results...,
+    contexts...)``; the result is recovered per kind: the largest buffer
+    for all-gather (result = n x operand) and the equal-shaped
+    all-reduce / collective-permute, the smallest for reduce-scatter
+    (result = operand / n), half the data total for all-to-all (operand
+    halves mirror result halves)."""
+    sizes = _data_shapes(token)
+    if not sizes:
+        return 0
+    if not start:
+        return sum(sizes)
+    if kind == "reduce-scatter":
+        return min(sizes)
+    if kind == "all-to-all":
+        return sum(sizes) // 2
+    return max(sizes)
+
+
+def parse_collectives(hlo_text: str) -> tuple[CollectiveOp, ...]:
+    """Every collective instruction in optimized-HLO text, in program
+    order.  Handles sync and async (``-start``/``-done``) spellings —
+    a ``-start``'s tuple result carries operand AND result buffers, so
+    the payload is recovered per kind (see :func:`_payload_bytes`)
+    rather than summed — and both replica-group formats (explicit
+    ``{{0,1},{2,3}}`` and iota ``[groups,size]<=[n]``)."""
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _COLL_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group("kind")
+        if kind == "collective-permute":
+            _, _, tail = line.partition("source_target_pairs=")
+            group = len(_PAIR_RE.findall(tail)) or 1
+        else:
+            g = _GROUPS_RE.search(line)
+            if g is not None:
+                group = len(g.group(1).split(","))
+            else:
+                gi = _GROUPS_IOTA_RE.search(line)
+                group = int(gi.group(1)) if gi else 1
+        ops.append(
+            CollectiveOp(
+                kind=kind,
+                payload_bytes=_payload_bytes(
+                    kind, m.group("start") is not None, m.group("shape")
+                ),
+                group_size=group,
+            )
+        )
+    return tuple(ops)
+
+
+@dataclasses.dataclass(frozen=True)
+class Ledger:
+    """What one compiled program does, statically: its collectives, and
+    XLA's per-execution cost model (flops / bytes accessed are
+    ``cost_analysis()`` numbers; absent keys come through as 0.0)."""
+
+    collectives: tuple[CollectiveOp, ...]
+    flops: float
+    bytes_accessed: float
+
+    def counts(self) -> dict[str, int]:
+        """{collective kind: instruction count}."""
+        out: dict[str, int] = {}
+        for op in self.collectives:
+            out[op.kind] = out.get(op.kind, 0) + 1
+        return out
+
+    def payload_bytes(self) -> dict[str, int]:
+        """{collective kind: summed result-payload bytes}."""
+        out: dict[str, int] = {}
+        for op in self.collectives:
+            out[op.kind] = out.get(op.kind, 0) + op.payload_bytes
+        return out
+
+    def wire_bytes(self) -> dict[str, float]:
+        """{collective kind: summed analytic per-device wire bytes}."""
+        out: dict[str, float] = {}
+        for op in self.collectives:
+            out[op.kind] = out.get(op.kind, 0.0) + op.wire_bytes
+        return out
+
+    def total_wire_bytes(self) -> float:
+        return sum(op.wire_bytes for op in self.collectives)
+
+    def summary(self) -> str:
+        lines = [
+            f"flops/exec: {self.flops:.3e}   "
+            f"bytes accessed: {self.bytes_accessed:.3e}"
+        ]
+        counts, wire = self.counts(), self.wire_bytes()
+        for kind in sorted(counts):
+            lines.append(
+                f"{kind}: {counts[kind]} op(s), "
+                f"payload {self.payload_bytes()[kind]} B, "
+                f"wire ~{wire[kind]:.0f} B/device"
+            )
+        if not counts:
+            lines.append("no collectives")
+        return "\n".join(lines)
+
+
+def _cost_entry(compiled) -> dict:
+    """Normalize ``compiled.cost_analysis()`` across jax versions: it has
+    returned a dict, a list of one dict per partition, and None."""
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
+def analyze(fn, *args, **kwargs) -> Ledger:
+    """Ledger of a jittable: ``fn`` is a jitted function (anything with
+    ``.lower``), lowered and compiled against ``*args``/``**kwargs``
+    (abstract shapes suffice — values are never executed)."""
+    if not hasattr(fn, "lower"):
+        import jax
+
+        fn = jax.jit(fn)
+    compiled = fn.lower(*args, **kwargs).compile()
+    cost = _cost_entry(compiled)
+    return Ledger(
+        collectives=parse_collectives(compiled.as_text()),
+        flops=float(cost.get("flops", 0.0)),
+        bytes_accessed=float(cost.get("bytes accessed", 0.0)),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class RooflineReport:
+    """A static ledger diffed against one MEASURED span: achieved rates
+    and their fraction of the stated peaks.  Fractions are None when the
+    corresponding peak was not given."""
+
+    measured_s: float
+    flops_per_s: float
+    hbm_bytes_per_s: float
+    wire_bytes_per_s: float
+    flops_fraction: Optional[float]
+    hbm_fraction: Optional[float]
+    wire_fraction: Optional[float]
+
+    @property
+    def bound(self) -> str:
+        """Which stated peak the run came closest to saturating."""
+        cands = {
+            "compute": self.flops_fraction,
+            "memory": self.hbm_fraction,
+            "network": self.wire_fraction,
+        }
+        cands = {k: v for k, v in cands.items() if v is not None}
+        if not cands:
+            return "unknown"
+        return max(cands, key=cands.get)
+
+    def summary(self) -> str:
+        def pct(f):
+            return "n/a" if f is None else f"{100 * f:.1f}%"
+
+        return (
+            f"measured {self.measured_s * 1e3:.3f} ms: "
+            f"{self.flops_per_s / 1e12:.3f} TFLOP/s "
+            f"({pct(self.flops_fraction)} of peak), "
+            f"HBM {self.hbm_bytes_per_s / 1e9:.2f} GB/s "
+            f"({pct(self.hbm_fraction)}), "
+            f"wire {self.wire_bytes_per_s / 1e9:.2f} GB/s "
+            f"({pct(self.wire_fraction)}) -> {self.bound}-bound"
+        )
+
+
+def roofline(
+    ledger: Ledger,
+    measured_s: float,
+    executions: int = 1,
+    peak_flops_per_s: Optional[float] = None,
+    peak_hbm_bytes_per_s: Optional[float] = None,
+    peak_wire_bytes_per_s: Optional[float] = None,
+) -> RooflineReport:
+    """Diff the static ledger against a measured wall time (one span
+    covering ``executions`` runs of the program): achieved FLOP/s, HBM
+    GB/s, and wire GB/s, each as a fraction of the given peak — the
+    "what fraction of the roofline did we reach, and which ceiling is
+    it" report every perf PR argues from."""
+    if measured_s <= 0:
+        raise ValueError(f"measured_s must be > 0, got {measured_s}")
+    flops_rate = ledger.flops * executions / measured_s
+    hbm_rate = ledger.bytes_accessed * executions / measured_s
+    wire_rate = ledger.total_wire_bytes() * executions / measured_s
+
+    def frac(rate, peak):
+        return None if peak is None else rate / peak
+
+    return RooflineReport(
+        measured_s=measured_s,
+        flops_per_s=flops_rate,
+        hbm_bytes_per_s=hbm_rate,
+        wire_bytes_per_s=wire_rate,
+        flops_fraction=frac(flops_rate, peak_flops_per_s),
+        hbm_fraction=frac(hbm_rate, peak_hbm_bytes_per_s),
+        wire_fraction=frac(wire_rate, peak_wire_bytes_per_s),
+    )

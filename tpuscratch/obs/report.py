@@ -1,0 +1,188 @@
+"""Collapse an obs JSONL run into a summary table.
+
+``python -m tpuscratch.obs.report run.jsonl [run.h1.jsonl ...]
+[--event serve/tick] [--json]``
+
+Reads one or more per-host sink files (``obs.sink.Sink`` output), groups
+events by kind, and prints per-event counts plus min/p50/mean/max for
+every numeric field — the rank-0 "gather the per-rank numbers and print
+the table" step of the reference's drivers (mpicuda3.cu:315-325), run
+after the fact over the artifact instead of inside the job.
+
+``metrics`` events (registry snapshots) are folded with
+``obs.metrics.merge_snapshots`` semantics: the LAST snapshot per
+(file, scope) wins — snapshots of one registry are cumulative, and
+``scope`` (``Sink.emit_metrics(..., scope=registry.id)``) identifies the
+registry — then the survivors merge across scopes and hosts (distinct
+registries are disjoint populations: one engine per batch size in a
+sweep, one trainer per run).
+
+This module's own imports are light (json/argparse + the stdlib-only
+``obs.metrics``); running it as ``python -m tpuscratch.obs.report``
+still executes the ``tpuscratch`` package init (which imports jax), so
+the CLI needs the framework's environment — the summarize/format
+functions themselves are importable into any tool that has the package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Iterable, Optional
+
+from tpuscratch.obs.metrics import merge_snapshots, percentile
+
+__all__ = ["load_events", "summarize", "format_table", "main"]
+
+
+def load_events(paths: Iterable[str]) -> list[dict]:
+    """All events from the given JSONL files, in file order.  Blank
+    lines are skipped; a malformed line raises with its location (a
+    truncated artifact should fail loudly, not summarize silently)."""
+    events = []
+    for path in paths:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"{path}:{lineno}: not JSON ({e.msg})"
+                    ) from None
+                rec["_file"] = path
+                events.append(rec)
+    return events
+
+
+def summarize(events: list[dict],
+              only_event: Optional[str] = None) -> dict:
+    """{event kind: {"count": n, "fields": {field: stats}}} plus a
+    merged ``"metrics"`` entry (cross-host merge of each file's last
+    registry snapshot) and the ``"run"`` metadata events verbatim."""
+    by_kind: dict[str, list[dict]] = {}
+    # (file, scope) -> newest snapshot of that registry
+    last_snapshot: dict[tuple, dict] = {}
+    runs = []
+    for rec in events:
+        kind = rec.get("event", "?")
+        if kind == "run":
+            runs.append({k: v for k, v in rec.items()
+                         if not k.startswith("_")})
+            continue
+        if kind == "metrics" and isinstance(rec.get("metrics"), dict):
+            last_snapshot[(rec["_file"], rec.get("scope"))] = rec["metrics"]
+            continue
+        if only_event is not None and kind != only_event:
+            continue
+        by_kind.setdefault(kind, []).append(rec)
+
+    out: dict = {"runs": runs, "events": {}}
+    for kind, recs in sorted(by_kind.items()):
+        fields: dict[str, list[float]] = {}
+        for rec in recs:
+            for key, val in rec.items():
+                if key in ("event", "t") or key.startswith("_"):
+                    continue
+                if isinstance(val, bool) or not isinstance(
+                    val, (int, float)
+                ):
+                    continue
+                fields.setdefault(key, []).append(float(val))
+        out["events"][kind] = {
+            "count": len(recs),
+            "fields": {
+                key: {
+                    "min": min(vals),
+                    "p50": percentile(vals, 50),
+                    "mean": sum(vals) / len(vals),
+                    "max": max(vals),
+                }
+                for key, vals in sorted(fields.items())
+            },
+        }
+    if last_snapshot:
+        out["metrics"] = merge_snapshots(last_snapshot.values())
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v != v:  # nan
+        return "nan"
+    if v == 0:
+        return "0"
+    if abs(v) >= 1e5 or abs(v) < 1e-3:
+        return f"{v:.3e}"
+    return f"{v:.6g}"
+
+
+def format_table(summary: dict) -> str:
+    """The human rendering: one block per event kind, one row per
+    numeric field."""
+    lines = []
+    for run in summary.get("runs", []):
+        meta = " ".join(
+            f"{k}={run[k]}" for k in sorted(run) if k not in ("event", "t")
+        )
+        lines.append(f"run: {meta}")
+    for kind, info in summary.get("events", {}).items():
+        lines.append(f"\n{kind}  (n={info['count']})")
+        fields = info["fields"]
+        if fields:
+            width = max(len(k) for k in fields)
+            lines.append(
+                f"  {'field'.ljust(width)}  {'min':>12} {'p50':>12} "
+                f"{'mean':>12} {'max':>12}"
+            )
+            for key, st in fields.items():
+                lines.append(
+                    f"  {key.ljust(width)}  {_fmt(st['min']):>12} "
+                    f"{_fmt(st['p50']):>12} {_fmt(st['mean']):>12} "
+                    f"{_fmt(st['max']):>12}"
+                )
+    metrics = summary.get("metrics")
+    if metrics:
+        lines.append("\nmetrics (final snapshot, merged across hosts)")
+        width = max(len(k) for k in metrics)
+        for name, m in metrics.items():
+            kind = m.get("kind", "?")
+            if kind == "counter":
+                detail = f"count {_fmt(m['value'])}"
+            elif kind == "gauge":
+                detail = (
+                    f"value {_fmt(m['value'])}  "
+                    f"[min {_fmt(m['min'])}, max {_fmt(m['max'])}]"
+                )
+            else:
+                detail = (
+                    f"n {m.get('count', 0)}  mean {_fmt(m.get('mean', 0.0))}"
+                    f"  [min {_fmt(m.get('min', 0.0))}, "
+                    f"max {_fmt(m.get('max', 0.0))}]"
+                )
+            lines.append(f"  {name.ljust(width)}  {kind:<9} {detail}")
+    return "\n".join(lines) if lines else "no events"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuscratch.obs.report", description=__doc__
+    )
+    ap.add_argument("paths", nargs="+", help="obs JSONL file(s)")
+    ap.add_argument("--event", default=None,
+                    help="only summarize this event kind")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    summary = summarize(load_events(args.paths), only_event=args.event)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print(format_table(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

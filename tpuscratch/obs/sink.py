@@ -1,0 +1,135 @@
+"""Per-host JSONL event sink with run metadata.
+
+One line per event, one file per host — the structured replacement for
+the reference's rank-prefixed printf logging (its per-rank coord-named
+dump files, generalized from result arrays to telemetry).  The first
+line of every file is a ``run`` event carrying the run metadata
+(argv-ish identity: who wrote this file, when, with what config), so a
+bare JSONL artifact is self-describing and ``obs.report`` can collapse
+it without side channels.
+
+Writes are buffered (``flush_every`` events) and each event costs one
+dict build + one ``json.dumps`` — cheap enough to emit per engine tick.
+``NullSink`` is the disabled path: every emit is a constant-time no-op,
+so instrumented layers hold a sink unconditionally instead of
+``if sink is not None`` at every site.
+
+This module deliberately does not import jax — host-side tooling built
+on it stays cheap to import and jax-decoupled (the package init still
+imports jax, so module-level lightness is about import cost, not a
+jax-free CLI).  The per-host process index is whatever the caller
+passes (``ServeEngine``/``trainer`` pass ``jax.process_index()``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+__all__ = ["NullSink", "Sink", "open_sink"]
+
+
+class NullSink:
+    """The disabled sink: accepts every emit, writes nothing."""
+
+    enabled = False
+    path = None
+
+    def emit(self, event: str, **fields) -> None:
+        pass
+
+    def emit_metrics(self, snapshot: dict, event: str = "metrics",
+                     scope=None) -> None:
+        pass
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self) -> "NullSink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+class Sink:
+    """Append-only JSONL event writer.
+
+    ``run`` metadata is written as the file's first event.  ``host``
+    disambiguates multi-host runs: a non-zero host suffixes the filename
+    (``run.jsonl`` -> ``run.h3.jsonl``) so hosts never interleave writes
+    in one file — the per-host half of "per-host JSONL sink"; merging is
+    the reader's job (``obs.report`` accepts several files).
+    """
+
+    enabled = True
+
+    def __init__(self, path: str, run: Optional[dict] = None,
+                 host: int = 0, flush_every: int = 64) -> None:
+        if host:
+            root, ext = os.path.splitext(path)
+            path = f"{root}.h{host}{ext or '.jsonl'}"
+        self.path = path
+        self.host = host
+        self._buf: list[str] = []
+        self._flush_every = max(1, flush_every)
+        self._t0 = time.time()
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._f = open(path, "a")
+        self.emit("run", host=host, **(run or {}))
+
+    def emit(self, event: str, **fields) -> None:
+        """One JSONL line: ``{"event": ..., "t": <s since sink open>,
+        **fields}``.  Fields must be JSON-serializable."""
+        rec = {"event": event, "t": round(time.time() - self._t0, 6)}
+        rec.update(fields)
+        self._buf.append(json.dumps(rec))
+        if len(self._buf) >= self._flush_every:
+            self.flush()
+
+    def emit_metrics(self, snapshot: dict, event: str = "metrics",
+                     scope=None) -> None:
+        """A registry snapshot (``MetricsRegistry.snapshot()``) as one
+        event, metrics nested under ``"metrics"``.  ``scope`` (usually
+        ``MetricsRegistry.id``) names WHICH registry this is a snapshot
+        of: a reader keeps only the newest snapshot per scope (they are
+        cumulative) but merges across scopes (distinct registries, e.g.
+        one engine per batch size in a sweep)."""
+        if scope is None:
+            self.emit(event, metrics=snapshot)
+        else:
+            self.emit(event, metrics=snapshot, scope=scope)
+
+    def flush(self) -> None:
+        if self._buf:
+            self._f.write("\n".join(self._buf) + "\n")
+            self._buf.clear()
+        self._f.flush()
+
+    def close(self) -> None:
+        if not self._f.closed:
+            self.flush()
+            self._f.close()
+
+    def __enter__(self) -> "Sink":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def open_sink(path: Optional[str], run: Optional[dict] = None,
+              host: int = 0, **kw):
+    """``Sink`` when ``path`` is set, ``NullSink`` otherwise — the one
+    construction idiom every instrumented layer uses, so "no obs
+    requested" costs a no-op object rather than branches at call sites."""
+    if path is None:
+        return NullSink()
+    return Sink(path, run=run, host=host, **kw)

@@ -18,6 +18,20 @@ import jax
 from jax import lax
 
 
+def profiler_trace_supported() -> bool:
+    """Whether ``jax.profiler.start_trace``/``stop_trace`` exist on this
+    jax.  Existence is necessary but not sufficient — on some images the
+    call itself fails at runtime (missing profiler backend), so
+    ``runtime.profiling.trace`` ALSO guards the call and degrades to a
+    warned no-op span; this predicate is the cheap static half."""
+    prof = getattr(jax, "profiler", None)
+    return (
+        prof is not None
+        and hasattr(prof, "start_trace")
+        and hasattr(prof, "stop_trace")
+    )
+
+
 def _install() -> None:
     if not hasattr(lax, "axis_size"):
 
@@ -42,6 +56,16 @@ def _install() -> None:
             )
 
         jax.shard_map = shard_map  # type: ignore[attr-defined]
+
+    if not hasattr(getattr(jax, "profiler", object()), "TraceAnnotation"):
+        import contextlib
+
+        # profiler timeline annotations are decorative: absent support
+        # degrades to a no-op context, keeping annotate() callers working
+        if hasattr(jax, "profiler"):
+            jax.profiler.TraceAnnotation = (  # type: ignore[attr-defined]
+                lambda name, **kw: contextlib.nullcontext()
+            )
 
     try:
         from jax.experimental.pallas import tpu as pltpu

@@ -15,6 +15,11 @@ conventions and adds what the XLA runtime offers beyond them:
 - ``trace``: context manager around ``jax.profiler`` emitting a
   TensorBoard-readable XLA trace (device timelines, fusion names) — the
   part clock() could never see.
+
+The merge conventions themselves now live in ``tpuscratch.obs.metrics``
+(the observability subsystem): ``cross_rank_span`` delegates to its
+``span_max_min``, and ``obs.metrics.mesh_span`` is the device-side
+variant that runs the max/min through the mesh collectives.
 """
 
 from __future__ import annotations
@@ -22,11 +27,12 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
+import warnings
 from typing import Iterator
 
 import jax
 
-from tpuscratch.bench.timing import span_max_min
+from tpuscratch.obs.metrics import span_max_min
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,8 +91,34 @@ def cross_rank_span(timelines: list[Timeline], name: str) -> float:
 
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
-    """XLA profiler trace (TensorBoard format) around a block of work."""
-    jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    """XLA profiler trace (TensorBoard format) around a block of work.
+
+    When trace support is unavailable on this jax — the API absent
+    (``compat.profiler_trace_supported``) or ``start_trace`` itself
+    failing at runtime, as on images whose jax 0.4.37 ships without a
+    working profiler backend — the bracket degrades to a no-op span with
+    a logged warning instead of killing the instrumented run: profiling
+    must never be the thing that takes serving down."""
+    from tpuscratch.runtime import compat
+
+    if not compat.profiler_trace_supported():
+        warnings.warn(
+            "jax.profiler trace support unavailable on this jax; "
+            "runtime.profiling.trace degraded to a no-op span",
+            RuntimeWarning, stacklevel=3,
+        )
+        yield
+        return
+    try:
+        jax.profiler.start_trace(logdir, create_perfetto_link=False)
+    except Exception as e:
+        warnings.warn(
+            f"jax.profiler.start_trace failed ({e}); trace degraded to "
+            "a no-op span",
+            RuntimeWarning, stacklevel=3,
+        )
+        yield
+        return
     try:
         yield
     finally:
@@ -94,5 +126,11 @@ def trace(logdir: str) -> Iterator[None]:
 
 
 def annotate(name: str):
-    """Named region visible in profiler timelines (TraceAnnotation)."""
-    return jax.profiler.TraceAnnotation(name)
+    """Named region visible in profiler timelines (TraceAnnotation; a
+    no-op context on jax builds without it — including builds with no
+    ``jax.profiler`` module at all, where compat's attribute fallback
+    has nothing to hang off)."""
+    prof = getattr(jax, "profiler", None)
+    if prof is None or not hasattr(prof, "TraceAnnotation"):
+        return contextlib.nullcontext()
+    return prof.TraceAnnotation(name)

@@ -49,20 +49,10 @@ from tpuscratch.parallel.scores import masked_scores, masked_softmax
 from tpuscratch.serve.kvcache import CacheGeometry, kv_cache_spec
 
 
-class CompileCounter:
-    """Counts traces of a jitted program body.  jax retraces exactly on
-    compilation-cache misses, so the count IS the compile count — the
-    hook the engine's steady-state zero-recompile assertion reads."""
-
-    def __init__(self) -> None:
-        self.count = 0
-
-    def wrap(self, fn):
-        def counted(*args):
-            self.count += 1
-            return fn(*args)
-
-        return counted
+# promoted to the observability subsystem (recompile detection is not a
+# serving-only concern — the trainer's no-retrace coverage uses it too);
+# re-exported here so serve-side imports keep working
+from tpuscratch.obs.metrics import CompileCounter  # noqa: F401,E402
 
 
 def check_serve_mesh(mesh: Mesh, cfg: TransformerConfig,

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -35,9 +36,10 @@ import numpy as np
 from jax.sharding import Mesh
 
 from tpuscratch.models.transformer import TransformerConfig, init_params
+from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
+from tpuscratch.obs.sink import NullSink
 from tpuscratch.runtime.profiling import Timeline
 from tpuscratch.serve.decode import (
-    CompileCounter,
     build_decode_step,
     build_prefill,
     check_serve_mesh,
@@ -129,12 +131,18 @@ class ServeEngine:
     Slot ``s`` belongs to dp group ``s // (n_slots / dp_size)`` — the
     contiguous chunk P(dp) sharding hands that group — and its pages come
     from that group's own :class:`PageAllocator` (ids are group-local,
-    matching the dp-sharded pages axis of the cache)."""
+    matching the dp-sharded pages axis of the cache).
+
+    ``sink`` (an ``obs.sink.Sink``; default the no-op ``NullSink``)
+    receives one ``serve/tick`` event per tick plus a ``serve/report`` +
+    metrics snapshot per drain; ``self.metrics`` is the live
+    ``obs.MetricsRegistry`` regardless of sink."""
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig, scfg: ServeConfig,
                  params: Optional[dict] = None,
                  embed: Optional[jax.Array] = None,
-                 dp: str = "dp", sp: str = "sp"):
+                 dp: str = "dp", sp: str = "sp",
+                 sink=None):
         check_serve_mesh(mesh, cfg, dp, sp)
         self._dp_size = mesh.shape[dp]
         if scfg.n_slots % self._dp_size:
@@ -174,6 +182,20 @@ class ServeEngine:
         self._seen_rids: set[int] = set()
         self._seed_key = jax.random.key(scfg.seed)
         self.timeline = Timeline()
+        # observability: every tick updates the registry (host-side
+        # attribute writes, < 2% of a compiled step) and, when a sink is
+        # attached, emits one JSONL event — queue depth, free-page
+        # watermark, tick latency, insert/evict counts, compile counts
+        self.metrics = MetricsRegistry()
+        self.sink = sink if sink is not None else NullSink()
+        self._tick = 0
+        self.sink.emit(
+            "serve/engine",
+            n_slots=scfg.n_slots, n_pages=scfg.n_pages,
+            page_size=scfg.page_size, max_seq=scfg.max_seq,
+            dp_size=self._dp_size, n_layers=cfg.n_layers,
+            n_heads=cfg.n_heads, d_model=cfg.d_model,
+        )
         self.decode_counter = CompileCounter()
         self.prefill_counter = CompileCounter()
         self._decode = build_decode_step(
@@ -338,7 +360,49 @@ class ServeEngine:
     def step(self) -> list[tuple[int, tuple[int, ...]]]:
         """One engine tick: admit what fits, decode one token for every
         active slot, evict what finished.  Returns the finished
-        ``(rid, tokens)`` pairs."""
+        ``(rid, tokens)`` pairs.  Each tick updates ``self.metrics``
+        (tick latency, queue depth, free-page watermark, insert/evict
+        counts, compile counts) and emits one sink event."""
+        t0 = time.perf_counter()
+        prefills0 = self._prefill_count
+        tokens0 = self._tokens_generated
+        finished = self._tick_inner()
+        self._observe_tick(
+            time.perf_counter() - t0,
+            inserted=self._prefill_count - prefills0,
+            evicted=len(finished),
+            tokens=self._tokens_generated - tokens0,
+        )
+        return finished
+
+    def _observe_tick(self, tick_s: float, inserted: int, evicted: int,
+                      tokens: int) -> None:
+        m = self.metrics
+        self._tick += 1
+        free_min = min(a.n_free for a in self._allocators)
+        m.histogram("serve/tick_s").observe(tick_s)
+        m.gauge("serve/queue_depth").set(self.n_queued)
+        m.gauge("serve/active_slots").set(self.n_active)
+        # per-group minimum: Gauge.min is the run's free-page watermark,
+        # the admission-control headroom signal
+        m.gauge("serve/free_pages").set(free_min)
+        m.counter("serve/inserts").inc(inserted)
+        m.counter("serve/evictions").inc(evicted)
+        m.counter("serve/tokens").inc(tokens)
+        m.gauge("serve/decode_compiles").set(self.decode_counter.count)
+        m.gauge("serve/prefill_compiles").set(self.prefill_counter.count)
+        if self.sink.enabled:  # skip the event build on the no-obs path
+            self.sink.emit(
+                "serve/tick",
+                tick=self._tick, tick_s=round(tick_s, 6),
+                queue_depth=self.n_queued, active=self.n_active,
+                free_pages_min=free_min,
+                inserted=inserted, evicted=evicted, tokens=tokens,
+                decode_compiles=self.decode_counter.count,
+                prefill_compiles=self.prefill_counter.count,
+            )
+
+    def _tick_inner(self) -> list[tuple[int, tuple[int, ...]]]:
         finished = []
         while self._queue:
             slot = self._find_slot(self._queue[0])
@@ -424,6 +488,25 @@ class ServeEngine:
             for rid, toks in self.step():
                 outputs[rid] = toks
             steps += 1
+        report = self._report(outputs, tokens0, decode0, prefill0,
+                              prefill_s0, decode_s0)
+        self.sink.emit(
+            "serve/report",
+            completed=report.completed,
+            tokens_generated=report.tokens_generated,
+            decode_steps=report.decode_steps, prefills=report.prefills,
+            decode_compiles=report.decode_compiles,
+            prefill_compiles=report.prefill_compiles,
+            prefill_s=round(report.prefill_s, 6),
+            decode_s=round(report.decode_s, 6),
+        )
+        self.sink.emit_metrics(self.metrics.snapshot(),
+                               scope=self.metrics.id)
+        self.sink.flush()
+        return report
+
+    def _report(self, outputs, tokens0, decode0, prefill0, prefill_s0,
+                decode_s0) -> GenerateReport:
         return GenerateReport(
             completed=len(outputs),
             tokens_generated=self._tokens_generated - tokens0,
