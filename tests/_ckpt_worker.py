@@ -1,8 +1,16 @@
-"""Worker for the kill/resume checkpoint test (not a test module).
+"""Worker for the kill/resume checkpoint tests (not a test module).
 
-Runs ``checkpointed_stencil`` and, when TPUSCRATCH_DIE_AFTER_SAVES is
-set, hard-exits (os._exit — no cleanup, the closest deterministic stand-in
-for a scheduler SIGKILL) after that many checkpoint saves. Usage:
+Runs ``checkpointed_stencil`` and dies mid-flight when asked:
+
+- ``TPUSCRATCH_DIE_AFTER_SAVES=<n>`` hard-exits (os._exit — no cleanup,
+  the deterministic stand-in for a scheduler SIGKILL) after the n-th
+  checkpoint save completes;
+- ``TPUSCRATCH_CHAOS_KILL=<stage>:<save_idx>`` SIGKILLs the process AT a
+  named stage INSIDE ``checkpoint.save`` on the given save occurrence,
+  through the ft chaos hook — the kill-mid-save matrix (every internal
+  stage must leave a valid resumable step behind).
+
+Usage:
 
     python tests/_ckpt_worker.py <ckpt_dir> <steps> <save_every>
 """
@@ -12,6 +20,7 @@ import sys
 
 ckpt_dir, steps, save_every = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
 die_after = int(os.environ.get("TPUSCRATCH_DIE_AFTER_SAVES", "0"))
+chaos_kill = os.environ.get("TPUSCRATCH_CHAOS_KILL", "")
 
 from tpuscratch.runtime.hostenv import force_cpu_devices
 
@@ -37,11 +46,20 @@ if die_after:
 
     checkpoint.save = killing_save
 
+chaos = None
+if chaos_kill:
+    from tpuscratch.ft.chaos import ChaosPlan, Fault
+
+    stage, save_idx = chaos_kill.rsplit(":", 1)
+    chaos = ChaosPlan(0, [
+        Fault("ckpt/save", stage=stage, at=(int(save_idx),), kind="kill"),
+    ])
+
 rng = np.random.default_rng(123)  # same world every invocation
 world = rng.standard_normal((16, 16)).astype(np.float32)
 out = driver.checkpointed_stencil(
     world, steps=steps, ckpt_dir=ckpt_dir, save_every=save_every,
-    mesh=make_mesh_2d((2, 2)),
+    mesh=make_mesh_2d((2, 2)), chaos=chaos,
 )
 np.save(os.path.join(ckpt_dir, "result.npy"), out)
 print(f"WORKER done at step {checkpoint.latest_step(ckpt_dir)}", flush=True)

@@ -14,12 +14,14 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 WORKER = pathlib.Path(__file__).parent / "_ckpt_worker.py"
 REPO = pathlib.Path(__file__).parent.parent
 
 
-def _run_worker(ckpt_dir, steps, save_every, die_after=0, timeout=180):
+def _run_worker(ckpt_dir, steps, save_every, die_after=0, chaos_kill="",
+                timeout=180):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
@@ -28,6 +30,10 @@ def _run_worker(ckpt_dir, steps, save_every, die_after=0, timeout=180):
         env["TPUSCRATCH_DIE_AFTER_SAVES"] = str(die_after)
     else:
         env.pop("TPUSCRATCH_DIE_AFTER_SAVES", None)
+    if chaos_kill:
+        env["TPUSCRATCH_CHAOS_KILL"] = chaos_kill
+    else:
+        env.pop("TPUSCRATCH_CHAOS_KILL", None)
     p = subprocess.run(
         [sys.executable, str(WORKER), str(ckpt_dir), str(steps), str(save_every)],
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -36,17 +42,26 @@ def _run_worker(ckpt_dir, steps, save_every, die_after=0, timeout=180):
     return p
 
 
-def test_kill_resume_bitmatches_uninterrupted(tmp_path):
+STEPS, SAVE_EVERY = 10, 2
+
+
+@pytest.fixture(scope="module")
+def clean_result(tmp_path_factory):
+    """One uninterrupted worker run — the shared oracle for every
+    kill/resume test in this module (subprocesses are the expensive
+    part of these tests)."""
+    clean_dir = tmp_path_factory.mktemp("clean")
+    p = _run_worker(clean_dir, STEPS, SAVE_EVERY)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert f"WORKER done at step {STEPS}" in p.stdout
+    return np.load(clean_dir / "result.npy")
+
+
+def test_kill_resume_bitmatches_uninterrupted(tmp_path, clean_result):
     from tpuscratch.runtime import checkpoint
 
-    steps, save_every = 10, 2
-
-    # 1. the oracle: one uninterrupted run
-    clean_dir = tmp_path / "clean"
-    p = _run_worker(clean_dir, steps, save_every)
-    assert p.returncode == 0, p.stdout + p.stderr
-    assert f"WORKER done at step {steps}" in p.stdout
-    clean = np.load(clean_dir / "result.npy")
+    steps, save_every = STEPS, SAVE_EVERY
+    clean = clean_result
 
     # 2. a run preempted after its 2nd save (step 4 of 10)
     kill_dir = tmp_path / "killed"
@@ -64,6 +79,127 @@ def test_kill_resume_bitmatches_uninterrupted(tmp_path):
 
     # prune kept the tail only
     assert checkpoint.latest_step(kill_dir) == steps
+
+
+@pytest.mark.chaos
+def test_sigkill_inside_save_always_leaves_valid_step(tmp_path,
+                                                      clean_result):
+    """The kill-mid-save matrix: SIGKILL the worker AT internal stages of
+    ``checkpoint.save`` (via the ft chaos hook) across different save
+    occurrences; resume must always find a valid step and finish with
+    params bit-identical to an uninterrupted run.  The matrix here keeps
+    the endpoints (nothing-on-disk-yet, just-published) in tier-1; the
+    interior stages are covered subprocess-free by the hook-crash test
+    below."""
+    from tpuscratch.runtime import checkpoint
+
+    steps, save_every = STEPS, SAVE_EVERY
+    clean = clean_result
+
+    # stage x save-occurrence points: before any leaf hits disk and
+    # right after the atomic publish
+    for stage, save_idx in [("begin", 0), ("publish", 3)]:
+        kill_dir = tmp_path / f"kill_{stage}_{save_idx}"
+        p = _run_worker(kill_dir, steps, save_every,
+                        chaos_kill=f"{stage}:{save_idx}")
+        assert p.returncode == -9, (stage, p.returncode, p.stdout + p.stderr)
+        latest = checkpoint.latest_step(kill_dir)
+        # a save killed before its publish leaves the PREVIOUS step (none
+        # for the very first); killed after publish leaves its own
+        expected = save_idx * save_every if stage != "publish" \
+            else (save_idx + 1) * save_every
+        assert latest == (expected or None), (stage, latest)
+        if latest is not None:
+            # the surviving step must be fully loadable, not torn
+            tiles, s, _ = checkpoint.restore(
+                kill_dir, np.zeros((2, 2, 10, 10), np.float32)
+            )
+            assert s == latest
+        p = _run_worker(kill_dir, steps, save_every)
+        assert p.returncode == 0, (stage, p.stdout + p.stderr)
+        np.testing.assert_array_equal(
+            np.load(kill_dir / "result.npy"), clean
+        )
+
+
+def test_save_hook_crash_at_any_stage_keeps_published_step(tmp_path):
+    """In-process half of the crash-window fix: a hook that raises at ANY
+    stage of an overwriting save leaves the already-published step intact
+    and restorable (the aside-publish-delete sequence + _gc recovery)."""
+    from tpuscratch.runtime import checkpoint
+
+    d = tmp_path / "ck"
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.float32(2.0)}
+    checkpoint.save(d, 1, tree)
+    checkpoint.save(d, 2, tree)
+    for stage in ["begin", "leaf_0", "leaf_1", "manifest", "swap",
+                  "publish", "end"]:
+        def hook(s, stage=stage):
+            if s == stage:
+                raise OSError(f"injected crash at {s}")
+
+        with pytest.raises(OSError):
+            checkpoint.save(d, 2, tree, hook=hook)
+        assert checkpoint.steps(d) == [1, 2], stage
+        got, s, _ = checkpoint.restore(d, tree, step=2)
+        np.testing.assert_array_equal(got["a"], tree["a"])
+
+
+def test_reads_see_stranded_aside_and_writer_collects_orphans(tmp_path):
+    """A crash between aside-rename and publish strands the published
+    step under ``.old_step_*``.  The READ path recognizes it as that
+    step without renaming or deleting anything (so a concurrent reader
+    can never race an in-flight save); the next save() renames it back
+    and collects orphaned ``.tmp_step_*`` write temps."""
+    from tpuscratch.runtime import checkpoint
+
+    d = tmp_path / "ck"
+    tree = {"a": np.ones((3,), np.float32)}
+    checkpoint.save(d, 1, tree)
+    checkpoint.save(d, 2, tree)
+    (d / "step_000000002").rename(d / ".old_step_2_999")
+    (d / ".tmp_step_2_zzz").mkdir()
+    assert checkpoint.steps(d) == [1, 2]          # aside recognized
+    assert (d / ".old_step_2_999").exists()       # ...but NOT renamed
+    assert (d / ".tmp_step_2_zzz").exists()       # reads delete nothing
+    got, s, _ = checkpoint.restore(d, tree)       # latest == stranded 2
+    assert s == 2
+    np.testing.assert_array_equal(got["a"], tree["a"])
+    checkpoint.save(d, 3, tree)                   # the writer's _gc runs
+    assert (d / "step_000000002").exists()        # aside renamed back
+    assert not (d / ".old_step_2_999").exists()
+    assert not (d / ".tmp_step_2_zzz").exists()
+    assert checkpoint.steps(d) == [1, 2, 3]
+
+
+def test_restore_rejects_torn_and_drifted_leaves(tmp_path):
+    """Per-leaf validation: a truncated .npy fails the manifest
+    byte-size check BEFORE the load; a shape/dtype drift against the
+    example tree fails loudly instead of mis-loading silently."""
+    from tpuscratch.runtime import checkpoint
+
+    d = tmp_path / "ck"
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": np.zeros((), np.int32)}
+    checkpoint.save(d, 1, tree)
+
+    leaf = d / "step_000000001" / "leaf_0.npy"
+    data = leaf.read_bytes()
+    leaf.write_bytes(data[:-4])                   # torn write
+    with pytest.raises(ValueError, match="torn or corrupted"):
+        checkpoint.restore(d, tree, step=1)
+    leaf.write_bytes(data)                        # repaired
+    checkpoint.restore(d, tree, step=1)
+
+    with pytest.raises(ValueError, match="structure drifted"):
+        checkpoint.restore(
+            d, {"a": np.zeros((3, 2), np.float32),
+                "b": np.zeros((), np.int32)}, step=1)
+    with pytest.raises(ValueError, match="structure drifted"):
+        checkpoint.restore(
+            d, {"a": np.zeros((2, 3), np.float64),
+                "b": np.zeros((), np.int32)}, step=1)
 
 
 def test_restore_past_target_is_noop(tmp_path):

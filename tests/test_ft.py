@@ -1,0 +1,431 @@
+"""tpuscratch.ft: chaos determinism, guarded training, retry, supervisor.
+
+The correctness anchors (ISSUE 3 acceptance):
+
+- chaos determinism: the same ``ChaosPlan(seed)`` produces the same
+  fault schedule; a trainer run that suffers an injected NaN step + an
+  injected preemption finishes under ``supervise`` with final params
+  bit-identical to the same run's replay — and (rollback heals a
+  consumed one-shot fault) to the fault-free run;
+- serve: transient prefill faults are retried and complete; a
+  deterministically-failing request is quarantined after its budget
+  while every other request's outputs are byte-identical to a
+  fault-free run — no livelock;
+- uninstrumented paths unchanged: no chaos + no guard means the
+  compiled train step contains no guard ops and both trainer and engine
+  stay at one compile (CompileCounter-gated).
+"""
+
+import json
+import math
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tpuscratch.ft import (
+    ChaosPlan,
+    Fault,
+    GuardFailure,
+    GuardPolicy,
+    InjectedFault,
+    Preempted,
+    RestartBudget,
+    RestartsExhausted,
+    RetryPolicy,
+    WatchdogTimeout,
+    retry,
+    supervise,
+    supervise_train,
+)
+from tpuscratch.ft.guards import STATUS_CLIPPED, STATUS_OK, STATUS_SKIPPED
+from tpuscratch.models.transformer import (
+    TransformerConfig,
+    init_params,
+    train_step,
+)
+from tpuscratch.models.trainer import train
+from tpuscratch.runtime.errors import CommError
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import Request, ServeConfig, ServeEngine
+
+
+def _params_equal(a, b) -> bool:
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+class TestChaosPlan:
+    def test_same_seed_same_schedule(self):
+        def schedule(seed):
+            p = ChaosPlan(seed, [Fault("a/b", p=0.3, times=None)])
+            return [i for i in range(200) if p.should_fire("a/b", index=i)]
+
+        s0, s0b, s1 = schedule(7), schedule(7), schedule(8)
+        assert s0 == s0b and s0
+        assert s0 != s1
+        assert 20 < len(s0) < 100  # ~rate 0.3
+
+    def test_times_budget_consumed_across_replays(self):
+        # the rollback-replay property: a times-bounded fault at a fixed
+        # index stops firing once consumed, so the replay runs clean
+        p = ChaosPlan(0, [Fault("a/b", at=(5,), times=1)])
+        assert p.should_fire("a/b", index=5) is not None
+        assert p.should_fire("a/b", index=5) is None
+        assert p.stats() == {"a/b": 1}
+
+    def test_key_and_stage_selectors(self):
+        p = ChaosPlan(0, [Fault("s", key=3, p=1.0, at=None, times=None),
+                          Fault("ckpt/save", stage="publish", at=(0,))])
+        assert p.should_fire("s", index=0, key=2) is None
+        assert p.should_fire("s", index=0, key=3) is not None
+        assert p.should_fire("ckpt/save", stage="manifest") is None
+        # stage occurrences count independently: this is publish's 0th
+        assert p.should_fire("ckpt/save", stage="publish") is not None
+
+    def test_maybe_fail_raises_injected_comm_error(self):
+        p = ChaosPlan(0, [Fault("comm/x", at=(0,))])
+        with pytest.raises(InjectedFault) as ei:
+            p.maybe_fail("comm/x", index=0, op="allreduce")
+        assert ei.value.op == "allreduce"
+        assert isinstance(ei.value, CommError)
+
+    def test_corrupt_batch_poisons_exactly_when_scheduled(self):
+        p = ChaosPlan(0, [Fault("train/grad", at=(4,), kind="nan")])
+        x = jnp.ones((2, 3))
+        assert p.corrupt_batch(x, 3) is x
+        bad = p.corrupt_batch(x, 4)
+        assert math.isnan(float(bad[0, 0]))
+
+    def test_maybe_preempt(self):
+        p = ChaosPlan(0, [Fault("train/preempt", at=(10,), kind="preempt")])
+        p.maybe_preempt(index=9)
+        with pytest.raises(Preempted):
+            p.maybe_preempt(index=10)
+
+
+class TestRetry:
+    def test_transient_failure_recovers(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        slept = []
+        out = retry(flaky, RetryPolicy(max_attempts=4, base_s=0.01),
+                    sleep=slept.append)
+        assert out == "ok" and calls["n"] == 3 and len(slept) == 2
+
+    def test_exhaustion_reraises_last_error(self):
+        def always():
+            raise OSError("hard")
+
+        with pytest.raises(OSError, match="hard"):
+            retry(always, RetryPolicy(max_attempts=2, base_s=0.0),
+                  sleep=lambda s: None)
+
+    def test_deterministic_jitter(self):
+        a = RetryPolicy(base_s=0.1, jitter=0.5, seed=3)
+        b = RetryPolicy(base_s=0.1, jitter=0.5, seed=3)
+        c = RetryPolicy(base_s=0.1, jitter=0.5, seed=4)
+        assert [a.delay(i) for i in range(5)] == [b.delay(i) for i in range(5)]
+        assert a.delay(0) != c.delay(0)
+        assert all(0.05 <= a.delay(i) for i in range(5))
+
+    def test_watchdog_abandons_stalled_attempt(self):
+        import time
+
+        calls = {"n": 0}
+
+        def stalls_once():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                time.sleep(0.5)
+            return "done"
+
+        out = retry(
+            stalls_once,
+            RetryPolicy(max_attempts=2, base_s=0.0, attempt_timeout_s=0.05),
+            sleep=lambda s: None,
+        )
+        assert out == "done" and calls["n"] == 2
+
+    def test_watchdog_timeout_surfaces_when_exhausted(self):
+        import time
+
+        with pytest.raises(WatchdogTimeout):
+            retry(lambda: time.sleep(0.5),
+                  RetryPolicy(max_attempts=1, attempt_timeout_s=0.05))
+
+    def test_log_names_failing_op_from_comm_error(self):
+        lines = []
+
+        def fails():
+            raise CommError("ring_shift", "link down")
+
+        with pytest.raises(CommError):
+            retry(fails, RetryPolicy(max_attempts=2, base_s=0.0),
+                  op="outer", log=lines.append, sleep=lambda s: None)
+        assert all("ring_shift" in ln for ln in lines) and len(lines) == 2
+
+
+class TestSupervisor:
+    def test_restarts_then_returns(self):
+        calls = {"n": 0}
+
+        def fn():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise Preempted("train/preempt", calls["n"])
+            return "final"
+
+        assert supervise(fn, budget=RestartBudget(max_restarts=3),
+                         sleep=lambda s: None) == "final"
+        assert calls["n"] == 3
+
+    def test_budget_exhaustion(self):
+        def fn():
+            raise Preempted("train/preempt")
+
+        with pytest.raises(RestartsExhausted):
+            supervise(fn, budget=RestartBudget(max_restarts=2),
+                      sleep=lambda s: None)
+
+    def test_non_restartable_propagates(self):
+        def fn():
+            raise GuardFailure("poisoned stream")
+
+        with pytest.raises(GuardFailure):
+            supervise(fn, sleep=lambda s: None)
+
+
+def _mesh():
+    # (1, 2): ring attention over sp still exercised, compile cost ~40%
+    # lower than 2x2 — ft logic is mesh-size-independent (sharding
+    # equivalence is test_models' job; bit-identity holds per mesh)
+    return make_mesh((1, 2), ("dp", "sp"), jax.devices()[:2])
+
+
+def _cfg():
+    return TransformerConfig(
+        d_model=16, n_heads=2, n_experts=2, d_ff=32, capacity_factor=2.0
+    )
+
+
+@pytest.mark.chaos
+class TestGuardedStep:
+    def test_statuses_and_skip_protection(self):
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        cfg = _cfg()
+        fn = train_step(mesh, cfg, lr=0.05, guard=(1e30, 4.0))
+        plain = train_step(mesh, cfg, lr=0.05)
+        params = init_params(0, cfg)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+        y = jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32)
+        nan_ref = jnp.asarray(float("nan"), jnp.float32)
+
+        # clean step: ok, update == the unguarded program's update
+        new, loss, gnorm, st = fn(params, x, y, nan_ref)
+        ref, ref_loss = plain(params, x, y)
+        assert int(st) == STATUS_OK
+        assert float(loss) == float(ref_loss)
+        assert _params_equal(new, ref)
+
+        # NaN batch: skipped, params bit-identical (the in-program select)
+        bad = x.at[0, 0, 0].set(jnp.nan)
+        new2, loss2, _, st2 = fn(params, bad, y, nan_ref)
+        assert int(st2) == STATUS_SKIPPED
+        assert math.isnan(float(loss2))
+        assert _params_equal(new2, params)
+
+        # spike: loss far above the fed reference -> skipped
+        tiny_ref = jnp.asarray(1e-9, jnp.float32)
+        _, _, _, st3 = fn(params, x, y, tiny_ref)
+        assert int(st3) == STATUS_SKIPPED
+
+        # clip: a tiny clip_norm marks the step clipped but applies it
+        clip_fn = train_step(mesh, cfg, lr=0.05, guard=(1e-3, 1e30))
+        new4, _, gnorm4, st4 = clip_fn(params, x, y, nan_ref)
+        assert int(st4) == STATUS_CLIPPED
+        assert float(gnorm4) > 1e-3
+        assert not _params_equal(new4, params)
+        assert not _params_equal(new4, ref)  # the update was rescaled
+
+    def test_unguarded_program_contains_no_guard_ops(self):
+        # the uninstrumented-unchanged gate: guard=None lowers to a
+        # program with no finiteness test; guard=(...) adds it
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        cfg = _cfg()
+        params = init_params(0, cfg)
+        x = jnp.zeros((2, 8, 16), jnp.float32)
+        plain_txt = train_step(mesh, cfg).lower(params, x, x).as_text()
+        guarded_txt = train_step(mesh, cfg, guard=(1e30, 1e30)).lower(
+            params, x, x, jnp.float32(0)
+        ).as_text()
+        assert "is_finite" not in plain_txt
+        assert "is_finite" in guarded_txt
+
+
+@pytest.mark.chaos
+class TestTrainerChaos:
+    def test_nan_rollback_heals_and_preemption_resumes(self, devices,
+                                                       tmp_path):
+        mesh, cfg = _mesh(), _cfg()
+        kw = dict(save_every=3, lr=0.05, seed=3)
+        clean, _ = train(mesh, cfg, steps=6,
+                         ckpt_dir=str(tmp_path / "clean"), **kw)
+
+        # one-shot NaN at step 4 + guard(max_skips=0): the poisoned chunk
+        # rolls back, the replay consumes nothing (times=1 spent), and
+        # the final params are bit-identical to the fault-free run —
+        # with exactly one compile of the guarded step (sink-gated)
+        sink_path = tmp_path / "obs.jsonl"
+        from tpuscratch.obs.sink import Sink
+
+        plan = ChaosPlan(0, [Fault("train/grad", at=(4,), kind="nan")])
+        with Sink(str(sink_path)) as sink:
+            healed, rep = train(
+                mesh, cfg, steps=6, ckpt_dir=str(tmp_path / "nan"),
+                chaos=plan, guard=GuardPolicy(max_skips=0, max_rollbacks=1),
+                obs=sink, **kw,
+            )
+        assert rep.skipped == 1 and rep.rollbacks == 1
+        assert _params_equal(healed, clean)
+        events = [json.loads(ln) for ln in sink_path.read_text().splitlines()]
+        by_ev = {}
+        for e in events:
+            by_ev.setdefault(e["event"], []).append(e)
+        assert "ft/fault" in by_ev and "ft/rollback" in by_ev
+        assert "ft/guard" in by_ev
+        # zero steady-state recompiles, rollback replay included
+        assert by_ev["train/run"][-1]["compiles"] == 1
+
+        # preemption-only under supervise: bit-identical to fault-free
+        plan2 = ChaosPlan(0, [Fault("train/preempt", at=(3,),
+                                    kind="preempt")])
+        resumed, _ = supervise_train(
+            mesh, cfg, 6, str(tmp_path / "pre"), chaos=plan2, **kw)
+        assert _params_equal(resumed, clean)
+
+    def test_nan_plus_preemption_replay_is_bit_identical(self, devices,
+                                                         tmp_path):
+        mesh, cfg = _mesh(), _cfg()
+        kw = dict(save_every=3, lr=0.05, seed=3)
+
+        def run(tag):
+            plan = ChaosPlan(1, [
+                Fault("train/grad", at=(1,), kind="nan"),
+                Fault("train/preempt", at=(3,), kind="preempt"),
+            ])
+            from tpuscratch.obs.metrics import MetricsRegistry
+
+            metrics = MetricsRegistry()
+            params, rep = supervise_train(
+                mesh, cfg, 6, str(tmp_path / tag), chaos=plan,
+                guard=GuardPolicy(max_skips=0, max_rollbacks=2),
+                metrics=metrics, **kw,
+            )
+            return params, plan, int(metrics.counter("ft/restarts").value)
+
+        p1, plan1, restarts1 = run("a")
+        p2, plan2, restarts2 = run("b")
+        assert _params_equal(p1, p2)                     # replay-identical
+        assert plan1.stats() == plan2.stats() != {}      # same schedule
+        assert restarts1 == restarts2 == 1
+
+    def test_rollback_budget_exhaustion_raises_guard_failure(self):
+        # the ladder's bounded end — pure host logic, no compile needed:
+        # a never-healing skip stream burns the rollback budget and
+        # raises instead of replaying forever
+        from tpuscratch.ft.guards import GuardState
+
+        st = GuardState(GuardPolicy(max_skips=0, max_rollbacks=1))
+        assert st.observe([STATUS_SKIPPED])   # rollback needed
+        st.rolled_back()                      # 1st: within budget
+        assert st.observe([STATUS_OK, STATUS_SKIPPED])
+        with pytest.raises(GuardFailure):
+            st.rolled_back()                  # 2nd: budget spent
+        assert st.skips == 2 and st.rollbacks == 2
+
+
+@pytest.mark.chaos
+class TestServeChaos:
+    def _build(self, chaos=None, retry_budget=0):
+        # 1 layer: the quarantine/replay logic under test is engine-side;
+        # depth only grows compile time (decode equivalence at depth is
+        # test_serve's job)
+        cfg = TransformerConfig(d_model=32, n_heads=4, n_experts=4,
+                                d_ff=48, n_layers=1, capacity_factor=4.0)
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        scfg = ServeConfig(n_slots=4, n_pages=16, page_size=4, max_seq=24,
+                           vocab=16, retry_budget=retry_budget)
+        return ServeEngine(mesh, cfg, scfg, chaos=chaos)
+
+    def test_transient_and_poison_prefill_faults(self, devices):
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4)
+                for i in range(4)]
+        clean = self._build().run(reqs)
+        assert clean.completed == 4
+
+        # transient: rid 1's first two admissions fail, third succeeds —
+        # retried in-engine, outputs byte-identical to the fault-free run
+        plan = ChaosPlan(0, [Fault("serve/prefill", key=1, at=(0, 1),
+                                   times=2)])
+        eng = self._build(chaos=plan, retry_budget=3)
+        rep = eng.run(reqs)
+        assert rep.outputs == clean.outputs
+        assert rep.quarantined == ()
+        assert rep.decode_compiles == 1      # tick program unchanged
+
+        # poison: rid 1 fails EVERY admission -> quarantined after the
+        # budget; every other request byte-identical; engine drains (no
+        # livelock) and leaks no pages
+        plan2 = ChaosPlan(0, [Fault("serve/prefill", key=1, p=1.0,
+                                    at=None, times=None)])
+        eng2 = self._build(chaos=plan2, retry_budget=2)
+        rep2 = eng2.run(reqs)
+        assert rep2.quarantined == (1,)
+        assert 1 in eng2.quarantined
+        assert rep2.outputs == tuple(
+            (r, t) for r, t in clean.outputs if r != 1
+        )
+        assert eng2.free_pages() == [16, 16]
+        assert eng2.n_queued == 0 and eng2.n_active == 0
+        assert rep2.decode_compiles == 1
+
+    def test_default_budget_is_legacy(self):
+        # retry_budget defaults to 0 = the raise-through contract test_serve
+        # pins (test_failed_prefill_returns_pages_and_requeues); the
+        # quarantine machinery is strictly opt-in
+        assert ServeConfig(n_slots=4, n_pages=16, page_size=4, max_seq=24,
+                           vocab=16).retry_budget == 0
+
+
+class TestHostpoolRetry:
+    def test_alloc_retry_wiring(self):
+        hostpool = pytest.importorskip("tpuscratch.native.hostpool")
+        if not hostpool.available():
+            pytest.skip("native library not built")
+        lines = []
+        pool = hostpool.HostPool(
+            lock_pages=False,
+            retry=RetryPolicy(max_attempts=2, base_s=0.0),
+        )
+        with pool:
+            # a sane allocation succeeds untouched by the retry path
+            with pool.alloc(4096) as buf:
+                assert buf.nbytes == 4096
+            # an impossible allocation exercises trim+retry, then fails
+            with pytest.raises(MemoryError):
+                retry(lambda: pool.alloc(1 << 62),
+                      RetryPolicy(max_attempts=2, base_s=0.0),
+                      op="hostpool.alloc", log=lines.append,
+                      sleep=lambda s: None)
+        assert len(lines) == 2 and "hostpool.alloc" in lines[0]

@@ -137,6 +137,44 @@ class TestErrors:
             f()
         assert ei.value.op == "op-name"
 
+    def test_guarded_attaches_op_to_opless_comm_error(self):
+        # a chaos-injected CommError is raised without knowing which op
+        # wraps it; the guard fills the op (and rank) in so ft retry
+        # logs name the failing op
+        with pytest.raises(CommError) as ei:
+            with guarded("allreduce", rank=2):
+                raise CommError("", "injected error fault")
+        assert ei.value.op == "allreduce"
+        assert ei.value.rank == 2
+        assert "[rank 2] allreduce: injected error fault" in str(ei.value)
+
+    def test_abort_policy_hard_exits_subprocess(self):
+        # the os._exit(1) path (MPI_Abort parity) — only testable from
+        # outside the process
+        import os
+        import pathlib
+        import subprocess
+        import sys
+
+        repo = pathlib.Path(__file__).parent.parent
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(repo)]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
+        code = (
+            "from tpuscratch.runtime.errors import ErrorPolicy, guarded\n"
+            "with guarded('mesh build', ErrorPolicy.ABORT, rank=1):\n"
+            "    raise ValueError('bad topology')\n"
+            "print('UNREACHED')\n"
+        )
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120,
+                           env=env, cwd=str(repo))
+        assert p.returncode == 1, (p.returncode, p.stderr)
+        assert "UNREACHED" not in p.stdout
+        assert "[rank 1] mesh build: ValueError: bad topology" in p.stderr
+
 
 class TestLogging:
     def test_prefix(self):

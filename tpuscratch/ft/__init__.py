@@ -1,0 +1,61 @@
+"""tpuscratch.ft — fault injection, guarded training, and supervision.
+
+The reference's entire robustness story is ``mpierr.h``'s raise-or-abort
+dual policy (ported as ``runtime.errors``): every failure is either an
+exception or a job teardown.  The stack grown around it — checkpointed
+trainer, continuous-batching serve engine, obs — has failure surfaces
+that abort-on-error cannot serve: a preempted TPU slice, a NaN'd
+gradient, a torn checkpoint write, a poison request that
+deterministically fails prefill.  Production-scale systems treat failure
+as the steady state (MegaScale-style fault tolerance, Bamboo-style
+preemption resilience); this package is the subsystem that makes every
+failure either retried, rolled back, degraded, or quarantined — and the
+deterministic chaos harness that proves it:
+
+- **chaos**      — ``ChaosPlan(seed, faults)``: a seeded, deterministic
+  fault injector pluggable behind hooks in the trainer, the halo driver,
+  the serve engine, and ``checkpoint.save`` (hooks compile to nothing
+  when absent, the obs grad-norm contract).
+- **guards**     — ``GuardPolicy`` + the host-side escalation ladder for
+  the device-side finiteness/loss-spike guard folded into the compiled
+  train step (``models.transformer`` ``guard=``): skip-step →
+  clip → rollback-to-last-checkpoint, each bounded and counted.
+- **retry**      — generic ``retry(fn, policy)`` with exponential
+  backoff, deterministic jitter, and a wall-clock watchdog; used by
+  checkpoint save/restore, ``native.hostpool`` allocation, and serve
+  prefill.
+- **supervisor** — ``supervise(fn)`` / ``supervise_train(...)``: the
+  restart loop that catches preemptions and transient comm faults,
+  resumes from ``latest_step`` (the bit-identical replay the trainer
+  already proves), enforces a restart budget with backoff, and emits
+  ``ft/restart`` / ``ft/rollback`` / ``ft/fault`` events through obs.
+"""
+
+from tpuscratch.ft.chaos import (  # noqa: F401
+    ChaosPlan,
+    Fault,
+    InjectedFault,
+    Preempted,
+    bind_sink,
+)
+from tpuscratch.ft.guards import (  # noqa: F401
+    STATUS_CLIPPED,
+    STATUS_OK,
+    STATUS_SKIPPED,
+    GuardFailure,
+    GuardPolicy,
+    GuardState,
+)
+from tpuscratch.ft.retry import (  # noqa: F401
+    DEFAULT_SAVE_RETRY,
+    RetryPolicy,
+    RetryTimeout,
+    WatchdogTimeout,
+    retry,
+)
+from tpuscratch.ft.supervisor import (  # noqa: F401
+    RestartBudget,
+    RestartsExhausted,
+    supervise,
+    supervise_train,
+)

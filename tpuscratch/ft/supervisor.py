@@ -1,0 +1,139 @@
+"""The preemption supervisor: restart, restore, replay — bounded.
+
+``mpierr.h``'s ABORT policy ends the job on the first failure; the
+supervisor is the inverse contract for failures that are the STEADY
+state of large runs (preempted slices, transient comm faults, flaky
+checkpoint IO): catch the restartable class, back off, re-invoke — and
+let the checkpoint layer's resume-from-``latest_step`` plus the
+trainer's bit-identical replay contract turn "the job died" into "the
+job continued".  The restart budget is the supervisor's own bounded
+rung: a failure that keeps recurring past it escalates to the caller
+(``RestartsExhausted``), the same discipline as ``guards``.
+
+Events + metrics flow through ``obs``: one ``ft/restart`` per caught
+failure, ``ft/run`` on completion, counters in the (optional) registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional, TypeVar
+
+from tpuscratch.ft.chaos import Preempted
+from tpuscratch.ft.retry import jittered_backoff
+from tpuscratch.obs.metrics import MetricsRegistry
+from tpuscratch.obs.sink import NullSink
+from tpuscratch.runtime.errors import CommError
+
+T = TypeVar("T")
+
+#: failures worth re-invoking for, by default: preemptions (the run was
+#: healthy), comm-layer faults (transient by assumption — the bounded
+#: budget is what makes that assumption safe), and IO errors (flaky
+#: filesystem under the checkpoint dir).  GuardFailure is deliberately
+#: absent: a poisoned data stream does not heal by restarting.
+RESTARTABLE = (Preempted, CommError, OSError)
+
+
+class RestartsExhausted(RuntimeError):
+    """The restart budget is spent — chained to the last failure."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RestartBudget:
+    """How many re-invocations, and how fast: exponential backoff from
+    ``backoff_s`` capped at ``max_backoff_s``, jittered deterministically
+    from ``seed`` (``ft.retry.jittered_backoff`` — the same formula the
+    retry policy sleeps on)."""
+
+    max_restarts: int = 3
+    backoff_s: float = 0.0
+    max_backoff_s: float = 5.0
+    jitter: float = 0.1
+    seed: int = 0
+
+    def delay(self, restart: int) -> float:
+        return jittered_backoff(self.seed, restart - 1, self.backoff_s,
+                                2.0, self.max_backoff_s, self.jitter)
+
+
+def supervise(
+    fn: Callable[[], T],
+    *,
+    budget: RestartBudget = RestartBudget(),
+    restartable: tuple = RESTARTABLE,
+    sink=None,
+    metrics: Optional[MetricsRegistry] = None,
+    log: Callable[[str], None] = lambda s: None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """Run ``fn()`` under the restart loop; return its result.
+
+    ``fn`` must be RE-INVOCABLE: each call picks up where the last left
+    off (the trainer does, via ``ckpt_dir`` resume — that is the whole
+    design of the checkpoint layer).  Failures outside ``restartable``
+    propagate immediately; restartable ones are counted, emitted as
+    ``ft/restart`` events, backed off, and re-invoked until the budget
+    runs out (``RestartsExhausted``)."""
+    sink = sink if sink is not None else NullSink()
+    metrics = metrics if metrics is not None else MetricsRegistry()
+    restarts = 0
+    t0 = time.perf_counter()
+    while True:
+        try:
+            out = fn()
+        except restartable as exc:
+            if restarts >= budget.max_restarts:
+                # a give-up is NOT a restart: fn() will not be re-invoked,
+                # so neither the counter nor an ft/restart event fires
+                sink.emit("ft/give_up", restarts=restarts,
+                          error=f"{type(exc).__name__}: {exc}")
+                sink.flush()
+                raise RestartsExhausted(
+                    f"restart budget {budget.max_restarts} exhausted"
+                ) from exc
+            restarts += 1
+            metrics.counter("ft/restarts").inc()
+            op = getattr(exc, "op", None) or getattr(exc, "site", None)
+            log(f"supervisor restart {restarts}/{budget.max_restarts}: "
+                f"{type(exc).__name__}: {exc}")
+            sink.emit(
+                "ft/restart", restart=restarts,
+                error=f"{type(exc).__name__}: {exc}",
+                **({"op": op} if op else {}),
+            )
+            d = budget.delay(restarts)
+            if d > 0:
+                sleep(d)
+            continue
+        sink.emit(
+            "ft/run", restarts=restarts,
+            wall_s=round(time.perf_counter() - t0, 6),
+        )
+        sink.flush()
+        return out
+
+
+def supervise_train(mesh, cfg, steps: int, ckpt_dir: str, *,
+                    budget: RestartBudget = RestartBudget(),
+                    restartable: tuple = RESTARTABLE,
+                    sink=None, metrics: Optional[MetricsRegistry] = None,
+                    log: Callable[[str], None] = lambda s: None,
+                    sleep: Callable[[float], None] = time.sleep,
+                    **train_kw):
+    """:func:`supervise` around ``models.trainer.train`` — each restart
+    re-invokes ``train`` with the same arguments, which resumes from
+    ``latest_step(ckpt_dir)`` and replays deterministically (the
+    bit-identical contract ``tests/test_trainer.py`` proves).  A chaos
+    plan passed via ``train_kw['chaos']`` persists ACROSS restarts, so a
+    ``times``-bounded fault consumed before the preemption stays
+    consumed in the replay.  Returns ``(params, TrainReport)`` of the
+    completing invocation."""
+    from tpuscratch.models.trainer import train  # lazy: avoids the cycle
+
+    def attempt():
+        return train(mesh, cfg, steps, ckpt_dir, **train_kw)
+
+    return supervise(attempt, budget=budget, restartable=restartable,
+                     sink=sink, metrics=metrics, log=log, sleep=sleep)

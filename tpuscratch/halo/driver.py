@@ -152,6 +152,7 @@ def checkpointed_stencil(
     periodic: bool = True,
     keep: int = 3,
     sink=None,
+    chaos=None,
 ) -> np.ndarray:
     """``distributed_stencil`` with preemption survival: the tile state is
     checkpointed every ``save_every`` steps and the run RESUMES from the
@@ -160,6 +161,13 @@ def checkpointed_stencil(
     ``sink`` (an ``obs.sink.Sink``) receives one ``halo/chunk`` event
     per save chunk — step reached, fenced wall seconds, cell-updates/s —
     the same telemetry the trainer emits per chunk.
+
+    ``chaos`` (an ``ft.ChaosPlan``) plugs the fault injector in: a
+    transient ``comm/halo_chunk`` CommError around each compiled chunk,
+    checkpoint-IO faults through ``save``'s stage hook (saves run under
+    ``ft.retry``), and ``halo/preempt`` — a simulated preemption AFTER a
+    chunk's save, the supervisor's restartable signal.  Absent (the
+    default), no hook code runs.
 
     The reference runs under scheduler walltime kills with no way to
     continue (per-rank result dumps only, mpi-2d-stencil-subarray.cpp:62;
@@ -196,11 +204,22 @@ def checkpointed_stencil(
         resumed_at=start,
     )
     cells = world.shape[0] * world.shape[1]
+    save_hook = None
+    if chaos is not None:
+        from tpuscratch.ft.chaos import bind_sink
+        from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY, retry
+
+        bind_sink(chaos, sink)
+        save_hook = chaos.save_hook()
     programs: dict[int, object] = {}  # chunk size -> compiled program
     while start < steps:
         chunk = min(save_every, steps - start)
         if chunk not in programs:
             programs[chunk] = make_stencil_program(mesh, spec, chunk, coeffs, impl)
+        if chaos is not None:
+            # the collective wrapper: a transient CommError here is the
+            # supervisor's restartable class; resume replays this chunk
+            chaos.maybe_fail("comm/halo_chunk", index=start, op="halo_chunk")
         t0 = time.perf_counter()
         state = jax.block_until_ready(programs[chunk](state))
         chunk_s = time.perf_counter() - t0
@@ -210,11 +229,22 @@ def checkpointed_stencil(
             step=start, chunk=chunk, wall_s=round(chunk_s, 6),
             cell_updates_per_s=round(cells * chunk / chunk_s, 3),
         )
-        checkpoint.save(
-            ckpt_dir, start, np.asarray(state),
-            metadata={"steps_total": steps, "impl": impl},
-        )
+
+        def do_save(snap=np.asarray(state), at=start):
+            return checkpoint.save(
+                ckpt_dir, at, snap,
+                metadata={"steps_total": steps, "impl": impl},
+                hook=save_hook,
+            )
+
+        if chaos is not None:
+            retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
+        else:
+            do_save()
         checkpoint.prune(ckpt_dir, keep)
+        if chaos is not None:
+            # AFTER the save: the restarted run resumes exactly here
+            chaos.maybe_preempt("halo/preempt", index=start)
     sink.flush()
     return assemble(np.asarray(state), topo, layout)
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 import time
 from typing import Callable, Optional
 
@@ -30,6 +31,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from tpuscratch.ft.chaos import bind_sink
+from tpuscratch.ft.guards import (
+    STATUS_CLIPPED,
+    STATUS_SKIPPED,
+    GuardPolicy,
+    GuardState,
+)
+from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY, RetryPolicy, retry
 from tpuscratch.models.transformer import (
     TransformerConfig,
     init_adam_state,
@@ -70,11 +79,28 @@ def _cfg_fingerprint(cfg: TransformerConfig) -> str:
     return ",".join(f"{k}={fields[k]}" for k in sorted(fields))
 
 
+def _restore_state(ckpt_dir: str, params, opt, step):
+    """Restore the full training state at ``step`` (params alone for
+    SGD, params+moments for Adam) — the ONE restore/unpack sequence the
+    entry resume and the guard rollback share.  Returns
+    (params, opt, step, metadata)."""
+    state = {"params": params, "opt": opt} if opt is not None else params
+    state, step, meta = checkpoint.restore(ckpt_dir, state, step=step)
+    if opt is not None:
+        return state["params"], state["opt"], step, meta
+    return state, opt, step, meta
+
+
 @dataclasses.dataclass(frozen=True)
 class TrainReport:
-    steps_run: int       # executed in THIS invocation (resume skips the rest)
+    steps_run: int       # committed in THIS invocation (resume skips the
+    #                      rest; rolled-back chunks don't count)
     final_step: int
     losses: tuple[float, ...]  # loss at each save point, this invocation
+    # guard ladder counts (zero when no guard was attached)
+    skipped: int = 0
+    clipped: int = 0
+    rollbacks: int = 0
 
 
 def train(
@@ -92,6 +118,9 @@ def train(
     keep: int = 3,
     log: Callable[[str], None] = lambda s: None,
     obs=None,
+    chaos=None,
+    guard: Optional[GuardPolicy | GuardState] = None,
+    save_retry: Optional[RetryPolicy] = None,
 ) -> tuple[dict, TrainReport]:
     """Run (or resume) ``steps`` training steps, checkpointing every
     ``save_every``. Returns (params, report). ``optimizer`` is 'sgd' or
@@ -105,7 +134,28 @@ def train(
     metrics snapshot.  The grad-norm output is only compiled into the
     step when a sink is attached, so an uninstrumented run's program is
     unchanged; either way a ``CompileCounter`` hooks the step body, so
-    retrace-freedom across a run is observable (tests assert == 1)."""
+    retrace-freedom across a run is observable (tests assert == 1).
+
+    Fault tolerance (all default-off; the uninstrumented program and
+    loop are unchanged when absent):
+
+    - ``chaos`` (an ``ft.ChaosPlan``) plugs the fault injector in:
+      batch corruption per step (``train/grad``), transient CommErrors
+      around the compiled step (``comm/train_step``), checkpoint-IO
+      faults through ``save``'s stage hook (``ckpt/save``), and
+      simulated preemption at chunk boundaries AFTER the save
+      (``train/preempt`` — raises ``ft.Preempted`` for the supervisor).
+    - ``guard`` (an ``ft.GuardPolicy``, or an ``ft.GuardState`` to keep
+      one counter set across supervised restarts) compiles the
+      device-side finiteness/spike/clip guard into the step and runs
+      the host escalation ladder on the statuses read back each chunk:
+      skipped steps apply nothing (in-program), over-norm steps apply
+      clipped updates, and more than ``max_skips`` CONSECUTIVE skips
+      roll the run back to the last checkpoint and replay the chunk
+      (bounded by ``max_rollbacks``, then ``ft.GuardFailure``).
+    - ``save_retry`` (an ``ft.RetryPolicy``) wraps every checkpoint
+      save; defaults on when ``chaos`` is attached so injected IO
+      faults are absorbed rather than fatal."""
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if optimizer not in ("sgd", "adam"):
@@ -158,12 +208,8 @@ def train(
                     f"resume mismatch: checkpoint has {key}={meta[key]}, "
                     f"this run asked for {val} (use a fresh ckpt_dir)"
                 )
-        state = {"params": params, "opt": opt} if opt is not None else params
-        state, start, meta = checkpoint.restore(ckpt_dir, state, step=start)
-        if opt is not None:
-            params, opt = state["params"], state["opt"]
-        else:
-            params = state
+        params, opt, start, meta = _restore_state(ckpt_dir, params, opt,
+                                                  start)
         log(f"resumed at step {start} (meta {meta})")
 
     sink = obs if obs is not None else NullSink()
@@ -175,31 +221,100 @@ def train(
         steps=steps, lr=lr, optimizer=optimizer, batch=batch, seq=seq,
         seed=seed, resumed_at=start, cfg=_cfg_fingerprint(cfg),
     )
-    if optimizer == "adam":
-        adam_fn = train_step_adam(mesh, cfg, lr=lr, counter=counter,
-                                  with_grad_norm=want_gnorm)
+    # guard may be a policy (fresh counters) or a GuardState (shared
+    # across supervised restarts, the ChaosPlan-persistence convention —
+    # skip/clip/rollback counts then survive a preemption)
+    if isinstance(guard, GuardState):
+        guard_state, guard = guard, guard.policy
     else:
-        sgd_fn = train_step(mesh, cfg, lr=lr, counter=counter,
-                            with_grad_norm=want_gnorm)
+        guard_state = GuardState(guard) if guard is not None else None
+    step_guard = guard.step_guard() if guard is not None else None
+    if optimizer == "adam":
+        step_fn = train_step_adam(mesh, cfg, lr=lr, counter=counter,
+                                  with_grad_norm=want_gnorm,
+                                  guard=step_guard)
+    else:
+        step_fn = train_step(mesh, cfg, lr=lr, counter=counter,
+                             with_grad_norm=want_gnorm, guard=step_guard)
+    if chaos is not None:
+        # injected faults land in the run's own event stream
+        bind_sink(chaos, sink)
+        # the collective wrapper: each step call may raise a transient
+        # CommError — the supervisor's restartable class
+        step_fn = chaos.wrap_collective(step_fn, "train_step")
+    metadata = {
+        "steps_total": steps, "lr": lr, "seed": seed,
+        "batch": batch, "seq": seq, "cfg": _cfg_fingerprint(cfg),
+        "optimizer": optimizer,
+    }
+    save_hook = chaos.save_hook() if chaos is not None else None
+    save_policy = save_retry if save_retry is not None else (
+        DEFAULT_SAVE_RETRY if chaos is not None else None
+    )
     losses = []
     ran = 0
+    ref_loss = float("nan")  # spike baseline: previous chunk's loss
     run_t0 = time.perf_counter()
     while start < steps:
         chunk = min(save_every, steps - start)
         loss = gnorm = None
+        statuses = []
         t0 = time.perf_counter()
         for i in range(chunk):
             x, y = synthetic_batch(seed, start + i, batch, seq, cfg.d_model)
-            if optimizer == "adam":
-                params, opt, loss, *rest = adam_fn(params, opt, x, y)
+            if chaos is not None:
+                x = chaos.corrupt_batch(x, start + i)
+            if guard is not None:
+                rl = jnp.asarray(ref_loss, jnp.float32)
+                if optimizer == "adam":
+                    params, opt, loss, gnorm, st = step_fn(params, opt, x,
+                                                           y, rl)
+                else:
+                    params, loss, gnorm, st = step_fn(params, x, y, rl)
+                statuses.append(st)
+            elif optimizer == "adam":
+                params, opt, loss, *rest = step_fn(params, opt, x, y)
+                gnorm = rest[0] if rest else None
             else:
-                params, loss, *rest = sgd_fn(params, x, y)
-            gnorm = rest[0] if rest else None
-        start += chunk
-        ran += chunk
+                params, loss, *rest = step_fn(params, x, y)
+                gnorm = rest[0] if rest else None
         loss_f = float(jax.block_until_ready(loss))
         chunk_s = time.perf_counter() - t0  # fenced by the loss readback
+        if guard is not None:
+            st_host = [int(s) for s in statuses]
+            skips = st_host.count(STATUS_SKIPPED)
+            clips = st_host.count(STATUS_CLIPPED)
+            if skips or clips:
+                metrics.counter("ft/skipped_steps").inc(skips)
+                metrics.counter("ft/clipped_steps").inc(clips)
+                sink.emit("ft/guard", step=start + chunk, skipped=skips,
+                          clipped=clips)
+            if guard_state.observe(st_host):
+                # the stream is poisoned, not glitched: discard this
+                # chunk, restore the last committed state, replay
+                guard_state.rolled_back()  # GuardFailure past the budget
+                metrics.counter("ft/rollbacks").inc()
+                rb_to = checkpoint.latest_step(ckpt_dir)
+                if rb_to is None:
+                    params = init_params(seed, cfg)
+                    opt = (init_adam_state(params) if optimizer == "adam"
+                           else None)
+                    rb_to = 0
+                else:
+                    params, opt, rb_to, _ = _restore_state(
+                        ckpt_dir, params, opt, rb_to
+                    )
+                sink.emit("ft/rollback", from_step=start + chunk,
+                          to_step=rb_to)
+                log(f"guard rollback: step {start + chunk} -> {rb_to}")
+                start = rb_to
+                ref_loss = float("nan")
+                continue
+        start += chunk
+        ran += chunk
         losses.append(loss_f)
+        if math.isfinite(loss_f):
+            ref_loss = loss_f
         metrics.counter("train/steps").inc(chunk)
         metrics.gauge("train/loss").set(loss_f)
         metrics.histogram("train/step_s").observe(chunk_s / chunk)
@@ -219,16 +334,20 @@ def train(
         state = (
             {"params": params, "opt": opt} if opt is not None else params
         )
-        checkpoint.save(
-            ckpt_dir, start, jax.tree.map(np.asarray, state),
-            metadata={
-                "steps_total": steps, "lr": lr, "seed": seed,
-                "batch": batch, "seq": seq, "cfg": _cfg_fingerprint(cfg),
-                "optimizer": optimizer,
-            },
-        )
+
+        def do_save(snap=jax.tree.map(np.asarray, state), at=start):
+            return checkpoint.save(ckpt_dir, at, snap, metadata=metadata,
+                                   hook=save_hook)
+
+        if save_policy is not None:
+            retry(do_save, save_policy, op="ckpt/save", log=log)
+        else:
+            do_save()
         checkpoint.prune(ckpt_dir, keep)
         log(f"step {start}/{steps}: loss {loss_f:.5f}")
+        if chaos is not None:
+            # AFTER the save: the restarted run resumes exactly here
+            chaos.maybe_preempt("train/preempt", index=start)
     sink.emit(
         "train/run",
         steps_run=ran, final_step=start,
@@ -237,4 +356,10 @@ def train(
     )
     sink.emit_metrics(metrics.snapshot(), scope=metrics.id)
     sink.flush()
-    return params, TrainReport(ran, start, tuple(losses))
+    gs = guard_state
+    return params, TrainReport(
+        ran, start, tuple(losses),
+        skipped=gs.skips if gs else 0,
+        clipped=gs.clips if gs else 0,
+        rollbacks=gs.rollbacks if gs else 0,
+    )

@@ -222,23 +222,81 @@ def _grad_norm(grads, dp: str):
     return jnp.sqrt(sum(jax.tree_util.tree_leaves(sq)))
 
 
+def _apply_guard(loss, gnorm, grads, ref_loss, clip_norm, spike_factor,
+                 dp: str, sp: str):
+    """Device-side health guard (the compiled half of ``ft.guards``):
+
+    - finiteness: the local ``isfinite(loss) & isfinite(gnorm)`` flag
+      (a NaN/Inf in ANY gradient leaf propagates into the global grad
+      norm, so the pair covers the whole tree) reduced over ALL mesh
+      axes through ``comm.collectives`` — every rank agrees, so the
+      skip-select below cannot diverge the replicas;
+    - loss spike: ``loss > spike_factor * ref_loss`` against the
+      caller-fed reference loss (the previous chunk's; a non-finite or
+      non-positive reference disables the check — the first chunk);
+    - clip: gradients above ``clip_norm`` are rescaled in-program.
+
+    Returns ``(ok, status, grads)``: ``ok`` gates the update
+    (skip-step = params pass through unchanged), ``status`` is the ONE
+    extra int32 scalar output (0 ok / 1 clipped / 2 skipped)."""
+    from tpuscratch.comm import collectives as C
+
+    finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+    finite = C.allreduce_min(finite.astype(jnp.int32), (dp, sp)) > 0
+    spiked = (
+        jnp.isfinite(ref_loss) & (ref_loss > 0)
+        & (loss > jnp.float32(spike_factor) * ref_loss)
+    )
+    ok = finite & ~spiked
+    clip = finite & (gnorm > clip_norm)
+    scale = jnp.where(clip, jnp.float32(clip_norm) / jnp.maximum(gnorm, 1e-30),
+                      jnp.float32(1.0))
+    grads = jax.tree.map(lambda g: g * scale, grads)
+    status = jnp.where(ok, jnp.where(clip, 1, 0), 2).astype(jnp.int32)
+    return ok, status, grads
+
+
 def train_step_fn(cfg: TransformerConfig, lr: float = 1e-2,
                   sp: str = "sp", dp: str = "dp",
-                  with_grad_norm: bool = False):
+                  with_grad_norm: bool = False,
+                  guard: tuple | None = None):
     """The shard_map body: (params, x, y) -> (new_params, loss) — or
     (new_params, loss, grad_norm) when ``with_grad_norm`` (the obs
     trainer hook; a separate trace, so the uninstrumented program is
-    byte-identical to before)."""
+    byte-identical to before).
 
-    def step(params, x, y):
+    ``guard=(clip_norm, spike_factor)`` folds the device-side health
+    guard in (see :func:`_apply_guard`): the body becomes
+    (params, x, y, ref_loss) -> (new_params, loss, grad_norm, status)
+    with a skipped step passing params through unchanged.  ``guard=None``
+    returns EXACTLY the pre-guard body, so uninstrumented programs are
+    unchanged."""
+    if guard is None:
+        def step(params, x, y):
+            loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
+            grads = _grad_reduce(grads, dp, sp)
+            new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
+            if with_grad_norm:
+                return new_params, loss, _grad_norm(grads, dp)
+            return new_params, loss
+
+        return step
+
+    clip_norm, spike_factor = guard
+
+    def guarded_step(params, x, y, ref_loss):
         loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
         grads = _grad_reduce(grads, dp, sp)
-        new_params = jax.tree.map(lambda w, g: w - lr * g, params, grads)
-        if with_grad_norm:
-            return new_params, loss, _grad_norm(grads, dp)
-        return new_params, loss
+        gnorm = _grad_norm(grads, dp)
+        ok, status, grads = _apply_guard(
+            loss, gnorm, grads, ref_loss, clip_norm, spike_factor, dp, sp
+        )
+        new_params = jax.tree.map(
+            lambda w, g: jnp.where(ok, w - lr * g, w), params, grads
+        )
+        return new_params, loss, gnorm, status
 
-    return step
+    return guarded_step
 
 
 def init_adam_state(params) -> dict:
@@ -285,24 +343,48 @@ def _adam_update(params, opt, grads, lr, b1, b2, eps):
 def train_step_adam_fn(cfg: TransformerConfig, lr: float = 1e-3,
                        b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                        sp: str = "sp", dp: str = "dp",
-                       with_grad_norm: bool = False):
+                       with_grad_norm: bool = False,
+                       guard: tuple | None = None):
     """The shard_map body: (params, opt, x, y) -> (params, opt, loss)
     (+ grad_norm when ``with_grad_norm``).
 
     Adam is elementwise, so the per-shard update composes with any
     sharding as long as the moments shard like the params (they do, by
-    construction); the cross-rank math is all in ``_grad_reduce``."""
+    construction); the cross-rank math is all in ``_grad_reduce``.
 
-    def step(params, opt, x, y):
+    ``guard=(clip_norm, spike_factor)``: same contract as
+    :func:`train_step_fn` — (params, opt, x, y, ref_loss) ->
+    (params, opt, loss, grad_norm, status); a skipped step freezes the
+    MOMENTS and the step count along with the params (a half-applied
+    optimizer state would corrupt the bias correction)."""
+    if guard is None:
+        def step(params, opt, x, y):
+            loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
+            grads = _grad_reduce(grads, dp, sp)
+            new_params, new_opt = _adam_update(params, opt, grads, lr, b1, b2,
+                                               eps)
+            if with_grad_norm:
+                return new_params, new_opt, loss, _grad_norm(grads, dp)
+            return new_params, new_opt, loss
+
+        return step
+
+    clip_norm, spike_factor = guard
+
+    def guarded_step(params, opt, x, y, ref_loss):
         loss, grads = jax.value_and_grad(_loss)(params, x, y, cfg, sp, dp)
         grads = _grad_reduce(grads, dp, sp)
-        new_params, new_opt = _adam_update(params, opt, grads, lr, b1, b2,
-                                           eps)
-        if with_grad_norm:
-            return new_params, new_opt, loss, _grad_norm(grads, dp)
-        return new_params, new_opt, loss
+        gnorm = _grad_norm(grads, dp)
+        ok, status, grads = _apply_guard(
+            loss, gnorm, grads, ref_loss, clip_norm, spike_factor, dp, sp
+        )
+        up_params, up_opt = _adam_update(params, opt, grads, lr, b1, b2, eps)
+        sel = lambda new, cur: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(ok, a, b), new, cur
+        )
+        return sel(up_params, params), sel(up_opt, opt), loss, gnorm, status
 
-    return step
+    return guarded_step
 
 
 def train_step_adam(
@@ -316,25 +398,34 @@ def train_step_adam(
     sp: str = "sp",
     with_grad_norm: bool = False,
     counter=None,
+    guard: tuple | None = None,
 ):
     """:func:`train_step` with Adam: jit'd fn(params, opt_state, x, y)
     -> (params, opt_state, loss); ``opt_state`` from
     :func:`init_adam_state`, moments sharded like their params.
     ``with_grad_norm`` appends the replicated grad-norm scalar;
     ``counter`` (an ``obs.CompileCounter``) counts traces of the body —
-    the trainer's recompile detector."""
+    the trainer's recompile detector.  ``guard=(clip_norm,
+    spike_factor)`` builds the guarded variant — fn(params, opt, x, y,
+    ref_loss) -> (params, opt, loss, grad_norm, status); ``guard=None``
+    leaves the program unchanged."""
     _validate_step_config(mesh, cfg, dp, sp)
     pspec = param_spec(cfg, dp)
     ospec = adam_state_spec(cfg, dp)
     body = train_step_adam_fn(cfg, lr, b1, b2, eps, sp=sp, dp=dp,
-                              with_grad_norm=with_grad_norm)
+                              with_grad_norm=with_grad_norm, guard=guard)
     if counter is not None:
         body = counter.wrap(body)
-    out = (pspec, ospec, P(), P()) if with_grad_norm else (pspec, ospec, P())
+    if guard is not None:
+        in_specs = (pspec, ospec, P(dp, sp), P(dp, sp), P())
+        out = (pspec, ospec, P(), P(), P())
+    else:
+        in_specs = (pspec, ospec, P(dp, sp), P(dp, sp))
+        out = (pspec, ospec, P(), P()) if with_grad_norm else (pspec, ospec, P())
     return run_spmd(
         mesh,
         body,
-        (pspec, ospec, P(dp, sp), P(dp, sp)),
+        in_specs,
         out,
     )
 
@@ -611,6 +702,7 @@ def train_step(
     sp: str = "sp",
     with_grad_norm: bool = False,
     counter=None,
+    guard: tuple | None = None,
 ):
     """Compiled training step over ``mesh`` (axes ``dp`` x ``sp``).
 
@@ -621,17 +713,28 @@ def train_step(
     program.  ``with_grad_norm`` appends the replicated grad-norm
     scalar to the outputs; ``counter`` (an ``obs.CompileCounter``)
     counts traces of the body, the trainer's recompile detector.
+
+    ``guard=(clip_norm, spike_factor)`` builds the ft-guarded variant —
+    fn(params, x, y, ref_loss) -> (params, loss, grad_norm, status),
+    the finiteness/spike/clip guard folded into the SAME compiled
+    program (see :func:`_apply_guard`); ``guard=None`` (the default)
+    leaves the program unchanged.
     """
     _validate_step_config(mesh, cfg, dp, sp)
     pspec = param_spec(cfg, dp)
     body = train_step_fn(cfg, lr, sp=sp, dp=dp,
-                         with_grad_norm=with_grad_norm)
+                         with_grad_norm=with_grad_norm, guard=guard)
     if counter is not None:
         body = counter.wrap(body)
-    out = (pspec, P(), P()) if with_grad_norm else (pspec, P())
+    if guard is not None:
+        in_specs = (pspec, P(dp, sp), P(dp, sp), P())
+        out = (pspec, P(), P(), P())
+    else:
+        in_specs = (pspec, P(dp, sp), P(dp, sp))
+        out = (pspec, P(), P()) if with_grad_norm else (pspec, P())
     return run_spmd(
         mesh,
         body,
-        (pspec, P(dp, sp), P(dp, sp)),
+        in_specs,
         out,
     )

@@ -133,15 +133,23 @@ class HostBuffer:
 
 
 class HostPool:
-    """Pooled page-aligned (optionally page-locked) host buffers."""
+    """Pooled page-aligned (optionally page-locked) host buffers.
 
-    def __init__(self, lock_pages: bool = True):
+    ``retry`` (an ``ft.RetryPolicy``) makes :meth:`alloc` absorb
+    transient exhaustion: a failed allocation trims the pool's cached
+    free lists back to the OS and retries under the policy's backoff —
+    the RLIMIT_MEMLOCK budget is shared process-wide, so another pool
+    releasing between attempts is a real recovery path.  ``None`` (the
+    default) keeps the fail-fast contract."""
+
+    def __init__(self, lock_pages: bool = True, retry=None):
         lib = _lib()
         if lib is None:
             raise RuntimeError(
                 "native library unavailable — tpuscratch.native.build() "
                 "or `make -C native` first"
             )
+        self._retry = retry
         self._handle = lib.ts_pool_create(1 if lock_pages else 0)
         if not self._handle:
             raise MemoryError("ts_pool_create failed")
@@ -156,6 +164,19 @@ class HostPool:
         if nbytes <= 0:
             raise ValueError(f"alloc of {nbytes} bytes")
         ptr = _lib().ts_pool_alloc(self._handle, nbytes)
+        if not ptr and self._retry is not None:
+            from tpuscratch.ft.retry import retry as _ft_retry
+
+            def attempt() -> int:
+                self.trim()  # cached free lists back to the OS first
+                p = _lib().ts_pool_alloc(self._handle, nbytes)
+                if not p:
+                    raise MemoryError(
+                        f"host pool exhausted allocating {nbytes} B"
+                    )
+                return p
+
+            ptr = _ft_retry(attempt, self._retry, op="hostpool.alloc")
         if not ptr:
             raise MemoryError(f"host pool exhausted allocating {nbytes} B")
         return HostBuffer(self, ptr, nbytes)

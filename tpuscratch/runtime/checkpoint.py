@@ -5,13 +5,27 @@ The reference has no checkpointing — only per-rank result dumps
 stencil run on a preemptible TPU slice needs one, so the framework closes
 the gap with a deliberately small format: one directory per step holding
 the pytree's leaves as ``.npy`` plus a JSON manifest (treedef, step,
-user metadata). Atomic via write-to-temp + rename; ``latest_step`` +
-``restore`` give resume-after-preemption.
+per-leaf shape/dtype/file-size, user metadata). Atomic via
+write-to-temp + rename; ``latest_step`` + ``restore`` give
+resume-after-preemption.
+
+Crash safety: a same-step overwrite renames the published dir ASIDE
+(call-unique name), publishes the new one, then deletes the aside — so
+no kill point loses an already-published step.  The read path
+(``steps``/``restore``) RECOGNIZES a stranded aside as that step and
+never renames or deletes anything, so concurrent readers cannot race an
+in-flight save; the writer's next ``save`` runs :func:`_gc`, which
+renames an unreplaced aside back and deletes orphaned ``.tmp_step_*``
+write temps.
+``save`` takes a ``hook`` called at each internal stage — the chaos
+harness's injection point (``tests/test_checkpoint_resume.py`` SIGKILLs
+a worker at every stage and proves resume always finds a valid step).
 
 Multi-host note: each process saves only addressable shards it owns in
 this simple format; for sharded multi-host arrays prefer one directory per
 process (``tag=f"proc{jax.process_index()}"``), mirroring the reference's
-per-rank files keyed by coordinates.
+per-rank files keyed by coordinates.  The aside/GC scheme assumes one
+writer per directory, same as the atomic-rename scheme before it.
 """
 
 from __future__ import annotations
@@ -21,25 +35,97 @@ import os
 import pathlib
 import shutil
 import tempfile
-from typing import Any, Optional
+import uuid
+from typing import Any, Callable, Optional
 
 import jax
 import numpy as np
 
 _MANIFEST = "manifest.json"
+_TMP_PREFIX = ".tmp_step_"
+_OLD_PREFIX = ".old_step_"
 
 
-def save(ckpt_dir: str | os.PathLike, step: int, tree: Any, metadata: Optional[dict] = None, tag: str = "state") -> pathlib.Path:
-    """Atomically write ``tree`` as checkpoint ``step``. Returns the path."""
+def _aside_step(name: str) -> int:
+    return int(name[len(_OLD_PREFIX):].split("_")[0])
+
+
+def _gc(root: pathlib.Path) -> None:
+    """Collect debris from crashed saves — called by the single WRITER
+    (``save``) only; the read path never mutates (it *recognizes*
+    stranded asides instead, :func:`_step_dir`).  Orphaned write temps
+    are deleted; an aside whose replacement never published is renamed
+    BACK, otherwise deleted."""
+    if not root.exists():
+        return
+    for p in root.iterdir():
+        if not p.is_dir():
+            continue
+        if p.name.startswith(_TMP_PREFIX):
+            shutil.rmtree(p, ignore_errors=True)
+        elif p.name.startswith(_OLD_PREFIX):
+            final = root / f"step_{_aside_step(p.name):09d}"
+            if final.exists():
+                shutil.rmtree(p, ignore_errors=True)
+            else:
+                p.rename(final)
+
+
+def _step_dir(root: pathlib.Path, step: int) -> pathlib.Path:
+    """Directory holding checkpoint ``step`` — normally
+    ``step_<step>``, falling back to a stranded ``.old_step_<step>_*``
+    aside (a crash between the aside-rename and the publish).  Pure
+    lookup: readers never rename, so they can never race the writer's
+    swap window."""
+    final = root / f"step_{step:09d}"
+    if final.exists():
+        return final
+    for p in root.iterdir():
+        if (p.is_dir() and p.name.startswith(_OLD_PREFIX)
+                and _aside_step(p.name) == step
+                and (p / _MANIFEST).exists()):
+            return p
+    return final
+
+
+def save(ckpt_dir: str | os.PathLike, step: int, tree: Any,
+         metadata: Optional[dict] = None, tag: str = "state",
+         hook: Optional[Callable[[str], None]] = None) -> pathlib.Path:
+    """Atomically write ``tree`` as checkpoint ``step``. Returns the path.
+
+    ``hook`` (chaos/testing only) is called with a stage name at each
+    internal boundary: ``"begin"``, ``"leaf_<i>"`` after each leaf
+    write, ``"manifest"``, ``"swap"`` after an existing same-step dir is
+    renamed aside, ``"publish"`` after the temp dir is renamed into
+    place, ``"end"`` after the aside dir is removed.  A hook that raises
+    (or kills the process) at ANY stage leaves the directory with every
+    previously-published step intact — either directly or via the next
+    call's :func:`_gc`."""
     root = pathlib.Path(ckpt_dir)
     root.mkdir(parents=True, exist_ok=True)
+    _gc(root)
+    fire = hook if hook is not None else (lambda stage: None)
     leaves, treedef = jax.tree.flatten(tree)
     tmp = pathlib.Path(
-        tempfile.mkdtemp(prefix=f".tmp_step_{step}_", dir=root)
+        tempfile.mkdtemp(prefix=f"{_TMP_PREFIX}{step}_", dir=root)
     )
+    final = root / f"step_{step:09d}"
+    old: Optional[pathlib.Path] = None
     try:
+        fire("begin")
+        leaf_meta = []
         for i, leaf in enumerate(leaves):
-            np.save(tmp / f"leaf_{i}.npy", np.asarray(leaf))
+            arr = np.asarray(leaf)
+            path_i = tmp / f"leaf_{i}.npy"
+            np.save(path_i, arr)
+            # per-leaf identity + on-disk byte size: restore's cheap
+            # torn-write check (a truncated .npy fails BEFORE np.load)
+            leaf_meta.append({
+                "shape": list(arr.shape),
+                "dtype": str(arr.dtype),
+                "size": path_i.stat().st_size,
+            })
+            fire(f"leaf_{i}")
         (tmp / _MANIFEST).write_text(
             json.dumps(
                 {
@@ -47,29 +133,59 @@ def save(ckpt_dir: str | os.PathLike, step: int, tree: Any, metadata: Optional[d
                     "tag": tag,
                     "n_leaves": len(leaves),
                     "treedef": str(treedef),
+                    "leaves": leaf_meta,
                     "metadata": metadata or {},
                 }
             )
         )
-        final = root / f"step_{step:09d}"
+        fire("manifest")
         if final.exists():
-            shutil.rmtree(final)
+            # overwrite: aside-publish-delete, never delete-then-publish
+            # (a crash between rmtree and rename would lose the step).
+            # The aside name is unique PER CALL, not per process: a
+            # watchdog-abandoned save's zombie thread must never collide
+            # with its retry on the same aside path
+            old = root / (
+                f"{_OLD_PREFIX}{step}_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+            )
+            final.rename(old)
+            fire("swap")
         tmp.rename(final)  # atomic publish
+        fire("publish")
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
+            old = None
+        fire("end")
         return final
     except BaseException:
         shutil.rmtree(tmp, ignore_errors=True)
+        if old is not None:
+            if final.exists():
+                shutil.rmtree(old, ignore_errors=True)
+            else:
+                old.rename(final)  # put the published step back
         raise
 
 
 def steps(ckpt_dir: str | os.PathLike) -> list[int]:
+    """Published step numbers, NEWEST state of the directory — including
+    steps stranded under ``.old_step_*`` asides by a crash between the
+    aside-rename and the publish.  Pure read: nothing is renamed or
+    deleted here (the writer's next ``save`` does that), so concurrent
+    readers can never break an in-flight save."""
     root = pathlib.Path(ckpt_dir)
     if not root.exists():
         return []
-    out = []
+    published = set()
+    stranded = set()
     for p in root.iterdir():
-        if p.is_dir() and p.name.startswith("step_") and (p / _MANIFEST).exists():
-            out.append(int(p.name.split("_")[1]))
-    return sorted(out)
+        if not p.is_dir() or not (p / _MANIFEST).exists():
+            continue
+        if p.name.startswith("step_"):
+            published.add(int(p.name.split("_")[1]))
+        elif p.name.startswith(_OLD_PREFIX):
+            stranded.add(_aside_step(p.name))
+    return sorted(published | stranded)
 
 
 def latest_step(ckpt_dir: str | os.PathLike) -> Optional[int]:
@@ -80,20 +196,44 @@ def latest_step(ckpt_dir: str | os.PathLike) -> Optional[int]:
 def restore(ckpt_dir: str | os.PathLike, example_tree: Any, step: Optional[int] = None) -> tuple[Any, int, dict]:
     """Load (tree, step, metadata); ``example_tree`` supplies the treedef.
 
-    Defaults to the latest step. Leaf count is validated against the
-    example so a structure drift fails loudly instead of mis-zipping.
+    Defaults to the latest step. Validation is per-leaf, not just a
+    count: each leaf's on-disk file size is checked against the manifest
+    (torn/truncated writes fail before the load) and its shape and dtype
+    against the example tree (a corrupted or drifted leaf fails loudly
+    instead of mis-loading silently).
     """
     step, manifest = _read_manifest(ckpt_dir, step)
-    path = pathlib.Path(ckpt_dir) / f"step_{step:09d}"
+    path = _step_dir(pathlib.Path(ckpt_dir), step)
     leaves, treedef = jax.tree.flatten(example_tree)
     if manifest["n_leaves"] != len(leaves):
         raise ValueError(
             f"checkpoint has {manifest['n_leaves']} leaves, example tree "
             f"has {len(leaves)} — structure changed since save"
         )
-    loaded = [
-        np.load(path / f"leaf_{i}.npy") for i in range(manifest["n_leaves"])
-    ]
+    leaf_meta = manifest.get("leaves")  # absent in legacy checkpoints
+    loaded = []
+    for i, example in enumerate(leaves):
+        f = path / f"leaf_{i}.npy"
+        if leaf_meta is not None:
+            size = f.stat().st_size
+            if size != leaf_meta[i]["size"]:
+                raise ValueError(
+                    f"checkpoint leaf {i} is {size} B on disk, manifest "
+                    f"recorded {leaf_meta[i]['size']} B — torn or "
+                    f"corrupted write"
+                )
+        arr = np.load(f)
+        ex_shape = tuple(np.shape(example))
+        ex_dtype = np.dtype(
+            getattr(example, "dtype", None) or np.asarray(example).dtype
+        )
+        if arr.shape != ex_shape or arr.dtype != ex_dtype:
+            raise ValueError(
+                f"checkpoint leaf {i} has shape {arr.shape} dtype "
+                f"{arr.dtype}; example tree expects {ex_shape} "
+                f"{ex_dtype} — structure drifted since save"
+            )
+        loaded.append(arr)
     return jax.tree.unflatten(treedef, loaded), step, manifest["metadata"]
 
 
@@ -103,7 +243,7 @@ def _read_manifest(ckpt_dir: str | os.PathLike, step: Optional[int]) -> tuple[in
         step = latest_step(ckpt_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {ckpt_dir}")
-    path = pathlib.Path(ckpt_dir) / f"step_{step:09d}"
+    path = _step_dir(pathlib.Path(ckpt_dir), step)
     return step, json.loads((path / _MANIFEST).read_text())
 
 

@@ -37,7 +37,20 @@ class CommError(RuntimeError):
     def __init__(self, op: str, message: str, rank: Optional[int] = None):
         self.op = op
         self.rank = rank
+        self.message = message
         super().__init__(format_comm_err(op, message, rank))
+
+    def with_op(self, op: str, rank: Optional[int] = None) -> "CommError":
+        """Attach op/rank context post-hoc when the error was raised
+        without it (chaos-injected faults don't know which op wraps
+        them); ``guarded`` calls this on pass-through so ft retry logs
+        name the actual failing op.  A non-empty existing op wins."""
+        if not self.op:
+            self.op = op
+        if self.rank is None:
+            self.rank = rank
+        self.args = (format_comm_err(self.op, self.message, self.rank),)
+        return self
 
 
 def format_comm_err(op: str, message: str, rank: Optional[int] = None) -> str:
@@ -83,8 +96,11 @@ def guarded(op: str, policy: ErrorPolicy = ErrorPolicy.RAISE, rank: Optional[int
     try:
         yield
     except CommError as exc:
-        # Already wrapped by an inner guard: don't re-wrap, but an ABORT
-        # policy must still abort (MPI_Abort parity).
+        # Already wrapped by an inner guard: don't re-wrap — but fill in
+        # missing op/rank context (an injected fault raised without an op
+        # picks up this guard's), and an ABORT policy must still abort
+        # (MPI_Abort parity).
+        exc.with_op(op, rank)
         if policy is ErrorPolicy.ABORT:
             _handle(exc, exc.op, policy, exc.rank if exc.rank is not None else rank)
         raise

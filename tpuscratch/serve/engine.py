@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from tpuscratch.ft.chaos import bind_sink
 from tpuscratch.models.transformer import TransformerConfig, init_params
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
@@ -60,6 +61,13 @@ class ServeConfig:
     temperature: float = 0.0  # 0 = greedy
     top_k: int = 0            # 0 = full distribution
     seed: int = 0             # sampling + embedding seed
+    # extra prefill attempts per request before QUARANTINE.  0 (default)
+    # keeps the legacy contract: a failed admission requeues the request
+    # and re-raises to the caller.  > 0: failed admissions are retried
+    # in-engine (transient faults complete) and a request that exhausts
+    # the budget is quarantined — reported, never requeued — so one
+    # poison request cannot livelock the engine.
+    retry_budget: int = 0
 
     @property
     def max_pages(self) -> int:
@@ -87,6 +95,7 @@ class GenerateReport:
     prefill_s: float
     decode_s: float
     outputs: tuple[tuple[int, tuple[int, ...]], ...]  # (rid, tokens) by rid
+    quarantined: tuple[int, ...] = ()  # rids dropped THIS drain (budget spent)
 
 
 @dataclasses.dataclass
@@ -142,7 +151,7 @@ class ServeEngine:
                  params: Optional[dict] = None,
                  embed: Optional[jax.Array] = None,
                  dp: str = "dp", sp: str = "sp",
-                 sink=None):
+                 sink=None, chaos=None):
         check_serve_mesh(mesh, cfg, dp, sp)
         self._dp_size = mesh.shape[dp]
         if scfg.n_slots % self._dp_size:
@@ -180,6 +189,8 @@ class ServeEngine:
         self._slots_per_group = scfg.n_slots // self._dp_size
         self._queue: collections.deque[Request] = collections.deque()
         self._seen_rids: set[int] = set()
+        self._chaos = chaos  # ft.ChaosPlan or None: "serve/prefill" site
+        self._quarantined: dict[int, str] = {}  # rid -> last error
         self._seed_key = jax.random.key(scfg.seed)
         self.timeline = Timeline()
         # observability: every tick updates the registry (host-side
@@ -188,6 +199,7 @@ class ServeEngine:
         # watermark, tick latency, insert/evict counts, compile counts
         self.metrics = MetricsRegistry()
         self.sink = sink if sink is not None else NullSink()
+        bind_sink(chaos, self.sink)  # injected ft/fault events join the stream
         self._tick = 0
         self.sink.emit(
             "serve/engine",
@@ -231,6 +243,11 @@ class ServeEngine:
     @property
     def n_queued(self) -> int:
         return len(self._queue)
+
+    @property
+    def quarantined(self) -> dict[int, str]:
+        """{rid: last error} of requests dropped after the retry budget."""
+        return dict(self._quarantined)
 
     def _group_of(self, slot: int) -> int:
         return slot // self._slots_per_group
@@ -298,7 +315,18 @@ class ServeEngine:
             keys, logits, self.scfg.temperature, self.scfg.top_k
         )
 
-    def _admit(self, req: Request, slot: int) -> None:
+    def _admit(self, req: Request, slot: int) -> bool:
+        """Prefill ``req`` into ``slot``; True when the slot was taken.
+
+        With ``scfg.retry_budget == 0`` (default) a prefill failure keeps
+        the legacy contract: grant returned, request requeued at the
+        head, cache recovered, exception re-raised.  With a budget,
+        failed attempts are retried in-engine (the cache reset + replay
+        between attempts, so transient faults complete with outputs
+        byte-identical to a fault-free run) and a request that exhausts
+        ``1 + retry_budget`` attempts is QUARANTINED: its grant is
+        returned, it never requeues, and the engine moves on — the
+        deterministic-poison livelock the unconditional requeue had."""
         geom, scfg = self.geom, self.scfg
         group = self._group_of(slot)
         pages = self._allocators[group].alloc(
@@ -318,28 +346,62 @@ class ServeEngine:
             (self._dp_size, scfg.max_pages), geom.n_pages, np.int32
         )
         page_rows[group, : len(pages)] = pages
-        try:
+
+        def attempt() -> int:
+            if self._chaos is not None:
+                self._chaos.maybe_fail("serve/prefill", key=req.rid,
+                                       op="serve/prefill")
             with self.timeline.span("serve/prefill"):
                 out, self._kv = self._prefills[bucket](
                     self.params, self._kv, jnp.asarray(x),
                     jnp.asarray(page_rows), jnp.int32(n_tok),
                 )
                 logits = self._unembed(out[n_tok - 1][None], self.embed)
-                tok = int(
+                return int(
                     self._sample(
                         request_key(scfg.seed, req.rid, 0)[None], logits
                     )[0]
                 )
-        except Exception:
-            # a failing prefill (transient device error, first-bucket
-            # compile OOM) must not bleed the pool dry across retries:
-            # return the grant, put the request back at the head, and
-            # reset the (possibly donated-and-consumed) cache — every
-            # in-flight request requeues for deterministic replay
-            self._allocators[group].free(pages)
-            self._queue.appendleft(req)
-            self._recover_cache()
-            raise
+
+        if scfg.retry_budget == 0:
+            try:
+                tok = attempt()
+            except Exception:
+                # a failing prefill (transient device error, first-bucket
+                # compile OOM) must not bleed the pool dry across retries:
+                # return the grant, put the request back at the head, and
+                # reset the (possibly donated-and-consumed) cache — every
+                # in-flight request requeues for deterministic replay
+                self._allocators[group].free(pages)
+                self._queue.appendleft(req)
+                self._recover_cache()
+                raise
+        else:
+            tok = None
+            attempts = 1 + scfg.retry_budget
+            for a in range(attempts):
+                try:
+                    tok = attempt()
+                    break
+                except Exception as exc:
+                    self.metrics.counter("serve/prefill_failures").inc()
+                    # the donated cache may be consumed: reset it and
+                    # requeue every IN-FLIGHT request (rids key the PRNG
+                    # streams, so their replay is byte-identical); THIS
+                    # request keeps its grant for the next attempt
+                    self._recover_cache()
+                    if a + 1 >= attempts:
+                        self._allocators[group].free(pages)
+                        reason = f"{type(exc).__name__}: {exc}"
+                        self._quarantined[req.rid] = reason
+                        self.metrics.counter("serve/quarantined").inc()
+                        self.sink.emit("ft/quarantine", rid=req.rid,
+                                       attempts=attempts, error=reason)
+                        return False
+                    if self.sink.enabled:
+                        self.sink.emit("ft/prefill_retry", rid=req.rid,
+                                       attempt=a + 1,
+                                       error=f"{type(exc).__name__}: {exc}")
         self._prefill_s += self._last_span_s()
         self._prefill_count += 1
         self._tokens_generated += 1
@@ -347,6 +409,7 @@ class ServeEngine:
             rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_tok,
             max_new=req.max_new, last_token=tok, generated=[tok],
         )
+        return True
 
     def _evict(self, slot: int) -> tuple[int, tuple[int, ...]]:
         st = self._slots[slot]
@@ -409,7 +472,8 @@ class ServeEngine:
             if slot is None:
                 break
             req = self._queue.popleft()
-            self._admit(req, slot)
+            if not self._admit(req, slot):
+                continue  # quarantined: the slot stays free
             if req.max_new == 1:
                 finished.append(self._evict(slot))  # budget spent at prefill
 
@@ -475,6 +539,7 @@ class ServeEngine:
         tokens0 = self._tokens_generated
         decode0, prefill0 = self._decode_steps, self._prefill_count
         prefill_s0, decode_s0 = self._prefill_s, self._decode_s
+        quarantined0 = set(self._quarantined)
         for r in requests:
             self.submit(r)
         outputs: dict[int, tuple[int, ...]] = {}
@@ -489,7 +554,9 @@ class ServeEngine:
                 outputs[rid] = toks
             steps += 1
         report = self._report(outputs, tokens0, decode0, prefill0,
-                              prefill_s0, decode_s0)
+                              prefill_s0, decode_s0,
+                              tuple(sorted(set(self._quarantined)
+                                           - quarantined0)))
         self.sink.emit(
             "serve/report",
             completed=report.completed,
@@ -499,6 +566,7 @@ class ServeEngine:
             prefill_compiles=report.prefill_compiles,
             prefill_s=round(report.prefill_s, 6),
             decode_s=round(report.decode_s, 6),
+            quarantined=len(report.quarantined),
         )
         self.sink.emit_metrics(self.metrics.snapshot(),
                                scope=self.metrics.id)
@@ -506,7 +574,7 @@ class ServeEngine:
         return report
 
     def _report(self, outputs, tokens0, decode0, prefill0, prefill_s0,
-                decode_s0) -> GenerateReport:
+                decode_s0, quarantined=()) -> GenerateReport:
         return GenerateReport(
             completed=len(outputs),
             tokens_generated=self._tokens_generated - tokens0,
@@ -517,4 +585,5 @@ class ServeEngine:
             prefill_s=self._prefill_s - prefill_s0,
             decode_s=self._decode_s - decode_s0,
             outputs=tuple(sorted(outputs.items())),
+            quarantined=tuple(quarantined),
         )
