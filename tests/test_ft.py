@@ -340,6 +340,28 @@ class TestTrainerChaos:
         assert plan1.stats() == plan2.stats() != {}      # same schedule
         assert restarts1 == restarts2 == 1
 
+    @pytest.mark.zero
+    def test_zero_nan_rollback_heals_bit_identical(self, devices,
+                                                   tmp_path):
+        """GuardPolicy skip/rollback under ZeRO-SHARDED moments: the
+        ladder restores dp-sharded flat optimizer shards from the
+        checkpoint and the healed run is bit-identical to the
+        fault-free ZeRO run — the same contract the replicated path
+        proves above, now over the sharded state layout."""
+        mesh, cfg = _mesh(), _cfg()
+        kw = dict(save_every=3, lr=0.005, seed=3, optimizer="adam",
+                  zero=True)
+        clean, _ = train(mesh, cfg, steps=6,
+                         ckpt_dir=str(tmp_path / "zclean"), **kw)
+        plan = ChaosPlan(0, [Fault("train/grad", at=(4,), kind="nan")])
+        healed, rep = train(
+            mesh, cfg, steps=6, ckpt_dir=str(tmp_path / "znan"),
+            chaos=plan, guard=GuardPolicy(max_skips=0, max_rollbacks=1),
+            **kw,
+        )
+        assert rep.skipped == 1 and rep.rollbacks == 1
+        assert _params_equal(healed, clean)
+
     def test_rollback_budget_exhaustion_raises_guard_failure(self):
         # the ladder's bounded end — pure host logic, no compile needed:
         # a never-healing skip stream burns the rollback budget and

@@ -336,3 +336,64 @@ class TestFusedAdam:
                 np.asarray(p16[kk]), np.asarray(p32[kk]),
                 rtol=1e-2, atol=1e-2,
             )
+
+    def test_flat_donation_updates_in_place(self):
+        """ISSUE 4 satellite: the donating flat path consumes its
+        w/m/v inputs (outputs alias their HBM — the optimizer never
+        holds two live copies of a moment), keeps the gradient buffer,
+        leaves the live-array census flat, and matches the
+        non-donating program exactly."""
+        import jax
+
+        from tpuscratch.ops.adam import _COLS, fused_adam_flat
+        from tpuscratch.runtime import memory
+
+        def fresh():
+            rng = np.random.default_rng(33)
+            mk = lambda: jnp.asarray(  # noqa: E731
+                rng.standard_normal((64, _COLS)), jnp.float32
+            )
+            return mk(), mk(), mk(), jnp.abs(mk())
+
+        w, g, m, v = fresh()
+        jax.block_until_ready((w, g, m, v))
+        before = memory.live_bytes()
+        w2, m2, v2 = fused_adam_flat(w, g, m, v, 1e-3)
+        jax.block_until_ready((w2, m2, v2))
+        # donated inputs are consumed; the gradient is not donated
+        assert w.is_deleted() and m.is_deleted() and v.is_deleted()
+        assert not g.is_deleted()
+        # census: 3 outputs replaced 3 inputs in place — no growth
+        # beyond the (already-counted) gradient buffer
+        after = memory.live_bytes()
+        assert after <= before + w2.nbytes // 64, (before, after)
+
+        w3, g3, m3, v3 = fresh()
+        ref_w, ref_m, ref_v = fused_adam_flat(w3, g3, m3, v3, 1e-3,
+                                              donate=False)
+        assert not w3.is_deleted()  # donate=False leaves inputs alone
+        np.testing.assert_array_equal(np.asarray(w2), np.asarray(ref_w))
+        np.testing.assert_array_equal(np.asarray(m2), np.asarray(ref_m))
+        np.testing.assert_array_equal(np.asarray(v2), np.asarray(ref_v))
+
+    def test_tree_donation_matches_and_spares_originals(self):
+        """fused_adam_tree(donate=True) donates only the flat STAGING
+        copies — the caller's leaf arrays survive — and the numbers are
+        identical to the non-donating path."""
+        import jax
+
+        from tpuscratch.ops.adam import fused_adam_tree
+
+        rng = np.random.default_rng(34)
+        params = self._tree(rng)
+        grads = self._tree(rng)
+        mu = self._tree(rng)
+        nu = jax.tree.map(jnp.abs, self._tree(rng))
+        p1, m1, v1 = fused_adam_tree(params, grads, mu, nu, 1e-3)
+        p2, m2, v2 = fused_adam_tree(params, grads, mu, nu, 1e-3,
+                                     donate=True)
+        assert not any(x.is_deleted() for x in jax.tree.leaves(params))
+        assert not any(x.is_deleted() for x in jax.tree.leaves(mu))
+        for a, b in zip(jax.tree.leaves((p1, m1, v1)),
+                        jax.tree.leaves((p2, m2, v2))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

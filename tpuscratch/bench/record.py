@@ -742,6 +742,132 @@ def config12_decode(out: list, obs_path=None) -> None:
     )
 
 
+def config13_zero_train(out: list, iters: int = 3) -> None:
+    """Replicated vs ZeRO-sharded training (ISSUE 4): tokens/s of the
+    Adam train step at dp in {1, 2, 4}, next to the STATIC grad-sync
+    wire bytes the obs ledger reads off each compiled program — the row
+    that captures both halves of the ZeRO trade (measured rate, proven
+    comm).  The static bytes are exact (not sampled): reintroducing a
+    full gradient all-reduce shows up as grad_ratio jumping from ~0.5
+    to ~1.0 regardless of measurement noise.  The accum sweep records
+    the deferred-sync amortization: one reduce-scatter + all-gather per
+    k microbatches."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuscratch.bench.train_bench import bench_train
+    from tpuscratch.models.transformer import (
+        TransformerConfig,
+        init_adam_state,
+        init_params,
+        train_step_adam,
+    )
+    from tpuscratch.models.zero import init_zero_adam_state, train_step_zero
+    from tpuscratch.obs import ledger as obs_ledger
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    base = (
+        TransformerConfig(
+            d_model=1024, n_heads=8, n_experts=4, d_ff=4096, n_layers=4,
+            capacity_factor=2.0, attn_impl="pallas",
+        )
+        if on_tpu
+        else TransformerConfig(
+            d_model=32, n_heads=2, n_experts=4, d_ff=64, n_layers=1
+        )
+    )
+    seq = 2048 if on_tpu else 16
+    batch_per_dp = 8 if on_tpu else 2
+    avail = len(jax.devices())
+    emitted = 0
+    for dp in (1, 2, 4):
+        if dp > avail:
+            print(f"# config 13 dp={dp} skipped: {avail} device(s)",
+                  file=sys.stderr)
+            continue
+        cfg = dataclasses.replace(base, n_experts=max(base.n_experts, dp))
+        mesh = make_mesh((dp, 1), ("dp", "sp"), jax.devices()[:dp])
+        params = init_params(0, cfg)
+        x = jnp.zeros((dp * batch_per_dp, seq, cfg.d_model), jnp.float32)
+        rep_gs = obs_ledger.grad_sync_wire_bytes(obs_ledger.analyze(
+            train_step_adam(mesh, cfg), params, init_adam_state(params),
+            x, x,
+        ))
+        zero_gs = obs_ledger.grad_sync_wire_bytes(obs_ledger.analyze(
+            train_step_zero(mesh, cfg, donate=False), params,
+            init_zero_adam_state(params, dp), x, x,
+        ))
+        row = {
+            "dp": dp,
+            "grad_sync_bytes_replicated": rep_gs.grad,
+            "grad_sync_bytes_zero": zero_gs.grad,
+            "grad_ratio": (zero_gs.grad / rep_gs.grad
+                           if rep_gs.grad else None),
+            "zero_all_gather_bytes": zero_gs.all_gather,
+        }
+        for zero in (False, True):
+            try:
+                r = bench_train(
+                    mesh=mesh, cfg=cfg, batch=dp * batch_per_dp, seq=seq,
+                    steps=20 if on_tpu else 2, iters=iters,
+                    fence="readback" if on_tpu else "block",
+                    optimizer="adam", zero=zero,
+                )
+            except Exception as e:
+                print(f"# config 13 dp={dp} zero={zero} failed: {e}",
+                      file=sys.stderr)
+                continue
+            print(f"# {r.summary()} -> {r.items_per_s:.3e} tok/s",
+                  file=sys.stderr)
+            row["zero_tokens_per_s" if zero else "repl_tokens_per_s"] = (
+                r.items_per_s
+            )
+        if "repl_tokens_per_s" not in row and \
+                "zero_tokens_per_s" not in row:
+            continue
+        _emit(out, config=13, metric=f"zero_vs_replicated_dp{dp}", **row)
+        emitted += 1
+    if not emitted:
+        raise RuntimeError("all config-13 dp points failed")
+
+    # deferred-sync accumulation sweep (largest mesh that fit): static
+    # per-microbatch sync bytes ÷ k alongside the measured rate
+    dp = min(4, avail) if avail >= 2 else 1
+    dp = {1: 1, 2: 2, 3: 2}.get(dp, 4)
+    cfg = dataclasses.replace(base, n_experts=max(base.n_experts, dp))
+    mesh = make_mesh((dp, 1), ("dp", "sp"), jax.devices()[:dp])
+    sweep = []
+    for k in (1, 2, 4):
+        params = init_params(0, cfg)
+        xk = jnp.zeros(
+            ((k,) if k > 1 else ()) + (dp * batch_per_dp, seq, cfg.d_model),
+            jnp.float32,
+        )
+        gs = obs_ledger.grad_sync_wire_bytes(obs_ledger.analyze(
+            train_step_zero(mesh, cfg, accum_steps=k, donate=False),
+            params, init_zero_adam_state(params, dp), xk, xk,
+        ))
+        entry = {"accum": k,
+                 "sync_bytes_per_microbatch": gs.per_microbatch(k)}
+        try:
+            r = bench_train(
+                mesh=mesh, cfg=cfg, batch=dp * batch_per_dp, seq=seq,
+                steps=10 if on_tpu else 2, iters=iters,
+                fence="readback" if on_tpu else "block",
+                optimizer="adam", zero=True, accum_steps=k,
+            )
+            print(f"# {r.summary()} -> {r.items_per_s:.3e} tok/s",
+                  file=sys.stderr)
+            entry["tokens_per_s"] = r.items_per_s
+        except Exception as e:
+            print(f"# config 13 accum={k} failed: {e}", file=sys.stderr)
+        sweep.append(entry)
+    _emit(out, config=13, metric="zero_accum_sweep", dp=dp, sweep=sweep)
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -755,12 +881,13 @@ CONFIGS = {
     10: config10_dma_halo,
     11: config11_train,
     12: config12_decode,
+    13: config13_zero_train,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

@@ -47,22 +47,59 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
 
 
 def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
-                             lr: float = 1e-3, optimizer: str = "sgd"):
+                             lr: float = 1e-3, optimizer: str = "sgd",
+                             zero: bool = False, accum_steps: int = 1):
     """jit'd fn(params, x, y) -> (params, loss) running ``steps`` train
     steps in one scan (the data is reused — throughput, not learning).
     ``optimizer='adam'`` carries the moment state through the scan too
     (initialized fresh inside the program — throughput, not a resumable
-    run)."""
+    run).  ``zero=True`` swaps in the ZeRO-sharded step
+    (``models.zero``: reduce-scatter grad sync, dp-sharded flat Adam
+    shards carried through the scan, trailing param all-gather);
+    ``accum_steps=k`` (ZeRO only) shapes x, y as ``(k, batch, seq, d)``
+    and defers the one gradient sync to the last microbatch."""
     from jax.sharding import PartitionSpec as P
 
     from tpuscratch.comm import run_spmd
     from tpuscratch.models.transformer import (
         init_adam_state,
+        param_spec as _param_spec,
         train_step_adam_fn,
     )
 
     if optimizer not in ("sgd", "adam"):
         raise ValueError(f"optimizer must be sgd|adam, got {optimizer!r}")
+    if zero and optimizer != "adam":
+        raise ValueError("zero=True requires optimizer='adam'")
+    if accum_steps > 1 and not zero:
+        raise ValueError("accum_steps > 1 is the ZeRO deferred-sync path")
+    if zero:
+        from jax import lax as _lax
+
+        from tpuscratch.models.zero import (
+            local_zero_state,
+            train_step_zero_fn,
+        )
+
+        step = train_step_zero_fn(cfg, lr=lr, accum_steps=accum_steps)
+        n_dp = mesh.shape["dp"]
+
+        def body(params, x, y):
+            def one(carry, _):
+                p, o = carry
+                p, o, loss = step(p, o, x, y)
+                return (p, o), loss
+
+            (params, _), losses = _lax.scan(
+                one, (params, local_zero_state(params, n_dp)), None,
+                length=steps,
+            )
+            return params, losses[-1]
+
+        pspec = _param_spec(cfg)
+        dspec = (P("dp", "sp") if accum_steps == 1
+                 else P(None, "dp", "sp"))
+        return run_spmd(mesh, body, (pspec, dspec, dspec), (pspec, P()))
     if optimizer == "adam":
         step = train_step_adam_fn(cfg, lr=lr)
 
@@ -108,8 +145,14 @@ def bench_train(
     fence: str = "readback",
     seed: int = 0,
     optimizer: str = "sgd",
+    zero: bool = False,
+    accum_steps: int = 1,
 ) -> BenchResult:
-    """tokens/s of the composed train step; items = tokens processed."""
+    """tokens/s of the composed train step; items = tokens processed.
+    ``zero``/``accum_steps``: the ZeRO-sharded step (see
+    :func:`train_throughput_program`) — with accumulation every scanned
+    step consumes ``accum_steps`` microbatches, and the token count
+    scales accordingly."""
     from tpuscratch.runtime.mesh import make_mesh
 
     on_tpu = jax.default_backend() == "tpu"
@@ -132,20 +175,27 @@ def bench_train(
     steps = steps if steps is not None else (20 if on_tpu else 2)
 
     rng = np.random.default_rng(seed)
-    x = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32))
-    y = jnp.asarray(rng.standard_normal((batch, seq, cfg.d_model)).astype(np.float32))
+    shape = (batch, seq, cfg.d_model)
+    if accum_steps > 1:
+        shape = (accum_steps,) + shape
+    x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
     params = init_params(seed, cfg)
-    prog = train_throughput_program(mesh, cfg, steps, optimizer=optimizer)
+    prog = train_throughput_program(mesh, cfg, steps, optimizer=optimizer,
+                                    zero=zero, accum_steps=accum_steps)
     # correctness gate doubles as compile warmup: the loss must be finite
     out_params, loss = prog(params, x, y)
     if not np.isfinite(float(loss)):
         raise AssertionError(f"train step produced loss {float(loss)}")
-    tokens = batch * seq * steps
+    tokens = batch * seq * steps * accum_steps
+    opt_tag = f"{'zero-' if zero else ''}{optimizer}" + (
+        f"-accum{accum_steps}" if accum_steps > 1 else ""
+    )
     return time_device(
         prog, params, x, y, iters=iters, warmup=1, fence=fence,
         name=(
             f"train d{cfg.d_model} ff{cfg.d_ff} L{cfg.n_layers} "
-            f"e{cfg.n_experts} {cfg.compute_dtype} {optimizer} b{batch} "
+            f"e{cfg.n_experts} {cfg.compute_dtype} {opt_tag} b{batch} "
             f"s{seq} x{steps} on {mesh.shape['dp']}x{mesh.shape['sp']} "
             f"({cfg.attn_impl})"
         ),
@@ -272,11 +322,29 @@ def bench_obs_overhead(
 def main() -> int:
     import sys
 
-    if "--obs-overhead" in sys.argv[1:]:
+    argv = sys.argv[1:]
+    if "--obs-overhead" in argv:
         o = bench_obs_overhead()
         print(o.summary())
         return 0
-    r = bench_train()
+    zero = "--zero" in argv
+    optimizer = "adam" if (zero or "--adam" in argv) else "sgd"
+    if "--accum" in argv:
+        # --accum k1,k2,...: the deferred-sync sweep — one row per
+        # accumulation depth, same optimizer/mesh, so the k-fold sync
+        # cut shows up as the tokens/s delta down the column
+        at = argv.index("--accum") + 1
+        try:
+            ks = [int(k) for k in argv[at].split(",")]
+        except (IndexError, ValueError):
+            print("usage: train_bench --accum K1[,K2,...]  (e.g. "
+                  "--accum 1,2,4)", file=sys.stderr)
+            return 2
+        for k in ks:
+            r = bench_train(zero=True, accum_steps=k, optimizer="adam")
+            print(f"{r.summary()} -> {r.items_per_s:.3e} tokens/s")
+        return 0
+    r = bench_train(zero=zero, optimizer=optimizer)
     print(f"{r.summary()} -> {r.items_per_s:.3e} tokens/s")
     return 0
 

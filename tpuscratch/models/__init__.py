@@ -16,6 +16,11 @@ from tpuscratch.models.transformer import (  # noqa: F401
     train_step,
     train_step_adam,
 )
+from tpuscratch.models.zero import (  # noqa: F401
+    init_zero_adam_state,
+    train_step_zero,
+    zero_state_spec,
+)
 from tpuscratch.models.ssm import SSMConfig, ssm_block  # noqa: F401
 from tpuscratch.models.ssm import init_params as init_ssm_params  # noqa: F401
 from tpuscratch.models.trainer import TrainReport, train  # noqa: F401

@@ -46,6 +46,12 @@ from tpuscratch.models.transformer import (
     train_step,
     train_step_adam,
 )
+from tpuscratch.models.zero import (
+    init_zero_adam_state,
+    put_zero_state,
+    train_step_zero,
+)
+from tpuscratch.runtime.errors import CommError
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
 from tpuscratch.runtime import checkpoint
@@ -79,13 +85,16 @@ def _cfg_fingerprint(cfg: TransformerConfig) -> str:
     return ",".join(f"{k}={fields[k]}" for k in sorted(fields))
 
 
-def _restore_state(ckpt_dir: str, params, opt, step):
+def _restore_state(ckpt_dir: str, params, opt, step, mesh_shape=None):
     """Restore the full training state at ``step`` (params alone for
-    SGD, params+moments for Adam) — the ONE restore/unpack sequence the
-    entry resume and the guard rollback share.  Returns
-    (params, opt, step, metadata)."""
+    SGD, params+moments for Adam/ZeRO) — the ONE restore/unpack sequence
+    the entry resume and the guard rollback share.  ``mesh_shape`` (the
+    ZeRO path) makes the checkpoint layer itself reject a checkpoint
+    whose dp-sharded optimizer leaves were laid out for a different
+    mesh.  Returns (params, opt, step, metadata)."""
     state = {"params": params, "opt": opt} if opt is not None else params
-    state, step, meta = checkpoint.restore(ckpt_dir, state, step=step)
+    state, step, meta = checkpoint.restore(ckpt_dir, state, step=step,
+                                           mesh_shape=mesh_shape)
     if opt is not None:
         return state["params"], state["opt"], step, meta
     return state, opt, step, meta
@@ -121,6 +130,8 @@ def train(
     chaos=None,
     guard: Optional[GuardPolicy | GuardState] = None,
     save_retry: Optional[RetryPolicy] = None,
+    zero: bool = False,
+    accum_steps: int = 1,
 ) -> tuple[dict, TrainReport]:
     """Run (or resume) ``steps`` training steps, checkpointing every
     ``save_every``. Returns (params, report). ``optimizer`` is 'sgd' or
@@ -155,18 +166,44 @@ def train(
       (bounded by ``max_rollbacks``, then ``ft.GuardFailure``).
     - ``save_retry`` (an ``ft.RetryPolicy``) wraps every checkpoint
       save; defaults on when ``chaos`` is attached so injected IO
-      faults are absorbed rather than fatal."""
+      faults are absorbed rather than fatal.
+
+    ``zero=True`` (requires ``optimizer='adam'``) selects the
+    ZeRO-sharded path (``models.zero``): gradients reduce-scatter over
+    "dp" instead of all-reducing, the Adam moments live as dp-sharded
+    flat shards (optimizer HBM ÷ |dp|, updated in place via buffer
+    donation), and updated params are all-gathered for the next
+    forward.  The checkpoint then holds the SHARDED optimizer leaves
+    and records the mesh shape — resuming on a mesh with a different
+    |dp| raises a ``CommError`` instead of mis-loading.
+    ``accum_steps=k`` (ZeRO only) folds k microbatches into each
+    update with gradient accumulation, deferring the single
+    reduce-scatter to the last microbatch; each step then consumes k
+    consecutive entries of the deterministic batch stream, so
+    ``accum_steps`` is part of the resume identity like ``batch``."""
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if optimizer not in ("sgd", "adam"):
         raise ValueError(f"optimizer must be sgd|adam, got {optimizer!r}")
+    if zero and optimizer != "adam":
+        raise ValueError("zero=True shards optimizer state: optimizer "
+                         f"must be 'adam', got {optimizer!r}")
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if accum_steps > 1 and not zero:
+        raise ValueError("accum_steps > 1 is the ZeRO path's "
+                         "deferred-sync feature: pass zero=True")
     dp_n = mesh.shape["dp"]
     sp_n = mesh.shape["sp"]
     batch = batch if batch is not None else 2 * dp_n
     seq = seq if seq is not None else 8 * sp_n
+    mesh_shape = {"dp": int(dp_n), "sp": int(sp_n)} if zero else None
 
     params = init_params(seed, cfg)
-    opt = init_adam_state(params) if optimizer == "adam" else None
+    if zero:
+        opt = put_zero_state(init_zero_adam_state(params, dp_n), mesh, cfg)
+    else:
+        opt = init_adam_state(params) if optimizer == "adam" else None
     start = 0
     if checkpoint.latest_step(ckpt_dir) is not None:
         # the bit-identical contract only holds if the resumed run replays
@@ -181,14 +218,31 @@ def train(
         # adam resume against one fails as a clear mismatch instead of
         # a leaf-count error from restore
         meta.setdefault("optimizer", "sgd")
+        # pre-ZeRO checkpoints are replicated single-microbatch runs
+        meta.setdefault("zero", False)
+        meta.setdefault("accum_steps", 1)
         if start > steps:
             raise ValueError(
                 f"checkpoint in {ckpt_dir} is at step {start}, beyond the "
                 f"requested {steps} (use a fresh ckpt_dir)"
             )
+        if zero and meta.get("mesh_shape") is not None \
+                and meta["mesh_shape"] != mesh_shape:
+            # the dp-sharded flat moments are laid out for ONE |dp|;
+            # CommError (not ValueError) — this is a sharding-layout
+            # failure, the class the comm/runtime layer owns
+            raise CommError(
+                "train/resume",
+                f"checkpoint in {ckpt_dir} holds ZeRO optimizer state "
+                f"sharded for mesh {meta['mesh_shape']}, this run's mesh "
+                f"is {mesh_shape} — dp-sharded moments cannot be "
+                f"re-laid-out implicitly (re-train or resume on a "
+                f"matching mesh)",
+            )
         for key, val in (
             ("lr", lr), ("seed", seed), ("batch", batch), ("seq", seq),
             ("cfg", _cfg_fingerprint(cfg)), ("optimizer", optimizer),
+            ("zero", zero), ("accum_steps", accum_steps),
         ):
             if key not in meta:
                 # legacy checkpoint (pre-dates this key): resumable, but
@@ -208,8 +262,11 @@ def train(
                     f"resume mismatch: checkpoint has {key}={meta[key]}, "
                     f"this run asked for {val} (use a fresh ckpt_dir)"
                 )
-        params, opt, start, meta = _restore_state(ckpt_dir, params, opt,
-                                                  start)
+        params, opt, start, meta = _restore_state(
+            ckpt_dir, params, opt, start, mesh_shape=mesh_shape
+        )
+        if zero:
+            opt = put_zero_state(opt, mesh, cfg)
         log(f"resumed at step {start} (meta {meta})")
 
     sink = obs if obs is not None else NullSink()
@@ -229,7 +286,12 @@ def train(
     else:
         guard_state = GuardState(guard) if guard is not None else None
     step_guard = guard.step_guard() if guard is not None else None
-    if optimizer == "adam":
+    if zero:
+        step_fn = train_step_zero(mesh, cfg, lr=lr, counter=counter,
+                                  accum_steps=accum_steps,
+                                  with_grad_norm=want_gnorm,
+                                  guard=step_guard)
+    elif optimizer == "adam":
         step_fn = train_step_adam(mesh, cfg, lr=lr, counter=counter,
                                   with_grad_norm=want_gnorm,
                                   guard=step_guard)
@@ -245,8 +307,10 @@ def train(
     metadata = {
         "steps_total": steps, "lr": lr, "seed": seed,
         "batch": batch, "seq": seq, "cfg": _cfg_fingerprint(cfg),
-        "optimizer": optimizer,
+        "optimizer": optimizer, "zero": zero, "accum_steps": accum_steps,
     }
+    if zero:
+        metadata["mesh_shape"] = mesh_shape
     save_hook = chaos.save_hook() if chaos is not None else None
     save_policy = save_retry if save_retry is not None else (
         DEFAULT_SAVE_RETRY if chaos is not None else None
@@ -261,7 +325,20 @@ def train(
         statuses = []
         t0 = time.perf_counter()
         for i in range(chunk):
-            x, y = synthetic_batch(seed, start + i, batch, seq, cfg.d_model)
+            if accum_steps > 1:
+                # each update consumes accum_steps consecutive entries
+                # of the deterministic stream (at k=1 this is exactly
+                # the legacy indexing, so trajectories line up)
+                micro = [
+                    synthetic_batch(seed, (start + i) * accum_steps + j,
+                                    batch, seq, cfg.d_model)
+                    for j in range(accum_steps)
+                ]
+                x = jnp.stack([m[0] for m in micro])
+                y = jnp.stack([m[1] for m in micro])
+            else:
+                x, y = synthetic_batch(seed, start + i, batch, seq,
+                                       cfg.d_model)
             if chaos is not None:
                 x = chaos.corrupt_batch(x, start + i)
             if guard is not None:
@@ -297,13 +374,20 @@ def train(
                 rb_to = checkpoint.latest_step(ckpt_dir)
                 if rb_to is None:
                     params = init_params(seed, cfg)
-                    opt = (init_adam_state(params) if optimizer == "adam"
-                           else None)
+                    if zero:
+                        opt = put_zero_state(
+                            init_zero_adam_state(params, dp_n), mesh, cfg
+                        )
+                    else:
+                        opt = (init_adam_state(params)
+                               if optimizer == "adam" else None)
                     rb_to = 0
                 else:
                     params, opt, rb_to, _ = _restore_state(
-                        ckpt_dir, params, opt, rb_to
+                        ckpt_dir, params, opt, rb_to, mesh_shape=mesh_shape
                     )
+                    if zero:
+                        opt = put_zero_state(opt, mesh, cfg)
                 sink.emit("ft/rollback", from_step=start + chunk,
                           to_step=rb_to)
                 log(f"guard rollback: step {start + chunk} -> {rb_to}")
@@ -323,7 +407,9 @@ def train(
             "step": start, "loss": loss_f,
             "step_s": round(chunk_s / chunk, 6),
             "steps_per_s": round(chunk / chunk_s, 3),
-            "tokens_per_s": round(chunk * batch * seq / chunk_s, 3),
+            "tokens_per_s": round(
+                chunk * accum_steps * batch * seq / chunk_s, 3
+            ),
             "compiles": counter.count,
         }
         if gnorm is not None:

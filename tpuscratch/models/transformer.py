@@ -110,6 +110,75 @@ def _is_expert_leaf(path) -> bool:
     return any(getattr(k, "key", None) in EXPERT_LEAVES for k in path)
 
 
+def nonexpert_size(tree) -> int:
+    """Total element count of the NON-expert (replicated) leaves — the
+    population the ZeRO path flattens into one dp-sharded vector."""
+    total = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if not _is_expert_leaf(path):
+            total += int(np.prod(np.shape(leaf)))
+    return total
+
+
+def expert_leaves(tree) -> list:
+    """The expert leaves of ``tree`` in tree-flatten order (the order
+    :func:`pack_nonexpert`/:func:`unpack_nonexpert` also walk) — the
+    dp-sharded complement of the packed flat vector."""
+    return [
+        leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        if _is_expert_leaf(path)
+    ]
+
+
+def pack_nonexpert(tree, pad_to: int | None = None):
+    """Flatten every non-expert leaf of ``tree`` into ONE 1-D f32 vector
+    (tree-flatten order), zero-padded to ``pad_to`` elements — the layout
+    the ZeRO path reduce-scatters over "dp" and the fused optimizer
+    updates as per-rank shards.  Zero padding is exact for the gradient
+    math: padded slots carry zero gradient and zero moments forever."""
+    flats = [
+        jnp.ravel(leaf)
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        if not _is_expert_leaf(path)
+    ]
+    flat = jnp.concatenate(flats) if flats else jnp.zeros((0,), jnp.float32)
+    if pad_to is not None:
+        if pad_to < flat.size:
+            raise ValueError(
+                f"pad_to {pad_to} smaller than packed size {flat.size}"
+            )
+        if pad_to > flat.size:
+            flat = jnp.concatenate(
+                [flat, jnp.zeros((pad_to - flat.size,), flat.dtype)]
+            )
+    return flat
+
+
+def unpack_nonexpert(flat, experts: list, like):
+    """Inverse of :func:`pack_nonexpert` + :func:`expert_leaves`:
+    rebuild a full parameter tree shaped ``like``, non-expert leaves
+    sliced out of ``flat`` (padding tail ignored), expert leaves taken
+    from the ``experts`` list in order."""
+    offset = 0
+    exp_iter = iter(experts)
+
+    def fill(path, leaf):
+        nonlocal offset
+        if _is_expert_leaf(path):
+            return next(exp_iter)
+        n = int(np.prod(np.shape(leaf)))
+        seg = flat[offset:offset + n].reshape(np.shape(leaf))
+        offset += n
+        return seg
+
+    out = jax.tree_util.tree_map_with_path(fill, like)
+    rest = list(exp_iter)
+    if rest:
+        raise ValueError(f"{len(rest)} expert leaves left over in unpack")
+    return out
+
+
 def param_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
     """PartitionSpec pytree: expert leaves sharded over ``dp`` on their
     expert axis, everything else replicated. Built structurally from the
@@ -322,20 +391,37 @@ def adam_state_spec(cfg: TransformerConfig, dp: str = "dp") -> dict:
     }
 
 
+def adam_alpha(t, lr, b1, b2):
+    """Bias-corrected Adam step size at (1-based) step ``t`` — scalar,
+    traced once, shared by every update variant (tree-mapped, fused
+    kernel, ZeRO shard)."""
+    tf = t.astype(jnp.float32)
+    return lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
+
+
+def _adam_apply(params, mu, nu, grads, alpha, b1, b2, eps):
+    """One elementwise Adam application over matching pytrees with the
+    step size ``alpha`` already bias-corrected: returns
+    (new_params, new_mu, new_nu).  Sharding-agnostic — the ZeRO path
+    runs it on dp-local expert leaves, the replicated path on the full
+    tree."""
+    mu = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, mu, grads)
+    nu = jax.tree.map(lambda v, g: b2 * v + (1.0 - b2) * g * g, nu, grads)
+    new_params = jax.tree.map(
+        lambda w, m, v: w - alpha * m / (jnp.sqrt(v) + eps),
+        params, mu, nu,
+    )
+    return new_params, mu, nu
+
+
 def _adam_update(params, opt, grads, lr, b1, b2, eps):
     """The shared Adam math (elementwise, sharding-agnostic): returns
     (new_params, new_opt).  Bias correction is folded into the step
     size (scalar, traced once)."""
     t = opt["t"] + 1
-    mu = jax.tree.map(lambda m, g: b1 * m + (1.0 - b1) * g, opt["mu"], grads)
-    nu = jax.tree.map(
-        lambda v, g: b2 * v + (1.0 - b2) * g * g, opt["nu"], grads
-    )
-    tf = t.astype(jnp.float32)
-    alpha = lr * jnp.sqrt(1.0 - b2**tf) / (1.0 - b1**tf)
-    new_params = jax.tree.map(
-        lambda w, m, v: w - alpha * m / (jnp.sqrt(v) + eps),
-        params, mu, nu,
+    new_params, mu, nu = _adam_apply(
+        params, opt["mu"], opt["nu"], grads, adam_alpha(t, lr, b1, b2),
+        b1, b2, eps,
     )
     return new_params, {"mu": mu, "nu": nu, "t": t}
 

@@ -35,11 +35,13 @@ from typing import Optional, Sequence
 
 __all__ = [
     "CollectiveOp",
+    "GradSyncBytes",
     "Ledger",
     "RooflineReport",
     "all_gather_wire_bytes",
     "all_to_all_wire_bytes",
     "analyze",
+    "grad_sync_wire_bytes",
     "parse_collectives",
     "reduce_scatter_wire_bytes",
     "ring_all_reduce_wire_bytes",
@@ -249,6 +251,64 @@ class Ledger:
         if not counts:
             lines.append("no collectives")
         return "\n".join(lines)
+
+
+@dataclasses.dataclass(frozen=True)
+class GradSyncBytes:
+    """Per-device wire bytes of one train step's parameter/gradient
+    synchronization, split by collective kind (attention/MoE traffic —
+    ppermute, all-to-all — is deliberately excluded; those move
+    activations, not gradients):
+
+    - ``all_reduce``: every reducing all-reduce — the replicated path's
+      full gradient sync, plus the sp-copy psums and scalar loss pmeans
+      both paths share (scalar ops contribute ~0);
+    - ``reduce_scatter``: the ZeRO path's gradient sync — each rank
+      receives only its ``1/|dp|`` shard;
+    - ``all_gather``: the ZeRO path's trailing param gather (rebuilding
+      replicated params from updated shards).
+
+    ``grad`` (all_reduce + reduce_scatter) is the gradient-reduction
+    leg — the quantity the ≤ 0.55x regression guard watches: a ZeRO
+    step that reintroduces a full gradient all-reduce doubles it.
+    ``total`` adds the trailing all-gather — the whole sync cost of one
+    update, which gradient accumulation (``accum_steps=k``) pays once
+    per k microbatches instead of per microbatch."""
+
+    all_reduce: float
+    reduce_scatter: float
+    all_gather: float
+
+    @property
+    def grad(self) -> float:
+        return self.all_reduce + self.reduce_scatter
+
+    @property
+    def total(self) -> float:
+        return self.grad + self.all_gather
+
+    def per_microbatch(self, accum_steps: int = 1) -> float:
+        """Sync bytes amortized per microbatch under deferred-sync
+        accumulation: the one reduce-scatter + all-gather is paid once
+        per ``accum_steps`` microbatches."""
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        return self.total / accum_steps
+
+
+def grad_sync_wire_bytes(ledger: "Ledger") -> GradSyncBytes:
+    """The gradient-synchronization slice of a train-step ledger: summed
+    analytic wire bytes of its all-reduce, reduce-scatter, and
+    all-gather instructions (see :class:`GradSyncBytes` for what each
+    leg means).  Validated exactly against the ``(n-1)*shard`` /
+    ``(n-1)/n*result`` formulas for the ZeRO step in
+    tests/test_zero.py."""
+    wire = ledger.wire_bytes()
+    return GradSyncBytes(
+        all_reduce=wire.get("all-reduce", 0.0),
+        reduce_scatter=wire.get("reduce-scatter", 0.0),
+        all_gather=wire.get("all-gather", 0.0),
+    )
 
 
 def _cost_entry(compiled) -> dict:

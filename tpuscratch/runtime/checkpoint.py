@@ -193,7 +193,9 @@ def latest_step(ckpt_dir: str | os.PathLike) -> Optional[int]:
     return found[-1] if found else None
 
 
-def restore(ckpt_dir: str | os.PathLike, example_tree: Any, step: Optional[int] = None) -> tuple[Any, int, dict]:
+def restore(ckpt_dir: str | os.PathLike, example_tree: Any,
+            step: Optional[int] = None,
+            mesh_shape: Optional[dict] = None) -> tuple[Any, int, dict]:
     """Load (tree, step, metadata); ``example_tree`` supplies the treedef.
 
     Defaults to the latest step. Validation is per-leaf, not just a
@@ -201,8 +203,28 @@ def restore(ckpt_dir: str | os.PathLike, example_tree: Any, step: Optional[int] 
     (torn/truncated writes fail before the load) and its shape and dtype
     against the example tree (a corrupted or drifted leaf fails loudly
     instead of mis-loading silently).
+
+    ``mesh_shape`` (e.g. ``{"dp": 2, "sp": 2}``): callers restoring
+    MESH-SHARDED leaves — the ZeRO trainer's dp-sharded flat optimizer
+    moments — pass the mesh they will lay the state out on; if the
+    manifest metadata recorded a different ``mesh_shape`` at save time,
+    restore raises a :class:`runtime.errors.CommError` BEFORE any leaf
+    load (the sharded layout is part of the data's meaning, and a
+    shape-coincidence mis-load would silently scramble shards).
     """
     step, manifest = _read_manifest(ckpt_dir, step)
+    if mesh_shape is not None:
+        saved = manifest.get("metadata", {}).get("mesh_shape")
+        if saved is not None and saved != mesh_shape:
+            from tpuscratch.runtime.errors import CommError
+
+            raise CommError(
+                "ckpt/restore",
+                f"checkpoint step {step} in {ckpt_dir} holds leaves "
+                f"sharded for mesh {saved}, caller's mesh is "
+                f"{mesh_shape} — dp-sharded optimizer state cannot be "
+                f"re-laid-out implicitly across mesh shapes",
+            )
     path = _step_dir(pathlib.Path(ckpt_dir), step)
     leaves, treedef = jax.tree.flatten(example_tree)
     if manifest["n_leaves"] != len(leaves):
