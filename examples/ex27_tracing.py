@@ -1,0 +1,112 @@
+"""Flight recorder + goodput: every second of a chaotic run, accounted.
+
+The reference's performance lens is hand-placed clock() brackets printed
+per segment; this example flies the second observability layer over a
+deliberately messy training run — a chaos-injected NaN forces a guard
+rollback mid-run — and shows the artifacts a production fleet debugs
+from:
+
+1. the **flight recorder**: train/chunk, ckpt/save, and rollback spans
+   in a bounded ring, exported as Chrome trace-event JSON (open the
+   printed file in Perfetto / chrome://tracing) and schema-validated;
+2. the **goodput report**: the run's JSONL event stream partitioned into
+   goodput vs badput buckets — compile, checkpoint, rollback replay —
+   that provably sum to the wall time, plus MFU from the static ledger
+   FLOPs against a stated peak;
+3. the **straggler lens**: per-phase per-rank skew through the mesh
+   collectives (mesh_reduce max/min), naming a seeded slow rank.
+
+argv tier:  ex27_tracing.py [--steps=N]
+"""
+
+import json
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main(argv=None) -> None:
+    ensure_devices()
+    import numpy as np
+
+    from tpuscratch.ft import ChaosPlan, Fault, GuardPolicy
+    from tpuscratch.models import TransformerConfig
+    from tpuscratch.models.trainer import train
+    from tpuscratch.models.transformer import init_params, train_step
+    from tpuscratch.obs import (
+        FlightRecorder,
+        Sink,
+        analyze,
+        goodput_report,
+        mesh_straggler,
+        validate_chrome_trace,
+    )
+    from tpuscratch.obs import report as obs_report
+    from tpuscratch.runtime.config import Config
+    from tpuscratch.runtime.mesh import make_mesh
+
+    cli = Config.load(argv)
+    # two chunks of 3 steps; the NaN at step 4 rolls the second chunk back
+    steps = max(cli.steps, 6) if "steps" in cli.explicit else 6
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2, d_ff=32,
+                            n_layers=1, capacity_factor=2.0)
+    workdir = tempfile.mkdtemp(prefix="tpuscratch_trace_")
+    path = f"{workdir}/run.jsonl"
+
+    banner("1. chaotic training under the flight recorder")
+    rec = FlightRecorder()
+    plan = ChaosPlan(0, [Fault("train/grad", at=(4,), kind="nan")])
+    with Sink(path, run={"example": "ex27"}) as sink:
+        _, rep = train(
+            mesh, cfg, steps=steps, save_every=3,
+            ckpt_dir=f"{workdir}/ckpt", seed=3, obs=sink, recorder=rec,
+            chaos=plan, guard=GuardPolicy(max_skips=0, max_rollbacks=1),
+        )
+    print(f"ran {rep.steps_run} steps, skipped {rep.skipped}, "
+          f"rollbacks {rep.rollbacks}")
+    assert rep.rollbacks == 1, "the injected NaN should have rolled back"
+
+    banner("2. Chrome trace export (load in Perfetto)")
+    trace = rec.chrome_trace(pid=0, label="trainer")
+    n = validate_chrome_trace(trace)
+    trace_path = f"{workdir}/trace.json"
+    with open(trace_path, "w") as f:
+        json.dump(trace, f)
+    phases = rec.phase_totals()
+    for name in sorted(phases):
+        ph = phases[name]
+        print(f"  {name:<16} {ph.count:3d} span(s)  "
+              f"{ph.seconds * 1e3:8.2f} ms total")
+    print(f"{n} trace events validated (paired B/E, monotonic ts)")
+    print(f"trace written to {trace_path} — open it at ui.perfetto.dev")
+
+    banner("3. goodput report: MFU + the badput breakdown")
+    params = init_params(3, cfg)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 8, cfg.d_model)).astype(np.float32)
+    led = analyze(train_step(mesh, cfg), params, x, x)
+    events = obs_report.load_events([path])
+    gp = goodput_report(events, flops_per_step=led.flops,
+                        peak_flops_per_s=1e12)
+    print(gp.summary())
+    gp.check()  # buckets partition the wall exactly, by construction
+    assert gp.buckets["rollback"] > 0, "rollback badput must be visible"
+    assert gp.buckets["checkpoint"] > 0
+    assert gp.steps == steps
+    print("buckets sum to wall time: PASSED")
+
+    banner("4. straggler detection on a 2x2 mesh (seeded slow rank)")
+    mesh22 = make_mesh((2, 2), ("dp", "sp"))
+    per_rank = [0.101, 0.100, 0.502, 0.099]  # rank 2 is the straggler
+    sr = mesh_straggler(mesh22, "train/chunk", per_rank)
+    print(f"  {sr.summary()}")
+    assert sr.slowest == 2 and sr.skew > 4.0
+    print("\ntracing & goodput loop PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1047 @@
+"""Second observability layer: flight recorder + Chrome trace export,
+goodput/MFU accounting, straggler detection, and the bench regression
+gate (ISSUE 5)."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpuscratch.obs.trace import (
+    FlightRecorder,
+    StragglerReport,
+    detect_stragglers,
+    merge_chrome_traces,
+    mesh_straggler,
+    span_stamps,
+    validate_chrome_trace,
+)
+from tpuscratch.obs import goodput, regress, report
+from tpuscratch.runtime.mesh import make_mesh
+
+
+@pytest.mark.trace
+class TestFlightRecorder:
+    def test_span_records_and_aggregates(self):
+        rec = FlightRecorder()
+        with rec.span("phase", step=1) as ev:
+            time.sleep(0.002)
+        assert ev.end is not None and ev.seconds >= 0.002
+        evs = rec.events()
+        assert len(evs) == 1 and evs[0].name == "phase"
+        ph = rec.phase_totals()["phase"]
+        assert ph.count == 1 and ph.seconds == pytest.approx(ev.seconds)
+        assert ph.max_s == pytest.approx(ev.seconds)
+
+    def test_span_survives_exception(self):
+        rec = FlightRecorder()
+        with pytest.raises(RuntimeError):
+            with rec.span("boom"):
+                raise RuntimeError("x")
+        assert rec.phase_totals()["boom"].count == 1
+        assert rec.events()[0].end is not None
+
+    def test_instant(self):
+        rec = FlightRecorder()
+        rec.instant("mark", k=3)
+        ev = rec.events()[0]
+        assert ev.name == "mark" and ev.args == {"k": 3}
+
+    def test_ring_bounded_but_totals_exact(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(100):
+            rec.close_span(rec.open_span("p"))
+        assert len(rec.events()) <= 16
+        assert rec.dropped > 0
+        # eviction loses detail, never accounting
+        assert rec.phase_totals()["p"].count == 100
+
+    def test_thread_safety(self):
+        rec = FlightRecorder(capacity=64)
+
+        def worker():
+            for _ in range(200):
+                rec.close_span(rec.open_span("t"))
+                rec.instant("i")
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rec.phase_totals()["t"].count == 800
+        assert len(rec.events()) <= 64
+
+    def test_span_sync_fences_device_values(self, devices):
+        import jax
+        import jax.numpy as jnp
+
+        rec = FlightRecorder()
+        y = jax.jit(lambda a: a * 2)(jnp.ones(1 << 12))
+        with rec.span("fenced", sync=(y,)):
+            pass
+        assert rec.phase_totals()["fenced"].count == 1
+
+    def test_span_stamps(self):
+        rec = FlightRecorder()
+        for _ in range(3):
+            rec.close_span(rec.open_span("a"))
+        rec.close_span(rec.open_span("b"))
+        begins, ends = span_stamps(rec, "a")
+        assert len(begins) == len(ends) == 3
+        assert all(e >= b for b, e in zip(begins, ends))
+
+    def test_close_open_spans_commits_partial_wall(self):
+        """A span left open by a crashed invocation still counts its
+        partial wall once close_open_spans runs (the failure-path
+        filing); balanced spans are untouched."""
+        rec = FlightRecorder()
+        rec.close_span(rec.open_span("done"))
+        rec.open_span("leaked")
+        time.sleep(0.002)
+        assert rec.close_open_spans() == 1
+        ph = rec.phase_totals()
+        assert ph["leaked"].count == 1 and ph["leaked"].seconds >= 0.002
+        assert ph["done"].count == 1
+        assert rec.close_open_spans() == 0  # idempotent
+
+    def test_file_flight_data_on_raise(self, tmp_path):
+        """file_flight_data closes in-flight spans and emits the
+        trace/phase totals + buffered tail when the body raises — the
+        mid-chunk-crash accounting the trainer and halo driver share."""
+        from tpuscratch.obs.sink import Sink
+        from tpuscratch.obs.trace import file_flight_data
+
+        p = str(tmp_path / "crash.jsonl")
+        rec = FlightRecorder()
+        with pytest.raises(RuntimeError):
+            with Sink(p, flush_every=1000) as sink:
+                with file_flight_data(sink, rec):
+                    rec.close_span(rec.open_span("train/chunk"))
+                    rec.open_span("train/chunk")  # mid-chunk crash
+                    time.sleep(0.002)
+                    raise RuntimeError("boom")
+        events = [json.loads(l) for l in open(p)]
+        phases = [e for e in events if e["event"] == "trace/phase"]
+        assert len(phases) == 1 and phases[0]["phase"] == "train/chunk"
+        # BOTH spans counted — the in-flight one at its partial wall
+        assert phases[0]["count"] == 2
+        assert phases[0]["seconds"] >= 0.002
+
+
+@pytest.mark.trace
+class TestChromeTrace:
+    @staticmethod
+    def _recorder():
+        rec = FlightRecorder()
+        with rec.span("outer", step=1):
+            with rec.span("inner"):
+                time.sleep(0.001)
+        rec.instant("mark")
+        return rec
+
+    def test_golden_schema(self):
+        """Valid JSON, paired B/E events, monotonic ts — the golden
+        check the acceptance criteria gate on."""
+        trace = self._recorder().chrome_trace(pid=0, label="t")
+        text = json.dumps(trace)          # serializable as-is
+        assert json.loads(text) == trace  # and round-trips
+        n = validate_chrome_trace(trace)
+        assert n == 5  # outer B/E, inner B/E, one instant
+        phs = [e["ph"] for e in trace["traceEvents"]]
+        assert phs.count("B") == 2 and phs.count("E") == 2
+        assert phs.count("i") == 1
+
+    def test_nesting_order(self):
+        """inner opens after outer's B and closes before outer's E."""
+        trace = self._recorder().chrome_trace()
+        seq = [(e["name"], e["ph"]) for e in trace["traceEvents"]
+               if e["ph"] in ("B", "E")]
+        assert seq == [("outer", "B"), ("inner", "B"),
+                       ("inner", "E"), ("outer", "E")]
+
+    def test_validator_rejects_mispaired(self):
+        trace = self._recorder().chrome_trace()
+        bad = dict(trace, traceEvents=[
+            e for e in trace["traceEvents"]
+            if not (e["ph"] == "E" and e["name"] == "inner")
+        ])
+        with pytest.raises(ValueError, match="mispaired|unclosed"):
+            validate_chrome_trace(bad)
+
+    def test_validator_rejects_nonmonotonic(self):
+        trace = self._recorder().chrome_trace()
+        evs = [dict(e) for e in trace["traceEvents"]]
+        data = [e for e in evs if e["ph"] != "M"]
+        data[-1]["ts"] = -1.0
+        with pytest.raises(ValueError, match="non-monotonic"):
+            validate_chrome_trace(dict(trace, traceEvents=evs))
+
+    def test_merge_per_host_lanes(self):
+        traces = {h: self._recorder().chrome_trace(pid=0) for h in (0, 1)}
+        merged = merge_chrome_traces(traces)
+        pids = {e["pid"] for e in merged["traceEvents"]}
+        assert pids == {0, 1}
+        # merged file still serializes
+        json.dumps(merged)
+
+    def test_equal_timestamp_nesting_exports_in_true_order(self):
+        """A frozen clock makes every stamp tie: the op-seq tiebreak
+        still exports B-outer before B-inner (and E-inner before
+        E-outer), so the exporter can never produce a trace its own
+        validator rejects."""
+        t = [1.0]
+        rec = FlightRecorder(clock=lambda: t[0])
+        outer = rec.open_span("outer")
+        inner = rec.open_span("inner")
+        rec.close_span(inner)
+        rec.close_span(outer)
+        trace = rec.chrome_trace()
+        validate_chrome_trace(trace)
+        seq = [(e["name"], e["ph"]) for e in trace["traceEvents"]
+               if e["ph"] in ("B", "E")]
+        assert seq == [("outer", "B"), ("inner", "B"),
+                       ("inner", "E"), ("outer", "E")]
+
+    def test_open_span_not_exported(self):
+        rec = FlightRecorder()
+        ev = rec.open_span("open")
+        rec.close_span(rec.open_span("closed"))
+        # the still-open span is not in the ring (pushed at close), so
+        # the export holds only complete pairs
+        trace = rec.chrome_trace()
+        names = {e["name"] for e in trace["traceEvents"]
+                 if e["ph"] in ("B", "E")}
+        assert names == {"closed"}
+        rec.close_span(ev)
+
+
+@pytest.mark.trace
+class TestTimelineDelegation:
+    def test_one_span_implementation(self):
+        """Timeline.span is the recorder bracket: the same span lands in
+        both the legacy list and the shared recorder's ring."""
+        from tpuscratch.runtime.profiling import Timeline
+
+        rec = FlightRecorder()
+        tl = Timeline(rec)
+        with tl.span("work"):
+            time.sleep(0.001)
+        assert tl.seconds("work") >= 0.001
+        assert rec.phase_totals()["work"].count == 1
+        sp = rec.events()[0]
+        assert (sp.begin, sp.end) == (tl.spans[0].begin, tl.spans[0].end)
+
+    def test_default_recorder_created(self):
+        from tpuscratch.runtime.profiling import Timeline
+
+        tl = Timeline()
+        with tl.span("x"):
+            pass
+        assert tl.recorder.phase_totals()["x"].count == 1
+
+    def test_exception_path_still_records(self):
+        from tpuscratch.runtime.profiling import Timeline
+
+        tl = Timeline()
+        with pytest.raises(RuntimeError):
+            with tl.span("bad"):
+                raise RuntimeError("x")
+        assert len(tl.spans) == 1
+        assert tl.recorder.phase_totals()["bad"].count == 1
+
+
+@pytest.mark.trace
+class TestStraggler:
+    def test_detect_pure(self):
+        per_host = {"train/chunk": {0: 0.1, 1: 0.5, 2: 0.1},
+                    "ckpt/save": {0: 0.01, 1: 0.01},
+                    "solo": {0: 9.9}}
+        reps = detect_stragglers(per_host, min_skew=1.2)
+        assert [r.phase for r in reps] == ["train/chunk"]
+        r = reps[0]
+        assert r.slowest == 1 and r.fastest in (0, 2)
+        assert r.skew == pytest.approx(5.0)
+        assert "host 1 slowest" in r.summary()
+
+    def test_skew_guards_zero(self):
+        r = StragglerReport("p", 1, 0, 0.5, 0.0)
+        assert r.skew == math.inf
+        assert StragglerReport("p", 0, 0, 0.0, 0.0).skew == 1.0
+
+    def test_mesh_straggler_fingers_seeded_slow_rank(self, devices):
+        """The acceptance gate: a deliberate slow rank on a 2x2 CPU mesh
+        is named, with its skew ratio, through mesh_reduce max/min."""
+        mesh = make_mesh((2, 2), ("dp", "sp"))
+        per_rank = [0.101, 0.100, 0.502, 0.099]  # rank 2 seeded slow
+        r = mesh_straggler(mesh, "train/chunk", per_rank)
+        assert r.slowest == 2 and r.fastest == 3
+        assert r.max_s == pytest.approx(0.502, rel=1e-3)
+        assert r.skew == pytest.approx(0.502 / 0.099, rel=1e-2)
+
+    def test_report_stragglers_table(self, tmp_path):
+        """trace/phase events from two hosts -> the stragglers table
+        names the slow host; cumulative semantics (newest wins)."""
+        p = str(tmp_path / "run.jsonl")
+        events = [
+            {"event": "run", "t": 0.0},
+            # host 0 emits twice: the SECOND (cumulative) total wins
+            {"event": "trace/phase", "t": 1.0, "phase": "train/chunk",
+             "host": 0, "seconds": 0.05, "count": 1},
+            {"event": "trace/phase", "t": 2.0, "phase": "train/chunk",
+             "host": 0, "seconds": 0.10, "count": 2},
+            {"event": "trace/phase", "t": 2.0, "phase": "train/chunk",
+             "host": 1, "seconds": 0.40, "count": 2},
+        ]
+        with open(p, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        summ = report.summarize(report.load_events([p]))
+        rows = summ["stragglers"]
+        assert len(rows) == 1
+        assert rows[0]["slowest"] == 1 and rows[0]["fastest"] == 0
+        assert rows[0]["skew"] == pytest.approx(4.0)
+        table = report.format_table(summ)
+        assert "stragglers" in table and "host 1 slowest" in table
+        # trace/phase stays out of the per-event stat blocks
+        assert "trace/phase" not in summ["events"]
+
+    def test_distinct_recorders_in_one_file_add(self, tmp_path):
+        """A sweep's per-engine recorders share one sink file: their
+        trace/phase events carry distinct scopes, so one host's totals
+        ADD instead of last-wins (the scoped-snapshot rule), while a
+        duplicated artifact (same scope, two files) still dedups."""
+        from tpuscratch.obs.trace import fold_phase_events
+
+        events = [
+            # engine A then engine B, same file, same host
+            {"event": "trace/phase", "_file": "f", "host": 0,
+             "scope": "rec-a", "phase": "serve/decode", "seconds": 0.3},
+            {"event": "trace/phase", "_file": "f", "host": 0,
+             "scope": "rec-b", "phase": "serve/decode", "seconds": 0.2},
+            # the same rec-a totals loaded again from a copied file
+            {"event": "trace/phase", "_file": "f2", "host": 0,
+             "scope": "rec-a", "phase": "serve/decode", "seconds": 0.3},
+        ]
+        folded = fold_phase_events(events)
+        assert folded["serve/decode"] == {0: pytest.approx(0.5)}
+
+    @pytest.mark.slow
+    def test_restart_recorders_do_not_last_win(self, devices, tmp_path):
+        """supervise_train without an explicit recorder: each restarted
+        train() flies a fresh recorder into ONE sink file; every
+        invocation's chunks stay in the folded totals (the cheap fold
+        semantics live in test_distinct_recorders_in_one_file_add)."""
+        from tpuscratch.ft import ChaosPlan, Fault, supervise_train
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.obs.sink import Sink
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        plan = ChaosPlan(0, [Fault("train/preempt", at=(2,),
+                                   kind="preempt")])
+        p = str(tmp_path / "sup.jsonl")
+        with Sink(p) as s:
+            supervise_train(mesh, cfg, 4, str(tmp_path / "ck"),
+                            save_every=2, chaos=plan, sink=s, obs=s,
+                            sleep=lambda d: None)
+        from tpuscratch.obs.trace import fold_phase_events
+
+        events = report.load_events([p])
+        scopes = {e.get("scope") for e in events
+                  if e["event"] == "trace/phase"}
+        assert len(scopes) == 2  # one recorder per invocation
+        folded = fold_phase_events(events)
+        chunks = [e for e in events if e["event"] == "train/chunk"]
+        total = sum(e["chunk_s"] for e in chunks)
+        assert folded["train/chunk"][0] == pytest.approx(total, rel=0.01)
+
+    def test_infinite_skew_exports_json_safe(self, tmp_path):
+        """A 0.0-rounded fastest host must not leak ``Infinity`` into
+        the --json artifact (non-standard JSON): skew exports as None
+        and the table prints 'inf'."""
+        p = str(tmp_path / "run.jsonl")
+        events = [
+            {"event": "trace/phase", "t": 1.0, "phase": "train/chunk",
+             "host": 0, "seconds": 0.0, "count": 1},
+            {"event": "trace/phase", "t": 1.0, "phase": "train/chunk",
+             "host": 1, "seconds": 0.4, "count": 1},
+        ]
+        with open(p, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        summ = report.summarize(report.load_events([p]))
+        rows = summ["stragglers"]
+        assert rows[0]["skew"] is None
+        json.dumps(summ, allow_nan=False)  # strict-JSON clean
+        assert "(skew inf)" in report.format_table(summ)
+
+    def test_event_filter_suppresses_stragglers(self, tmp_path):
+        """--event views must not smuggle the cross-stream skew table."""
+        p = str(tmp_path / "run.jsonl")
+        events = [
+            {"event": "serve/tick", "t": 0.5, "tick_s": 0.01},
+            {"event": "trace/phase", "t": 1.0, "phase": "train/chunk",
+             "host": 0, "seconds": 0.1, "count": 1},
+            {"event": "trace/phase", "t": 1.0, "phase": "train/chunk",
+             "host": 1, "seconds": 0.4, "count": 1},
+        ]
+        with open(p, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        loaded = report.load_events([p])
+        assert "stragglers" in report.summarize(loaded)
+        filtered = report.summarize(loaded, only_event="serve/tick")
+        assert "stragglers" not in filtered
+        # an EXPLICIT --event trace/phase request is not an empty view:
+        # the raw events get their per-kind stat block
+        raw = report.summarize(loaded, only_event="trace/phase")
+        assert raw["events"]["trace/phase"]["count"] == 2
+
+    def test_trainer_emits_trace_phase(self, devices, tmp_path):
+        from tpuscratch.models.trainer import train
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.obs.sink import Sink
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        p = str(tmp_path / "t.jsonl")
+        with Sink(p) as s:
+            train(mesh, cfg, steps=2, save_every=2,
+                  ckpt_dir=str(tmp_path / "ck"), obs=s)
+        phases = {e["phase"] for e in report.load_events([p])
+                  if e["event"] == "trace/phase"}
+        assert {"train/chunk", "ckpt/save"} <= phases
+
+
+@pytest.mark.trace
+class TestGoodput:
+    @staticmethod
+    def _canned_events():
+        """An ft-heavy stream: chunks, saves, a rollback, a restart
+        backoff — every duration placed so the intervals don't overlap."""
+        return [
+            {"event": "run", "t": 0.0},
+            {"event": "train/config", "t": 0.1},
+            # chunk 1 (3 steps), ends at 2.0, 1.9 s long, 1.0 s compile
+            {"event": "train/chunk", "t": 2.0, "step": 3, "steps": 3,
+             "tokens": 48, "chunk_s": 1.9, "compile_s": 1.0,
+             "tokens_per_s": 25.0},
+            {"event": "ckpt/save", "t": 2.2, "step": 3, "wall_s": 0.2},
+            # a rolled-back chunk: 0.8 s of lost compute + restore
+            {"event": "ft/guard", "t": 3.0, "step": 6, "skipped": 1},
+            {"event": "ft/rollback", "t": 3.0, "from_step": 6,
+             "to_step": 3, "lost_s": 0.8},
+            # supervisor backoff after a preemption
+            {"event": "ft/restart", "t": 3.5, "restart": 1,
+             "backoff_s": 0.5},
+            # replayed chunk commits, ends at 4.3
+            {"event": "train/chunk", "t": 4.3, "step": 6, "steps": 3,
+             "tokens": 48, "chunk_s": 0.7, "compile_s": 0.0,
+             "tokens_per_s": 68.0},
+            {"event": "ckpt/save", "t": 4.5, "step": 6, "wall_s": 0.2},
+            {"event": "train/run", "t": 4.5, "steps_run": 6,
+             "wall_s": 4.4},
+        ]
+
+    def test_canned_buckets_sum_exactly(self):
+        gp = goodput.goodput_report(self._canned_events())
+        assert gp.wall_s == pytest.approx(4.5)
+        gp.check()
+        b = gp.buckets
+        assert b["step"] == pytest.approx(1.9 - 1.0 + 0.7)
+        assert b["compile"] == pytest.approx(1.0)
+        assert b["checkpoint"] == pytest.approx(0.4)
+        assert b["rollback"] == pytest.approx(0.8)
+        assert b["restart"] == pytest.approx(0.5)
+        assert b["other"] == pytest.approx(4.5 - 1.6 - 1.0 - 0.4 - 0.8 - 0.5)
+        assert gp.steps == 6 and gp.tokens == 96
+        assert sum(b.values()) == pytest.approx(gp.wall_s, rel=1e-9)
+
+    def test_mfu_from_flops(self):
+        gp = goodput.goodput_report(
+            self._canned_events(),
+            flops_per_step=1e9, peak_flops_per_s=1e10,
+        )
+        # 6 steps x 1e9 over 4.5 s of wall at 1e10 peak
+        assert gp.model_flops_per_s == pytest.approx(6e9 / 4.5)
+        assert gp.mfu == pytest.approx(6e9 / 4.5 / 1e10)
+        assert "MFU" in gp.summary()
+
+    def test_flops_per_token_path(self):
+        gp = goodput.goodput_report(self._canned_events(),
+                                    flops_per_token=1e6,
+                                    peak_flops_per_s=1e9)
+        assert gp.mfu == pytest.approx(96e6 / 4.5 / 1e9)
+
+    def test_overlapping_durations_clip(self):
+        """Overhanging durations never push the sum past the wall."""
+        events = [
+            {"event": "run", "t": 0.0},
+            {"event": "serve/tick", "t": 1.0, "tick_s": 0.9},
+            {"event": "serve/tick", "t": 1.5, "tick_s": 0.9},  # overlaps
+            {"event": "train/run", "t": 2.0},
+        ]
+        gp = goodput.goodput_report(events)
+        gp.check()
+        assert gp.buckets["step"] == pytest.approx(1.4)  # clipped
+
+    def test_resumed_file_splits_sink_sessions(self):
+        """A crashed run resumed by a NEW process appends to the same
+        JSONL path with a reset sink clock (its own ``run`` header at
+        t~0).  The sessions must be accounted as separate windows — one
+        merged window would collapse the two clocks, shrink the wall,
+        and let the sessions' intervals overlap-clip each other."""
+        session1 = [
+            {"event": "run", "t": 0.0, "_file": "a.jsonl"},
+            {"event": "train/chunk", "t": 100.0, "steps": 3, "tokens": 48,
+             "chunk_s": 90.0, "compile_s": 0.0, "_file": "a.jsonl"},
+        ]
+        session2 = [  # reopened after a SIGKILL: clock restarts
+            {"event": "run", "t": 0.0, "_file": "a.jsonl"},
+            {"event": "train/chunk", "t": 50.0, "steps": 3, "tokens": 48,
+             "chunk_s": 40.0, "compile_s": 0.0, "_file": "a.jsonl"},
+        ]
+        gp = goodput.goodput_report(session1 + session2)
+        gp.check()
+        assert gp.wall_s == pytest.approx(150.0)   # 100 + 50, not 100
+        assert gp.buckets["step"] == pytest.approx(130.0)  # 90 + 40
+        assert gp.steps == 6
+        # and the wall_s override refuses the multi-session ambiguity
+        with pytest.raises(ValueError, match="single-session"):
+            goodput.goodput_report(session1 + session2, wall_s=200.0)
+
+    def test_halo_chunk_compile_carved(self):
+        """halo/chunk carries compile_s like train/chunk: the fresh
+        chunk's compile-dominated wall is badput, not goodput."""
+        events = [
+            {"event": "run", "t": 0.0},
+            {"event": "halo/chunk", "t": 1.5, "wall_s": 1.4,
+             "compile_s": 1.4},
+            {"event": "halo/chunk", "t": 2.0, "wall_s": 0.4,
+             "compile_s": 0.0},
+            {"event": "halo/run", "t": 2.5},
+        ]
+        gp = goodput.goodput_report(events)
+        gp.check()
+        assert gp.buckets["compile"] == pytest.approx(1.4)
+        assert gp.buckets["step"] == pytest.approx(0.4)
+
+    def test_serve_compile_tick_booked_as_compile(self):
+        """A serve/tick whose cumulative compile counters moved is a
+        compile-dominated bracket; steady-state ticks stay goodput."""
+        events = [
+            {"event": "run", "t": 0.0},
+            # first tick compiles prefill + decode
+            {"event": "serve/tick", "t": 1.0, "tick_s": 0.9,
+             "decode_compiles": 1, "prefill_compiles": 1},
+            {"event": "serve/tick", "t": 1.4, "tick_s": 0.3,
+             "decode_compiles": 1, "prefill_compiles": 1},
+            # a fresh engine in the same file: counters RESET, recompile
+            {"event": "serve/tick", "t": 2.4, "tick_s": 0.9,
+             "decode_compiles": 0, "prefill_compiles": 1},
+            {"event": "serve/tick", "t": 2.8, "tick_s": 0.3,
+             "decode_compiles": 0, "prefill_compiles": 1},
+            {"event": "serve/report", "t": 3.0},
+        ]
+        gp = goodput.goodput_report(events)
+        gp.check()
+        assert gp.buckets["compile"] == pytest.approx(1.8)
+        assert gp.buckets["step"] == pytest.approx(0.6)
+
+    def test_wall_override(self):
+        gp = goodput.goodput_report(self._canned_events(), wall_s=5.0)
+        assert gp.wall_s == pytest.approx(5.0)
+        gp.check()
+
+    def test_straggler_wait_carved_from_other(self):
+        events = self._canned_events() + [
+            {"event": "trace/phase", "t": 4.5, "phase": "train/chunk",
+             "host": 0, "seconds": 0.5, "count": 2, "_file": "a"},
+            {"event": "trace/phase", "t": 4.5, "phase": "train/chunk",
+             "host": 1, "seconds": 0.4, "count": 2, "_file": "b"},
+        ]
+        gp = goodput.goodput_report(events)
+        gp.check()
+        assert gp.buckets["straggler_wait"] == pytest.approx(0.1)
+
+    def test_same_host_two_files_is_not_a_straggler_pair(self):
+        """One host writing two sink files (a sweep's two engines, a
+        re-opened sink) folds to one host — no phantom straggler_wait."""
+        events = self._canned_events() + [
+            {"event": "trace/phase", "t": 4.5, "phase": "serve/decode",
+             "host": 0, "seconds": 0.5, "count": 2, "_file": "a"},
+            {"event": "trace/phase", "t": 4.5, "phase": "serve/decode",
+             "host": 0, "seconds": 0.3, "count": 1, "_file": "b"},
+        ]
+        gp = goodput.goodput_report(events)
+        gp.check()
+        assert gp.buckets["straggler_wait"] == 0.0
+
+    @pytest.mark.chaos
+    def test_live_guarded_chaos_run_sums_to_wall(self, devices, tmp_path):
+        """The acceptance gate: on a real guarded+chaos CPU run the
+        buckets sum to the measured wall (the partition is exact by
+        construction; +-1%% is the stated criterion) and the rollback /
+        checkpoint badput is visible."""
+        from tpuscratch.ft import ChaosPlan, Fault, GuardPolicy
+        from tpuscratch.models.trainer import train
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.obs.sink import Sink
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        p = str(tmp_path / "run.jsonl")
+        plan = ChaosPlan(0, [Fault("train/grad", at=(4,), kind="nan")])
+        with Sink(p) as sink:
+            _, rep = train(
+                mesh, cfg, steps=6, save_every=3, seed=3,
+                ckpt_dir=str(tmp_path / "ck"), obs=sink, chaos=plan,
+                guard=GuardPolicy(max_skips=0, max_rollbacks=1),
+            )
+        assert rep.rollbacks == 1
+        gp = goodput.goodput_report(report.load_events([p]))
+        total = sum(gp.buckets.values())
+        assert abs(total - gp.wall_s) <= 0.01 * gp.wall_s
+        assert gp.buckets["rollback"] > 0
+        assert gp.buckets["checkpoint"] > 0
+        assert gp.buckets["step"] > 0
+        assert gp.steps == 6
+        # the wall the report accounts is the event window, which sits
+        # inside the run (sink opened before, flushed after)
+        run_wall = [e for e in report.load_events([p])
+                    if e["event"] == "train/run"][0]["wall_s"]
+        assert gp.wall_s <= run_wall * 1.5 + 0.5
+
+
+@pytest.mark.trace
+class TestRegress:
+    BASE = [
+        {"config": 11, "metric": "train_tokens_per_s_float32",
+         "value": 100000.0, "p50_s": 0.5, "platform": "cpu"},
+        {"config": 12, "metric": "serve_decode_tokens_per_s",
+         "value": 5000.0, "p50_s": 0.002, "p99_s": 0.004,
+         "platform": "cpu"},
+        {"config": 13, "metric": "zero_vs_replicated_dp4", "dp": 4,
+         "grad_sync_bytes_zero": 12864.0, "grad_ratio": 0.5,
+         "platform": "cpu"},
+    ]
+
+    @staticmethod
+    def _write(tmp_path, name, rows):
+        p = str(tmp_path / name)
+        with open(p, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+        return p
+
+    def test_clean_pair_passes(self, tmp_path):
+        base = regress.index_rows(self.BASE)
+        new = regress.index_rows([
+            dict(self.BASE[0], value=98000.0),          # -2%: in band
+            dict(self.BASE[1], p99_s=0.0042),           # +5%: in band
+            dict(self.BASE[2]),
+        ])
+        findings = regress.compare(base, new, noise=0.1)
+        assert not regress.has_regression(findings)
+        assert all(f.status in ("ok",) for f in findings)
+
+    def test_tokens_drop_regresses_and_latency_rise_regresses(self):
+        base = regress.index_rows(self.BASE)
+        new = regress.index_rows([
+            dict(self.BASE[0], value=70000.0),          # -30% tokens/s
+            dict(self.BASE[1], p99_s=0.008),            # 2x p99
+            dict(self.BASE[2], grad_sync_bytes_zero=25728.0),  # 2x wire
+        ])
+        findings = regress.compare(base, new, noise=0.1)
+        bad = {(f.metric, f.field) for f in findings
+               if f.status == "regressed"}
+        assert ("train_tokens_per_s_float32", "value") in bad
+        assert ("serve_decode_tokens_per_s", "p99_s") in bad
+        assert ("zero_vs_replicated_dp4", "grad_sync_bytes_zero") in bad
+
+    def test_improvement_and_missing_are_not_failures(self):
+        base = regress.index_rows(self.BASE)
+        new = regress.index_rows([dict(self.BASE[0], value=200000.0)])
+        findings = regress.compare(base, new, noise=0.1)
+        assert not regress.has_regression(findings)
+        statuses = {f.status for f in findings}
+        assert "improved" in statuses and "missing" in statuses
+
+    def test_dropped_field_surfaces_as_missing(self):
+        """A renamed/dropped FIELD (not a whole metric) must not
+        silently disable its gate."""
+        base = regress.index_rows(self.BASE)
+        row = {k: v for k, v in self.BASE[1].items() if k != "p99_s"}
+        new = regress.index_rows([self.BASE[0], row, self.BASE[2]])
+        findings = regress.compare(base, new, noise=0.1)
+        assert not regress.has_regression(findings)
+        missing = [f for f in findings if f.status == "missing"]
+        assert [(f.metric, f.field) for f in missing] == [
+            ("serve_decode_tokens_per_s", "p99_s")
+        ]
+
+    def test_nonfinite_new_value_regresses(self):
+        """A field PRESENT in the new row but NaN/inf is a degenerated
+        measurement — a failing state, not a 'missing' warning (that
+        escape is for configs legitimately skipped on absent hardware)."""
+        base = regress.index_rows(self.BASE)
+        new = regress.index_rows([
+            dict(self.BASE[0], value=float("nan")),
+            dict(self.BASE[1], p50_s=float("inf")),
+            self.BASE[2],
+        ])
+        findings = regress.compare(base, new, noise=0.1)
+        assert regress.has_regression(findings)
+        bad = {(f.metric, f.field) for f in findings
+               if f.status == "regressed"}
+        assert ("train_tokens_per_s_float32", "value") in bad
+        assert ("serve_decode_tokens_per_s", "p50_s") in bad
+
+    def test_last_row_wins(self, tmp_path):
+        p = self._write(tmp_path, "b.json",
+                        [dict(self.BASE[0], value=1.0), self.BASE[0]])
+        rows = regress.load_rows(p)
+        assert rows[(11, "train_tokens_per_s_float32")]["value"] == 100000.0
+
+    def test_load_rows_tolerates_torn_and_nonobject_lines(self, tmp_path):
+        """load_rows goes through obs.report.load_events — corrupt AND
+        non-object lines (a bare number would have crashed the old
+        loader's indexing) are skipped with a located warning."""
+        p = self._write(tmp_path, "torn.json", [self.BASE[0]])
+        with open(p, "a") as f:
+            f.write('42\n{"config": 12, "metric": "tr')  # torn tail
+        with pytest.warns(RuntimeWarning, match="torn.json"):
+            rows = regress.load_rows(p)
+        assert set(rows) == {(11, "train_tokens_per_s_float32")}
+
+    def test_cli_smoke(self, tmp_path):
+        """The acceptance gate as a subprocess: clean pair exits 0, an
+        injected 30%% tokens/s regression exits nonzero."""
+        base = self._write(tmp_path, "base.json", self.BASE)
+        good = self._write(tmp_path, "good.json",
+                           [dict(self.BASE[0], value=97000.0),
+                            self.BASE[1], self.BASE[2]])
+        bad = self._write(tmp_path, "bad.json",
+                          [dict(self.BASE[0], value=70000.0),
+                           self.BASE[1], self.BASE[2]])
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, good],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, bad],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "REGRESSED" in r.stdout
+
+    def test_json_output_strict_on_zero_base(self, tmp_path, capsys):
+        """A 0 -> nonzero comparison (delta=inf) must not leak the
+        non-standard ``Infinity`` token into --json output."""
+        base = self._write(tmp_path, "zb.json", [
+            {"config": 13, "metric": "zero_vs_replicated_dp1",
+             "grad_sync_bytes_zero": 0.0, "platform": "cpu"},
+        ])
+        new = self._write(tmp_path, "zn.json", [
+            {"config": 13, "metric": "zero_vs_replicated_dp1",
+             "grad_sync_bytes_zero": 6432.0, "platform": "cpu"},
+        ])
+        rc = regress.main([base, new, "--json"])
+        assert rc == 1  # 0 -> nonzero bytes is a regression
+        rows = json.loads(
+            capsys.readouterr().out,
+            parse_constant=lambda c: (_ for _ in ()).throw(ValueError(c)),
+        )
+        bad = [x for x in rows if x["status"] == "regressed"]
+        assert bad and bad[0]["delta"] is None
+
+    def test_record_check_mode(self, tmp_path, monkeypatch, capsys):
+        """record.py --check BASE.json wires the gate in-process."""
+        from tpuscratch.bench import record
+
+        def fake_config(out):
+            record._emit(out, config=99, metric="fake_tokens_per_s",
+                         value=70000.0)
+
+        monkeypatch.setitem(record.CONFIGS, 99, fake_config)
+        base = self._write(
+            tmp_path, "base.json",
+            [{"config": 99, "metric": "fake_tokens_per_s",
+              "value": 100000.0, "platform": "cpu"}],
+        )
+        rc = record.main(["--configs", "99", "--check", base])
+        assert rc == 1
+        base_ok = self._write(
+            tmp_path, "ok.json",
+            [{"config": 99, "metric": "fake_tokens_per_s",
+              "value": 71000.0, "platform": "cpu"}],
+        )
+        rc = record.main(["--configs", "99", "--check", base_ok])
+        assert rc == 0
+
+
+@pytest.mark.trace
+class TestTrainerTraceWiring:
+    def test_recorder_spans_and_goodput_fields(self, devices, tmp_path):
+        from tpuscratch.models.trainer import train
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.obs.sink import Sink
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        rec = FlightRecorder()
+        p = str(tmp_path / "t.jsonl")
+        with Sink(p) as s:
+            train(mesh, cfg, steps=4, save_every=2,
+                  ckpt_dir=str(tmp_path / "ck"), obs=s, recorder=rec)
+        totals = rec.phase_totals()
+        assert totals["train/chunk"].count == 2
+        assert totals["ckpt/save"].count == 2
+        validate_chrome_trace(rec.chrome_trace())
+        chunks = [e for e in report.load_events([p])
+                  if e["event"] == "train/chunk"]
+        for ev in chunks:
+            for key in ("steps", "tokens", "chunk_s", "compile_s"):
+                assert key in ev, key
+        # the first chunk traced the program: its compile share is real
+        assert chunks[0]["compile_s"] > 0
+        assert chunks[1]["compile_s"] == 0
+        saves = [e for e in report.load_events([p])
+                 if e["event"] == "ckpt/save"]
+        assert len(saves) == 2 and all(e["wall_s"] > 0 for e in saves)
+
+    def test_always_on_without_sink(self, devices, tmp_path):
+        """No sink, no recorder passed: the trainer still flies its own
+        bounded recorder (always-on) and the program is unchanged."""
+        from tpuscratch.models.trainer import train
+        from tpuscratch.models.transformer import TransformerConfig
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        _, rep = train(mesh, cfg, steps=2, save_every=2,
+                       ckpt_dir=str(tmp_path / "ck"))
+        assert rep.steps_run == 2
+
+
+@pytest.mark.trace
+class TestEngineTraceWiring:
+    def test_engine_spans_share_recorder(self, devices, tmp_path):
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.obs.sink import Sink
+        from tpuscratch.serve import Request, ServeConfig, ServeEngine
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=32, n_heads=2, n_experts=2,
+                                d_ff=64, n_layers=1)
+        scfg = ServeConfig(n_slots=2, n_pages=16, page_size=4, max_seq=16,
+                           vocab=16)
+        rec = FlightRecorder()
+        p = str(tmp_path / "s.jsonl")
+        with Sink(p) as s:
+            eng = ServeEngine(mesh, cfg, scfg, sink=s, recorder=rec)
+            eng.run([Request(rid=0, prompt=(1, 2), max_new=3)])
+        totals = rec.phase_totals()
+        assert totals["serve/prefill"].count == 1
+        assert totals["serve/decode"].count >= 2
+        validate_chrome_trace(rec.chrome_trace())
+        phases = {e["phase"] for e in report.load_events([p])
+                  if e["event"] == "trace/phase"}
+        assert {"serve/prefill", "serve/decode"} <= phases
+
+    def test_halo_preempted_run_files_flight_data(self, devices, tmp_path):
+        """A preemption mid-run must not discard the invocation's phase
+        totals (the trainer's failure-path hardening, on the halo side)."""
+        import numpy as np
+
+        from tpuscratch.ft import ChaosPlan, Fault, Preempted
+        from tpuscratch.halo.driver import checkpointed_stencil
+        from tpuscratch.obs.sink import Sink
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        world = np.random.default_rng(0).standard_normal(
+            (8, 8)).astype(np.float32)
+        plan = ChaosPlan(0, [Fault("halo/preempt", at=(2,),
+                                   kind="preempt")])
+        p = str(tmp_path / "hp.jsonl")
+        with Sink(p) as s:
+            with pytest.raises(Preempted):
+                checkpointed_stencil(world, steps=4, save_every=2,
+                                     ckpt_dir=str(tmp_path / "ck"),
+                                     mesh=make_mesh_2d((1, 1)), sink=s,
+                                     chaos=plan)
+        phases = {e["phase"] for e in report.load_events([p])
+                  if e["event"] == "trace/phase"}
+        assert {"halo/chunk", "ckpt/save"} <= phases
+
+    def test_halo_driver_emits_save_events(self, devices, tmp_path):
+        import numpy as np
+
+        from tpuscratch.halo.driver import checkpointed_stencil
+        from tpuscratch.obs.sink import Sink
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        world = np.random.default_rng(0).standard_normal(
+            (8, 8)).astype(np.float32)
+        rec = FlightRecorder()
+        p = str(tmp_path / "h.jsonl")
+        with Sink(p) as s:
+            checkpointed_stencil(world, steps=4, save_every=2,
+                                 ckpt_dir=str(tmp_path / "ck"),
+                                 mesh=make_mesh_2d((1, 1)), sink=s,
+                                 recorder=rec)
+        totals = rec.phase_totals()
+        assert totals["halo/chunk"].count == 2
+        assert totals["ckpt/save"].count == 2
+        events = report.load_events([p])
+        assert sum(e["event"] == "ckpt/save" for e in events) == 2
+        assert {"halo/chunk", "ckpt/save"} <= {
+            e["phase"] for e in events if e["event"] == "trace/phase"
+        }
+        # both chunks share one program (chunk size 2): the first chunk
+        # absorbed the jit compile and says so; the second is pure step,
+        # so goodput's compile carve-out sees the halo layer too
+        chunks = [e for e in events if e["event"] == "halo/chunk"]
+        assert chunks[0]["compile_s"] == chunks[0]["wall_s"] > 0
+        assert chunks[1]["compile_s"] == 0.0
+
+
+@pytest.mark.trace
+class TestSupervisorBackoff:
+    def test_restart_event_carries_backoff(self, devices, tmp_path):
+        from tpuscratch.ft import ChaosPlan, Fault, supervise_train
+        from tpuscratch.models.transformer import TransformerConfig
+        from tpuscratch.obs.sink import Sink
+
+        mesh = make_mesh((1, 1), ("dp", "sp"))
+        cfg = TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                                d_ff=32, n_layers=1)
+        rec = FlightRecorder()
+        plan = ChaosPlan(0, [Fault("train/preempt", at=(2,),
+                                   kind="preempt")])
+        p = str(tmp_path / "sup.jsonl")
+        with Sink(p) as s:
+            supervise_train(mesh, cfg, 4, str(tmp_path / "ck"),
+                            save_every=2, chaos=plan, sink=s,
+                            recorder=rec, sleep=lambda d: None)
+        restarts = [e for e in report.load_events([p])
+                    if e["event"] == "ft/restart"]
+        assert len(restarts) == 1
+        assert "backoff_s" in restarts[0]
+        # the shared recorder carries the trainer's chunks AND the
+        # supervisor's restart instant on one timeline
+        assert rec.phase_totals()["train/chunk"].count >= 2
+        assert any(
+            getattr(e, "name", "") == "ft/restart" for e in rec.events()
+        )
+
+
+class TestSinkAtexit:
+    def test_tail_flushed_at_interpreter_exit(self, tmp_path):
+        """A sink that is never closed still writes its buffered tail
+        when the interpreter exits (the atexit satellite)."""
+        p = str(tmp_path / "orphan.jsonl")
+        code = (
+            "from tpuscratch.obs.sink import Sink\n"
+            f"s = Sink({p!r}, flush_every=1000)\n"
+            "s.emit('tick', n=1)\n"
+            # no close(), no flush(): fall off the end of the script
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 0, r.stderr
+        lines = [json.loads(ln) for ln in open(p) if ln.strip()]
+        assert [ln["event"] for ln in lines] == ["run", "tick"]
+
+    def test_crashing_run_keeps_tail(self, tmp_path):
+        p = str(tmp_path / "crash.jsonl")
+        code = (
+            "from tpuscratch.obs.sink import Sink\n"
+            f"s = Sink({p!r}, flush_every=1000)\n"
+            "s.emit('tick', n=1)\n"
+            "raise RuntimeError('boom')\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode != 0
+        lines = [json.loads(ln) for ln in open(p) if ln.strip()]
+        assert [ln["event"] for ln in lines] == ["run", "tick"]
+
+    def test_close_idempotent_after_atexit_unregister(self, tmp_path):
+        from tpuscratch.obs.sink import Sink
+
+        s = Sink(str(tmp_path / "x.jsonl"))
+        s.close()
+        s.close()  # no raise
+
+    def test_dropped_sink_closes_at_gc_not_pinned(self, tmp_path):
+        """An unclosed sink that goes out of scope is collectable (the
+        finalizer holds no reference to it) and closes at GC — a sweep
+        building one sink per engine does not leak file descriptors."""
+        import gc
+        import weakref
+
+        from tpuscratch.obs.sink import Sink
+
+        p = str(tmp_path / "g.jsonl")
+        s = Sink(p, flush_every=1000)
+        s.emit("tick", n=1)
+        ref = weakref.ref(s)
+        del s
+        gc.collect()
+        assert ref() is None  # not pinned by the exit hook
+        lines = [json.loads(ln) for ln in open(p) if ln.strip()]
+        assert [ln["event"] for ln in lines] == ["run", "tick"]
+
+
+class TestReportCorruptLines:
+    def test_torn_final_line_skipped_with_warning(self, tmp_path):
+        """The post-SIGKILL artifact: a truncated last line is skipped
+        with a warning, the surviving events still summarize."""
+        p = str(tmp_path / "torn.jsonl")
+        with open(p, "w") as f:
+            f.write('{"event": "run", "t": 0.0}\n')
+            f.write('{"event": "serve/tick", "t": 0.1, "tick_s": 0.01}\n')
+            f.write('{"event": "serve/tick", "t": 0.2, "tick_')  # torn
+        with pytest.warns(RuntimeWarning, match="torn.jsonl:3"):
+            events = report.load_events([p])
+        assert len(events) == 2
+        summ = report.summarize(events)
+        assert summ["events"]["serve/tick"]["count"] == 1
+
+    def test_non_object_line_skipped(self, tmp_path):
+        p = str(tmp_path / "odd.jsonl")
+        with open(p, "w") as f:
+            f.write('{"event": "run"}\n[1, 2]\n42\n')
+        with pytest.warns(RuntimeWarning):
+            events = report.load_events([p])
+        assert len(events) == 1
+
+    def test_cli_survives_corrupt_file(self, tmp_path):
+        p = str(tmp_path / "bad.jsonl")
+        with open(p, "w") as f:
+            f.write('{"event": "run"}\nnot json\n'
+                    '{"event": "tick", "t": 0.1, "x": 1}\n')
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.report", p],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "tick" in r.stdout

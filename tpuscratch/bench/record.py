@@ -895,6 +895,14 @@ def main(argv=None) -> int:
                          "(opt-in: the instrumented ticks are labeled in "
                          "the row's detail, so recorded numbers stay "
                          "comparable with pre-obs rows by default)")
+    ap.add_argument("--check", default=None, metavar="BASE.json",
+                    help="after measuring, diff this run's rows against "
+                         "BASE.json through tpuscratch.obs.regress; a "
+                         "beyond-noise regression makes the exit code "
+                         "nonzero — the enforceable form of the BENCH_* "
+                         "trajectory")
+    ap.add_argument("--noise", type=float, default=0.1,
+                    help="fractional noise band for --check (default 0.1)")
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force an N-device virtual CPU mesh first (dev path)")
     args = ap.parse_args(argv)
@@ -917,6 +925,20 @@ def main(argv=None) -> int:
         with open(args.json, "a") as f:
             for row in out:
                 f.write(json.dumps(row) + "\n")
+    if args.check:
+        from tpuscratch.obs.regress import (
+            compare,
+            format_findings,
+            has_regression,
+            index_rows,
+            load_rows,
+        )
+
+        findings = compare(load_rows(args.check), index_rows(out),
+                           noise=args.noise)
+        print(format_findings(findings, args.noise), file=sys.stderr)
+        if has_regression(findings):
+            rc = rc or 1
     return rc
 
 

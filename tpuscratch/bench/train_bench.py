@@ -251,18 +251,20 @@ def bench_obs_overhead(
     either way: (a) the compiled step's best fenced time over ``iters``
     runs of ``steps`` steps; (b) the cost of the obs update as wired in
     the trainer — registry counter/gauge/histogram writes EVERY step,
-    one buffered sink event every ``emit_every`` steps (the trainer
-    emits per save chunk; ``save_every`` defaults to 10) — amortized
-    over thousands of repetitions.  The subsystem's budget for
-    ``overhead`` is < 2% even against this sub-millisecond CPU step
-    (the pessimistic denominator: a real chip config's step is
-    milliseconds)."""
+    one flight-recorder span bracket plus one buffered sink event every
+    ``emit_every`` steps (the trainer brackets and emits per save chunk;
+    ``save_every`` defaults to 10) — amortized over thousands of
+    repetitions.  The subsystem's budget for ``overhead`` is < 2% even
+    against this sub-millisecond CPU step (the pessimistic denominator:
+    a real chip config's step is milliseconds); since the trace layer
+    landed, that budget covers the recorder too."""
     import tempfile
     import time
 
     from tpuscratch.models.transformer import train_step
     from tpuscratch.obs.metrics import MetricsRegistry
     from tpuscratch.obs.sink import Sink
+    from tpuscratch.obs.trace import FlightRecorder
     from tpuscratch.runtime.mesh import make_mesh
 
     on_tpu = jax.default_backend() == "tpu"
@@ -304,15 +306,22 @@ def bench_obs_overhead(
         path = sink_path or f"{tmp}/overhead.jsonl"
         with Sink(path, run={"bench": "obs-overhead"}) as sink:
             metrics = MetricsRegistry()
+            rec = FlightRecorder()
             for _ in range(iters):
                 t0 = time.perf_counter()
+                sp = rec.open_span("bench/chunk")
                 for i in range(reps):
                     metrics.counter("train/steps").inc()
                     metrics.gauge("train/last_step").set(i)
                     metrics.histogram("train/step_s").observe(step_best)
                     if i % emit_every == 0:
+                        # chunk boundary, the trainer's shape: close the
+                        # chunk bracket, emit, open the next
+                        rec.close_span(sp)
+                        sp = rec.open_span("bench/chunk")
                         sink.emit("train/chunk", step=i, loss=0.0,
                                   grad_norm=0.0, compiles=1)
+                rec.close_span(sp)
                 instr_best = min(
                     instr_best, (time.perf_counter() - t0) / reps
                 )

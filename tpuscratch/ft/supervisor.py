@@ -65,6 +65,7 @@ def supervise(
     restartable: tuple = RESTARTABLE,
     sink=None,
     metrics: Optional[MetricsRegistry] = None,
+    recorder=None,
     log: Callable[[str], None] = lambda s: None,
     sleep: Callable[[float], None] = time.sleep,
 ) -> T:
@@ -74,8 +75,11 @@ def supervise(
     off (the trainer does, via ``ckpt_dir`` resume — that is the whole
     design of the checkpoint layer).  Failures outside ``restartable``
     propagate immediately; restartable ones are counted, emitted as
-    ``ft/restart`` events, backed off, and re-invoked until the budget
-    runs out (``RestartsExhausted``)."""
+    ``ft/restart`` events (with the ``backoff_s`` about to be slept —
+    the goodput report's "restart" badput bucket), backed off, and
+    re-invoked until the budget runs out (``RestartsExhausted``).
+    ``recorder`` (an ``obs.trace.FlightRecorder``) additionally marks
+    each restart as an instant on the flight-recorder timeline."""
     sink = sink if sink is not None else NullSink()
     metrics = metrics if metrics is not None else MetricsRegistry()
     restarts = 0
@@ -98,14 +102,21 @@ def supervise(
             op = getattr(exc, "op", None) or getattr(exc, "site", None)
             log(f"supervisor restart {restarts}/{budget.max_restarts}: "
                 f"{type(exc).__name__}: {exc}")
+            d = budget.delay(restarts)
+            if recorder is not None:
+                recorder.instant("ft/restart", restart=restarts,
+                                 error=type(exc).__name__)
+            if d > 0:
+                sleep(d)
+            # emitted AFTER the backoff: duration-carrying events are
+            # stamped at the END of their activity (the goodput
+            # convention), so [t - backoff_s, t] is the slept window
             sink.emit(
                 "ft/restart", restart=restarts,
                 error=f"{type(exc).__name__}: {exc}",
+                backoff_s=round(d, 6),
                 **({"op": op} if op else {}),
             )
-            d = budget.delay(restarts)
-            if d > 0:
-                sleep(d)
             continue
         sink.emit(
             "ft/run", restarts=restarts,
@@ -119,6 +130,7 @@ def supervise_train(mesh, cfg, steps: int, ckpt_dir: str, *,
                     budget: RestartBudget = RestartBudget(),
                     restartable: tuple = RESTARTABLE,
                     sink=None, metrics: Optional[MetricsRegistry] = None,
+                    recorder=None,
                     log: Callable[[str], None] = lambda s: None,
                     sleep: Callable[[float], None] = time.sleep,
                     **train_kw):
@@ -128,12 +140,18 @@ def supervise_train(mesh, cfg, steps: int, ckpt_dir: str, *,
     bit-identical contract ``tests/test_trainer.py`` proves).  A chaos
     plan passed via ``train_kw['chaos']`` persists ACROSS restarts, so a
     ``times``-bounded fault consumed before the preemption stays
-    consumed in the replay.  Returns ``(params, TrainReport)`` of the
-    completing invocation."""
+    consumed in the replay.  A ``recorder`` is shared with the trainer
+    (every restart's chunks land on ONE flight-recorder timeline, with
+    the restart instants between them).  Returns
+    ``(params, TrainReport)`` of the completing invocation."""
     from tpuscratch.models.trainer import train  # lazy: avoids the cycle
+
+    if recorder is not None:
+        train_kw.setdefault("recorder", recorder)
 
     def attempt():
         return train(mesh, cfg, steps, ckpt_dir, **train_kw)
 
     return supervise(attempt, budget=budget, restartable=restartable,
-                     sink=sink, metrics=metrics, log=log, sleep=sleep)
+                     sink=sink, metrics=metrics, recorder=recorder,
+                     log=log, sleep=sleep)

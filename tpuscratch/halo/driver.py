@@ -153,6 +153,7 @@ def checkpointed_stencil(
     keep: int = 3,
     sink=None,
     chaos=None,
+    recorder=None,
 ) -> np.ndarray:
     """``distributed_stencil`` with preemption survival: the tile state is
     checkpointed every ``save_every`` steps and the run RESUMES from the
@@ -160,7 +161,12 @@ def checkpointed_stencil(
 
     ``sink`` (an ``obs.sink.Sink``) receives one ``halo/chunk`` event
     per save chunk — step reached, fenced wall seconds, cell-updates/s —
-    the same telemetry the trainer emits per chunk.
+    plus one ``ckpt/save`` event per save (its wall seconds feed the
+    goodput checkpoint bucket) — the same telemetry the trainer emits.
+    ``recorder`` (an ``obs.trace.FlightRecorder``; a fresh bounded one
+    when absent) collects ``halo/chunk``/``ckpt/save`` spans for
+    Chrome-trace export and emits cumulative ``trace/phase`` totals at
+    the end of the run.
 
     ``chaos`` (an ``ft.ChaosPlan``) plugs the fault injector in: a
     transient ``comm/halo_chunk`` CommError around each compiled chunk,
@@ -179,10 +185,16 @@ def checkpointed_stencil(
     """
     from tpuscratch.runtime import checkpoint
     from tpuscratch.obs.sink import NullSink
+    from tpuscratch.obs.trace import (
+        FlightRecorder,
+        emit_phase_totals,
+        file_flight_data,
+    )
 
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     sink = sink if sink is not None else NullSink()
+    rec = recorder if recorder is not None else FlightRecorder()
     mesh, topo, layout, spec = _setup(world.shape, mesh, halo, periodic)
 
     tiles = decompose(world, topo, layout)
@@ -212,39 +224,56 @@ def checkpointed_stencil(
         bind_sink(chaos, sink)
         save_hook = chaos.save_hook()
     programs: dict[int, object] = {}  # chunk size -> compiled program
-    while start < steps:
-        chunk = min(save_every, steps - start)
-        if chunk not in programs:
-            programs[chunk] = make_stencil_program(mesh, spec, chunk, coeffs, impl)
-        if chaos is not None:
-            # the collective wrapper: a transient CommError here is the
-            # supervisor's restartable class; resume replays this chunk
-            chaos.maybe_fail("comm/halo_chunk", index=start, op="halo_chunk")
-        t0 = time.perf_counter()
-        state = jax.block_until_ready(programs[chunk](state))
-        chunk_s = time.perf_counter() - t0
-        start += chunk
-        sink.emit(
-            "halo/chunk",
-            step=start, chunk=chunk, wall_s=round(chunk_s, 6),
-            cell_updates_per_s=round(cells * chunk / chunk_s, 3),
-        )
-
-        def do_save(snap=np.asarray(state), at=start):
-            return checkpoint.save(
-                ckpt_dir, at, snap,
-                metadata={"steps_total": steps, "impl": impl},
-                hook=save_hook,
+    # a preempted/failed invocation still files its flight data (the
+    # trainer's hardening): in-flight spans closed at their partial
+    # wall, cumulative trace/phase totals scoped by this recorder's
+    # id, plus the buffered event tail
+    with file_flight_data(sink, rec):
+        while start < steps:
+            chunk = min(save_every, steps - start)
+            fresh = chunk not in programs
+            if fresh:
+                programs[chunk] = make_stencil_program(mesh, spec, chunk, coeffs, impl)
+            if chaos is not None:
+                # the collective wrapper: a transient CommError here is the
+                # supervisor's restartable class; resume replays this chunk
+                chaos.maybe_fail("comm/halo_chunk", index=start, op="halo_chunk")
+            chunk_sp = rec.open_span("halo/chunk", step_begin=start)
+            state = jax.block_until_ready(programs[chunk](state))
+            rec.close_span(chunk_sp)
+            chunk_s = chunk_sp.seconds
+            start += chunk
+            # a freshly-built program jit-compiles inside this chunk's
+            # first call, so the bracket is compile-dominated wall — the
+            # trainer's CompileCounter convention at chunk granularity;
+            # obs.goodput carves compile_s out of the step bucket
+            sink.emit(
+                "halo/chunk",
+                step=start, chunk=chunk, wall_s=round(chunk_s, 6),
+                cell_updates_per_s=round(cells * chunk / chunk_s, 3),
+                compile_s=round(chunk_s, 6) if fresh else 0.0,
             )
 
-        if chaos is not None:
-            retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
-        else:
-            do_save()
-        checkpoint.prune(ckpt_dir, keep)
-        if chaos is not None:
-            # AFTER the save: the restarted run resumes exactly here
-            chaos.maybe_preempt("halo/preempt", index=start)
+            def do_save(snap=np.asarray(state), at=start):
+                return checkpoint.save(
+                    ckpt_dir, at, snap,
+                    metadata={"steps_total": steps, "impl": impl},
+                    hook=save_hook,
+                )
+
+            save_sp = rec.open_span("ckpt/save", step=start)
+            if chaos is not None:
+                retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
+            else:
+                do_save()
+            checkpoint.prune(ckpt_dir, keep)
+            rec.close_span(save_sp)
+            sink.emit("ckpt/save", step=start,
+                      wall_s=round(save_sp.seconds, 6))
+            if chaos is not None:
+                # AFTER the save: the restarted run resumes exactly here
+                chaos.maybe_preempt("halo/preempt", index=start)
+    emit_phase_totals(sink, rec)
     sink.flush()
     return assemble(np.asarray(state), topo, layout)
 

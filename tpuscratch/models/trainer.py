@@ -54,6 +54,11 @@ from tpuscratch.models.zero import (
 from tpuscratch.runtime.errors import CommError
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
+from tpuscratch.obs.trace import (
+    FlightRecorder,
+    emit_phase_totals,
+    file_flight_data,
+)
 from tpuscratch.runtime import checkpoint
 
 
@@ -127,6 +132,7 @@ def train(
     keep: int = 3,
     log: Callable[[str], None] = lambda s: None,
     obs=None,
+    recorder: Optional[FlightRecorder] = None,
     chaos=None,
     guard: Optional[GuardPolicy | GuardState] = None,
     save_retry: Optional[RetryPolicy] = None,
@@ -146,6 +152,16 @@ def train(
     step when a sink is attached, so an uninstrumented run's program is
     unchanged; either way a ``CompileCounter`` hooks the step body, so
     retrace-freedom across a run is observable (tests assert == 1).
+
+    ``recorder`` (an ``obs.trace.FlightRecorder``; a fresh one is
+    created when absent — the flight recorder is always-on and bounded)
+    collects ``train/chunk`` / ``ckpt/save`` / ``train/rollback`` spans
+    for Chrome-trace export; per-phase totals are emitted as cumulative
+    ``trace/phase`` events through the sink at the end of the run (the
+    straggler table's input).  The ``train/chunk`` event additionally
+    carries ``steps``/``tokens``/``chunk_s``/``compile_s``, and every
+    ``ckpt/save``/``ft/rollback`` event a duration, so ``obs.goodput``
+    can partition the run's wall time from the artifact alone.
 
     Fault tolerance (all default-off; the uninstrumented program and
     loop are unchanged when absent):
@@ -273,6 +289,7 @@ def train(
     want_gnorm = sink.enabled
     metrics = MetricsRegistry()
     counter = CompileCounter()
+    rec = recorder if recorder is not None else FlightRecorder()
     sink.emit(
         "train/config",
         steps=steps, lr=lr, optimizer=optimizer, batch=batch, seq=seq,
@@ -319,127 +336,157 @@ def train(
     ran = 0
     ref_loss = float("nan")  # spike baseline: previous chunk's loss
     run_t0 = time.perf_counter()
-    while start < steps:
-        chunk = min(save_every, steps - start)
-        loss = gnorm = None
-        statuses = []
-        t0 = time.perf_counter()
-        for i in range(chunk):
-            if accum_steps > 1:
-                # each update consumes accum_steps consecutive entries
-                # of the deterministic stream (at k=1 this is exactly
-                # the legacy indexing, so trajectories line up)
-                micro = [
-                    synthetic_batch(seed, (start + i) * accum_steps + j,
-                                    batch, seq, cfg.d_model)
-                    for j in range(accum_steps)
-                ]
-                x = jnp.stack([m[0] for m in micro])
-                y = jnp.stack([m[1] for m in micro])
-            else:
-                x, y = synthetic_batch(seed, start + i, batch, seq,
-                                       cfg.d_model)
-            if chaos is not None:
-                x = chaos.corrupt_batch(x, start + i)
-            if guard is not None:
-                rl = jnp.asarray(ref_loss, jnp.float32)
-                if optimizer == "adam":
-                    params, opt, loss, gnorm, st = step_fn(params, opt, x,
-                                                           y, rl)
+    # a preempted/failed invocation still files its flight data: in-flight
+    # spans closed at their partial wall, the cumulative trace/phase
+    # totals (scoped by this recorder's id, so a restart's fresh recorder
+    # ADDS instead of replacing), and the buffered event tail
+    with file_flight_data(sink, rec):
+        while start < steps:
+            chunk = min(save_every, steps - start)
+            loss = gnorm = None
+            statuses = []
+            compile_s = 0.0
+            chunk_sp = rec.open_span("train/chunk", step_begin=start)
+            for i in range(chunk):
+                if accum_steps > 1:
+                    # each update consumes accum_steps consecutive entries
+                    # of the deterministic stream (at k=1 this is exactly
+                    # the legacy indexing, so trajectories line up)
+                    micro = [
+                        synthetic_batch(seed, (start + i) * accum_steps + j,
+                                        batch, seq, cfg.d_model)
+                        for j in range(accum_steps)
+                    ]
+                    x = jnp.stack([m[0] for m in micro])
+                    y = jnp.stack([m[1] for m in micro])
                 else:
-                    params, loss, gnorm, st = step_fn(params, x, y, rl)
-                statuses.append(st)
-            elif optimizer == "adam":
-                params, opt, loss, *rest = step_fn(params, opt, x, y)
-                gnorm = rest[0] if rest else None
-            else:
-                params, loss, *rest = step_fn(params, x, y)
-                gnorm = rest[0] if rest else None
-        loss_f = float(jax.block_until_ready(loss))
-        chunk_s = time.perf_counter() - t0  # fenced by the loss readback
-        if guard is not None:
-            st_host = [int(s) for s in statuses]
-            skips = st_host.count(STATUS_SKIPPED)
-            clips = st_host.count(STATUS_CLIPPED)
-            if skips or clips:
-                metrics.counter("ft/skipped_steps").inc(skips)
-                metrics.counter("ft/clipped_steps").inc(clips)
-                sink.emit("ft/guard", step=start + chunk, skipped=skips,
-                          clipped=clips)
-            if guard_state.observe(st_host):
-                # the stream is poisoned, not glitched: discard this
-                # chunk, restore the last committed state, replay
-                guard_state.rolled_back()  # GuardFailure past the budget
-                metrics.counter("ft/rollbacks").inc()
-                rb_to = checkpoint.latest_step(ckpt_dir)
-                if rb_to is None:
-                    params = init_params(seed, cfg)
-                    if zero:
-                        opt = put_zero_state(
-                            init_zero_adam_state(params, dp_n), mesh, cfg
-                        )
+                    x, y = synthetic_batch(seed, start + i, batch, seq,
+                                           cfg.d_model)
+                if chaos is not None:
+                    x = chaos.corrupt_batch(x, start + i)
+                # compile detection: jit tracing + compilation run
+                # synchronously inside the traced call, so the bracket around
+                # a step whose CompileCounter ticked is compile-dominated
+                # wall — the goodput report's "compile" badput bucket
+                traced = counter.count
+                step_t0 = time.perf_counter()
+                if guard is not None:
+                    rl = jnp.asarray(ref_loss, jnp.float32)
+                    if optimizer == "adam":
+                        params, opt, loss, gnorm, st = step_fn(params, opt, x,
+                                                               y, rl)
                     else:
-                        opt = (init_adam_state(params)
-                               if optimizer == "adam" else None)
-                    rb_to = 0
+                        params, loss, gnorm, st = step_fn(params, x, y, rl)
+                    statuses.append(st)
+                elif optimizer == "adam":
+                    params, opt, loss, *rest = step_fn(params, opt, x, y)
+                    gnorm = rest[0] if rest else None
                 else:
-                    params, opt, rb_to, _ = _restore_state(
-                        ckpt_dir, params, opt, rb_to, mesh_shape=mesh_shape
-                    )
-                    if zero:
-                        opt = put_zero_state(opt, mesh, cfg)
-                sink.emit("ft/rollback", from_step=start + chunk,
-                          to_step=rb_to)
-                log(f"guard rollback: step {start + chunk} -> {rb_to}")
-                start = rb_to
-                ref_loss = float("nan")
-                continue
-        start += chunk
-        ran += chunk
-        losses.append(loss_f)
-        if math.isfinite(loss_f):
-            ref_loss = loss_f
-        metrics.counter("train/steps").inc(chunk)
-        metrics.gauge("train/loss").set(loss_f)
-        metrics.histogram("train/step_s").observe(chunk_s / chunk)
-        metrics.gauge("train/compiles").set(counter.count)
-        chunk_ev = {
-            "step": start, "loss": loss_f,
-            "step_s": round(chunk_s / chunk, 6),
-            "steps_per_s": round(chunk / chunk_s, 3),
-            "tokens_per_s": round(
-                chunk * accum_steps * batch * seq / chunk_s, 3
-            ),
-            "compiles": counter.count,
-        }
-        if gnorm is not None:
-            gnorm_f = float(gnorm)
-            chunk_ev["grad_norm"] = gnorm_f
-            metrics.gauge("train/grad_norm").set(gnorm_f)
-        sink.emit("train/chunk", **chunk_ev)
-        state = (
-            {"params": params, "opt": opt} if opt is not None else params
-        )
+                    params, loss, *rest = step_fn(params, x, y)
+                    gnorm = rest[0] if rest else None
+                if counter.count > traced:
+                    compile_s += time.perf_counter() - step_t0
+            loss_f = float(jax.block_until_ready(loss))
+            rec.close_span(chunk_sp)  # fenced by the loss readback
+            chunk_sp.args["compile_s"] = round(compile_s, 6)
+            chunk_s = chunk_sp.seconds
+            if guard is not None:
+                st_host = [int(s) for s in statuses]
+                skips = st_host.count(STATUS_SKIPPED)
+                clips = st_host.count(STATUS_CLIPPED)
+                if skips or clips:
+                    metrics.counter("ft/skipped_steps").inc(skips)
+                    metrics.counter("ft/clipped_steps").inc(clips)
+                    sink.emit("ft/guard", step=start + chunk, skipped=skips,
+                              clipped=clips)
+                if guard_state.observe(st_host):
+                    # the stream is poisoned, not glitched: discard this
+                    # chunk, restore the last committed state, replay
+                    guard_state.rolled_back()  # GuardFailure past the budget
+                    metrics.counter("ft/rollbacks").inc()
+                    rb_sp = rec.open_span("train/rollback", from_step=start + chunk)
+                    rb_to = checkpoint.latest_step(ckpt_dir)
+                    if rb_to is None:
+                        params = init_params(seed, cfg)
+                        if zero:
+                            opt = put_zero_state(
+                                init_zero_adam_state(params, dp_n), mesh, cfg
+                            )
+                        else:
+                            opt = (init_adam_state(params)
+                                   if optimizer == "adam" else None)
+                        rb_to = 0
+                    else:
+                        params, opt, rb_to, _ = _restore_state(
+                            ckpt_dir, params, opt, rb_to, mesh_shape=mesh_shape
+                        )
+                        if zero:
+                            opt = put_zero_state(opt, mesh, cfg)
+                    rec.close_span(rb_sp)
+                    # lost wall: the discarded chunk's compute + the restore
+                    # — the goodput "rollback" badput bucket
+                    sink.emit("ft/rollback", from_step=start + chunk,
+                              to_step=rb_to,
+                              lost_s=round(chunk_s + rb_sp.seconds, 6))
+                    log(f"guard rollback: step {start + chunk} -> {rb_to}")
+                    start = rb_to
+                    ref_loss = float("nan")
+                    continue
+            start += chunk
+            ran += chunk
+            losses.append(loss_f)
+            if math.isfinite(loss_f):
+                ref_loss = loss_f
+            metrics.counter("train/steps").inc(chunk)
+            metrics.gauge("train/loss").set(loss_f)
+            metrics.histogram("train/step_s").observe(chunk_s / chunk)
+            metrics.gauge("train/compiles").set(counter.count)
+            chunk_ev = {
+                "step": start, "loss": loss_f,
+                "steps": chunk,
+                "tokens": chunk * accum_steps * batch * seq,
+                "chunk_s": round(chunk_s, 6),
+                "compile_s": round(compile_s, 6),
+                "step_s": round(chunk_s / chunk, 6),
+                "steps_per_s": round(chunk / chunk_s, 3),
+                "tokens_per_s": round(
+                    chunk * accum_steps * batch * seq / chunk_s, 3
+                ),
+                "compiles": counter.count,
+            }
+            if gnorm is not None:
+                gnorm_f = float(gnorm)
+                chunk_ev["grad_norm"] = gnorm_f
+                metrics.gauge("train/grad_norm").set(gnorm_f)
+            sink.emit("train/chunk", **chunk_ev)
+            state = (
+                {"params": params, "opt": opt} if opt is not None else params
+            )
 
-        def do_save(snap=jax.tree.map(np.asarray, state), at=start):
-            return checkpoint.save(ckpt_dir, at, snap, metadata=metadata,
-                                   hook=save_hook)
+            def do_save(snap=jax.tree.map(np.asarray, state), at=start):
+                return checkpoint.save(ckpt_dir, at, snap, metadata=metadata,
+                                       hook=save_hook)
 
-        if save_policy is not None:
-            retry(do_save, save_policy, op="ckpt/save", log=log)
-        else:
-            do_save()
-        checkpoint.prune(ckpt_dir, keep)
-        log(f"step {start}/{steps}: loss {loss_f:.5f}")
-        if chaos is not None:
-            # AFTER the save: the restarted run resumes exactly here
-            chaos.maybe_preempt("train/preempt", index=start)
+            save_sp = rec.open_span("ckpt/save", step=start)
+            if save_policy is not None:
+                retry(do_save, save_policy, op="ckpt/save", log=log)
+            else:
+                do_save()
+            checkpoint.prune(ckpt_dir, keep)
+            rec.close_span(save_sp)
+            sink.emit("ckpt/save", step=start,
+                      wall_s=round(save_sp.seconds, 6))
+            log(f"step {start}/{steps}: loss {loss_f:.5f}")
+            if chaos is not None:
+                # AFTER the save: the restarted run resumes exactly here
+                chaos.maybe_preempt("train/preempt", index=start)
     sink.emit(
         "train/run",
         steps_run=ran, final_step=start,
         wall_s=round(time.perf_counter() - run_t0, 6),
         compiles=counter.count,
     )
+    emit_phase_totals(sink, rec)
     sink.emit_metrics(metrics.snapshot(), scope=metrics.id)
     sink.flush()
     gs = guard_state

@@ -23,7 +23,20 @@ recompile detection):
   instrumented layer (trainer, ServeEngine, halo drivers, benches)
   writes through it.
 - **report** — ``python -m tpuscratch.obs.report run.jsonl`` collapses a
-  run's JSONL into a summary table.
+  run's JSONL into a summary table (including the per-phase straggler
+  table when >= 2 hosts reported).
+- **trace** — the always-on bounded flight recorder: begin/end spans and
+  instants in a thread-safe ring, exported as Chrome trace-event JSON
+  for Perfetto; ``runtime/profiling.Timeline`` delegates here (one span
+  implementation), and per-phase skew through ``mesh_reduce`` names the
+  slowest rank.
+- **goodput** — MFU and the goodput/badput wall-time partition (compile,
+  checkpoint, rollback replay, restart backoff, straggler wait) computed
+  from the JSONL artifact plus the ledger's FLOPs; buckets sum to the
+  wall time by construction.
+- **regress** — ``python -m tpuscratch.obs.regress BASE.json NEW.json``
+  diffs two ``bench/record`` artifacts against a noise band and exits
+  nonzero on regression (also ``bench/record --check BASE.json``).
 """
 
 from tpuscratch.obs.metrics import (  # noqa: F401
@@ -51,3 +64,18 @@ from tpuscratch.obs.ledger import (  # noqa: F401
     roofline,
 )
 from tpuscratch.obs.sink import NullSink, Sink, open_sink  # noqa: F401
+from tpuscratch.obs.trace import (  # noqa: F401
+    FlightRecorder,
+    StragglerReport,
+    detect_stragglers,
+    emit_phase_totals,
+    merge_chrome_traces,
+    mesh_straggler,
+    span_stamps,
+    validate_chrome_trace,
+)
+from tpuscratch.obs.goodput import (  # noqa: F401
+    BUCKETS,
+    GoodputReport,
+    goodput_report,
+)

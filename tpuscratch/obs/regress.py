@@ -1,0 +1,222 @@
+"""The bench regression gate: diff two ``bench/record.py`` artifacts.
+
+``python -m tpuscratch.obs.regress BASE.json NEW.json [--noise 0.1]``
+
+``bench/record.py --json`` appends one JSON row per measurement; this
+CLI matches the two files' rows by ``(config, metric)`` (last row wins —
+append-mode files carry history; corrupt/torn lines are skipped with a
+warning, ``obs.report``'s loader tolerance), compares every numeric
+field whose direction it knows (tokens/s up is good, p50/p99/bytes down
+is good) against a fractional noise band, and **exits nonzero when
+anything regressed** — the BENCH_* trajectory as an enforceable gate instead of a
+decorative table.  ``record.py --check BASE.json`` runs the same
+comparison in-process right after measuring.
+
+Direction inference is by name substring (see ``_HIGHER``/``_LOWER``);
+fields with no inferable direction (platform, flops_per_token, device
+counts, nested sweeps) are ignored.  A metric present in BASE but
+missing from NEW is reported as ``missing`` — a warning, not a failure,
+because configs legitimately skip on absent hardware (``Needs``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import math
+import sys
+from typing import Iterable, Mapping, Optional
+
+__all__ = ["Finding", "compare", "format_findings", "index_rows",
+           "load_rows", "main"]
+
+#: name substrings ⇒ bigger is better
+_HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
+           "throughput", "updates", "tokens_per")
+#: name substrings ⇒ smaller is better (checked after _HIGHER)
+_LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
+          "overhead", "bubble", "crossover")
+#: fields that are identity/configuration, never compared
+_SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
+         "flops_per_token", "degenerate"}
+
+
+def direction(name: str) -> Optional[str]:
+    """'higher' | 'lower' | None for a metric/field name."""
+    low = name.lower()
+    if any(s in low for s in _HIGHER):
+        return "higher"
+    if any(s in low for s in _LOWER):
+        return "lower"
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One compared number (or one structural note)."""
+
+    config: object
+    metric: str
+    field: str
+    base: Optional[float]
+    new: Optional[float]
+    delta: Optional[float]          # (new - base) / base, sign as stored
+    status: str                     # ok | regressed | improved | missing | added
+
+    def line(self) -> str:
+        tag = {"regressed": "REGRESSED", "improved": "improved",
+               "missing": "MISSING", "added": "added"}.get(self.status, "ok")
+        if self.base is None or self.new is None:
+            return f"  {self.metric}.{self.field}: {tag}"
+        pct = 100 * (self.delta or 0.0)
+        return (
+            f"  {self.metric}.{self.field}: {self.base:.6g} -> "
+            f"{self.new:.6g} ({pct:+.1f}%) {tag}"
+        )
+
+
+def index_rows(rows: Iterable[dict]) -> dict[tuple, dict]:
+    """{(config, metric): row} — last occurrence wins (append-mode
+    artifacts carry every historical run; the newest is the state)."""
+    out: dict[tuple, dict] = {}
+    for row in rows:
+        metric = row.get("metric")
+        if metric is None:
+            continue
+        out[(row.get("config"), metric)] = row
+    return out
+
+
+def load_rows(path: str) -> dict[tuple, dict]:
+    """Indexed rows of one record artifact, loaded through
+    ``obs.report.load_events`` — the ONE torn-tail-tolerant JSONL
+    loader: blank lines skipped, corrupt/truncated and non-object lines
+    dropped with a located ``RuntimeWarning`` (stderr, for the CLI).
+    The loader's ``_file`` annotation is a string field, so the
+    comparison (numeric, direction-bearing fields only) never sees
+    it."""
+    from tpuscratch.obs.report import load_events
+
+    return index_rows(load_events([path]))
+
+
+def _comparable(row: dict) -> dict[str, float]:
+    """{field: value} of every direction-bearing numeric field."""
+    out = {}
+    for key, val in row.items():
+        if key in _SKIP or key == "metric":
+            continue
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        if not math.isfinite(val):
+            continue
+        name = row.get("metric", "") if key == "value" else key
+        if direction(name) is not None:
+            out[key] = float(val)
+    return out
+
+
+def compare(base: Mapping[tuple, dict], new: Mapping[tuple, dict],
+            noise: float = 0.1) -> list[Finding]:
+    """All findings, worst first.  ``noise`` is the fractional band a
+    change must exceed (in the BAD direction) to count as a regression;
+    symmetric for ``improved``."""
+    findings = []
+    for key in sorted(base, key=str):
+        cfg, metric = key
+        if key not in new:
+            findings.append(Finding(cfg, metric, "*", None, None, None,
+                                    "missing"))
+            continue
+        b_row, n_row = base[key], new[key]
+        b_num, n_num = _comparable(b_row), _comparable(n_row)
+        for field in sorted(b_num):
+            if field not in n_num:
+                raw = n_row.get(field)
+                if (isinstance(raw, float) and not math.isfinite(raw)):
+                    # present but NaN/inf: the measurement degenerated —
+                    # that is a failing state, not a skipped config
+                    findings.append(Finding(cfg, metric, field,
+                                            b_num[field], None, None,
+                                            "regressed"))
+                else:
+                    # a renamed/dropped field must not silently disable
+                    # its gate: surface it, like a whole-metric
+                    # disappearance
+                    findings.append(Finding(cfg, metric, field,
+                                            b_num[field], None, None,
+                                            "missing"))
+                continue
+            bv, nv = b_num[field], n_num[field]
+            d = direction(metric if field == "value" else field)
+            if bv == 0:
+                delta = 0.0 if nv == 0 else math.inf
+            else:
+                delta = (nv - bv) / abs(bv)
+            worse = delta < -noise if d == "higher" else delta > noise
+            better = delta > noise if d == "higher" else delta < -noise
+            status = ("regressed" if worse
+                      else "improved" if better else "ok")
+            findings.append(Finding(cfg, metric, field, bv, nv, delta,
+                                    status))
+    for key in sorted(set(new) - set(base), key=str):
+        findings.append(Finding(key[0], key[1], "*", None, None, None,
+                                "added"))
+    order = {"regressed": 0, "missing": 1, "improved": 2, "added": 3,
+             "ok": 4}
+    findings.sort(key=lambda f: (order[f.status], str(f.config), f.metric,
+                                 f.field))
+    return findings
+
+
+def has_regression(findings: Iterable[Finding]) -> bool:
+    return any(f.status == "regressed" for f in findings)
+
+
+def format_findings(findings: list[Finding], noise: float) -> str:
+    n_reg = sum(f.status == "regressed" for f in findings)
+    n_ok = sum(f.status == "ok" for f in findings)
+    n_imp = sum(f.status == "improved" for f in findings)
+    lines = [
+        f"regression gate (noise band ±{100 * noise:.0f}%): "
+        f"{n_reg} regressed, {n_imp} improved, {n_ok} within band"
+    ]
+    for f in findings:
+        if f.status != "ok":
+            lines.append(f.line())
+    if n_reg == 0 and len(lines) == 1:
+        lines.append("  all compared metrics within the noise band")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpuscratch.obs.regress", description=__doc__
+    )
+    ap.add_argument("base", help="baseline record JSON (bench/record --json)")
+    ap.add_argument("new", help="candidate record JSON to gate")
+    ap.add_argument("--noise", type=float, default=0.1,
+                    help="fractional noise band (default 0.1 = 10%%)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON instead of a table")
+    args = ap.parse_args(argv)
+    findings = compare(load_rows(args.base), load_rows(args.new),
+                       noise=args.noise)
+    if args.json:
+        rows = []
+        for f in findings:
+            row = dataclasses.asdict(f)
+            if row["delta"] is not None and not math.isfinite(row["delta"]):
+                # a 0 -> nonzero comparison carries delta=inf; None keeps
+                # the artifact strict JSON (no ``Infinity`` token)
+                row["delta"] = None
+            rows.append(row)
+        print(json.dumps(rows, allow_nan=False))
+    else:
+        print(format_findings(findings, args.noise))
+    return 1 if has_regression(findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,17 +29,22 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import warnings
 from typing import Iterable, Optional
 
 from tpuscratch.obs.metrics import merge_snapshots, percentile
+from tpuscratch.obs.trace import detect_stragglers, fold_phase_events
 
-__all__ = ["load_events", "summarize", "format_table", "main"]
+__all__ = ["load_events", "stragglers", "summarize", "format_table", "main"]
 
 
 def load_events(paths: Iterable[str]) -> list[dict]:
     """All events from the given JSONL files, in file order.  Blank
-    lines are skipped; a malformed line raises with its location (a
-    truncated artifact should fail loudly, not summarize silently)."""
+    lines are skipped; a corrupt/truncated line is SKIPPED with a
+    warning naming its location instead of failing the whole file — a
+    torn final line is the normal state of an artifact whose writer was
+    SIGKILLed mid-flush, and the surviving events are exactly what a
+    post-mortem needs."""
     events = []
     for path in paths:
         with open(path) as f:
@@ -50,19 +55,51 @@ def load_events(paths: Iterable[str]) -> list[dict]:
                 try:
                     rec = json.loads(line)
                 except json.JSONDecodeError as e:
-                    raise ValueError(
-                        f"{path}:{lineno}: not JSON ({e.msg})"
-                    ) from None
+                    warnings.warn(
+                        f"{path}:{lineno}: skipping corrupt JSONL line "
+                        f"({e.msg})",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                    continue
+                if not isinstance(rec, dict):
+                    warnings.warn(
+                        f"{path}:{lineno}: skipping non-object JSONL line",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                    continue
                 rec["_file"] = path
                 events.append(rec)
     return events
+
+
+def stragglers(events: list[dict], min_skew: float = 1.0) -> list[dict]:
+    """The per-phase host-skew table from ``trace/phase`` events: for
+    every phase reported by >= 2 hosts, name the slowest host, the
+    fastest, and the skew ratio.  The cumulative-event fold (newest per
+    (file, host, phase), same-host files take the larger total) is
+    ``obs.trace.fold_phase_events`` — shared with the goodput
+    straggler-wait carve-out, so the two readers always agree."""
+    per_phase = fold_phase_events(events)
+    return [
+        {
+            "phase": r.phase, "slowest": r.slowest, "fastest": r.fastest,
+            "max_s": r.max_s, "min_s": r.min_s,
+            # infinite skew (a 0.0-rounded fastest host) exports as None:
+            # ``json.dumps`` would otherwise emit the non-standard
+            # ``Infinity`` token and break strict consumers
+            "skew": round(r.skew, 4) if r.skew != float("inf") else None,
+        }
+        for r in detect_stragglers(per_phase, min_skew=min_skew)
+    ]
 
 
 def summarize(events: list[dict],
               only_event: Optional[str] = None) -> dict:
     """{event kind: {"count": n, "fields": {field: stats}}} plus a
     merged ``"metrics"`` entry (cross-host merge of each file's last
-    registry snapshot) and the ``"run"`` metadata events verbatim."""
+    registry snapshot), a ``"stragglers"`` table (per-phase host skew
+    from ``trace/phase`` events, when >= 2 hosts reported), and the
+    ``"run"`` metadata events verbatim."""
     by_kind: dict[str, list[dict]] = {}
     # (file, scope) -> newest snapshot of that registry
     last_snapshot: dict[tuple, dict] = {}
@@ -76,11 +113,20 @@ def summarize(events: list[dict],
         if kind == "metrics" and isinstance(rec.get("metrics"), dict):
             last_snapshot[(rec["_file"], rec.get("scope"))] = rec["metrics"]
             continue
+        if kind == "trace/phase" and only_event != "trace/phase":
+            # cumulative snapshots: folded by stragglers() — but an
+            # explicit --event trace/phase request gets the raw stats
+            continue
         if only_event is not None and kind != only_event:
             continue
         by_kind.setdefault(kind, []).append(rec)
 
     out: dict = {"runs": runs, "events": {}}
+    # the skew table reads the whole stream, so it only belongs on the
+    # unfiltered summary — an --event view must not smuggle other kinds
+    skew_rows = stragglers(events) if only_event is None else []
+    if skew_rows:
+        out["stragglers"] = skew_rows
     for kind, recs in sorted(by_kind.items()):
         fields: dict[str, list[float]] = {}
         for rec in recs:
@@ -143,6 +189,21 @@ def format_table(summary: dict) -> str:
                     f"{_fmt(st['p50']):>12} {_fmt(st['mean']):>12} "
                     f"{_fmt(st['max']):>12}"
                 )
+    skew_rows = summary.get("stragglers")
+    if skew_rows:
+        lines.append("\nstragglers (per-phase host skew, slowest first)")
+        width = max(len(r["phase"]) for r in skew_rows)
+
+        def _skew(r):
+            return float("inf") if r["skew"] is None else r["skew"]
+
+        for r in sorted(skew_rows, key=lambda r: -_skew(r)):
+            skew_txt = "inf" if r["skew"] is None else f"{r['skew']:.2f}x"
+            lines.append(
+                f"  {r['phase'].ljust(width)}  host {r['slowest']} slowest "
+                f"{_fmt(r['max_s'])} s vs host {r['fastest']} "
+                f"{_fmt(r['min_s'])} s  (skew {skew_txt})"
+            )
     metrics = summary.get("metrics")
     if metrics:
         lines.append("\nmetrics (final snapshot, merged across hosts)")

@@ -10,6 +10,10 @@ it without side channels.
 
 Writes are buffered (``flush_every`` events) and each event costs one
 dict build + one ``json.dumps`` — cheap enough to emit per engine tick.
+A ``weakref.finalize`` hook (GC + interpreter exit) flushes the buffered
+tail, so short runs and crashed runs that never reach ``close()`` don't
+silently lose events — and dropped unclosed sinks don't pin their file
+descriptors.
 ``NullSink`` is the disabled path: every emit is a constant-time no-op,
 so instrumented layers hold a sink unconditionally instead of
 ``if sink is not None`` at every site.
@@ -26,9 +30,24 @@ from __future__ import annotations
 import json
 import os
 import time
+import weakref
 from typing import Optional
 
 __all__ = ["NullSink", "Sink", "open_sink"]
+
+
+def _close_file(f, buf: list) -> None:
+    """Flush the buffered tail and close — the finalizer body.  A plain
+    function over (file, buffer) so ``weakref.finalize`` holds no
+    reference to the Sink itself (a dropped unclosed sink is collectable
+    and closes at GC; survivors close at interpreter exit)."""
+    if f.closed:
+        return
+    if buf:
+        f.write("\n".join(buf) + "\n")
+        buf.clear()
+    f.flush()
+    f.close()
 
 
 class NullSink:
@@ -83,6 +102,17 @@ class Sink:
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._f = open(path, "a")
+        # buffered writes must not be lost by a run that never reaches
+        # close(): a short script that just falls off the end, a crashed
+        # run whose exception unwinds past the sink, or a sink simply
+        # dropped without close().  weakref.finalize fires on GC AND at
+        # interpreter exit without pinning the sink (an atexit-bound
+        # method would keep every unclosed sink + fd alive for the
+        # process lifetime).  SIGKILL still loses the tail — that torn
+        # final line is why obs.report tolerates corrupt lines.
+        self._finalizer = weakref.finalize(
+            self, _close_file, self._f, self._buf
+        )
         self.emit("run", host=host, **(run or {}))
 
     def emit(self, event: str, **fields) -> None:
@@ -114,9 +144,7 @@ class Sink:
         self._f.flush()
 
     def close(self) -> None:
-        if not self._f.closed:
-            self.flush()
-            self._f.close()
+        self._finalizer()  # runs at most once: flush the tail + close
 
     def __enter__(self) -> "Sink":
         return self

@@ -26,13 +26,13 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import time
 import warnings
-from typing import Iterator
+from typing import Iterator, Optional
 
 import jax
 
 from tpuscratch.obs.metrics import span_max_min
+from tpuscratch.obs.trace import FlightRecorder
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,23 +47,31 @@ class Span:
 
 
 class Timeline:
-    """Per-process span collector (one per rank; merge via cross_rank_span)."""
+    """Per-process span collector (one per rank; merge via
+    cross_rank_span).  Since the flight recorder landed there is ONE
+    span implementation — ``obs.trace.FlightRecorder``'s sync-fencing
+    bracket — and Timeline is a thin delegate over it: every span ALSO
+    lands in ``self.recorder``'s ring (pass a shared recorder to pool
+    several layers' spans into one Chrome trace), while ``self.spans``
+    keeps the legacy per-collector list the merge helpers read."""
 
-    def __init__(self) -> None:
+    def __init__(self, recorder: Optional[FlightRecorder] = None) -> None:
+        self.recorder = recorder if recorder is not None else FlightRecorder()
         self.spans: list[Span] = []
 
     @contextlib.contextmanager
     def span(self, name: str, *sync) -> Iterator[None]:
-        """Wall-clock bracket. Any ``sync`` arrays are blocked on at both
-        edges so async dispatch cannot leak work in or out of the span."""
-        for s in sync:
-            jax.block_until_ready(s)
-        begin = time.perf_counter()
+        """Wall-clock bracket. Any ``sync`` arrays are blocked on at
+        entry so async dispatch cannot leak pending work into the span.
+        Delegates to the recorder's open/close — the one bracket
+        implementation — and mirrors the result into ``self.spans``
+        (on the exception path too, matching the recorder's ring)."""
+        ev = self.recorder.open_span(name, sync=sync)
         try:
             yield
         finally:
-            end = time.perf_counter()
-            self.spans.append(Span(name, begin, end))
+            self.recorder.close_span(ev)
+            self.spans.append(Span(name, ev.begin, ev.end))
 
     def seconds(self, name: str) -> float:
         """Total time across spans with this name."""

@@ -39,6 +39,7 @@ from tpuscratch.ft.chaos import bind_sink
 from tpuscratch.models.transformer import TransformerConfig, init_params
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
+from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
 from tpuscratch.runtime.profiling import Timeline
 from tpuscratch.serve.decode import (
     build_decode_step,
@@ -145,13 +146,17 @@ class ServeEngine:
     ``sink`` (an ``obs.sink.Sink``; default the no-op ``NullSink``)
     receives one ``serve/tick`` event per tick plus a ``serve/report`` +
     metrics snapshot per drain; ``self.metrics`` is the live
-    ``obs.MetricsRegistry`` regardless of sink."""
+    ``obs.MetricsRegistry`` regardless of sink.  ``recorder`` (an
+    ``obs.trace.FlightRecorder``; a fresh bounded one when absent — the
+    flight recorder is always on) collects the prefill/decode spans via
+    the engine's Timeline for Chrome-trace export; per-phase totals are
+    emitted as cumulative ``trace/phase`` events at each drain."""
 
     def __init__(self, mesh: Mesh, cfg: TransformerConfig, scfg: ServeConfig,
                  params: Optional[dict] = None,
                  embed: Optional[jax.Array] = None,
                  dp: str = "dp", sp: str = "sp",
-                 sink=None, chaos=None):
+                 sink=None, chaos=None, recorder=None):
         check_serve_mesh(mesh, cfg, dp, sp)
         self._dp_size = mesh.shape[dp]
         if scfg.n_slots % self._dp_size:
@@ -192,7 +197,10 @@ class ServeEngine:
         self._chaos = chaos  # ft.ChaosPlan or None: "serve/prefill" site
         self._quarantined: dict[int, str] = {}  # rid -> last error
         self._seed_key = jax.random.key(scfg.seed)
-        self.timeline = Timeline()
+        self.recorder = (
+            recorder if recorder is not None else FlightRecorder()
+        )
+        self.timeline = Timeline(self.recorder)
         # observability: every tick updates the registry (host-side
         # attribute writes, < 2% of a compiled step) and, when a sink is
         # attached, emits one JSONL event — queue depth, free-page
@@ -568,6 +576,7 @@ class ServeEngine:
             decode_s=round(report.decode_s, 6),
             quarantined=len(report.quarantined),
         )
+        emit_phase_totals(self.sink, self.recorder)
         self.sink.emit_metrics(self.metrics.snapshot(),
                                scope=self.metrics.id)
         self.sink.flush()
