@@ -10,7 +10,16 @@ pages, finished sequences evicted mid-stream so queued work back-fills
 their slots.  Watch the report: ONE decode compile no matter how many
 requests churn through, and every page back on the free list at drain.
 
-argv tier:  ex24_serving.py [--decode-slots=N] [--kv-pages=N] [--page-size=N]
+Two serving hot-path levers ride the same engine contract:
+``--int8`` stores KV pages quantized (int8 + per-page scales, ~1/4 the
+cache bytes per token — the decode-gather roofline), ``--spec[=K]``
+turns on self-drafting speculative decoding (K draft tokens verified
+per cache sweep; the report's accepted/drafted counters show how many
+sweeps the drafts saved, and the accounting identity
+``tokens == prefills + slot_steps + accepted`` is asserted live).
+
+argv tier:  ex24_serving.py [--decode-slots=N] [--kv-pages=N]
+            [--page-size=N] [--spec[=K]] [--int8]
 """
 
 import pathlib
@@ -29,6 +38,10 @@ def main(argv=None) -> None:
     from tpuscratch.runtime.mesh import make_mesh
     from tpuscratch.serve import Request, ServeConfig, ServeEngine
 
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    # sugar over the Config flag tier: bare --int8 / --spec spellings
+    argv = ["--kv-dtype=int8" if a == "--int8"
+            else "--spec=3" if a == "--spec" else a for a in argv]
     cli = Config.load(argv)
     mesh = make_mesh((2, 4), ("dp", "sp"))
     cfg = TransformerConfig(
@@ -38,19 +51,30 @@ def main(argv=None) -> None:
     scfg = ServeConfig(
         n_slots=cli.decode_slots, n_pages=cli.kv_pages,
         page_size=cli.page_size, max_seq=48, vocab=64, temperature=0.7,
-        top_k=8, seed=0,
+        top_k=8, seed=0, kv_dtype=cli.kv_dtype, spec_k=cli.spec,
     )
     banner(
         f"serving on a 2x4 (dp x sp) mesh: {scfg.n_slots} decode slots, "
-        f"{scfg.n_pages} pages/group x {scfg.page_size} tokens"
+        f"{scfg.n_pages} pages/group x {scfg.page_size} tokens, "
+        f"kv={scfg.kv_dtype}"
+        + (f", speculative k={scfg.spec_k}" if scfg.spec_k else "")
     )
 
     engine = ServeEngine(mesh, cfg, scfg)
     free0 = engine.free_pages()
-    rng_prompts = [
-        tuple((3 * i + j) % scfg.vocab for j in range(2 + (5 * i) % 9))
-        for i in range(2 * scfg.n_slots)  # 2x oversubscribed: queueing is real
-    ]
+    # periodic prompts when speculating (the draftable regime the
+    # prompt-lookup proposer exists for), mixed-length arbitrary ones
+    # otherwise — both 2x oversubscribed so queueing is real
+    if scfg.spec_k:
+        rng_prompts = [
+            tuple((j % (2 + i % 3)) + 1 for j in range(4 + (3 * i) % 7))
+            for i in range(2 * scfg.n_slots)
+        ]
+    else:
+        rng_prompts = [
+            tuple((3 * i + j) % scfg.vocab for j in range(2 + (5 * i) % 9))
+            for i in range(2 * scfg.n_slots)
+        ]
     requests = [
         Request(rid=i, prompt=p, max_new=3 + (7 * i) % 10)
         for i, p in enumerate(rng_prompts)
@@ -67,10 +91,24 @@ def main(argv=None) -> None:
     print(f"compiles: decode {report.decode_compiles} (steady state never "
           f"recompiles), prefill {report.prefill_compiles} (one per prompt "
           "shape bucket)")
+    if scfg.spec_k:
+        print(f"speculation: {report.drafted} drafted, {report.accepted} "
+              f"accepted (mean accept {report.accept_len_mean:.2f}/"
+              f"{scfg.spec_k} per sweep) — {report.slot_steps} sweeps for "
+              f"{report.tokens_generated - report.prefills} decoded tokens")
+    if scfg.kv_dtype == "int8":
+        print(f"kv cache: int8 pages, {engine.kv_bytes_per_token:.0f} "
+              "B/token of pool capacity (fp32 would be "
+              f"{2 * cfg.n_layers * cfg.n_heads * cfg.d_head * 4:.0f})")
     print(f"wall: prefill {report.prefill_s:.3f}s, decode {report.decode_s:.3f}s")
     print(f"pages: {free0} free before, {engine.free_pages()} after drain")
     assert engine.free_pages() == free0, "page leak!"
     assert report.decode_compiles == 1
+    # the speculative token-accounting identity: every emitted token is
+    # a prefill token, a sweep's base token, or an accepted draft
+    assert report.tokens_generated == (
+        report.prefills + report.slot_steps + report.accepted
+    ), "accepted-token counters do not reconcile with emitted tokens"
     print(f"[{jax.default_backend()}] serving demo PASSED")
 
 

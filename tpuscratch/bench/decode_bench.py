@@ -1,6 +1,12 @@
 """Serving-side decode throughput and per-token latency (BASELINE row 12).
 
-``python -m tpuscratch.bench.decode_bench [--json PATH]``
+``python -m tpuscratch.bench.decode_bench [--json PATH]
+[--kv-dtype int8] [--spec K]``
+
+``--kv-dtype int8`` runs the sweep on quantized KV pages (~1/4 the
+cache bytes per token); ``--spec K`` speculates K draft tokens per
+verify sweep over an accept-friendly periodic prompt — the two serving
+hot-path levers, locally sweepable before a record run.
 
 Every training-side row measures steps/s of a compiled program; serving
 is judged on different axes — sustained tokens/s at a batch size, and
@@ -14,8 +20,13 @@ Methodology: submit ``n_slots`` requests with max_new large enough to
 hold all slots busy through the measured window, warm up past prefill +
 the single decode compile, then time each engine tick individually.
 Per-token latency IS the tick time (each slot advances one token per
-tick); tokens/s = n_slots / p50.  Sampled tokens are pulled to host
-every tick (the engine's own np.asarray), so each timing is fenced by
+tick); tokens/s = n_slots / p50.  Under speculation a tick emits a
+variable count, so both are measured instead of assumed: tokens per
+tick comes from the engine's token counter, and each tick's latency is
+scaled by ``n_slots / tokens_that_tick`` so the reported percentiles
+stay PER-TOKEN (a verify sweep that lands k accepted tokens costs its
+tick once, not k times).  Sampled tokens are pulled to host every tick
+(the engine's own np.asarray), so each timing is fenced by
 construction.
 """
 
@@ -32,10 +43,29 @@ from tpuscratch.bench.timing import BenchResult, percentile
 
 @dataclasses.dataclass(frozen=True)
 class DecodeBenchResult:
-    """BenchResult plus the latency percentiles a serving SLO reads."""
+    """BenchResult plus the latency percentiles a serving SLO reads.
+
+    ``bytes_per_token`` is the STATIC cache-byte footprint per token of
+    pool capacity (int8 pages land at ~1/4 of fp32 — the decode-gather
+    roofline, see ``obs.ledger.kv_cache_bytes``); ``accept_len_mean``
+    is the measured-window mean accepted draft length per verify sweep
+    (None with speculation off).
+
+    ``times_per_token_s`` is each tick's time scaled to ONE slot's
+    per-token latency: ``tick_s * n_slots / tokens_emitted_that_tick``.
+    Without speculation every tick emits exactly ``n_slots`` tokens, so
+    it equals the raw tick times; a speculative tick emits ``n_slots +
+    accepted`` and the scaling credits the amortization — otherwise the
+    per-SWEEP time would be reported as per-token latency, overstating
+    it by the mean accepted length."""
 
     result: BenchResult
     n_slots: int
+    kv_dtype: str = "float32"
+    spec_k: int = 0
+    bytes_per_token: float = 0.0
+    accept_len_mean: float | None = None
+    times_per_token_s: tuple[float, ...] = ()
 
     @property
     def tokens_per_s(self) -> float:
@@ -43,18 +73,29 @@ class DecodeBenchResult:
 
     @property
     def p50_s(self) -> float:
-        return self.result.p50
+        return percentile(self.times_per_token_s or self.result.times_s, 50)
 
     @property
     def p99_s(self) -> float:
-        return percentile(self.result.times_s, 99)
+        return percentile(self.times_per_token_s or self.result.times_s, 99)
 
     def summary(self) -> str:
-        return (
+        out = (
             f"{self.result.name}: {self.tokens_per_s:.3e} tok/s, "
             f"per-token p50 {self.p50_s * 1e3:.3f} ms / "
             f"p99 {self.p99_s * 1e3:.3f} ms"
         )
+        if self.accept_len_mean is not None:
+            out += f", accept len {self.accept_len_mean:.2f}/{self.spec_k}"
+        return out
+
+
+def accept_friendly_prompt(length: int, vocab: int,
+                           period: int = 4) -> tuple[int, ...]:
+    """A periodic prompt — the workload speculative decoding exists for:
+    the prompt-lookup proposer finds its suffix n-gram immediately and
+    drafts the pattern's continuation (boilerplate/template traffic)."""
+    return tuple((t % period) + 1 for t in range(length))
 
 
 def bench_decode(
@@ -65,10 +106,20 @@ def bench_decode(
     measure_steps: int = 32,
     warmup_steps: int = 4,
     sink=None,
+    prompt: tuple[int, ...] | None = None,
 ) -> DecodeBenchResult:
     """Steady-state decode: all ``scfg.n_slots`` slots busy, per-tick
     timings over ``measure_steps`` ticks after ``warmup_steps`` warm
     ticks (prefill + the one decode compile land in warmup).
+
+    Speculation (``scfg.spec_k > 0``) changes the accounting, not the
+    method: a tick still runs one compiled sweep for every slot, but
+    emits a VARIABLE token count (base + accepted drafts), so tokens
+    per tick is measured from the engine's token counter over the
+    window rather than assumed to be ``n_slots``, and the result
+    carries the window's mean accepted draft length.  Pass an
+    accept-friendly ``prompt`` (:func:`accept_friendly_prompt`) to
+    measure the amortization regime rather than the all-rejected floor.
 
     ``sink`` (an ``obs.sink.Sink``) attaches to the engine, so the
     artifact carries per-tick queue depth, free-page watermark, and
@@ -77,21 +128,23 @@ def bench_decode(
     page pressure? a recompile?) instead of just visible in it."""
     from tpuscratch.serve import Request, ServeEngine
 
-    scfg = dataclasses.replace(
-        scfg, max_seq=max(scfg.max_seq,
-                          prompt_len + warmup_steps + measure_steps + 2),
-    )
-    engine = ServeEngine(mesh, cfg, scfg, sink=sink)
+    if prompt is not None:
+        prompt_len = len(prompt)
     # +1: prefill emits a token; the extra +1 keeps every slot ALIVE
     # through the last measured tick — finishing exactly on it would put
     # the all-slot eviction/free teardown inside the timed window, and
-    # with 64 samples p99 interpolates at the max
-    budget = warmup_steps + measure_steps + 2
+    # with 64 samples p99 interpolates at the max.  A speculative tick
+    # can emit up to spec_k + 1 tokens per slot, so the budget (and the
+    # pool reservation) scales by that ceiling.
+    budget = (warmup_steps + measure_steps + 2) * (scfg.spec_k + 1)
+    scfg = dataclasses.replace(
+        scfg, max_seq=max(scfg.max_seq, prompt_len + budget),
+    )
+    engine = ServeEngine(mesh, cfg, scfg, sink=sink)
+    if prompt is None:
+        prompt = tuple(t % scfg.vocab for t in range(1, prompt_len + 1))
     for i in range(scfg.n_slots):
-        engine.submit(Request(
-            rid=i, prompt=tuple(t % scfg.vocab for t in range(1, prompt_len + 1)),
-            max_new=budget,
-        ))
+        engine.submit(Request(rid=i, prompt=prompt, max_new=budget))
     for _ in range(warmup_steps):
         engine.step()
     if engine.n_active != scfg.n_slots:
@@ -100,23 +153,45 @@ def bench_decode(
             "raise the page pool or lower the batch"
         )
     compiles_before = engine.decode_compiles
-    times = []
+    tokens0, slots0 = engine.tokens_generated, engine.slot_steps
+    accepted0 = engine.spec_accepted
+    times, tick_tokens = [], []
+    tprev = engine.tokens_generated
     for _ in range(measure_steps):
         t0 = time.perf_counter()
         engine.step()  # pulls sampled tokens to host: fenced
         times.append(time.perf_counter() - t0)
+        tnow = engine.tokens_generated
+        tick_tokens.append(tnow - tprev)
+        tprev = tnow
     if engine.decode_compiles != compiles_before:
         raise RuntimeError(
             "decode recompiled inside the measured window "
             f"({compiles_before} -> {engine.decode_compiles})"
         )
+    tokens = engine.tokens_generated - tokens0
+    sweeps = engine.slot_steps - slots0
+    accept_mean = (
+        (engine.spec_accepted - accepted0) / sweeps
+        if scfg.spec_k > 0 and sweeps else None
+    )
     res = BenchResult(
         name=f"decode b={scfg.n_slots} prompt={prompt_len} "
-             f"page={scfg.page_size}",
+             f"page={scfg.page_size} kv={scfg.kv_dtype}"
+             + (f" spec={scfg.spec_k}" if scfg.spec_k else ""),
         times_s=tuple(times),
-        items=scfg.n_slots,  # tokens per tick
+        items=tokens / measure_steps,  # measured tokens per tick
     )
-    out = DecodeBenchResult(res, scfg.n_slots)
+    out = DecodeBenchResult(
+        res, scfg.n_slots,
+        kv_dtype=scfg.kv_dtype, spec_k=scfg.spec_k,
+        bytes_per_token=engine.kv_bytes_per_token,
+        accept_len_mean=accept_mean,
+        times_per_token_s=tuple(
+            t * scfg.n_slots / max(tk, 1)
+            for t, tk in zip(times, tick_tokens)
+        ),
+    )
     if sink is not None and sink.enabled:
         sink.emit(
             "bench/decode",
@@ -124,6 +199,10 @@ def bench_decode(
             measure_steps=measure_steps,
             tokens_per_s=out.tokens_per_s,
             p50_s_per_token=out.p50_s, p99_s_per_token=out.p99_s,
+            kv_dtype=scfg.kv_dtype, spec_k=scfg.spec_k,
+            bytes_per_token=out.bytes_per_token,
+            **({"accept_len_mean": accept_mean}
+               if accept_mean is not None else {}),
         )
         # scope = this engine's registry: the sweep runs one engine per
         # batch size into ONE file, and the report must merge them, not
@@ -179,6 +258,15 @@ def main(argv=None) -> int:
     ap.add_argument("--json", default=None)
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path (per-tick engine telemetry)")
+    ap.add_argument("--kv-dtype", default="float32",
+                    choices=("float32", "int8"),
+                    help="KV-cache page dtype (int8: quantized pages, "
+                         "~1/4 the cache bytes per token)")
+    ap.add_argument("--spec", type=int, default=0, metavar="K",
+                    help="speculative draft tokens per verify sweep "
+                         "(0 = off); sweeps use an accept-friendly "
+                         "periodic prompt so the amortization regime "
+                         "is what gets measured")
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args(argv)
     if args.cpu_devices:
@@ -191,6 +279,30 @@ def main(argv=None) -> int:
     on_tpu = jax.default_backend() == "tpu"
     mesh = make_mesh((1, 1), ("dp", "sp"))
     cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
+    scfg = dataclasses.replace(scfg, kv_dtype=args.kv_dtype,
+                               spec_k=args.spec)
+    if args.spec:
+        kwargs["prompt"] = accept_friendly_prompt(
+            kwargs.pop("prompt_len", 8), scfg.vocab
+        )
+        # a speculative slot's budget (hence page reservation) scales by
+        # spec + 1; drop sweep points whose full bank cannot fit the
+        # pool — the admission watermark would (correctly) refuse them
+        budget = (kwargs.get("warmup_steps", 4)
+                  + kwargs.get("measure_steps", 32) + 2) * (args.spec + 1)
+        need = -(-(len(kwargs["prompt"]) + budget) // scfg.page_size)
+        fitting = tuple(b for b in batches if b * need <= scfg.n_pages)
+        for b in set(batches) - set(fitting):
+            print(f"# batch {b} skipped: speculative reservation "
+                  f"{b * need} pages > pool {scfg.n_pages}",
+                  file=sys.stderr)
+        if not fitting:
+            ap.error(
+                f"--spec {args.spec}: even batch 1 reserves {need} pages "
+                f"> pool {scfg.n_pages}; lower --spec or the measured "
+                "window"
+            )
+        batches = fitting
     rows = []
     # context-managed: a sweep that dies mid-run (OOM at a large batch)
     # still flushes the buffered ticks — exactly the telemetry needed to
@@ -201,12 +313,18 @@ def main(argv=None) -> int:
         host=jax.process_index(),
     ) as sink:
         for r in sweep(mesh, cfg, scfg, batches, sink=sink, **kwargs):
-            rows.append({
+            row = {
                 "batch": r.n_slots,
                 "tokens_per_s": r.tokens_per_s,
                 "p50_s_per_token": r.p50_s,
                 "p99_s_per_token": r.p99_s,
-            })
+                "kv_dtype": r.kv_dtype,
+                "spec_k": r.spec_k,
+                "bytes_per_token": r.bytes_per_token,
+            }
+            if r.accept_len_mean is not None:
+                row["accept_len_mean"] = r.accept_len_mean
+            rows.append(row)
     payload = {"platform": jax.default_backend(), "sweep": rows}
     print(json.dumps(payload))
     if args.json:

@@ -21,8 +21,10 @@ the ones this repo establishes. Configs follow BASELINE.md:
    with the mesh; real chip when present)
 10. remote-DMA halo kernel, 1024^2 self-wrap     (real chip when present)
 11. composed-training tokens/s, f32 + bf16       (real chip when present)
-12. serve decode tokens/s + per-token p50/p99 over a batch-size sweep
-    (real chip when present)
+12. serve decode tokens/s + per-token p50/p99 over a batch-size sweep,
+    plus the quantized-KV static bytes/token row and the speculative-
+    decoding row (tokens/s + accept length on an accept-friendly
+    prompt)                                      (real chip when present)
 
 Each config prints one JSON line with the platform recorded, so CPU-proxy
 numbers can never masquerade as chip numbers.
@@ -704,12 +706,33 @@ def config12_decode(out: list, obs_path=None) -> None:
     the recorded artifact carries per-tick queue depth, free-page
     watermark, and tick latency next to the headline tokens/s — a
     regression in this row is then diagnosable from the artifact
-    (``python -m tpuscratch.obs.report <obs_path>``)."""
-    import jax
+    (``python -m tpuscratch.obs.report <obs_path>``).
 
-    from tpuscratch.bench.decode_bench import default_decode_setup, sweep
+    Three rows: the headline fp32 non-speculative sweep (unchanged
+    semantics — ``--check`` against pre-speculation artifacts stays
+    apples-to-apples), a STATIC ``serve_kv_cache_bytes`` row proving the
+    int8 page footprint (bytes per token + int8/f32 ratio, the
+    ledger-verified half of the quantized-KV claim), and a
+    ``serve_decode_spec`` row measuring speculative decoding on an
+    accept-friendly periodic prompt — tokens/s, the same-workload
+    non-speculative rate, their ratio (``spec_speedup``), and the mean
+    accepted draft length (regression directions: bytes/ratio down,
+    tokens-per-s/accept/speedup up — ``obs.regress``)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpuscratch.bench.decode_bench import (
+        accept_friendly_prompt,
+        bench_decode,
+        default_decode_setup,
+        sweep,
+    )
+    from tpuscratch.obs.ledger import kv_cache_bytes
     from tpuscratch.obs.sink import open_sink
     from tpuscratch.runtime.mesh import make_mesh
+    from tpuscratch.serve.kvcache import CacheGeometry, init_kv_cache
 
     on_tpu = jax.default_backend() == "tpu"
     mesh = make_mesh((1, 1), ("dp", "sp"))
@@ -720,26 +743,81 @@ def config12_decode(out: list, obs_path=None) -> None:
         host=jax.process_index(),
     ) as sink:
         results = sweep(mesh, cfg, scfg, batches, sink=sink, **kwargs)
-    best = max(results, key=lambda r: r.tokens_per_s)
-    _emit(
-        out,
-        config=12,
-        metric="serve_decode_tokens_per_s",
-        value=best.tokens_per_s,
-        p50_s=best.p50_s,
-        p99_s=best.p99_s,
-        sweep=[
-            {
-                "batch": r.n_slots,
-                "tokens_per_s": r.tokens_per_s,
-                "p50_s_per_token": r.p50_s,
-                "p99_s_per_token": r.p99_s,
-            }
-            for r in results
-        ],
-        detail=best.summary()
-        + (f" [obs: {obs_path}]" if obs_path else ""),
-    )
+        best = max(results, key=lambda r: r.tokens_per_s)
+        _emit(
+            out,
+            config=12,
+            metric="serve_decode_tokens_per_s",
+            value=best.tokens_per_s,
+            p50_s=best.p50_s,
+            p99_s=best.p99_s,
+            sweep=[
+                {
+                    "batch": r.n_slots,
+                    "tokens_per_s": r.tokens_per_s,
+                    "p50_s_per_token": r.p50_s,
+                    "p99_s_per_token": r.p99_s,
+                }
+                for r in results
+            ],
+            detail=best.summary()
+            + (f" [obs: {obs_path}]" if obs_path else ""),
+        )
+
+        # static cache-byte proof at this row's geometry: int8 pages +
+        # scales vs fp32 pages, per token of pool capacity — exact, not
+        # sampled (the ZeRO grad-leg pattern applied to serving HBM)
+        geom = CacheGeometry(cfg.n_layers, scfg.n_pages, scfg.page_size,
+                             cfg.n_heads, cfg.d_head)
+        b_f32 = kv_cache_bytes(init_kv_cache(geom))
+        b_int8 = kv_cache_bytes(init_kv_cache(geom, dtype=jnp.int8))
+        _emit(
+            out,
+            config=12,
+            metric="serve_kv_cache_bytes",
+            bytes_per_token_f32=b_f32 / geom.max_tokens,
+            bytes_per_token_int8=b_int8 / geom.max_tokens,
+            int8_ratio=b_int8 / b_f32,
+            detail=f"{b_f32 / geom.max_tokens:.0f} -> "
+                   f"{b_int8 / geom.max_tokens:.0f} B/token "
+                   f"({b_int8 / b_f32:.3f}x) at config-12 geometry",
+        )
+
+        # speculative decoding on an accept-friendly periodic prompt
+        # (the amortization regime), with the same-workload
+        # non-speculative rate beside it.  Batch capped below the sweep
+        # maximum on TPU: a speculative slot's budget (and page
+        # reservation) scales by spec_k + 1, and 32 slots of that would
+        # outgrow the row's page pool — the admission watermark would
+        # (correctly) refuse to fill the bank
+        batch = min(batches[-1], 8) if on_tpu else batches[-1]
+        prompt = accept_friendly_prompt(
+            kwargs.get("prompt_len", 8), scfg.vocab
+        )
+        kw = {k: v for k, v in kwargs.items() if k != "prompt_len"}
+        r_base = bench_decode(
+            mesh, cfg, _dc.replace(scfg, n_slots=batch),
+            prompt=prompt, sink=sink, **kw,
+        )
+        r_spec = bench_decode(
+            mesh, cfg, _dc.replace(scfg, n_slots=batch,
+                                   spec_k=4 if on_tpu else 3),
+            prompt=prompt, sink=sink, **kw,
+        )
+        print(f"# {r_spec.summary()} (vs {r_base.tokens_per_s:.3e} tok/s "
+              "non-spec)", file=sys.stderr)
+        _emit(
+            out,
+            config=12,
+            metric="serve_decode_spec",
+            value=r_spec.tokens_per_s,
+            nospec_tokens_per_s=r_base.tokens_per_s,
+            spec_speedup=r_spec.tokens_per_s / r_base.tokens_per_s,
+            accept_len_mean=r_spec.accept_len_mean,
+            p50_s=r_spec.p50_s,
+            p99_s=r_spec.p99_s,
+            detail=r_spec.summary(),
+        )
 
 
 def config13_zero_train(out: list, iters: int = 3) -> None:

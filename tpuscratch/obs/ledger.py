@@ -42,6 +42,7 @@ __all__ = [
     "all_to_all_wire_bytes",
     "analyze",
     "grad_sync_wire_bytes",
+    "kv_cache_bytes",
     "parse_collectives",
     "reduce_scatter_wire_bytes",
     "ring_all_reduce_wire_bytes",
@@ -309,6 +310,22 @@ def grad_sync_wire_bytes(ledger: "Ledger") -> GradSyncBytes:
         reduce_scatter=wire.get("reduce-scatter", 0.0),
         all_gather=wire.get("all-gather", 0.0),
     )
+
+
+def kv_cache_bytes(cache) -> int:
+    """Total buffer bytes of a serve KV-cache pytree
+    (``serve.kvcache.init_kv_cache`` output — page pools plus, for int8
+    pools, the per-page per-head scale planes).
+
+    This is the static half of the quantized-KV claim, the same proof
+    pattern as :func:`grad_sync_wire_bytes` for the ZeRO 0.5x
+    gradient-leg: decode gathers the whole cached prefix per token, so
+    cache bytes ARE its HBM/wire roofline, and int8 pages land at
+    ``1/4 + 1/(page_size * d_head)`` of the fp32 bytes regardless of
+    measurement noise — pinned ≤ 0.55x by a regression test
+    (tests/test_serve.py) at the record-config-12 geometry."""
+    leaves = cache.values() if hasattr(cache, "values") else cache
+    return int(sum(leaf.size * leaf.dtype.itemsize for leaf in leaves))
 
 
 def _cost_entry(compiled) -> dict:

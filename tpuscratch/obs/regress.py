@@ -33,8 +33,11 @@ __all__ = ["Finding", "compare", "format_findings", "index_rows",
 
 #: name substrings ⇒ bigger is better
 _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
-           "throughput", "updates", "tokens_per")
+           "throughput", "updates", "tokens_per", "accept", "speedup")
 #: name substrings ⇒ smaller is better (checked after _HIGHER)
+#: (note the ordering: ``accept_len_mean`` and ``spec_speedup`` match
+#: _HIGHER before "ratio"/"bytes" substrings could ever mislabel them —
+#: accepted draft length and speculative speedup regress DOWNWARD)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
           "overhead", "bubble", "crossover")
 #: fields that are identity/configuration, never compared

@@ -1119,12 +1119,46 @@ def flash_attention(
 # ---- cached decode attention ---------------------------------------------
 
 
+def _gather_pages(pages, table, page_scale):
+    """Each sequence's contiguous fp32 cache view: pages (P, page, H, D)
+    gathered by a clipped (B, max_pages) table into (B, T, H, D).
+
+    ``page_scale`` (P, H) — present for int8 pools — dequantizes AFTER
+    the gather: the gather itself moves int8 bytes (a quarter of the
+    fp32 sweep, which is the decode roofline) plus H floats of scale per
+    page, and the fp32 expansion happens on the already-local view."""
+    B, max_pages = table.shape
+    page_size, H, D = pages.shape[1:]
+    T = max_pages * page_size
+    g = pages[table]                              # (B, max_pages, page, H, D)
+    if page_scale is not None:
+        g = g.astype(jnp.float32) * page_scale[table][:, :, None, :, None]
+    return g.reshape(B, T, H, D)
+
+
+def _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens):
+    if k_pages.ndim != 4 or k_pages.shape != v_pages.shape:
+        raise ValueError(
+            f"bad decode shapes q={q.shape} k={k_pages.shape} "
+            f"v={v_pages.shape}"
+        )
+    B, H, D = q.shape[0], q.shape[-2], q.shape[-1]
+    n_pages, page_size, Hp, Dp = k_pages.shape
+    if (Hp, Dp) != (H, D) or page_table.shape[0] != B or seq_lens.shape != (B,):
+        raise ValueError(
+            f"mismatched decode operands: q={q.shape} pages={k_pages.shape} "
+            f"table={page_table.shape} lens={seq_lens.shape}"
+        )
+
+
 def decode_attention(
     q: jax.Array,
     k_pages: jax.Array,
     v_pages: jax.Array,
     page_table: jax.Array,
     seq_lens: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Single-token attention over a block-paged KV cache (serve path).
 
@@ -1135,6 +1169,11 @@ def decode_attention(
     sentinel) marking unallocated tail entries; seq_lens (B,) int32 —
     each sequence's true cached length INCLUDING the current token
     (its K/V must already be written). Returns (B, H, D).
+
+    ``k_scale``/``v_scale`` (P, H) fp32 — required when the pools are
+    int8 (``serve.kvcache.quantize_pages`` layout): the gather moves the
+    int8 pages (a quarter of the fp32 bytes — and bytes ARE the decode
+    roofline) and dequantizes the gathered view in place.
 
     Each sequence gathers its pages into a contiguous (max_pages *
     page_size, H, D) view and masks key positions at or beyond its true
@@ -1147,24 +1186,17 @@ def decode_attention(
     ``parallel.scores.masked_scores``. Sequences with ``seq_len == 0``
     (empty decode slots) return zeros rather than NaN.
     """
-    if q.ndim != 3 or k_pages.ndim != 4 or k_pages.shape != v_pages.shape:
-        raise ValueError(
-            f"bad decode shapes q={q.shape} k={k_pages.shape} "
-            f"v={v_pages.shape}"
-        )
+    if q.ndim != 3:
+        raise ValueError(f"bad decode shapes q={q.shape}")
+    _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens)
     B, H, D = q.shape
-    n_pages, page_size, Hp, Dp = k_pages.shape
-    if (Hp, Dp) != (H, D) or page_table.shape[0] != B or seq_lens.shape != (B,):
-        raise ValueError(
-            f"mismatched decode operands: q={q.shape} pages={k_pages.shape} "
-            f"table={page_table.shape} lens={seq_lens.shape}"
-        )
+    n_pages, page_size = k_pages.shape[:2]
     # clip BEFORE gathering (unallocated sentinel entries land on page 0;
     # the length mask keeps their scores out of the softmax)
     table = jnp.clip(page_table, 0, n_pages - 1)
     T = page_table.shape[1] * page_size
-    k = k_pages[table].reshape(B, T, H, D)
-    v = v_pages[table].reshape(B, T, H, D)
+    k = _gather_pages(k_pages, table, k_scale)
+    v = _gather_pages(v_pages, table, v_scale)
     scale = 1.0 / float(D) ** 0.5
     s = jnp.einsum(
         "bhd,bthd->bht", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -1172,4 +1204,50 @@ def decode_attention(
     valid = jnp.arange(T)[None, None, :] < seq_lens[:, None, None]  # (B,1,T)
     p = masked_softmax(jnp.where(valid, s, NEG_INF), valid)
     out = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def verify_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    seq_lens: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Speculative-verify attention: K queued tokens per sequence attend
+    the paged cache through ONE gather (serve verify path).
+
+    q (B, K, H, D) — position 0 is the last accepted token, positions
+    1..K-1 the draft; pools/table/scales as in :func:`decode_attention`;
+    seq_lens (B,) is the cached length INCLUDING position 0 (all K
+    positions' K/V must already be written).  Position j attends the
+    first ``seq_lens + j`` cache entries — the ragged-causal mask over
+    in-flight draft tokens.  Returns (B, K, H, D); ``seq_len == 0``
+    slots return zeros at every position.
+
+    This is the HBM-sweep amortization speculative decoding buys: plain
+    decode pays one full cache gather per generated token, the verify
+    step pays ONE gather for K scored positions — up to K tokens
+    emitted per sweep when the draft holds (Leviathan et al. 2023).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"bad verify shapes q={q.shape}")
+    _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens)
+    B, K, H, D = q.shape
+    n_pages, page_size = k_pages.shape[:2]
+    table = jnp.clip(page_table, 0, n_pages - 1)
+    T = page_table.shape[1] * page_size
+    k = _gather_pages(k_pages, table, k_scale)    # ONE sweep for K queries
+    v = _gather_pages(v_pages, table, v_scale)
+    scale = 1.0 / float(D) ** 0.5
+    s = jnp.einsum(
+        "bkhd,bthd->bkht", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    lens = seq_lens[:, None, None, None] + jnp.arange(K)[None, :, None, None]
+    valid = jnp.arange(T)[None, None, None, :] < lens       # (B, K, 1, T)
+    valid = valid & (seq_lens[:, None, None, None] > 0)     # idle slots -> 0
+    p = masked_softmax(jnp.where(valid, s, NEG_INF), valid)
+    out = jnp.einsum("bkht,bthd->bkhd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)

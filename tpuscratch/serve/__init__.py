@@ -12,6 +12,8 @@ from tpuscratch.serve.decode import (  # noqa: F401
     CompileCounter,
     build_decode_step,
     build_prefill,
+    build_verify_step,
+    propose_draft,
 )
 from tpuscratch.serve.engine import (  # noqa: F401
     GenerateReport,
@@ -23,11 +25,15 @@ from tpuscratch.serve.engine import (  # noqa: F401
 from tpuscratch.serve.kvcache import (  # noqa: F401
     CacheGeometry,
     PageAllocator,
+    dequantize_pages,
     init_kv_cache,
     kv_cache_spec,
+    quantize_pages,
 )
 from tpuscratch.serve.sampling import (  # noqa: F401
+    accept_speculative,
     request_key,
     sample_batch,
     sample_logits,
+    target_probs,
 )

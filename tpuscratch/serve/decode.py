@@ -29,9 +29,28 @@ Each builder returns ONE jitted program per batch shape, with a
 zero-recompile-after-warmup assertion hangs off it.  The decode step
 donates the cache buffers, so steady-state decode updates pages in place
 instead of copying the pool every token.
+
+Two serving-hot-path levers compose here (both off by default):
+
+- ``quantized=True`` stores K/V pages as int8 with per-page per-head
+  scales (serve/kvcache.py): the per-token cache *write* requantizes the
+  written page from its dequantized view (entries past the write offset
+  — freed-page leftovers or rejected draft tokens — are zeroed so stale
+  magnitudes cannot inflate a page's scale), and the per-token cache
+  *read* gathers int8 pages, dequantizing after the gather
+  (``ops.attention.decode_attention``) — the full-prefix sweep every
+  decoded token pays moves ~1/4 the bytes;
+- :func:`build_verify_step` scores ``n_draft + 1`` queued tokens per
+  slot in ONE cached forward (``ops.attention.verify_attention``: one
+  page gather amortized over all of them) — the verify half of
+  speculative decoding, with :func:`propose_draft` as the self-drafting
+  prompt-lookup proposer and ``serve.sampling.accept_speculative`` as
+  the distribution-preserving acceptance rule.
 """
 
 from __future__ import annotations
+
+from typing import Sequence
 
 import jax.numpy as jnp
 from jax import lax
@@ -43,10 +62,15 @@ from tpuscratch.models.transformer import (
     _rms_norm,
     param_spec,
 )
-from tpuscratch.ops.attention import decode_attention
+from tpuscratch.ops.attention import decode_attention, verify_attention
 from tpuscratch.parallel.expert import expert_parallel_ffn
 from tpuscratch.parallel.scores import masked_scores, masked_softmax
-from tpuscratch.serve.kvcache import CacheGeometry, kv_cache_spec
+from tpuscratch.serve.kvcache import (
+    CacheGeometry,
+    dequantize_pages,
+    kv_cache_spec,
+    quantize_pages,
+)
 
 
 # promoted to the observability subsystem (recompile detection is not a
@@ -114,7 +138,37 @@ def _moe_residual(p, x, cfg: TransformerConfig, dp: str):
     return x + moe
 
 
-def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp"):
+def _quant_write(pages_q, scales, li, write_page, write_off, new_vals):
+    """One quantized token write per slot: insert ``new_vals`` (B, H, D)
+    at (``write_page``, ``write_off``) of layer ``li``'s int8 pool,
+    requantizing each touched page.
+
+    The page is rebuilt from its dequantized view with entries BEYOND
+    the write offset zeroed: a sequence fills its pages in order, so
+    offsets past the write are never live data — they are freed-page
+    leftovers or rejected draft tokens, and letting them into the page's
+    absmax would permanently inflate its scale.  Entries below the
+    offset requantize idempotently while the scale is unchanged (q ->
+    q*s -> q), and a page's absmax is monotone over its lifetime (the
+    maximal entry dequantizes exactly), so each entry is requantized at
+    most once per scale growth.  Sentinel write pages (idle slots,
+    beyond-draft positions) gather a clipped page but scatter with drop
+    mode — no write lands."""
+    n_pages, page_size = pages_q.shape[1], pages_q.shape[2]
+    idx = jnp.clip(write_page, 0, n_pages - 1)
+    pg = dequantize_pages(pages_q[li, idx], scales[li, idx])  # (B,pg,H,D)
+    offs = jnp.arange(page_size)[None, :, None, None]
+    wo = write_off[:, None, None, None]
+    pg = jnp.where(offs == wo, new_vals[:, None],
+                   jnp.where(offs < wo, pg, 0.0))
+    q, s = quantize_pages(pg)
+    pages_q = pages_q.at[li, write_page].set(q, mode="drop")
+    scales = scales.at[li, write_page].set(s, mode="drop")
+    return pages_q, scales
+
+
+def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp",
+                   quantized: bool = False):
     """The decode shard_map body:
     (params, kv, x, page_tables, write_page, write_off, seq_lens)
     -> (out, kv').
@@ -128,6 +182,8 @@ def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp"):
 
     def step(params, kv, x, page_tables, write_page, write_off, seq_lens):
         kv_k, kv_v = kv["k"], kv["v"]
+        k_scale = kv.get("k_scale")
+        v_scale = kv.get("v_scale")
         H, Dh = cfg.n_heads, cfg.d_head
         B = x.shape[0]
         # idle slots must not compete for MoE expert capacity: routing
@@ -144,34 +200,202 @@ def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp"):
             q = _head_slice((h @ p["wq"]).reshape(B, H, Dh), sp, H)
             k = _head_slice((h @ p["wk"]).reshape(B, H, Dh), sp, H)
             v = _head_slice((h @ p["wv"]).reshape(B, H, Dh), sp, H)
-            kv_k = kv_k.at[li, write_page, write_off].set(k, mode="drop")
-            kv_v = kv_v.at[li, write_page, write_off].set(v, mode="drop")
-            attn = decode_attention(
-                q, kv_k[li], kv_v[li], page_tables, seq_lens
-            )
+            if quantized:
+                kv_k, k_scale = _quant_write(
+                    kv_k, k_scale, li, write_page, write_off, k
+                )
+                kv_v, v_scale = _quant_write(
+                    kv_v, v_scale, li, write_page, write_off, v
+                )
+                attn = decode_attention(
+                    q, kv_k[li], kv_v[li], page_tables, seq_lens,
+                    k_scale[li], v_scale[li],
+                )
+            else:
+                kv_k = kv_k.at[li, write_page, write_off].set(k, mode="drop")
+                kv_v = kv_v.at[li, write_page, write_off].set(v, mode="drop")
+                attn = decode_attention(
+                    q, kv_k[li], kv_v[li], page_tables, seq_lens
+                )
             x = _attn_residual(p, attn, x, cfg, sp)
             x = _moe_residual(p, x[perm], cfg, dp)[inv]
-        return x, {"k": kv_k, "v": kv_v}
+        return x, _cache_out(kv_k, kv_v, k_scale, v_scale)
 
     return step
 
 
+def _cache_out(kv_k, kv_v, k_scale, v_scale) -> dict:
+    out = {"k": kv_k, "v": kv_v}
+    if k_scale is not None:
+        out["k_scale"] = k_scale
+        out["v_scale"] = v_scale
+    return out
+
+
 def build_decode_step(mesh: Mesh, cfg: TransformerConfig,
                       geom: CacheGeometry, dp: str = "dp", sp: str = "sp",
-                      counter: CompileCounter | None = None):
+                      counter: CompileCounter | None = None,
+                      quantized: bool = False):
     """Compiled decode step over ``mesh``: jit'd
     fn(params, kv, x, page_tables, write_page, write_off, seq_lens) ->
     (out (B, d), kv') with slots sharded P(dp) and the cache donated
     (page pools update in place).  One compile per (B, max_pages)
     bucket; the engine holds B fixed at its slot count, so steady-state
-    decode never recompiles (``counter`` proves it)."""
+    decode never recompiles (``counter`` proves it).  ``quantized``
+    selects the int8-page cache contract (scale leaves in ``kv``)."""
     check_serve_mesh(mesh, cfg, dp, sp)
     _check_geometry(cfg, geom)
-    body = decode_step_fn(cfg, sp=sp, dp=dp)
+    body = decode_step_fn(cfg, sp=sp, dp=dp, quantized=quantized)
     if counter is not None:
         body = counter.wrap(body)
     pspec = param_spec(cfg, dp)
-    kspec = kv_cache_spec(dp, sp)
+    kspec = kv_cache_spec(dp, sp, quantized)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, kspec, P(dp), P(dp), P(dp), P(dp), P(dp)),
+        (P(dp), kspec),
+        donate_argnums=(1,),
+    )
+
+
+# ---- speculative decoding: self-drafting proposer + batched verify -------
+
+
+def propose_draft(context: Sequence[int], k: int,
+                  ngram: int = 2) -> tuple[int, ...]:
+    """Self-drafting prompt-lookup proposal (host-side, O(len) scan):
+    find the most recent EARLIER occurrence of the context's final
+    ``ngram`` tokens and propose the (up to) ``k`` tokens that followed
+    it.  Returns ``()`` when the context never repeats its suffix — the
+    engine then degenerates to plain one-token decode for that slot.
+
+    No draft model anywhere: the sequence drafts itself from its own
+    prompt + generated history (prompt-lookup / n-gram speculation),
+    which is exactly the regime where decode loops over boilerplate —
+    code, templates, retrieved spans — and an HBM-bound sweep can be
+    amortized over several accepted tokens.  The most recent match with
+    a FULL ``k``-token continuation wins (local repetition predicts the
+    immediate continuation best, and a full draft amortizes the sweep
+    furthest — on a short-period context the nearest match is always
+    truncated by the sequence end); a truncated continuation is the
+    fallback."""
+    if k < 1 or ngram < 1:
+        return ()
+    ctx = tuple(int(t) for t in context)
+    n = len(ctx)
+    if n < ngram + 1:
+        return ()
+    suffix = ctx[n - ngram:]
+    partial: tuple[int, ...] = ()
+    for i in range(n - ngram - 1, -1, -1):
+        if ctx[i:i + ngram] == suffix:
+            cont = ctx[i + ngram: i + ngram + k]
+            if len(cont) == k:
+                return cont
+            if not partial:
+                partial = cont
+    return partial
+
+
+def verify_step_fn(cfg: TransformerConfig, n_draft: int, sp: str = "sp",
+                   dp: str = "dp", quantized: bool = False):
+    """The speculative-verify shard_map body: like
+    :func:`decode_step_fn` but scoring ``K = n_draft + 1`` queued tokens
+    per slot in one forward —
+    (params, kv, x, page_tables, write_pages, write_offs, seq_lens)
+    -> (out (B_loc, K, d), kv').
+
+    Local shapes: x (B_loc, K, d) — position 0 each slot's last accepted
+    token, positions 1..n_draft its draft (zero vectors past the slot's
+    true draft length); write_pages/write_offs (B_loc, K) — where each
+    position's K/V lands, with the out-of-range sentinel for idle slots
+    AND beyond-draft positions (drop-mode scatter / quantized-write drop
+    makes them no-ops); seq_lens (B_loc,) — cached length INCLUDING
+    position 0 (0 idles the slot).  All K positions' K/V are written
+    BEFORE attention, so position j attends positions < seq_len + j —
+    rejected positions leave garbage entries past the accepted length
+    that the length mask hides and the next tick's writes overwrite
+    (the next sweep starts at the accepted frontier and writes K fresh
+    entries, always covering them)."""
+    K = n_draft + 1
+
+    def step(params, kv, x, page_tables, write_pages, write_offs, seq_lens):
+        kv_k, kv_v = kv["k"], kv["v"]
+        k_scale = kv.get("k_scale")
+        v_scale = kv.get("v_scale")
+        H, Dh = cfg.n_heads, cfg.d_head
+        B = x.shape[0]
+        n_pages = kv_k.shape[1]
+        # token-level idle-last permutation (decode_step_fn's rule, per
+        # TOKEN rather than per slot): a position is real iff its write
+        # page is real — idle slots and beyond-draft padding carry the
+        # sentinel — so padding zero-vectors lose every MoE capacity tie
+        idle = (write_pages >= n_pages).reshape(B * K)
+        perm = jnp.argsort(idle.astype(jnp.int32))
+        inv = jnp.argsort(perm)
+        for li, p in enumerate(params["layers"]):
+            h = _rms_norm(x, p["ln1"])
+            q = _head_slice((h @ p["wq"]).reshape(B, K, H, Dh), sp, H)
+            k = _head_slice((h @ p["wk"]).reshape(B, K, H, Dh), sp, H)
+            v = _head_slice((h @ p["wv"]).reshape(B, K, H, Dh), sp, H)
+            if quantized:
+                # sequential per position: adjacent draft positions can
+                # share a page, and each requantizing write must see the
+                # previous one's entries
+                for j in range(K):
+                    kv_k, k_scale = _quant_write(
+                        kv_k, k_scale, li, write_pages[:, j],
+                        write_offs[:, j], k[:, j],
+                    )
+                    kv_v, v_scale = _quant_write(
+                        kv_v, v_scale, li, write_pages[:, j],
+                        write_offs[:, j], v[:, j],
+                    )
+                attn = verify_attention(
+                    q, kv_k[li], kv_v[li], page_tables, seq_lens,
+                    k_scale[li], v_scale[li],
+                )
+            else:
+                kv_k = kv_k.at[li, write_pages, write_offs].set(
+                    k, mode="drop"
+                )
+                kv_v = kv_v.at[li, write_pages, write_offs].set(
+                    v, mode="drop"
+                )
+                attn = verify_attention(
+                    q, kv_k[li], kv_v[li], page_tables, seq_lens
+                )
+            x = _attn_residual(p, attn, x, cfg, sp)
+            flat = x.reshape(B * K, cfg.d_model)
+            x = _moe_residual(p, flat[perm], cfg, dp)[inv].reshape(
+                B, K, cfg.d_model
+            )
+        return x, _cache_out(kv_k, kv_v, k_scale, v_scale)
+
+    return step
+
+
+def build_verify_step(mesh: Mesh, cfg: TransformerConfig,
+                      geom: CacheGeometry, n_draft: int,
+                      dp: str = "dp", sp: str = "sp",
+                      counter: CompileCounter | None = None,
+                      quantized: bool = False):
+    """Compiled speculative-verify step over ``mesh``: jit'd
+    fn(params, kv, x (B, K, d), page_tables, write_pages (B, K),
+    write_offs (B, K), seq_lens) -> (out (B, K, d), kv'), cache donated.
+    ``K = n_draft + 1`` is static — the engine fixes the draft budget at
+    construction, so a speculative engine still compiles exactly ONE
+    decode-side program (``counter`` proves it stays that way)."""
+    if n_draft < 1:
+        raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+    check_serve_mesh(mesh, cfg, dp, sp)
+    _check_geometry(cfg, geom)
+    body = verify_step_fn(cfg, n_draft, sp=sp, dp=dp, quantized=quantized)
+    if counter is not None:
+        body = counter.wrap(body)
+    pspec = param_spec(cfg, dp)
+    kspec = kv_cache_spec(dp, sp, quantized)
     return run_spmd(
         mesh,
         body,
@@ -182,7 +406,7 @@ def build_decode_step(mesh: Mesh, cfg: TransformerConfig,
 
 
 def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
-               sp: str = "sp", dp: str = "dp"):
+               sp: str = "sp", dp: str = "dp", quantized: bool = False):
     """The prefill shard_map body: (params, kv, x, pages, n_tok) ->
     (out, kv').
 
@@ -195,10 +419,19 @@ def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
     true prompt length.  Returns the full per-position outputs — the
     engine samples from position ``n_tok - 1``, tests compare every one
     against ``model_apply``.
-    """
 
+    ``quantized``: K/V land as whole int8 pages — positions at or past
+    ``n_tok`` are zeroed before the per-page absmax, and only pages that
+    hold at least one prompt token are written (page granularity is
+    exactly what makes prefill quantization one reshape + one scatter
+    instead of a per-token requantize).
+    """
+    # S_bucket padded up to whole pages for the page-granular reshape;
+    # page count capped at the table width (a bucket can round past it)
     def run(params, kv, x, pages, n_tok):
         kv_k, kv_v = kv["k"], kv["v"]
+        k_scale = kv.get("k_scale")
+        v_scale = kv.get("v_scale")
         H, Dh = cfg.n_heads, cfg.d_head
         S = x.shape[0]
         pages = pages[0]
@@ -207,6 +440,22 @@ def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
         # padded positions (pos >= n_tok) write nowhere
         pg = jnp.where(pos < n_tok, page_of, geom.n_pages)
         off = pos % geom.page_size
+        if quantized:
+            pad = -S % geom.page_size
+            n_pg = (S + pad) // geom.page_size
+            pg_idx = jnp.arange(n_pg)
+            pg_ids = pages[jnp.clip(pg_idx, 0, pages.shape[0] - 1)]
+            # only pages holding prompt tokens are written
+            pg_write = jnp.where(pg_idx * geom.page_size < n_tok,
+                                 pg_ids, geom.n_pages)
+            tok_live = (pos < n_tok)[:, None, None]
+
+            def quant_pages(vals):
+                live = jnp.where(tok_live, vals, 0.0)
+                live = jnp.pad(live, ((0, pad), (0, 0), (0, 0)))
+                return quantize_pages(
+                    live.reshape(n_pg, geom.page_size, *vals.shape[1:])
+                )
         # causal x true-length mask: padded keys never attend, padded
         # query rows produce garbage that nothing reads
         mask = (pos[:, None] >= pos[None, :]) & (pos[None, :] < n_tok)
@@ -215,32 +464,43 @@ def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
             q = _head_slice((h @ p["wq"]).reshape(S, H, Dh), sp, H)
             k = _head_slice((h @ p["wk"]).reshape(S, H, Dh), sp, H)
             v = _head_slice((h @ p["wv"]).reshape(S, H, Dh), sp, H)
-            kv_k = kv_k.at[li, pg, off].set(k, mode="drop")
-            kv_v = kv_v.at[li, pg, off].set(v, mode="drop")
+            if quantized:
+                qk, sk = quant_pages(k)
+                qv, sv = quant_pages(v)
+                kv_k = kv_k.at[li, pg_write].set(qk, mode="drop")
+                kv_v = kv_v.at[li, pg_write].set(qv, mode="drop")
+                k_scale = k_scale.at[li, pg_write].set(sk, mode="drop")
+                v_scale = v_scale.at[li, pg_write].set(sv, mode="drop")
+            else:
+                kv_k = kv_k.at[li, pg, off].set(k, mode="drop")
+                kv_v = kv_v.at[li, pg, off].set(v, mode="drop")
             s = masked_scores(q, k, mask)                    # (H_loc, S, S)
             pr = masked_softmax(s, mask[None])
             attn = jnp.einsum("hst,thd->shd", pr, v.astype(jnp.float32))
             x = _attn_residual(p, attn.astype(x.dtype), x, cfg, sp)
             x = _moe_residual(p, x, cfg, dp)
-        return x, {"k": kv_k, "v": kv_v}
+        return x, _cache_out(kv_k, kv_v, k_scale, v_scale)
 
     return run
 
 
 def build_prefill(mesh: Mesh, cfg: TransformerConfig, geom: CacheGeometry,
                   dp: str = "dp", sp: str = "sp",
-                  counter: CompileCounter | None = None):
+                  counter: CompileCounter | None = None,
+                  quantized: bool = False):
     """Compiled prefill over ``mesh``: jit'd fn(params, kv, x, pages,
     n_tok) -> (out (S, d), kv'), cache donated.  One compile per prompt
     shape bucket (the engine pads prompts to power-of-two lengths to
-    bound the bucket count)."""
+    bound the bucket count).  ``quantized`` writes int8 pages; prompt
+    COMPUTE stays fp32 either way (prefill attends the just-projected
+    values, not the cache), so prefill outputs are dtype-independent."""
     check_serve_mesh(mesh, cfg, dp, sp)
     _check_geometry(cfg, geom)
-    body = prefill_fn(cfg, geom, sp=sp, dp=dp)
+    body = prefill_fn(cfg, geom, sp=sp, dp=dp, quantized=quantized)
     if counter is not None:
         body = counter.wrap(body)
     pspec = param_spec(cfg, dp)
-    kspec = kv_cache_spec(dp, sp)
+    kspec = kv_cache_spec(dp, sp, quantized)
     return run_spmd(
         mesh,
         body,

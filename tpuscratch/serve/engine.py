@@ -44,10 +44,20 @@ from tpuscratch.runtime.profiling import Timeline
 from tpuscratch.serve.decode import (
     build_decode_step,
     build_prefill,
+    build_verify_step,
     check_serve_mesh,
+    propose_draft,
 )
 from tpuscratch.serve.kvcache import CacheGeometry, PageAllocator, init_kv_cache
-from tpuscratch.serve.sampling import request_key, request_keys, sample_batch
+from tpuscratch.serve.sampling import (
+    accept_speculative,
+    request_key,
+    request_keys,
+    sample_batch,
+)
+
+#: ServeConfig.kv_dtype spellings -> cache buffer dtype
+_KV_DTYPES = {"float32": jnp.float32, "int8": jnp.int8}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,6 +79,19 @@ class ServeConfig:
     # the budget is quarantined — reported, never requeued — so one
     # poison request cannot livelock the engine.
     retry_budget: int = 0
+    # cache-byte lever: "float32" (exact) or "int8" (pages quantized
+    # with per-page per-head scales — ~4x fewer cache bytes per token,
+    # the decode gather's roofline; see serve/kvcache.py)
+    kv_dtype: str = "float32"
+    # HBM-sweep-amortization lever: draft tokens scored per verify sweep
+    # (0 = speculation off).  > 0 replaces the one-token decode program
+    # with ONE (spec_k + 1)-token verify program; accepted prefixes emit
+    # up to spec_k + 1 tokens per cache sweep, and the acceptance rule
+    # preserves the sampling distribution exactly (bit-identical output
+    # under greedy; serve/sampling.accept_speculative)
+    spec_k: int = 0
+    # suffix length for the self-drafting prompt-lookup match
+    spec_ngram: int = 2
 
     @property
     def max_pages(self) -> int:
@@ -85,7 +108,13 @@ class Request:
 
 @dataclasses.dataclass(frozen=True)
 class GenerateReport:
-    """What a drain produced — the serving twin of ``TrainReport``."""
+    """What a drain produced — the serving twin of ``TrainReport``.
+
+    Speculative accounting reconciles by construction:
+    ``tokens_generated == prefills + slot_steps + accepted`` — every
+    emitted token is a prefill token, a verify sweep's base token (one
+    per active slot per tick, speculation on or off), or an accepted
+    draft token (ex24 asserts this identity on a live run)."""
 
     completed: int
     tokens_generated: int
@@ -97,6 +126,16 @@ class GenerateReport:
     decode_s: float
     outputs: tuple[tuple[int, tuple[int, ...]], ...]  # (rid, tokens) by rid
     quarantined: tuple[int, ...] = ()  # rids dropped THIS drain (budget spent)
+    slot_steps: int = 0   # active-slot decode/verify invocations
+    drafted: int = 0      # speculative draft tokens scored
+    accepted: int = 0     # draft tokens accepted into outputs
+
+    @property
+    def accept_len_mean(self) -> Optional[float]:
+        """Mean accepted draft length per verify sweep (None: no sweeps)."""
+        if self.slot_steps == 0:
+            return None
+        return self.accepted / self.slot_steps
 
 
 @dataclasses.dataclass
@@ -169,7 +208,19 @@ class ServeEngine:
                 f"max_seq {scfg.max_seq} exceeds one group's pool "
                 f"({scfg.n_pages} pages x {scfg.page_size})"
             )
+        if scfg.kv_dtype not in _KV_DTYPES:
+            raise ValueError(
+                f"kv_dtype {scfg.kv_dtype!r} not in {sorted(_KV_DTYPES)}"
+            )
+        if scfg.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {scfg.spec_k}")
+        if scfg.spec_ngram < 1:
+            raise ValueError(
+                f"spec_ngram must be >= 1, got {scfg.spec_ngram}"
+            )
         self.mesh, self.cfg, self.scfg = mesh, cfg, scfg
+        self._kv_jnp_dtype = _KV_DTYPES[scfg.kv_dtype]
+        self._quantized = scfg.kv_dtype == "int8"
         self.geom = CacheGeometry(
             cfg.n_layers, scfg.n_pages, scfg.page_size, cfg.n_heads,
             cfg.d_head,
@@ -186,7 +237,8 @@ class ServeEngine:
                 f"embed {self.embed.shape} != ({scfg.vocab}, {cfg.d_model})"
             )
         self._embed_np = np.asarray(self.embed)
-        self._kv = init_kv_cache(self.geom, self._dp_size)
+        self._kv = init_kv_cache(self.geom, self._dp_size,
+                                 self._kv_jnp_dtype)
         self._allocators = [
             PageAllocator(scfg.n_pages) for _ in range(self._dp_size)
         ]
@@ -215,18 +267,32 @@ class ServeEngine:
             page_size=scfg.page_size, max_seq=scfg.max_seq,
             dp_size=self._dp_size, n_layers=cfg.n_layers,
             n_heads=cfg.n_heads, d_model=cfg.d_model,
+            kv_dtype=scfg.kv_dtype, spec_k=scfg.spec_k,
         )
         self.decode_counter = CompileCounter()
         self.prefill_counter = CompileCounter()
-        self._decode = build_decode_step(
-            mesh, cfg, self.geom, dp=dp, sp=sp, counter=self.decode_counter
-        )
+        # speculation swaps the one-token decode program for ONE fixed
+        # (spec_k + 1)-token verify program — still a single compile,
+        # still counted by decode_counter
+        if scfg.spec_k > 0:
+            self._decode = build_verify_step(
+                mesh, cfg, self.geom, scfg.spec_k, dp=dp, sp=sp,
+                counter=self.decode_counter, quantized=self._quantized,
+            )
+        else:
+            self._decode = build_decode_step(
+                mesh, cfg, self.geom, dp=dp, sp=sp,
+                counter=self.decode_counter, quantized=self._quantized,
+            )
         self._prefills: dict[int, object] = {}  # bucket len -> program
         self._dp, self._sp = dp, sp
         self._unembed = jax.jit(lambda o, e: o @ e.T)
         self._decode_steps = 0
         self._prefill_count = 0
         self._tokens_generated = 0
+        self._slot_steps = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
         self._prefill_s = 0.0
         self._decode_s = 0.0
 
@@ -243,6 +309,39 @@ class ServeEngine:
     def free_pages(self) -> list[int]:
         """Per-group free-page counts (the leak check reads this)."""
         return [a.n_free for a in self._allocators]
+
+    @property
+    def kv_cache_bytes(self) -> int:
+        """Total cache-pool bytes (pages + quantization scales) — the
+        static quantity the int8 lever shrinks; ``obs.ledger`` does the
+        accounting so bench rows and regression tests share it."""
+        from tpuscratch.obs.ledger import kv_cache_bytes
+
+        return kv_cache_bytes(self._kv)
+
+    @property
+    def kv_bytes_per_token(self) -> float:
+        """Cache bytes per token of pool capacity (pages + scales over
+        ``dp_size * n_pages * page_size`` token slots)."""
+        return self.kv_cache_bytes / (self._dp_size * self.geom.max_tokens)
+
+    @property
+    def tokens_generated(self) -> int:
+        """Engine-lifetime emitted tokens (benches read deltas)."""
+        return self._tokens_generated
+
+    @property
+    def slot_steps(self) -> int:
+        """Engine-lifetime active-slot decode/verify invocations."""
+        return self._slot_steps
+
+    @property
+    def spec_drafted(self) -> int:
+        return self._spec_drafted
+
+    @property
+    def spec_accepted(self) -> int:
+        return self._spec_accepted
 
     @property
     def n_active(self) -> int:
@@ -283,7 +382,8 @@ class ServeEngine:
             self._queue.appendleft(
                 Request(rid=st.rid, prompt=st.prompt, max_new=st.max_new)
             )
-        self._kv = init_kv_cache(self.geom, self._dp_size)
+        self._kv = init_kv_cache(self.geom, self._dp_size,
+                                 self._kv_jnp_dtype)
 
     # ---- request lifecycle ---------------------------------------------
 
@@ -346,7 +446,7 @@ class ServeEngine:
         if bucket not in self._prefills:
             self._prefills[bucket] = build_prefill(
                 self.mesh, self.cfg, geom, dp=self._dp, sp=self._sp,
-                counter=self.prefill_counter,
+                counter=self.prefill_counter, quantized=self._quantized,
             )
         x = np.zeros((bucket, self.cfg.d_model), np.float32)
         x[:n_tok] = self._embed_np[list(req.prompt)]
@@ -437,17 +537,19 @@ class ServeEngine:
         t0 = time.perf_counter()
         prefills0 = self._prefill_count
         tokens0 = self._tokens_generated
+        accepted0 = self._spec_accepted
         finished = self._tick_inner()
         self._observe_tick(
             time.perf_counter() - t0,
             inserted=self._prefill_count - prefills0,
             evicted=len(finished),
             tokens=self._tokens_generated - tokens0,
+            accepted=self._spec_accepted - accepted0,
         )
         return finished
 
     def _observe_tick(self, tick_s: float, inserted: int, evicted: int,
-                      tokens: int) -> None:
+                      tokens: int, accepted: int = 0) -> None:
         m = self.metrics
         self._tick += 1
         free_min = min(a.n_free for a in self._allocators)
@@ -460,6 +562,8 @@ class ServeEngine:
         m.counter("serve/inserts").inc(inserted)
         m.counter("serve/evictions").inc(evicted)
         m.counter("serve/tokens").inc(tokens)
+        if self.scfg.spec_k > 0:
+            m.counter("serve/accepted").inc(accepted)
         m.gauge("serve/decode_compiles").set(self.decode_counter.count)
         m.gauge("serve/prefill_compiles").set(self.prefill_counter.count)
         if self.sink.enabled:  # skip the event build on the no-obs path
@@ -469,6 +573,7 @@ class ServeEngine:
                 queue_depth=self.n_queued, active=self.n_active,
                 free_pages_min=free_min,
                 inserted=inserted, evicted=evicted, tokens=tokens,
+                accepted=accepted,
                 decode_compiles=self.decode_counter.count,
                 prefill_compiles=self.prefill_counter.count,
             )
@@ -488,7 +593,15 @@ class ServeEngine:
         active = [s for s, st in enumerate(self._slots) if st is not None]
         if not active:
             return finished
+        if self.scfg.spec_k > 0:
+            self._spec_tick(active, finished)
+        else:
+            self._decode_tick(active, finished)
+        return finished
 
+    def _decode_tick(self, active: list[int],
+                     finished: list[tuple[int, tuple[int, ...]]]) -> None:
+        """One plain decode sweep: one token per active slot."""
         scfg, geom = self.scfg, self.geom
         n = scfg.n_slots
         x = np.zeros((n, self.cfg.d_model), np.float32)
@@ -526,6 +639,7 @@ class ServeEngine:
             raise
         self._decode_s += self._last_span_s()
         self._decode_steps += 1
+        self._slot_steps += len(active)
         for s in active:
             st = self._slots[s]
             st.n_cached += 1
@@ -534,7 +648,78 @@ class ServeEngine:
             self._tokens_generated += 1
             if len(st.generated) >= st.max_new:
                 finished.append(self._evict(s))
-        return finished
+
+    def _spec_tick(self, active: list[int],
+                   finished: list[tuple[int, tuple[int, ...]]]) -> None:
+        """One speculative sweep: every active slot proposes up to
+        ``spec_k`` self-drafted tokens (``propose_draft`` over its own
+        prompt + generated history), the ONE verify forward scores the
+        whole bank — each slot's cache pages gathered once for all its
+        positions — and ``accept_speculative`` keeps the
+        distribution-preserving prefix: ``a + 1`` tokens emitted per
+        slot per sweep (``a`` accepted drafts + the terminal token),
+        against ONE cache sweep instead of ``a + 1``.
+
+        Rejected positions leave K/V garbage past the accepted frontier;
+        the length masks hide it and the next sweep's writes (which
+        start at the frontier and always cover at least as far)
+        overwrite it — so speculation never dirties replayable state.
+        The draft is clamped to the slot's remaining budget, keeping the
+        page-footprint reservation made at admission valid."""
+        scfg, geom = self.scfg, self.geom
+        n, k = scfg.n_slots, scfg.spec_k
+        K = k + 1
+        x = np.zeros((n, K, self.cfg.d_model), np.float32)
+        tables = np.full((n, scfg.max_pages), geom.n_pages, np.int32)
+        write_pages = np.full((n, K), geom.n_pages, np.int32)
+        write_offs = np.zeros((n, K), np.int32)
+        seq_lens = np.zeros((n,), np.int32)
+        drafts: dict[int, tuple[int, ...]] = {}
+        for s in active:
+            st = self._slots[s]
+            remaining = st.max_new - len(st.generated)
+            draft = propose_draft(
+                st.prompt + tuple(st.generated), k, scfg.spec_ngram
+            )[: remaining - 1]
+            drafts[s] = draft
+            toks = (st.last_token,) + draft
+            x[s, : len(toks)] = self._embed_np[list(toks)]
+            tables[s, : len(st.pages)] = st.pages
+            for j in range(len(toks)):
+                pos = st.n_cached + j
+                write_pages[s, j] = st.pages[pos // geom.page_size]
+                write_offs[s, j] = pos % geom.page_size
+            seq_lens[s] = st.n_cached + 1
+        try:
+            with self.timeline.span("serve/decode"):
+                out, self._kv = self._decode(
+                    self.params, self._kv, jnp.asarray(x), jnp.asarray(tables),
+                    jnp.asarray(write_pages), jnp.asarray(write_offs),
+                    jnp.asarray(seq_lens),
+                )
+                logits = np.asarray(self._unembed(out, self.embed))
+        except Exception:
+            self._recover_cache()  # donated kv may be consumed; replay
+            raise
+        self._decode_s += self._last_span_s()
+        self._decode_steps += 1
+        self._slot_steps += len(active)
+        accept_hist = self.metrics.histogram("serve/accept_len")
+        for s in active:
+            st = self._slots[s]
+            a, toks = accept_speculative(
+                scfg.seed, st.rid, len(st.generated), logits[s], drafts[s],
+                scfg.temperature, scfg.top_k,
+            )
+            accept_hist.observe(a)
+            self._spec_drafted += len(drafts[s])
+            self._spec_accepted += a
+            st.n_cached += a + 1
+            st.generated.extend(toks)
+            st.last_token = toks[-1]
+            self._tokens_generated += len(toks)
+            if len(st.generated) >= st.max_new:
+                finished.append(self._evict(s))
 
     def run(self, requests: Sequence[Request] = (),
             max_steps: int = 100_000) -> GenerateReport:
@@ -547,6 +732,8 @@ class ServeEngine:
         tokens0 = self._tokens_generated
         decode0, prefill0 = self._decode_steps, self._prefill_count
         prefill_s0, decode_s0 = self._prefill_s, self._decode_s
+        slot0, drafted0 = self._slot_steps, self._spec_drafted
+        accepted0 = self._spec_accepted
         quarantined0 = set(self._quarantined)
         for r in requests:
             self.submit(r)
@@ -562,7 +749,8 @@ class ServeEngine:
                 outputs[rid] = toks
             steps += 1
         report = self._report(outputs, tokens0, decode0, prefill0,
-                              prefill_s0, decode_s0,
+                              prefill_s0, decode_s0, slot0, drafted0,
+                              accepted0,
                               tuple(sorted(set(self._quarantined)
                                            - quarantined0)))
         self.sink.emit(
@@ -575,6 +763,8 @@ class ServeEngine:
             prefill_s=round(report.prefill_s, 6),
             decode_s=round(report.decode_s, 6),
             quarantined=len(report.quarantined),
+            slot_steps=report.slot_steps,
+            drafted=report.drafted, accepted=report.accepted,
         )
         emit_phase_totals(self.sink, self.recorder)
         self.sink.emit_metrics(self.metrics.snapshot(),
@@ -583,7 +773,8 @@ class ServeEngine:
         return report
 
     def _report(self, outputs, tokens0, decode0, prefill0, prefill_s0,
-                decode_s0, quarantined=()) -> GenerateReport:
+                decode_s0, slot0=0, drafted0=0, accepted0=0,
+                quarantined=()) -> GenerateReport:
         return GenerateReport(
             completed=len(outputs),
             tokens_generated=self._tokens_generated - tokens0,
@@ -595,4 +786,7 @@ class ServeEngine:
             decode_s=self._decode_s - decode_s0,
             outputs=tuple(sorted(outputs.items())),
             quarantined=tuple(quarantined),
+            slot_steps=self._slot_steps - slot0,
+            drafted=self._spec_drafted - drafted0,
+            accepted=self._spec_accepted - accepted0,
         )

@@ -23,6 +23,18 @@ The allocator is deliberately HOST-side Python: page grant/release is
 scheduler work that happens between compiled steps (the engine's
 admission/eviction loop), never inside one — the compiled decode step
 only ever sees page *tables*, which are plain int32 arrays.
+
+**Quantized pages (int8):** decode is a gather of the whole cached
+prefix per generated token, so cache *bytes* are the decode roofline.
+``init_kv_cache(..., dtype=jnp.int8)`` stores K/V pages as int8 with
+per-page per-head fp32 scales (``k_scale``/``v_scale``, shape
+``(n_layers, pages, n_heads)``) — symmetric absmax quantization,
+``value = q * scale`` with ``scale = absmax / 127``.  Cache bytes per
+token drop ~4x (one int8 byte vs four, plus ``2 * 4 / page_size`` bytes
+of amortized scale), page residency rises accordingly, and the decode
+gather moves a quarter of the wire/HBM bytes.  Scales sit OUTSIDE the
+page payload so the gather stays a dense int8 copy; dequantization
+happens after the gather, inside ``ops.attention.decode_attention``.
 """
 
 from __future__ import annotations
@@ -32,6 +44,13 @@ from typing import Iterable, Optional
 
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
+
+#: symmetric int8 range: q in [-127, 127], value = q * scale
+INT8_QMAX = 127.0
+
+#: absmax floor — an all-zero page quantizes with this scale instead of
+#: dividing by zero (dequantizes back to exact zeros either way)
+_SCALE_FLOOR = 1e-30
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,16 +83,51 @@ def init_kv_cache(geom: CacheGeometry, dp_size: int = 1,
     """The global cache pytree: ``{"k", "v"}`` buffers of shape
     ``(n_layers, dp_size * n_pages, page_size, n_heads, d_head)`` — the
     pages axis carries every group's pool (sharded over dp it splits back
-    to ``n_pages`` per group), heads global (sharded over sp)."""
+    to ``n_pages`` per group), heads global (sharded over sp).
+
+    ``dtype=jnp.int8`` adds the per-page per-head quantization scales:
+    ``{"k_scale", "v_scale"}`` fp32 buffers of shape
+    ``(n_layers, dp_size * n_pages, n_heads)``."""
     shape = (geom.n_layers, dp_size * geom.n_pages, geom.page_size,
              geom.n_heads, geom.d_head)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if dtype == jnp.int8:
+        sshape = shape[:2] + (geom.n_heads,)
+        cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
+        cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
+    return cache
 
 
-def kv_cache_spec(dp: str = "dp", sp: str = "sp") -> dict:
+def kv_cache_spec(dp: str = "dp", sp: str = "sp",
+                  quantized: bool = False) -> dict:
     """PartitionSpec pytree for :func:`init_kv_cache`'s output."""
     s = P(None, dp, None, sp, None)
-    return {"k": s, "v": s}
+    out = {"k": s, "v": s}
+    if quantized:
+        out["k_scale"] = P(None, dp, sp)
+        out["v_scale"] = P(None, dp, sp)
+    return out
+
+
+def quantize_pages(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric absmax int8 quantization of page-shaped values:
+    x ``(..., page_size, n_heads, d_head)`` fp32 ->
+    (q int8 same shape, scale ``(..., n_heads)`` fp32).  The scale is
+    per PAGE per HEAD — one amax over the page's tokens and the head
+    dim — so a page gather drags ``n_heads`` floats of metadata, not a
+    per-token vector.  Exactly invertible at the amax entry
+    (``round(127) * amax/127``), elsewhere within ``scale/2``."""
+    amax = jnp.max(jnp.abs(x), axis=(-3, -1))
+    scale = jnp.maximum(amax, _SCALE_FLOOR) / INT8_QMAX
+    q = jnp.round(x / scale[..., None, :, None])
+    q = jnp.clip(q, -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_pages(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`quantize_pages`: int8 pages x ``(..., n_heads)``
+    scales -> fp32 values."""
+    return q.astype(jnp.float32) * scale[..., None, :, None]
 
 
 class PageAllocator:

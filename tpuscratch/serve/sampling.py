@@ -15,6 +15,22 @@ that competition, so only real co-batched tokens can matter).
 ``top_k > 0`` renormalizes over the k largest logits before the
 categorical draw. Both are trace-time (static) switches, so an engine
 with fixed sampling parameters compiles its sampler exactly once.
+
+**Speculative acceptance** (:func:`accept_speculative`): the verify
+step scores every draft position in one forward; this module decides
+which prefix to keep.  The rule is Leviathan et al. 2023 rejection
+sampling specialized to a POINT-MASS proposal (the prompt-lookup draft
+is deterministic): accept draft token ``d`` with probability ``p(d)``
+under the target distribution, and on rejection sample from ``p`` with
+``d`` removed and renormalized — the emitted marginal is exactly ``p``
+at every position, so speculation never changes the sampling
+distribution.  Under greedy it degenerates to ``argmax == d``, making
+speculative output BIT-IDENTICAL to non-speculative.  Accept/reject
+draws key off ``fold_in(request_key(seed, rid, position), sub)`` — the
+same replay-stable contract as the base sampler — and the terminal draw
+(the token after the accepted prefix) uses the PLAIN ``request_key``
+stream, so a slot whose draft is empty consumes exactly the draws the
+non-speculative path would.
 """
 
 from __future__ import annotations
@@ -23,8 +39,14 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpuscratch.parallel.scores import NEG_INF
+
+#: fold_in subkeys for the speculative accept/reject path (0 is implicitly
+#: the base sampler's stream: request_key itself)
+_SUB_ACCEPT = 1
+_SUB_RESAMPLE = 2
 
 
 def request_key(seed: int, rid: int, position: int) -> jax.Array:
@@ -71,3 +93,127 @@ def sample_batch(keys: jax.Array, logits: jax.Array,
     return jax.vmap(
         lambda k, l: sample_logits(k, l, temperature, top_k)
     )(keys, logits)
+
+
+# ---- speculative acceptance ----------------------------------------------
+
+
+def accept_key(seed: int, rid: int, position: int) -> jax.Array:
+    """PRNG key for the accept/reject uniform at one draft position."""
+    return jax.random.fold_in(request_key(seed, rid, position), _SUB_ACCEPT)
+
+
+@jax.jit
+def _accept_uniforms(seed_key: jax.Array, rid: jax.Array,
+                     positions: jax.Array) -> jax.Array:
+    """Every accept/reject uniform for one verify sweep in ONE dispatch:
+    (n,) positions -> (n,) uniforms, each drawn under the same fold_in
+    chain as the scalar :func:`accept_key` spelling (vmap does not
+    change PRNG bits), so batching is invisible to replay.  Without
+    this, a temperature>0 sweep pays ~4 tiny device dispatches per
+    draft position per slot INSIDE the latency-measured tick — the
+    same overhead :func:`request_keys` exists to keep out of the
+    window.  One compile per draft length (bounded by spec_k + 1)."""
+    def one(pos):
+        base = jax.random.fold_in(jax.random.fold_in(seed_key, rid), pos)
+        return jax.random.uniform(jax.random.fold_in(base, _SUB_ACCEPT))
+    return jax.vmap(one)(positions)
+
+
+def resample_key(seed: int, rid: int, position: int) -> jax.Array:
+    """PRNG key for the residual (post-rejection) categorical draw."""
+    return jax.random.fold_in(request_key(seed, rid, position), _SUB_RESAMPLE)
+
+
+def target_probs(logits: np.ndarray, temperature: float,
+                 top_k: int = 0) -> np.ndarray:
+    """The probability vector :func:`sample_logits` draws from,
+    materialized (host-side fp32): softmax of ``logits / temperature``
+    restricted to the top-k support — ties at the k-th logit kept, the
+    same >= rule as the device sampler, so acceptance probabilities and
+    base-sampler draws refer to the SAME distribution."""
+    if temperature <= 0.0:
+        raise ValueError(f"temperature must be > 0, got {temperature}")
+    scaled = np.asarray(logits, np.float32) / np.float32(temperature)
+    if top_k:
+        kth = np.sort(scaled)[-top_k]
+        scaled = np.where(scaled >= kth, scaled, np.float32(NEG_INF))
+    scaled = scaled - scaled.max()
+    e = np.exp(scaled)
+    return e / e.sum()
+
+
+def accept_speculative(
+    seed: int,
+    rid: int,
+    position0: int,
+    logits,
+    draft,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> tuple[int, tuple[int, ...]]:
+    """Decide one slot's verify sweep: which draft prefix survives, and
+    the one extra token the surviving position emits.
+
+    ``logits`` — (>= len(draft)+1, V) target logits from the verify
+    forward: row ``j`` scores the position after accepting ``j`` draft
+    tokens.  ``position0`` — the generated-stream index of the first
+    token this sweep emits (keys the draws, exactly like the base
+    sampler's ``position``).  Returns ``(n_accepted, tokens)`` with
+    ``len(tokens) == n_accepted + 1``: the accepted draft prefix plus
+    the terminal token — the correction token sampled from the residual
+    distribution at the first rejection, or the bonus token after a
+    fully-accepted draft.  The terminal draw after the accepted prefix
+    ``a`` uses ``request_key(seed, rid, position0 + a)`` — the plain
+    per-position stream — so an empty draft reproduces the
+    non-speculative draw bit-for-bit at any temperature, and greedy
+    (``temperature == 0``) is pure argmax at every position.
+
+    Distribution identity (point-mass proposal ``q = δ_d``): accept with
+    ``min(1, p(d)/q(d)) = p(d)``; on reject sample from
+    ``norm((p - q)^+)`` = ``p`` with ``d`` zeroed, renormalized.  The
+    marginal is ``p(d)·δ_d + (1 - p(d))·p(·|≠d) = p``.
+    """
+    logits = np.asarray(logits, np.float32)
+    draft = tuple(int(t) for t in draft)
+    if logits.ndim != 2 or logits.shape[0] < len(draft) + 1:
+        raise ValueError(
+            f"need {len(draft) + 1} logit rows, got {logits.shape}"
+        )
+    if temperature == 0.0:
+        am = np.argmax(logits, axis=-1)
+        a = 0
+        while a < len(draft) and int(am[a]) == draft[a]:
+            a += 1
+        return a, draft[:a] + (int(am[a]),)
+    us = np.asarray(_accept_uniforms(
+        jax.random.key(seed), jnp.int32(rid),
+        jnp.arange(position0, position0 + len(draft), dtype=jnp.int32),
+    )) if draft else ()
+    a = 0
+    for j, d in enumerate(draft):
+        p = target_probs(logits[j], temperature, top_k)
+        if us[j] < p[d]:
+            a += 1
+            continue
+        # reject: the residual distribution is p with d removed
+        res = p.copy()
+        res[d] = 0.0
+        tot = float(res.sum())
+        if tot <= 0.0:
+            # p was (numerically) a point mass at d yet the draw landed
+            # in the zero-width tail: emitting d keeps the marginal
+            tok = d
+        else:
+            lg = jnp.where(jnp.asarray(res) > 0.0,
+                           jnp.log(jnp.asarray(res)), NEG_INF)
+            tok = int(jax.random.categorical(
+                resample_key(seed, rid, position0 + j), lg
+            ))
+        return a, draft[:a] + (tok,)
+    # every draft token accepted: the bonus draw is the base sampler's
+    tok = int(sample_logits(
+        request_key(seed, rid, position0 + a), jnp.asarray(logits[a]),
+        temperature, top_k,
+    ))
+    return a, draft[:a] + (tok,)
