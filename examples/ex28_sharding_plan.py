@@ -1,0 +1,87 @@
+"""4D parallelism behind one ShardingPlan, with comm/compute overlap.
+
+The reference composes every kernel against ONE cartesian topology
+(mpi10.cpp / stencil2D.h); this demo is that idea on the training hot
+path: a single ``ShardingPlan`` names the mesh axes (dp x sp x pp, with
+experts riding dp) and ``train(plan=...)`` composes GPipe pipeline
+stages, data/sequence parallelism, and dp-sharded ZeRO moments in one
+compiled step.  The plan's ``overlap`` flag decomposes the flat
+gradient reduce-scatter and the trailing param all-gather into
+independent per-block chains — the obs ledger proves the decomposition
+moves the collective COUNT and never the wire bytes, and the pp=2 run
+trains to a descending loss with the moments sharded over dp.
+"""
+
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main(argv=None) -> None:
+    ensure_devices()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpuscratch.models import TransformerConfig
+    from tpuscratch.models.trainer import train
+    from tpuscratch.models.transformer import init_params, stack_layers
+    from tpuscratch.models.zero import init_plan_zero_state, train_step_plan
+    from tpuscratch.obs import ledger as obs_ledger
+    from tpuscratch.parallel import ShardingPlan, bubble_fraction
+    from tpuscratch.runtime.mesh import make_mesh
+
+    mesh = make_mesh((2, 1, 2), ("dp", "sp", "pp"))
+    cfg = TransformerConfig(
+        d_model=16, n_heads=2, n_experts=2, d_ff=32, n_layers=2,
+        capacity_factor=2.0,
+    )
+    plan = ShardingPlan(mesh, pp="pp", n_micro=2)
+    banner(
+        f"ShardingPlan over dp{plan.dp_size} x sp{plan.sp_size} x "
+        f"pp{plan.pp_size}, n_micro={plan.n_micro} "
+        f"(bubble {bubble_fraction(plan.pp_size, plan.n_micro):.2f})"
+    )
+
+    # the static proof: overlap changes the collective schedule, never
+    # the wire bytes
+    stacked = stack_layers(init_params(0, cfg))
+    x = jnp.zeros((4, 16, cfg.d_model), jnp.float32)
+    rows = {}
+    for ov in (False, True):
+        p = ShardingPlan(mesh, pp="pp", n_micro=2, overlap=ov)
+        led = obs_ledger.analyze(
+            train_step_plan(p, cfg, donate=False), stacked,
+            init_plan_zero_state(stacked, p), x, x,
+        )
+        rows[ov] = (led.counts(), led.total_wire_bytes())
+        print(f"overlap={ov}: RS x{led.counts().get('reduce-scatter', 0)}"
+              f" AG x{led.counts().get('all-gather', 0)}, "
+              f"total wire {led.total_wire_bytes():.0f} B/device")
+    bytes_equal = rows[False][1] == rows[True][1]
+    count_moved = (rows[True][0]["reduce-scatter"]
+                   > rows[False][0]["reduce-scatter"])
+
+    banner("train(plan=...) — pp=2 GPipe + ZeRO moments sharded over dp")
+    with tempfile.TemporaryDirectory(prefix="plan_") as tmp:
+        params, rep = train(
+            mesh, cfg, steps=6, ckpt_dir=f"{tmp}/run", save_every=3,
+            optimizer="adam", zero=True, batch=4, seq=16, lr=0.005,
+            plan=plan, log=print,
+        )
+        improving = rep.losses[-1] < rep.losses[0]
+        print(f"loss {rep.losses[0]:.4f} -> {rep.losses[-1]:.4f}")
+        # the stacked params live stage-sharded; sanity: finite leaves
+        finite = all(
+            np.isfinite(np.asarray(leaf)).all()
+            for leaf in jax.tree.leaves(params)
+        )
+    ok = bytes_equal and count_moved and improving and finite
+    print("PASSED" if ok else "FAILED")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,363 @@
+"""ShardingPlan-composed 4D parallelism + comm/compute overlap (ISSUE 7
+acceptance anchors):
+
+- plan axes validate against the live mesh at construction (the error
+  names the missing axis — no deep shard_map failure);
+- a pp=1 plan's ``train()`` trajectory is BIT-identical to the legacy
+  path (params and losses), with the overlap schedule on — the
+  decomposed sync is pure scheduling, never arithmetic;
+- a pp=2 plan trains on a 2x2 CPU mesh with ZeRO moments sharded over
+  dp, tracking the pp=1 trajectory to f32 tolerance (exactly when the
+  microbatch-dependent MoE aux term is removed);
+- the obs ledger proves the overlap claim statically: the decomposed
+  schedule changes the collective COUNT but not the total wire bytes;
+- guard/rollback (ft) works under a pp plan, and a mismatched-plan
+  resume raises the CommError contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuscratch.models.trainer import train
+from tpuscratch.models.transformer import (
+    TransformerConfig,
+    init_params,
+    nonexpert_size,
+    stack_layers,
+)
+from tpuscratch.models.zero import (
+    init_plan_zero_state,
+    init_zero_adam_state,
+    put_plan_state,
+    train_step_plan,
+    train_step_zero,
+    zero_flat_size,
+)
+from tpuscratch.obs import ledger as obs_ledger
+from tpuscratch.parallel import ShardingPlan
+from tpuscratch.runtime.errors import CommError
+from tpuscratch.runtime.mesh import make_mesh
+
+pytestmark = pytest.mark.plan
+
+
+def _cfg(n_experts=2, n_layers=2, aux_coef=0.01):
+    return TransformerConfig(
+        d_model=16, n_heads=2, n_experts=n_experts, d_ff=32,
+        n_layers=n_layers, capacity_factor=2.0, aux_coef=aux_coef,
+    )
+
+
+def _mesh3(dp, sp, pp):
+    return make_mesh((dp, sp, pp), ("dp", "sp", "pp"),
+                     jax.devices()[:dp * sp * pp])
+
+
+def _data(batch=4, seq=16, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal((batch, seq, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal((batch, seq, d)), jnp.float32)
+    return x, y
+
+
+def _leaves_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(p), np.asarray(q))
+        for p, q in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+class TestPlanConstruction:
+    def test_missing_axis_named_in_error(self, devices):
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        with pytest.raises(ValueError, match="pp='stage'"):
+            ShardingPlan(mesh, pp="stage")
+        with pytest.raises(ValueError, match="dp='data'"):
+            ShardingPlan(mesh, dp="data")
+
+    def test_n_micro_needs_pp_axis(self, devices):
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        with pytest.raises(ValueError, match="pp axis"):
+            ShardingPlan(mesh, n_micro=2)
+
+    def test_spec_resolves_logical_axes(self, devices):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp")
+        assert plan.spec("pp", "ep") == P("pp", "dp")  # ep rides dp
+        assert plan.spec(("pp", "dp")) == P(("pp", "dp"))
+        assert plan.spec(None, "sp") == P(None, "sp")
+        assert plan.dp_size == 2 and plan.pp_size == 2
+        assert not ShardingPlan(mesh, pp="pp", n_micro=1).pipelined or \
+            plan.pp_size > 1  # pp=2 => pipelined
+        assert plan.pipelined
+
+    def test_tree_spec_maps_paths(self, devices):
+        from jax.sharding import PartitionSpec as P
+
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp")
+        tree = {"a": jnp.zeros((2, 2)), "b": {"c": jnp.zeros((2,))}}
+        spec = plan.tree_spec(
+            tree,
+            lambda path, leaf: ("pp",) if path[0].key == "a" else (),
+        )
+        assert spec == {"a": P("pp"), "b": {"c": P()}}
+
+    def test_describe_normalizes_degenerate_plan(self, devices):
+        mesh = _mesh3(2, 2, 1)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=1)
+        assert plan.describe() == {"dp": 2, "sp": 2, "pp": 1,
+                                   "n_micro": 1}
+        assert not plan.pipelined
+
+
+class TestPlanTrainer:
+    def test_pp1_plan_bit_identical_to_legacy(self, devices, tmp_path):
+        """The pp=1 plan routes to the EXACT legacy program (overlap on
+        by default — the decomposed sync is bit-transparent), so losses
+        AND params match bit for bit."""
+        cfg = _cfg()
+        kw = dict(save_every=3, lr=0.005, seed=5, optimizer="adam",
+                  zero=True)
+        legacy_mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        p_leg, rep_leg = train(legacy_mesh, cfg, steps=6,
+                               ckpt_dir=str(tmp_path / "leg"), **kw)
+        mesh = _mesh3(2, 2, 1)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=1)
+        p_plan, rep_plan = train(mesh, cfg, steps=6,
+                                 ckpt_dir=str(tmp_path / "plan"),
+                                 plan=plan, **kw)
+        assert rep_leg.losses == rep_plan.losses
+        assert _leaves_equal(p_leg, p_plan)
+
+    def test_pp2_matches_pp1_trajectory(self, devices, tmp_path):
+        """pp=2 vs pp=1 on the same global batch: with the
+        microbatch-dependent MoE aux term off, the only difference is
+        schedule reassociation — f32 tolerance."""
+        cfg = _cfg(aux_coef=0.0)
+        kw = dict(save_every=3, lr=0.005, seed=5, optimizer="adam",
+                  zero=True, batch=4, seq=16)
+        mesh1 = _mesh3(2, 2, 1)
+        _, rep1 = train(mesh1, cfg, steps=6,
+                        ckpt_dir=str(tmp_path / "p1"),
+                        plan=ShardingPlan(mesh1, pp="pp"), **kw)
+        mesh2 = _mesh3(2, 1, 2)
+        plan2 = ShardingPlan(mesh2, pp="pp", n_micro=2)
+        _, rep2 = train(mesh2, cfg, steps=6,
+                        ckpt_dir=str(tmp_path / "p2"), plan=plan2, **kw)
+        np.testing.assert_allclose(rep2.losses, rep1.losses, rtol=1e-4,
+                                   atol=1e-6)
+        assert rep2.losses[-1] < rep2.losses[0]
+
+    def test_pp2_zero_trains_and_resumes_bit_identical(self, devices,
+                                                       tmp_path):
+        """THE acceptance row: train(plan=...) with pp=2 on a 2x2 CPU
+        mesh (dp=2 x pp=2), ZeRO moments sharded over dp, resuming a
+        killed run bit-identically."""
+        cfg = _cfg()
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=2)
+        kw = dict(save_every=3, lr=0.005, seed=5, optimizer="adam",
+                  zero=True, batch=4, seq=16, plan=plan)
+        straight, rep = train(mesh, cfg, steps=6,
+                              ckpt_dir=str(tmp_path / "s"), **kw)
+        assert rep.losses[-1] < rep.losses[0]
+        inter = str(tmp_path / "i")
+        train(mesh, cfg, steps=3, ckpt_dir=inter, **kw)
+        resumed, rep2 = train(mesh, cfg, steps=6, ckpt_dir=inter, **kw)
+        assert rep2.steps_run == 3
+        assert _leaves_equal(straight, resumed)
+
+    def test_plan_zero_moments_shard_over_dp(self, devices):
+        """Under a pp plan the flat Adam moments live (pp, dp)-sharded:
+        each rank holds 1/(|pp|*|dp|) of the non-expert moment
+        elements."""
+        cfg = _cfg()
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=2)
+        stacked = stack_layers(init_params(0, cfg))
+        state = put_plan_state(init_plan_zero_state(stacked, plan),
+                               plan, cfg)
+        per_stage = nonexpert_size(stacked) // 2
+        flat = zero_flat_size(per_stage, 2)
+        for leaf in (state["mu_flat"], state["nu_flat"]):
+            assert leaf.shape == (2 * flat,)
+            shard_shapes = {s.data.shape for s in leaf.addressable_shards}
+            assert shard_shapes == {(flat // 2,)}
+
+    def test_mismatched_plan_resume_raises_commerror(self, devices,
+                                                     tmp_path):
+        cfg = _cfg()
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=2)
+        kw = dict(save_every=2, lr=0.005, seed=5, optimizer="adam",
+                  batch=4, seq=16)
+        d = str(tmp_path / "mm")
+        train(mesh, cfg, steps=2, ckpt_dir=d, plan=plan, **kw)
+        # non-zero run so the plan gate itself (not the ZeRO mesh_shape
+        # gate) is what fires on the legacy re-invocation
+        mesh1 = _mesh3(2, 1, 1)
+        with pytest.raises(CommError, match="plan"):
+            train(mesh1, cfg, steps=4, ckpt_dir=d,
+                  plan=ShardingPlan(mesh1, pp="pp"), **kw)
+        # and a legacy (pre-plan) checkpoint refuses a pipelined resume
+        d2 = str(tmp_path / "legacy")
+        legacy_mesh = make_mesh((2, 1), ("dp", "sp"), jax.devices()[:2])
+        train(legacy_mesh, cfg, steps=2, ckpt_dir=d2, **kw)
+        import json
+        import pathlib
+
+        for man in pathlib.Path(d2).glob("step_*/manifest.json"):
+            m = json.loads(man.read_text())
+            m["metadata"].pop("plan")
+            man.write_text(json.dumps(m))
+        with pytest.raises(CommError, match="plan"):
+            train(mesh, cfg, steps=4, ckpt_dir=d2, plan=plan, **kw)
+
+
+class TestOverlapLedger:
+    def test_overlap_changes_schedule_not_wire_bytes(self, devices):
+        """The comm claim, statically: the decomposed schedule holds
+        ``blocks`` reduce-scatters and ``blocks`` all-gathers where the
+        serial schedule holds one of each, at EXACTLY the same total
+        wire bytes (k transfers of shard/k)."""
+        cfg = _cfg()
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        params = init_params(0, cfg)
+        x = jnp.zeros((4, 16, 16), jnp.float32)
+        leds = {}
+        for blocks in (0, 4):
+            leds[blocks] = obs_ledger.analyze(
+                train_step_zero(mesh, cfg, donate=False,
+                                overlap_blocks=blocks),
+                params, init_zero_adam_state(params, 2), x, x,
+            )
+        c0, c4 = leds[0].counts(), leds[4].counts()
+        assert c0.get("reduce-scatter") == 1 and c0.get("all-gather") == 1
+        assert c4.get("reduce-scatter") == 4 and c4.get("all-gather") == 4
+        w0, w4 = leds[0].wire_bytes(), leds[4].wire_bytes()
+        assert w4["reduce-scatter"] == w0["reduce-scatter"]
+        assert w4["all-gather"] == w0["all-gather"]
+        assert leds[4].total_wire_bytes() == leds[0].total_wire_bytes()
+
+    def test_pp_plan_overlap_wire_bytes_equal(self, devices):
+        """Same proof through the pipelined plan step: per-stage chains
+        decompose, bytes stay put."""
+        cfg = _cfg()
+        mesh = _mesh3(2, 1, 2)
+        stacked = stack_layers(init_params(0, cfg))
+        x = jnp.zeros((4, 16, 16), jnp.float32)
+        leds = {}
+        for ov in (False, True):
+            plan = ShardingPlan(mesh, pp="pp", n_micro=2, overlap=ov)
+            leds[ov] = obs_ledger.analyze(
+                train_step_plan(plan, cfg, donate=False), stacked,
+                init_plan_zero_state(stacked, plan), x, x,
+            )
+        assert (leds[True].counts()["reduce-scatter"]
+                > leds[False].counts()["reduce-scatter"])
+        assert (leds[True].total_wire_bytes()
+                == leds[False].total_wire_bytes())
+
+    def test_overlap_is_bit_transparent(self, devices):
+        """Overlap on/off produce BIT-identical params, losses, and
+        moments — the strided block layout preserves every rank's
+        elements, so the ablation isolates pure scheduling."""
+        from tpuscratch.models.zero import put_zero_state
+
+        cfg = _cfg()
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        x, y = _data()
+
+        def run(blocks):
+            params = init_params(0, cfg)
+            opt = put_zero_state(init_zero_adam_state(params, 2), mesh,
+                                 cfg)
+            fn = train_step_zero(mesh, cfg, lr=0.01, donate=False,
+                                 overlap_blocks=blocks)
+            losses = []
+            for _ in range(3):
+                params, opt, loss = fn(params, opt, x, y)
+                losses.append(float(loss))
+            return losses, params, opt
+
+        l0, p0, o0 = run(0)
+        l4, p4, o4 = run(4)
+        assert l0 == l4
+        assert _leaves_equal(p0, p4)
+        assert _leaves_equal(o0, o4)
+
+
+class TestPlanGuard:
+    def test_guarded_pp_step_skips_nan_and_freezes_state(self, devices):
+        """The ft guard composes with the pipelined plan: a NaN batch
+        skips the step with the stacked params AND the (pp, dp)-sharded
+        moments passed through bit-identically."""
+        from tpuscratch.ft.guards import STATUS_OK, STATUS_SKIPPED
+
+        cfg = _cfg()
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=2)
+        x, y = _data()
+        stacked = stack_layers(init_params(0, cfg))
+        opt = put_plan_state(init_plan_zero_state(stacked, plan), plan,
+                             cfg)
+        fn = train_step_plan(plan, cfg, lr=0.01, guard=(1e30, 1e30),
+                             donate=False)
+        nan_ref = jnp.asarray(float("nan"), jnp.float32)
+        new_p, new_o, loss, gnorm, st = fn(stacked, opt, x, y, nan_ref)
+        assert int(st) == STATUS_OK
+        assert float(gnorm) > 0 and np.isfinite(float(loss))
+        assert not _leaves_equal(new_p, stacked)
+
+        bad = x.at[0, 0, 0].set(jnp.nan)
+        p2, o2, loss2, _, st2 = fn(stacked, opt, bad, y, nan_ref)
+        assert int(st2) == STATUS_SKIPPED
+        assert _leaves_equal(p2, stacked)
+        assert _leaves_equal(o2, opt)
+
+    @pytest.mark.chaos
+    def test_guard_rollback_under_pp_plan(self, devices, tmp_path):
+        """Rollback under a pp plan: a chaos-poisoned chunk rolls the
+        stacked params + sharded moments back to the last checkpoint
+        and the run completes, bit-identical to the fault-free run."""
+        from tpuscratch.ft.chaos import ChaosPlan, Fault
+        from tpuscratch.ft.guards import GuardPolicy
+
+        cfg = _cfg()
+        mesh = _mesh3(2, 1, 2)
+        plan = ShardingPlan(mesh, pp="pp", n_micro=2)
+        kw = dict(save_every=4, lr=0.005, seed=7, optimizer="adam",
+                  zero=True, batch=4, seq=16, plan=plan)
+        clean, _ = train(mesh, cfg, steps=8,
+                         ckpt_dir=str(tmp_path / "clean"), **kw)
+        chaos = ChaosPlan(seed=3, faults=[
+            Fault(site="train/grad", at=[5, 6], times=2, kind="nan"),
+        ])
+        guard = GuardPolicy(max_skips=1, max_rollbacks=2)
+        healed, rep = train(mesh, cfg, steps=8,
+                            ckpt_dir=str(tmp_path / "chaos"),
+                            chaos=chaos, guard=guard, **kw)
+        assert rep.rollbacks >= 1
+        assert _leaves_equal(clean, healed)
+
+
+def test_bench_program_runs_plan(devices):
+    """The bench plumbing: the plan-composed throughput program (3-axis
+    scan, in-program state) produces finite losses with overlap on and
+    off, and the legacy-shaped zero program accepts overlap blocks."""
+    from tpuscratch.bench.train_bench import bench_train
+
+    cfg = _cfg()
+    mesh = _mesh3(2, 1, 2)
+    for ov in (False, True):
+        plan = ShardingPlan(mesh, pp="pp", n_micro=2, overlap=ov)
+        r = bench_train(plan=plan, cfg=cfg, batch=4, seq=16, steps=2,
+                        iters=1, fence="block", optimizer="adam",
+                        zero=True)
+        assert r.items_per_s > 0
+        assert ("ov4" if ov else "serial") in r.name

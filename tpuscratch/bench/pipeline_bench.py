@@ -27,12 +27,10 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from tpuscratch.bench.timing import time_device
-from tpuscratch.comm import run_spmd
-from tpuscratch.parallel import bubble_fraction, pipeline_apply
-from tpuscratch.runtime.mesh import make_mesh_1d
+from tpuscratch.parallel import ShardingPlan, bubble_fraction
+from tpuscratch.runtime.mesh import make_mesh
 
 
 @dataclasses.dataclass(frozen=True)
@@ -68,6 +66,12 @@ def bench_pipeline_bubble(
     Runs the same stage chain at ``n_micro`` and ``2 * n_micro``
     microbatches; the wall-time difference prices one tick.
 
+    The program is built THROUGH a ``ShardingPlan``
+    (``plan.pipeline_program``), not by calling ``pipeline_apply``
+    directly — so the bench measures the same ``gpipe_scan`` schedule
+    the trainer's pipelined loss runs, reached through the same plan
+    validation the trainer uses.
+
     On a virtual CPU mesh the default stage count is capped at the HOST
     CORE count: stages can only overlap on real execution units, and
     timing more virtual devices than cores measures the scheduler, not
@@ -82,8 +86,16 @@ def bench_pipeline_bubble(
             import os
 
             devs = devs[: max(2, min(len(devs), os.cpu_count() or 1))]
-        mesh = make_mesh_1d(axis, devices=devs)
-    n = mesh.devices.size
+        # dp/sp are trivial here, but the mesh carries them so the SAME
+        # ShardingPlan type the trainer consumes drives this bench
+        mesh = make_mesh((1, 1, len(devs)), ("dp", "sp", axis), devs)
+    elif "dp" not in mesh.axis_names or "sp" not in mesh.axis_names:
+        # a legacy 1-axis stage mesh: rebuild with trivial dp/sp axes
+        # over the same devices so the plan's axis roles resolve
+        mesh = make_mesh((1, 1, mesh.devices.size), ("dp", "sp", axis),
+                         list(mesh.devices.flat))
+    n = mesh.shape[axis]
+    plan = ShardingPlan(mesh, pp=axis, n_micro=n_micro)
     rng = np.random.default_rng(0)
     Ws = jnp.asarray(
         rng.standard_normal((n, feature, feature)).astype(np.float32) * 0.1
@@ -93,12 +105,7 @@ def bench_pipeline_bubble(
         return jnp.tanh(x @ W[0])
 
     def program(M):
-        f = run_spmd(
-            mesh,
-            lambda W, m: pipeline_apply(stage, W, m, axis),
-            (P(axis), P()),
-            P(),
-        )
+        f = plan.pipeline_program(stage)
         micro = jnp.asarray(
             rng.standard_normal((M, feature)).astype(np.float32)
         )

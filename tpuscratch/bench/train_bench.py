@@ -48,7 +48,8 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
 
 def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
                              lr: float = 1e-3, optimizer: str = "sgd",
-                             zero: bool = False, accum_steps: int = 1):
+                             zero: bool = False, accum_steps: int = 1,
+                             plan=None):
     """jit'd fn(params, x, y) -> (params, loss) running ``steps`` train
     steps in one scan (the data is reused — throughput, not learning).
     ``optimizer='adam'`` carries the moment state through the scan too
@@ -57,7 +58,12 @@ def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
     (``models.zero``: reduce-scatter grad sync, dp-sharded flat Adam
     shards carried through the scan, trailing param all-gather);
     ``accum_steps=k`` (ZeRO only) shapes x, y as ``(k, batch, seq, d)``
-    and defers the one gradient sync to the last microbatch."""
+    and defers the one gradient sync to the last microbatch.
+
+    ``plan`` (a ``parallel.ShardingPlan`` over ``mesh``) selects the
+    plan-composed program: its overlap policy threads into the ZeRO
+    sync legs, and a PIPELINED plan scans the 3-axis GPipe + ZeRO step
+    over stage-stacked params (pass the ``stack_layers`` layout)."""
     from jax.sharding import PartitionSpec as P
 
     from tpuscratch.comm import run_spmd
@@ -73,6 +79,44 @@ def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
         raise ValueError("zero=True requires optimizer='adam'")
     if accum_steps > 1 and not zero:
         raise ValueError("accum_steps > 1 is the ZeRO deferred-sync path")
+    overlap_blocks = plan.overlap_blocks if plan is not None else 0
+    if plan is not None and plan.pipelined and accum_steps != 1:
+        raise ValueError("a pipelined plan already microbatches through "
+                         "n_micro; accum_steps must be 1")
+    if plan is not None and plan.pipelined:
+        from jax import lax as _lax
+
+        from tpuscratch.models.transformer import param_spec_pp
+        from tpuscratch.models.zero import (
+            local_zero_state,
+            train_step_plan_fn,
+        )
+
+        if optimizer != "adam":
+            raise ValueError("a pipelined plan trains with adam")
+        step = train_step_plan_fn(
+            cfg, plan.n_micro, lr=lr, sp=plan.sp, dp=plan.dp,
+            stage=plan.pp, zero=zero, overlap_blocks=overlap_blocks,
+        )
+        n_dp = plan.dp_size
+
+        def body(params, x, y):
+            def one(carry, _):
+                p, o = carry
+                p, o, loss = step(p, o, x, y)
+                return (p, o), loss
+
+            opt0 = (local_zero_state(params, n_dp) if zero
+                    else init_adam_state(params))
+            (params, _), losses = _lax.scan(
+                one, (params, opt0), None, length=steps
+            )
+            return params, losses[-1]
+
+        pspec = param_spec_pp(cfg, plan.pp, plan.dp)
+        dspec = plan.data_spec()
+        return run_spmd(plan.mesh, body, (pspec, dspec, dspec),
+                        (pspec, P()))
     if zero:
         from jax import lax as _lax
 
@@ -81,7 +125,8 @@ def train_throughput_program(mesh: Mesh, cfg: TransformerConfig, steps: int,
             train_step_zero_fn,
         )
 
-        step = train_step_zero_fn(cfg, lr=lr, accum_steps=accum_steps)
+        step = train_step_zero_fn(cfg, lr=lr, accum_steps=accum_steps,
+                                  overlap_blocks=overlap_blocks)
         n_dp = mesh.shape["dp"]
 
         def body(params, x, y):
@@ -147,15 +192,20 @@ def bench_train(
     optimizer: str = "sgd",
     zero: bool = False,
     accum_steps: int = 1,
+    plan=None,
 ) -> BenchResult:
     """tokens/s of the composed train step; items = tokens processed.
     ``zero``/``accum_steps``: the ZeRO-sharded step (see
     :func:`train_throughput_program`) — with accumulation every scanned
     step consumes ``accum_steps`` microbatches, and the token count
-    scales accordingly."""
+    scales accordingly.  ``plan``: bench the plan-composed program (the
+    same step path the trainer runs) — pipelined plans stack the layer
+    params and stream ``plan.n_micro`` microbatches per step."""
     from tpuscratch.runtime.mesh import make_mesh
 
     on_tpu = jax.default_backend() == "tpu"
+    if plan is not None:
+        mesh = plan.mesh
     if mesh is None:
         mesh = make_mesh((1, 1), ("dp", "sp"))
     if cfg is None:
@@ -181,8 +231,14 @@ def bench_train(
     x = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
     y = jnp.asarray(rng.standard_normal(shape).astype(np.float32))
     params = init_params(seed, cfg)
+    pipelined = plan is not None and plan.pipelined
+    if pipelined:
+        from tpuscratch.models.transformer import stack_layers
+
+        params = stack_layers(params)
     prog = train_throughput_program(mesh, cfg, steps, optimizer=optimizer,
-                                    zero=zero, accum_steps=accum_steps)
+                                    zero=zero, accum_steps=accum_steps,
+                                    plan=plan)
     # correctness gate doubles as compile warmup: the loss must be finite
     out_params, loss = prog(params, x, y)
     if not np.isfinite(float(loss)):
@@ -191,12 +247,17 @@ def bench_train(
     opt_tag = f"{'zero-' if zero else ''}{optimizer}" + (
         f"-accum{accum_steps}" if accum_steps > 1 else ""
     )
+    if plan is not None:
+        ov = plan.overlap_blocks
+        opt_tag += (f"-pp{plan.pp_size}-M{plan.n_micro}" if pipelined
+                    else "") + (f"-ov{ov}" if ov else "-serial")
+    mesh_tag = "x".join(str(s) for s in mesh.devices.shape)
     return time_device(
         prog, params, x, y, iters=iters, warmup=1, fence=fence,
         name=(
             f"train d{cfg.d_model} ff{cfg.d_ff} L{cfg.n_layers} "
             f"e{cfg.n_experts} {cfg.compute_dtype} {opt_tag} b{batch} "
-            f"s{seq} x{steps} on {mesh.shape['dp']}x{mesh.shape['sp']} "
+            f"s{seq} x{steps} on {mesh_tag} "
             f"({cfg.attn_impl})"
         ),
         items=tokens,
@@ -328,16 +389,71 @@ def bench_obs_overhead(
     return ObsOverhead(step_s=step_best, instr_s=instr_best)
 
 
+def _int_flag(argv, flag, default):
+    if flag not in argv:
+        return default
+    try:
+        return int(argv[argv.index(flag) + 1])
+    except (IndexError, ValueError):
+        raise SystemExit(f"usage: {flag} N")
+
+
 def main() -> int:
     import sys
 
     argv = sys.argv[1:]
+    cpu_devices = _int_flag(argv, "--cpu-devices", 0)
+    if cpu_devices:
+        from tpuscratch.runtime.hostenv import force_cpu_devices
+
+        force_cpu_devices(cpu_devices)
     if "--obs-overhead" in argv:
         o = bench_obs_overhead()
         print(o.summary())
         return 0
     zero = "--zero" in argv
     optimizer = "adam" if (zero or "--adam" in argv) else "sgd"
+    if "--pp" in argv or "--overlap" in argv or "--no-overlap" in argv:
+        # the plan-composed ablation row, runnable standalone:
+        #   train_bench --pp N [--dp D] [--micro M] --overlap|--no-overlap
+        # pp > 1 (or micro > 1) scans the 3-axis GPipe + ZeRO step; the
+        # overlap flag toggles the decomposed sync schedule (record.py
+        # config 14 sweeps the same grid)
+        from tpuscratch.parallel import ShardingPlan
+        from tpuscratch.runtime.mesh import make_mesh
+
+        pp = _int_flag(argv, "--pp", 1)
+        dp = _int_flag(argv, "--dp", 1)
+        micro = _int_flag(argv, "--micro", 2 if pp > 1 else 1)
+        need = dp * pp
+        if need > len(jax.devices()):
+            raise SystemExit(
+                f"--pp {pp} --dp {dp} needs {need} devices, have "
+                f"{len(jax.devices())} (use --cpu-devices N)"
+            )
+        mesh = make_mesh((dp, 1, pp), ("dp", "sp", "pp"),
+                         jax.devices()[:need])
+        plan = ShardingPlan(mesh, pp="pp", n_micro=micro,
+                            overlap="--no-overlap" not in argv)
+        on_tpu = jax.default_backend() == "tpu"
+        # layer count: the default depth rounded UP to a multiple of pp
+        # (stages own equal layer slices)
+        layers = -(-(4 if on_tpu else 2) // pp) * pp
+        cfg = (
+            TransformerConfig(
+                d_model=1024, n_heads=8, n_experts=4, d_ff=4096,
+                n_layers=layers, capacity_factor=2.0, attn_impl="pallas",
+            )
+            if on_tpu
+            else TransformerConfig(
+                d_model=32, n_heads=2, n_experts=2, d_ff=64,
+                n_layers=layers, capacity_factor=2.0,
+            )
+        )
+        r = bench_train(plan=plan, cfg=cfg, optimizer="adam", zero=True,
+                        batch=max(2 * dp, dp * micro))
+        print(f"{r.summary()} -> {r.items_per_s:.3e} tokens/s")
+        return 0
     if "--accum" in argv:
         # --accum k1,k2,...: the deferred-sync sweep — one row per
         # accumulation depth, same optimizer/mesh, so the k-fold sync

@@ -43,14 +43,19 @@ from tpuscratch.models.transformer import (
     TransformerConfig,
     init_adam_state,
     init_params,
+    stack_layers,
     train_step,
     train_step_adam,
 )
 from tpuscratch.models.zero import (
+    init_plan_zero_state,
     init_zero_adam_state,
+    put_plan_state,
     put_zero_state,
+    train_step_plan,
     train_step_zero,
 )
+from tpuscratch.parallel.plan import ShardingPlan
 from tpuscratch.runtime.errors import CommError
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
@@ -138,6 +143,7 @@ def train(
     save_retry: Optional[RetryPolicy] = None,
     zero: bool = False,
     accum_steps: int = 1,
+    plan: Optional[ShardingPlan] = None,
 ) -> tuple[dict, TrainReport]:
     """Run (or resume) ``steps`` training steps, checkpointing every
     ``save_every``. Returns (params, report). ``optimizer`` is 'sgd' or
@@ -196,7 +202,21 @@ def train(
     update with gradient accumulation, deferring the single
     reduce-scatter to the last microbatch; each step then consumes k
     consecutive entries of the deterministic batch stream, so
-    ``accum_steps`` is part of the resume identity like ``batch``."""
+    ``accum_steps`` is part of the resume identity like ``batch``.
+
+    ``plan`` (a ``parallel.ShardingPlan`` built over THIS mesh)
+    replaces the hardcoded dp x sp assumption with the plan's axis
+    mapping and schedule.  A dp x sp plan (no pp axis, or pp=1 with
+    one microbatch) runs the EXACT legacy program — bit-identical —
+    with the plan's overlap policy threaded into the ZeRO sync legs.
+    A PIPELINED plan (``pp`` axis, ``n_micro`` microbatches) trains
+    the stage-stacked model through the GPipe schedule composed with
+    dp x sp (and, under ``zero=True``, with dp-sharded ZeRO moments and
+    the bubble-filling decomposed grad sync) — one compiled step,
+    ``optimizer='adam'`` required.  The checkpoint records the
+    normalized plan identity; resuming under a mismatched plan raises
+    a ``CommError``, the same contract as a mismatched-|dp| ZeRO
+    restore."""
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if optimizer not in ("sgd", "adam"):
@@ -209,17 +229,74 @@ def train(
     if accum_steps > 1 and not zero:
         raise ValueError("accum_steps > 1 is the ZeRO path's "
                          "deferred-sync feature: pass zero=True")
-    dp_n = mesh.shape["dp"]
-    sp_n = mesh.shape["sp"]
+    dp_ax, sp_ax = (plan.dp, plan.sp) if plan is not None else ("dp", "sp")
+    if plan is not None and (
+        tuple(plan.mesh.axis_names) != tuple(mesh.axis_names)
+        or plan.mesh.devices.shape != mesh.devices.shape
+    ):
+        raise ValueError(
+            f"plan was built for mesh {dict(plan.mesh.shape)}, train() "
+            f"was handed mesh {dict(mesh.shape)} — build the plan over "
+            f"the mesh you train on (its axes are validated there)"
+        )
+    pipelined = plan is not None and plan.pipelined
+    if pipelined and optimizer != "adam":
+        raise ValueError(
+            "a pipelined plan trains with optimizer='adam' "
+            f"(got {optimizer!r})"
+        )
+    if pipelined and accum_steps != 1:
+        raise ValueError(
+            "a pipelined plan already microbatches through n_micro; "
+            "accum_steps must be 1"
+        )
+    dp_n = mesh.shape[dp_ax]
+    sp_n = mesh.shape[sp_ax]
+    pp_n = plan.pp_size if pipelined else 1
     batch = batch if batch is not None else 2 * dp_n
     seq = seq if seq is not None else 8 * sp_n
-    mesh_shape = {"dp": int(dp_n), "sp": int(sp_n)} if zero else None
-
-    params = init_params(seed, cfg)
+    if pipelined and (batch // dp_n) % plan.n_micro:
+        raise ValueError(
+            f"local batch {batch // dp_n} (batch {batch} / |dp| {dp_n}) "
+            f"not divisible by the plan's n_micro {plan.n_micro}"
+        )
+    # normalized plan identity: a pp=1 single-microbatch plan IS the
+    # legacy program, so the two resume interchangeably; anything
+    # pipelined is its own state layout and data schedule
+    plan_id = (plan.describe() if plan is not None else
+               {"dp": int(dp_n), "sp": int(sp_n), "pp": 1, "n_micro": 1})
     if zero:
-        opt = put_zero_state(init_zero_adam_state(params, dp_n), mesh, cfg)
+        mesh_shape = {"dp": int(dp_n), "sp": int(sp_n)}
+        if pipelined:
+            mesh_shape["pp"] = int(pp_n)
     else:
-        opt = init_adam_state(params) if optimizer == "adam" else None
+        mesh_shape = None
+
+    def fresh_state():
+        if pipelined:
+            params = stack_layers(init_params(seed, cfg))
+            opt = (put_plan_state(init_plan_zero_state(params, plan),
+                                  plan, cfg)
+                   if zero else init_adam_state(params))
+            return params, opt
+        params = init_params(seed, cfg)
+        if zero:
+            return params, put_zero_state(
+                init_zero_adam_state(params, dp_n), mesh, cfg, dp=dp_ax
+            )
+        return params, (init_adam_state(params) if optimizer == "adam"
+                        else None)
+
+    def commit_opt(opt):
+        """Re-commit restored optimizer state to its canonical device
+        layout (donation aliasing needs committed shardings)."""
+        if not zero:
+            return opt
+        if pipelined:
+            return put_plan_state(opt, plan, cfg)
+        return put_zero_state(opt, mesh, cfg, dp=dp_ax)
+
+    params, opt = fresh_state()
     start = 0
     if checkpoint.latest_step(ckpt_dir) is not None:
         # the bit-identical contract only holds if the resumed run replays
@@ -255,6 +332,27 @@ def train(
                 f"re-laid-out implicitly (re-train or resume on a "
                 f"matching mesh)",
             )
+        # the plan is part of the state's meaning: stage-stacked params,
+        # (pp, dp)-sharded moments, and the microbatched data schedule
+        # all depend on it — a mismatched plan fails with the same
+        # CommError contract as a mismatched-|dp| ZeRO restore
+        stored_plan = meta.get("plan")
+        if stored_plan is None:
+            if plan_id["pp"] > 1 or plan_id["n_micro"] > 1:
+                raise CommError(
+                    "train/resume",
+                    f"checkpoint in {ckpt_dir} predates ShardingPlan "
+                    f"metadata (a legacy dp x sp run) — it cannot resume "
+                    f"under the pipelined plan {plan_id}",
+                )
+        elif stored_plan != plan_id:
+            raise CommError(
+                "train/resume",
+                f"checkpoint in {ckpt_dir} was trained under plan "
+                f"{stored_plan}, this run's plan is {plan_id} — the "
+                f"stage/mesh layout of the state cannot be re-laid-out "
+                f"implicitly (re-train or resume under a matching plan)",
+            )
         for key, val in (
             ("lr", lr), ("seed", seed), ("batch", batch), ("seq", seq),
             ("cfg", _cfg_fingerprint(cfg)), ("optimizer", optimizer),
@@ -281,8 +379,7 @@ def train(
         params, opt, start, meta = _restore_state(
             ckpt_dir, params, opt, start, mesh_shape=mesh_shape
         )
-        if zero:
-            opt = put_zero_state(opt, mesh, cfg)
+        opt = commit_opt(opt)
         log(f"resumed at step {start} (meta {meta})")
 
     sink = obs if obs is not None else NullSink()
@@ -303,18 +400,26 @@ def train(
     else:
         guard_state = GuardState(guard) if guard is not None else None
     step_guard = guard.step_guard() if guard is not None else None
-    if zero:
-        step_fn = train_step_zero(mesh, cfg, lr=lr, counter=counter,
-                                  accum_steps=accum_steps,
+    if pipelined:
+        step_fn = train_step_plan(plan, cfg, lr=lr, zero=zero,
+                                  counter=counter,
                                   with_grad_norm=want_gnorm,
                                   guard=step_guard)
+    elif zero:
+        step_fn = train_step_zero(
+            mesh, cfg, lr=lr, counter=counter, accum_steps=accum_steps,
+            with_grad_norm=want_gnorm, guard=step_guard, dp=dp_ax,
+            sp=sp_ax,
+            overlap_blocks=plan.overlap_blocks if plan is not None else 0,
+        )
     elif optimizer == "adam":
         step_fn = train_step_adam(mesh, cfg, lr=lr, counter=counter,
                                   with_grad_norm=want_gnorm,
-                                  guard=step_guard)
+                                  guard=step_guard, dp=dp_ax, sp=sp_ax)
     else:
         step_fn = train_step(mesh, cfg, lr=lr, counter=counter,
-                             with_grad_norm=want_gnorm, guard=step_guard)
+                             with_grad_norm=want_gnorm, guard=step_guard,
+                             dp=dp_ax, sp=sp_ax)
     if chaos is not None:
         # injected faults land in the run's own event stream
         bind_sink(chaos, sink)
@@ -325,6 +430,7 @@ def train(
         "steps_total": steps, "lr": lr, "seed": seed,
         "batch": batch, "seq": seq, "cfg": _cfg_fingerprint(cfg),
         "optimizer": optimizer, "zero": zero, "accum_steps": accum_steps,
+        "plan": plan_id,
     }
     if zero:
         metadata["mesh_shape"] = mesh_shape
@@ -407,21 +513,13 @@ def train(
                     rb_sp = rec.open_span("train/rollback", from_step=start + chunk)
                     rb_to = checkpoint.latest_step(ckpt_dir)
                     if rb_to is None:
-                        params = init_params(seed, cfg)
-                        if zero:
-                            opt = put_zero_state(
-                                init_zero_adam_state(params, dp_n), mesh, cfg
-                            )
-                        else:
-                            opt = (init_adam_state(params)
-                                   if optimizer == "adam" else None)
+                        params, opt = fresh_state()
                         rb_to = 0
                     else:
                         params, opt, rb_to, _ = _restore_state(
                             ckpt_dir, params, opt, rb_to, mesh_shape=mesh_shape
                         )
-                        if zero:
-                            opt = put_zero_state(opt, mesh, cfg)
+                        opt = commit_opt(opt)
                     rec.close_span(rb_sp)
                     # lost wall: the discarded chunk's compute + the restore
                     # — the goodput "rollback" badput bucket

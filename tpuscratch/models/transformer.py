@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from tpuscratch.comm import run_spmd
 from tpuscratch.parallel.expert import expert_parallel_ffn
+from tpuscratch.parallel.pipeline import gpipe_scan
 from tpuscratch.parallel.ring_attention import ring_attention
 
 
@@ -292,7 +293,7 @@ def _grad_norm(grads, dp: str):
 
 
 def _apply_guard(loss, gnorm, grads, ref_loss, clip_norm, spike_factor,
-                 dp: str, sp: str):
+                 dp: str, sp: str, extra_axes: tuple = ()):
     """Device-side health guard (the compiled half of ``ft.guards``):
 
     - finiteness: the local ``isfinite(loss) & isfinite(gnorm)`` flag
@@ -307,11 +308,16 @@ def _apply_guard(loss, gnorm, grads, ref_loss, clip_norm, spike_factor,
 
     Returns ``(ok, status, grads)``: ``ok`` gates the update
     (skip-step = params pass through unchanged), ``status`` is the ONE
-    extra int32 scalar output (0 ok / 1 clipped / 2 skipped)."""
+    extra int32 scalar output (0 ok / 1 clipped / 2 skipped).
+    ``extra_axes`` extends the finiteness agreement to further mesh
+    axes (the pipeline plan's stage axis) so the skip-select cannot
+    diverge replicas on any axis of the mesh."""
     from tpuscratch.comm import collectives as C
 
     finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
-    finite = C.allreduce_min(finite.astype(jnp.int32), (dp, sp)) > 0
+    finite = C.allreduce_min(
+        finite.astype(jnp.int32), (dp, sp) + tuple(extra_axes)
+    ) > 0
     spiked = (
         jnp.isfinite(ref_loss) & (ref_loss > 0)
         & (loss > jnp.float32(spike_factor) * ref_loss)
@@ -591,8 +597,6 @@ def _pp_loss_fn(cfg: TransformerConfig, n_micro: int, sp: str, dp: str,
         if cd != jnp.float32:
             stacked = jax.tree.map(lambda w: w.astype(cd), stacked)
             x = x.astype(cd)
-        n_stage = lax.axis_size(stage)
-        me = lax.axis_index(stage)
         sl = stacked["layers"]
         ls = next(iter(sl.values())).shape[0]  # layers per stage
         B, S, d = x.shape
@@ -609,36 +613,11 @@ def _pp_loss_fn(cfg: TransformerConfig, n_micro: int, sp: str, dp: str,
                 aux = aux + a
             return act, aux
 
-        ticks = M + n_stage - 1
-        shift = [(i, i + 1) for i in range(n_stage - 1)]
-        out0 = jnp.zeros_like(micro)
-        act0 = jnp.zeros_like(micro[0])
-
-        def tick(state, t):
-            act, out, aux_acc = state
-            if n_stage > 1:
-                incoming = lax.ppermute(act, stage, shift)
-            else:
-                incoming = act
-            inject = jnp.where(t < M, micro[jnp.clip(t, 0, M - 1)], 0.0)
-            a_in = jnp.where(me == 0, inject, incoming)
-            y_out, aux = stage_apply(a_in)
-            valid = jnp.logical_and(t - me >= 0, t - me < M)
-            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
-            emit = t - (n_stage - 1)
-            upd = lax.dynamic_update_slice(
-                out, y_out[None],
-                (jnp.clip(emit, 0, M - 1),) + (0,) * y_out.ndim,
-            )
-            out = jnp.where((me == n_stage - 1) & (emit >= 0), upd, out)
-            return (y_out, out, aux_acc), ()
-
-        (_, out, aux_acc), _ = lax.scan(
-            tick, (act0, out0, jnp.float32(0.0)), jnp.arange(ticks)
-        )
-        out = lax.psum(jnp.where(me == n_stage - 1, out, 0.0), stage)
+        # the ONE GPipe schedule implementation (parallel/pipeline.py)
+        # — the same tick loop pipeline_apply and the pipeline bench run
+        out, aux_acc = gpipe_scan(stage_apply, micro, stage)
         out = out.reshape(B, S, d)
-        aux = lax.psum(aux_acc, stage) / M
+        aux = aux_acc / M
         mse = jnp.mean(
             jnp.square(out.astype(jnp.float32) - y.astype(jnp.float32))
         )

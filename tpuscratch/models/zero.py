@@ -35,6 +35,29 @@ Sharding note: the sp axis still holds COPIES of the non-expert
 gradients, so the shard is ``psum``'d over "sp" after the scatter —
 scatter-first ordering keeps that psum shard-sized, ``2(s-1)/s * N/d``
 instead of ``2(s-1)/s * N``.
+
+Two extensions land on top (ISSUE 7, driven by ``parallel.plan``):
+
+- **comm/compute overlap** (``overlap_blocks=k``): the one flat
+  reduce-scatter and the one trailing all-gather decompose into ``k``
+  independent per-block chains (RS_i -> fused update_i -> AG_i), so the
+  scheduler can fly block i's gather while block i+1's update computes
+  — the ``parallel.ring`` hop-overlap idiom applied to the sync legs
+  (Wang et al., ASPLOS'23's decomposed-collective pattern).  The block
+  layout is strided so each rank's shard stays CONTIGUOUS and
+  element-identical to the serial schedule: params, moments, and
+  checkpoints are bit-identical across overlap on/off, and total wire
+  bytes are unchanged (k transfers of shard/k) — only the collective
+  count/schedule moves, which ``obs.ledger`` asserts statically.
+- **the pipelined plan step** (:func:`train_step_plan`): the GPipe
+  microbatched loss (``transformer._pp_loss_fn`` over the plan's pp
+  axis) composed with the SAME dp-sharded ZeRO machinery — each
+  (stage, dp) rank packs ITS stage's non-expert gradients flat,
+  reduce-scatters over dp, updates its 1/|dp| moment shard in place,
+  and all-gathers within the stage.  Stages' sync chains are disjoint
+  by construction, so under overlap the decomposed reduce-scatters
+  drain into the schedule alongside other stages' chains instead of
+  serializing after the pipeline flush (the bubble-filling grad sync).
 """
 
 from __future__ import annotations
@@ -50,23 +73,34 @@ from tpuscratch.models.transformer import (
     LAYER_LEAVES,
     TransformerConfig,
     _adam_apply,
+    _adam_update,
     _apply_guard,
+    _grad_reduce,
     _is_expert_leaf,
     _loss,
+    _pp_loss_fn,
+    _validate_pp,
     _validate_step_config,
     adam_alpha,
+    adam_state_spec_pp,
     expert_leaves,
     nonexpert_size,
     pack_nonexpert,
     param_spec,
+    param_spec_pp,
     unpack_nonexpert,
 )
 from tpuscratch.ops.adam import fused_adam_tree
 
 __all__ = [
+    "init_plan_zero_state",
     "init_zero_adam_state",
     "local_zero_state",
+    "plan_zero_state_spec",
+    "put_plan_state",
     "put_zero_state",
+    "train_step_plan",
+    "train_step_plan_fn",
     "train_step_zero",
     "train_step_zero_fn",
     "zero_flat_size",
@@ -165,29 +199,139 @@ def local_zero_state(params_local, n_dp: int) -> dict:
     }
 
 
-def _zero_grad_sync(grads, n: int, dp: str, sp: str, flat_size: int):
+def _overlap_blocks(requested: int, shard_elems: int) -> int:
+    """Effective block count for the decomposed sync legs: the largest
+    ``k <= requested`` dividing the per-rank shard (shards are padded to
+    multiples of 8, so 2/4/8 always divide).  ``requested <= 1`` keeps
+    the serial (unchunked) schedule."""
+    if requested <= 1 or shard_elems <= 1:
+        return 1
+    k = min(requested, shard_elems)
+    while shard_elems % k:
+        k -= 1
+    return k
+
+
+def _zero_grad_sync(grads, n: int, dp: str, sp: str, flat_size: int,
+                    blocks: int = 1):
     """The ONE deferred gradient sync: pack the non-expert leaves flat,
     reduce-scatter over "dp" (each rank keeps its shard), psum the
     shard-sized result over the "sp" copy axis, and psum expert leaves
     over "sp" only (their dp copies are DIFFERENT experts) — everything
     divided by ``n`` exactly like ``_grad_reduce``.  Returns
-    ``(g_shard, g_exp)``."""
+    ``(g_shard, g_exp)``.
+
+    ``blocks > 1`` is the overlap decomposition: ``blocks`` independent
+    reduce-scatters of ``flat/blocks`` each, strided so block c of this
+    rank's result covers flat positions ``[me*shard + c*cs, ...)`` —
+    i.e. ``concat(blocks) == the serial shard``, element for element.
+    Same total wire bytes, ``blocks``-way scheduling freedom;
+    ``g_shard`` is then the list of block shards."""
     g_flat = pack_nonexpert(grads, flat_size)
+    g_exp = [lax.psum(g, sp) / n for g in expert_leaves(grads)]
+    if blocks > 1:
+        n_dp = lax.axis_size(dp)
+        cs = flat_size // n_dp // blocks
+        g3 = g_flat.reshape(n_dp, blocks, cs)
+        chunks = []
+        for c in range(blocks):
+            gc = g3[:, c, :].reshape(-1)
+            s = lax.psum_scatter(gc, dp, scatter_dimension=0, tiled=True)
+            chunks.append(lax.psum(s, sp) / n)
+        return chunks, g_exp
     g_shard = lax.psum_scatter(g_flat, dp, scatter_dimension=0, tiled=True)
     g_shard = lax.psum(g_shard, sp) / n
-    g_exp = [lax.psum(g, sp) / n for g in expert_leaves(grads)]
     return g_shard, g_exp
 
 
-def _zero_grad_norm(g_shard, g_exp, dp: str):
+def _zero_grad_norm(g_shard, g_exp, axes):
     """Global L2 norm of the reduced (logical) gradient under the ZeRO
-    layout: shard square-sums psum over "dp" (each rank holds 1/|dp| of
-    the flat gradient; padding slots are zero), expert leaves psum over
-    "dp" as in ``_grad_norm``.  Identical on every rank."""
-    s = lax.psum(jnp.sum(jnp.square(g_shard)), dp)
+    layout: shard square-sums psum over the sharding ``axes`` ("dp", or
+    ("dp", stage) under a pipelined plan — each rank holds a disjoint
+    slice of the flat gradient; padding slots are zero), expert leaves
+    psum over the same axes as in ``_grad_norm``.  Identical on every
+    rank.  ``g_shard`` may be the serial shard or the overlap block
+    list (block square-sums total the shard's exactly)."""
+    chunks = g_shard if isinstance(g_shard, (list, tuple)) else [g_shard]
+    s = lax.psum(sum(jnp.sum(jnp.square(c)) for c in chunks), axes)
     for g in g_exp:
-        s = s + lax.psum(jnp.sum(jnp.square(g.astype(jnp.float32))), dp)
+        s = s + lax.psum(jnp.sum(jnp.square(g.astype(jnp.float32))), axes)
     return jnp.sqrt(s)
+
+
+def _zero_flat_update(w_flat, g_shard, mu, nu, alpha, b1, b2, eps,
+                      dp: str, fused: bool):
+    """Update this rank's shard of the packed flat vector and rebuild
+    the full replicated vector via the trailing all-gather leg; returns
+    ``(new_flat, new_mu, new_nu)``.
+
+    ``g_shard`` an array: the serial schedule — one fused update on the
+    whole shard, ONE tiled all-gather.  ``g_shard`` a block list: the
+    overlap schedule — per-block update + per-block all-gather, blocks
+    independent of each other, so block i's gather can fly while block
+    i+1's update computes (and the decomposed reduce-scatters upstream
+    likewise).  The strided block layout keeps each rank's elements
+    identical to the serial schedule's, so params, moments, and
+    checkpoints are bit-identical across overlap on/off; total gather
+    wire bytes are unchanged (``k`` transfers of ``shard/k``)."""
+    n_dp = lax.axis_size(dp)
+    me = lax.axis_index(dp)
+    shard = w_flat.size // n_dp
+
+    def apply(wc, gc, mc, vc):
+        if fused:
+            nw, nm, nv = fused_adam_tree([wc], [gc], [mc], [vc],
+                                         alpha, b1, b2, eps)
+            return nw[0], nm[0], nv[0]
+        return _adam_apply(wc, mc, vc, gc, alpha, b1, b2, eps)
+
+    if not isinstance(g_shard, (list, tuple)):
+        w_shard = lax.dynamic_slice_in_dim(w_flat, me * shard, shard)
+        w_shard, mu, nu = apply(w_shard, g_shard, mu, nu)
+        return lax.all_gather(w_shard, dp, tiled=True), mu, nu
+
+    blocks = len(g_shard)
+    cs = shard // blocks
+    w_my = lax.dynamic_index_in_dim(
+        w_flat.reshape(n_dp, blocks, cs), me, 0, keepdims=False
+    )
+    mu2, nu2 = mu.reshape(blocks, cs), nu.reshape(blocks, cs)
+    gathered, new_mu, new_nu = [], [], []
+    for c in range(blocks):
+        wc, mc, vc = apply(w_my[c], g_shard[c], mu2[c], nu2[c])
+        gathered.append(lax.all_gather(wc, dp, tiled=True).reshape(n_dp, cs))
+        new_mu.append(mc)
+        new_nu.append(vc)
+    # (n_dp, blocks, cs) -> flat: position d*shard + c*cs + e — the
+    # serial layout, rebuilt from the block gathers by pure relayout
+    full = jnp.stack(gathered, axis=1).reshape(w_flat.size)
+    return full, jnp.concatenate(new_mu), jnp.concatenate(new_nu)
+
+
+def _zero_apply_update(params, opt, g_shard, g_exp, flat_size, lr, b1,
+                       b2, eps, dp: str, fused: bool):
+    """The full ZeRO parameter/optimizer update both step families
+    share (dp x sp and the pipelined plan): flat-shard Adam + trailing
+    all-gather through :func:`_zero_flat_update`, elementwise Adam on
+    the local expert leaves, repacked into a params tree shaped like
+    ``params``.  Returns ``(new_params, new_opt)``."""
+    t = opt["t"] + 1
+    alpha = adam_alpha(t, lr, b1, b2)
+    w_flat = pack_nonexpert(params, flat_size)
+    new_flat, mu_flat, nu_flat = _zero_flat_update(
+        w_flat, g_shard, opt["mu_flat"], opt["nu_flat"], alpha, b1, b2,
+        eps, dp, fused,
+    )
+    exp_w, mu_exp, nu_exp = _adam_apply(
+        expert_leaves(params), opt["mu_exp"], opt["nu_exp"], g_exp,
+        alpha, b1, b2, eps,
+    )
+    new_params = unpack_nonexpert(new_flat, exp_w, params)
+    new_opt = {
+        "mu_flat": mu_flat, "nu_flat": nu_flat,
+        "mu_exp": mu_exp, "nu_exp": nu_exp, "t": t,
+    }
+    return new_params, new_opt
 
 
 def train_step_zero_fn(cfg: TransformerConfig, lr: float = 1e-3,
@@ -196,7 +340,8 @@ def train_step_zero_fn(cfg: TransformerConfig, lr: float = 1e-3,
                        accum_steps: int = 1,
                        with_grad_norm: bool = False,
                        guard: tuple | None = None,
-                       fused: bool = True):
+                       fused: bool = True,
+                       overlap_blocks: int = 0):
     """The shard_map body: (params, opt, x, y) -> (params, opt, loss)
     (+ grad_norm when ``with_grad_norm``), with ``opt`` laid out by
     :func:`init_zero_adam_state`.
@@ -214,7 +359,13 @@ def train_step_zero_fn(cfg: TransformerConfig, lr: float = 1e-3,
 
     ``fused=False`` swaps the flat-shard update from the pallas fused
     kernel to the same elementwise expression — the A/B the trajectory
-    tests use to separate kernel drift from sharding drift."""
+    tests use to separate kernel drift from sharding drift.
+
+    ``overlap_blocks=k`` (0/1 = off) decomposes the flat reduce-scatter
+    and the trailing all-gather into ``k`` independent per-block
+    RS -> update -> AG chains (see module docstring): same total wire
+    bytes and BIT-identical results, ``k``-way scheduling freedom for
+    comm/compute overlap."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
 
@@ -241,46 +392,20 @@ def train_step_zero_fn(cfg: TransformerConfig, lr: float = 1e-3,
             loss = loss_sum / accum_steps
             grads = jax.tree.map(lambda g: g / accum_steps, g_sum)
         flat_size = zero_flat_size(nonexpert_size(params), n_dp)
-        g_shard, g_exp = _zero_grad_sync(grads, n, dp, sp, flat_size)
-        return loss, g_shard, g_exp, flat_size // n_dp
+        blocks = _overlap_blocks(overlap_blocks, flat_size // n_dp)
+        g_shard, g_exp = _zero_grad_sync(grads, n, dp, sp, flat_size,
+                                         blocks)
+        return loss, g_shard, g_exp, flat_size
 
-    def update(params, opt, g_shard, g_exp, shard_elems):
-        n_dp = lax.axis_size(dp)
-        t = opt["t"] + 1
-        alpha = adam_alpha(t, lr, b1, b2)
-        w_flat = pack_nonexpert(params, shard_elems * n_dp)
-        w_shard = lax.dynamic_slice_in_dim(
-            w_flat, lax.axis_index(dp) * shard_elems, shard_elems
-        )
-        if fused:
-            nw, nmu, nnu = fused_adam_tree(
-                [w_shard], [g_shard], [opt["mu_flat"]], [opt["nu_flat"]],
-                alpha, b1, b2, eps,
-            )
-            w_shard, mu_flat, nu_flat = nw[0], nmu[0], nnu[0]
-        else:
-            w_shard, mu_flat, nu_flat = _adam_apply(
-                w_shard, opt["mu_flat"], opt["nu_flat"], g_shard, alpha,
-                b1, b2, eps,
-            )
-        exp_w, mu_exp, nu_exp = _adam_apply(
-            expert_leaves(params), opt["mu_exp"], opt["nu_exp"], g_exp,
-            alpha, b1, b2, eps,
-        )
-        # the trailing all-gather: replicated params for the next forward
-        new_flat = lax.all_gather(w_shard, dp, tiled=True)
-        new_params = unpack_nonexpert(new_flat, exp_w, params)
-        new_opt = {
-            "mu_flat": mu_flat, "nu_flat": nu_flat,
-            "mu_exp": mu_exp, "nu_exp": nu_exp, "t": t,
-        }
-        return new_params, new_opt
+    def update(params, opt, g_shard, g_exp, flat_size):
+        return _zero_apply_update(params, opt, g_shard, g_exp, flat_size,
+                                  lr, b1, b2, eps, dp, fused)
 
     if guard is None:
         def step(params, opt, x, y):
-            loss, g_shard, g_exp, shard_elems = core(params, opt, x, y)
+            loss, g_shard, g_exp, flat_size = core(params, opt, x, y)
             new_params, new_opt = update(params, opt, g_shard, g_exp,
-                                         shard_elems)
+                                         flat_size)
             if with_grad_norm:
                 return (new_params, new_opt, loss,
                         _zero_grad_norm(g_shard, g_exp, dp))
@@ -291,14 +416,14 @@ def train_step_zero_fn(cfg: TransformerConfig, lr: float = 1e-3,
     clip_norm, spike_factor = guard
 
     def guarded_step(params, opt, x, y, ref_loss):
-        loss, g_shard, g_exp, shard_elems = core(params, opt, x, y)
+        loss, g_shard, g_exp, flat_size = core(params, opt, x, y)
         gnorm = _zero_grad_norm(g_shard, g_exp, dp)
         ok, status, clipped = _apply_guard(
             loss, gnorm, {"flat": g_shard, "exp": g_exp}, ref_loss,
             clip_norm, spike_factor, dp, sp,
         )
         up_params, up_opt = update(params, opt, clipped["flat"],
-                                   clipped["exp"], shard_elems)
+                                   clipped["exp"], flat_size)
         sel = lambda new, cur: jax.tree.map(  # noqa: E731
             lambda a, b: jnp.where(ok, a, b), new, cur
         )
@@ -322,10 +447,14 @@ def train_step_zero(
     guard: tuple | None = None,
     fused: bool = True,
     donate: bool = True,
+    overlap_blocks: int = 0,
 ):
     """Compiled ZeRO training step over ``mesh``: jit'd
     fn(params, opt, x, y) -> (params, opt, loss) with ``opt`` from
     :func:`init_zero_adam_state` sharded by :func:`zero_state_spec`.
+    ``overlap_blocks=k`` selects the decomposed (comm/compute overlap)
+    sync schedule — bit-identical results, same wire bytes, k-way
+    scheduling freedom (see :func:`train_step_zero_fn`).
     Same optional surfaces as ``train_step_adam``: ``with_grad_norm``
     appends the replicated grad-norm scalar, ``counter`` hooks the body
     for the recompile detector, ``guard=(clip_norm, spike_factor)``
@@ -346,6 +475,7 @@ def train_step_zero(
     body = train_step_zero_fn(
         cfg, lr, b1, b2, eps, sp=sp, dp=dp, accum_steps=accum_steps,
         with_grad_norm=with_grad_norm, guard=guard, fused=fused,
+        overlap_blocks=overlap_blocks,
     )
     if counter is not None:
         body = counter.wrap(body)
@@ -364,4 +494,252 @@ def train_step_zero(
         in_specs,
         out,
         donate_argnums=(1,) if donate else (),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The pipelined plan step: dp x sp x pp GPipe loss + dp-sharded ZeRO moments
+# ---------------------------------------------------------------------------
+
+
+def init_plan_zero_state(stacked, plan) -> dict:
+    """Fresh ZeRO Adam state for a PIPELINED plan's stacked params
+    (``transformer.stack_layers`` layout):
+
+    - ``mu_flat``/``nu_flat``: ``(|pp| * flat_stage,)`` f32 vectors,
+      spec ``P((pp, dp))`` — each (stage, dp) rank stores only the
+      1/|dp| shard of ITS stage's packed non-expert vector, so the
+      non-expert optimizer HBM divides by ``|pp| * |dp|`` per rank;
+    - ``mu_exp``/``nu_exp``: stacked expert-leaf moments, sharded
+      ``P(pp, ep)`` with their leaves (layer axis over stages, expert
+      axis over dp);
+    - ``t``: the replicated step count.
+
+    With ``|pp| = 1`` this is exactly :func:`init_zero_adam_state` on
+    the stacked tree."""
+    n_pp, n_dp = plan.pp_size, plan.dp_size
+    per_stage = nonexpert_size(stacked) // n_pp
+    flat = zero_flat_size(per_stage, n_dp)
+    exp = expert_leaves(stacked)
+    return {
+        "mu_flat": jnp.zeros((n_pp * flat,), jnp.float32),
+        "nu_flat": jnp.zeros((n_pp * flat,), jnp.float32),
+        "mu_exp": [jnp.zeros_like(x) for x in exp],
+        "nu_exp": [jnp.zeros_like(x) for x in exp],
+        "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def plan_zero_state_spec(cfg: TransformerConfig, plan) -> dict:
+    """PartitionSpec pytree for :func:`init_plan_zero_state`'s output —
+    built through the plan's logical-axis resolver (the pytree-path ->
+    mesh-axes mapping), so the spec follows whatever axis names the
+    plan mapped."""
+    n_exp = sum(1 for name in LAYER_LEAVES if name in EXPERT_LEAVES)
+    flat = plan.spec(("pp", "dp"))
+    exp = [plan.spec("pp", "ep")] * n_exp
+    return {
+        "mu_flat": flat,
+        "nu_flat": flat,
+        "mu_exp": exp,
+        "nu_exp": list(exp),
+        "t": P(),
+    }
+
+
+def put_plan_state(state, plan, cfg: TransformerConfig):
+    """Commit a (host or restored) plan-ZeRO state onto the plan's mesh
+    with its canonical shardings — the :func:`put_zero_state` analogue
+    for the pipelined layout (donated optimizer buffers must be
+    committed to alias in place)."""
+    spec = plan_zero_state_spec(cfg, plan)
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(plan.mesh, s), spec,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.device_put(state, shardings)
+
+
+def _pp_grad_norm(grads, dp: str, stage: str):
+    """Global L2 norm of the reduced gradient under the STACKED
+    (non-ZeRO) pp layout: every leaf is stage-sharded (different layers
+    per stage), so local square sums psum over the stage axis; expert
+    leaves additionally over dp (different experts per rank).
+    Identical on every rank."""
+
+    def leaf_sq(path, g):
+        s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        axes = (dp, stage) if _is_expert_leaf(path) else (stage,)
+        return lax.psum(s, axes)
+
+    sq = jax.tree_util.tree_map_with_path(leaf_sq, grads)
+    return jnp.sqrt(sum(jax.tree_util.tree_leaves(sq)))
+
+
+def train_step_plan_fn(cfg: TransformerConfig, n_micro: int = 2,
+                       lr: float = 1e-3, b1: float = 0.9,
+                       b2: float = 0.999, eps: float = 1e-8,
+                       sp: str = "sp", dp: str = "dp", stage: str = "pp",
+                       zero: bool = True, overlap_blocks: int = 0,
+                       with_grad_norm: bool = False,
+                       guard: tuple | None = None, fused: bool = True):
+    """The 3-axis shard_map body the ShardingPlan selects:
+    (stacked, opt, x, y) -> (stacked, opt, loss) (+ grad_norm / guard
+    outputs), composing the GPipe microbatched loss
+    (``transformer._pp_loss_fn`` — ring attention over sp, expert MoE
+    over dp, ``n_micro`` microbatches streaming over the stage axis)
+    with either
+
+    - ``zero=True``: dp-sharded ZeRO moments — each (stage, dp) rank
+      packs ITS stage's non-expert gradients flat, reduce-scatters over
+      dp (psum over the sp copy axis, all divided by
+      ``|dp|*|sp|*|pp|``), runs the fused Adam update on its shard, and
+      all-gathers within the stage.  Per-stage sync chains are disjoint
+      by construction; ``overlap_blocks=k`` further decomposes each
+      into k independent RS -> update -> AG chains (the bubble-filling
+      schedule: the flat sync drains alongside other stages' chains and
+      the scheduler's remaining work instead of serializing after the
+      pipeline flush) — same wire bytes, bit-identical results;
+    - ``zero=False``: stacked replicated-per-stage Adam moments
+      (``adam_state_spec_pp`` layout), classic ``_grad_reduce`` +
+      ``/ |pp|`` reduction.
+
+    ``guard=(clip_norm, spike_factor)``: the ft contract —
+    (stacked, opt, x, y, ref_loss) -> (..., loss, grad_norm, status)
+    with finiteness agreement extended over the stage axis, so a
+    skip-select can never diverge stages."""
+    loss_fn = _pp_loss_fn(cfg, n_micro, sp, dp, stage)
+
+    def core(params, x, y):
+        n_dp, n_pp = lax.axis_size(dp), lax.axis_size(stage)
+        n = n_dp * lax.axis_size(sp) * n_pp
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        if zero:
+            flat_size = zero_flat_size(nonexpert_size(params), n_dp)
+            blocks = _overlap_blocks(overlap_blocks, flat_size // n_dp)
+            g_shard, g_exp = _zero_grad_sync(grads, n, dp, sp, flat_size,
+                                             blocks)
+            return loss, (g_shard, g_exp, flat_size)
+        grads = _grad_reduce(grads, dp, sp)
+        if n_pp > 1:
+            grads = jax.tree.map(lambda g: g / n_pp, grads)
+        return loss, grads
+
+    def update(params, opt, payload):
+        if zero:
+            g_shard, g_exp, flat_size = payload
+            return _zero_apply_update(params, opt, g_shard, g_exp,
+                                      flat_size, lr, b1, b2, eps, dp,
+                                      fused)
+        return _adam_update(params, opt, payload, lr, b1, b2, eps)
+
+    def gnorm_of(payload):
+        if zero:
+            g_shard, g_exp, _ = payload
+            return _zero_grad_norm(g_shard, g_exp, (dp, stage))
+        return _pp_grad_norm(payload, dp, stage)
+
+    if guard is None:
+        def step(params, opt, x, y):
+            loss, payload = core(params, x, y)
+            new_params, new_opt = update(params, opt, payload)
+            if with_grad_norm:
+                return new_params, new_opt, loss, gnorm_of(payload)
+            return new_params, new_opt, loss
+
+        return step
+
+    clip_norm, spike_factor = guard
+
+    def guarded_step(params, opt, x, y, ref_loss):
+        loss, payload = core(params, x, y)
+        gnorm = gnorm_of(payload)
+        if zero:
+            g_shard, g_exp, flat_size = payload
+            ok, status, clipped = _apply_guard(
+                loss, gnorm, {"flat": g_shard, "exp": g_exp}, ref_loss,
+                clip_norm, spike_factor, dp, sp, extra_axes=(stage,),
+            )
+            payload = (clipped["flat"], clipped["exp"], flat_size)
+        else:
+            ok, status, payload = _apply_guard(
+                loss, gnorm, payload, ref_loss, clip_norm, spike_factor,
+                dp, sp, extra_axes=(stage,),
+            )
+        up_params, up_opt = update(params, opt, payload)
+        sel = lambda new, cur: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(ok, a, b), new, cur
+        )
+        return sel(up_params, params), sel(up_opt, opt), loss, gnorm, status
+
+    return guarded_step
+
+
+def train_step_plan(
+    plan,
+    cfg: TransformerConfig,
+    lr: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    zero: bool = True,
+    with_grad_norm: bool = False,
+    counter=None,
+    guard: tuple | None = None,
+    fused: bool = True,
+    donate: bool = True,
+):
+    """Compiled plan-composed training step over ``plan.mesh``: jit'd
+    fn(stacked, opt, x, y) -> (stacked, opt, loss) with the stacked
+    layout from ``transformer.stack_layers`` sharded by
+    ``param_spec_pp`` over the plan's pp/ep axes and ``opt`` from
+    :func:`init_plan_zero_state` (``zero=True``; the optimizer arg is
+    DONATED so the flat moment shards update in place — pass committed
+    state, :func:`put_plan_state`) or ``init_adam_state`` on the
+    stacked tree (``zero=False``).  Same optional surfaces as
+    ``train_step_zero``: ``with_grad_norm``, ``counter``,
+    ``guard=(clip_norm, spike_factor)``.
+
+    The overlap schedule comes from the PLAN (``plan.overlap_blocks``):
+    this is the one seam where the comm/compute-overlap policy and the
+    axis mapping travel together into the compiled program."""
+    mesh, dp, sp, stage = plan.mesh, plan.dp, plan.sp, plan.pp
+    if stage is None:
+        raise ValueError(
+            "train_step_plan needs a pipelined plan (pp=<axis name>); "
+            "a dp x sp plan trains through train_step_adam / "
+            "train_step_zero"
+        )
+    if plan.ep_axis != plan.dp:
+        raise NotImplementedError(
+            "expert parallelism rides the dp axis (EP groups == DP "
+            "groups); a distinct ep mesh axis is not supported yet"
+        )
+    _validate_pp(mesh, cfg, dp, sp, stage)
+    pspec = param_spec_pp(cfg, stage, dp)
+    ospec = (plan_zero_state_spec(cfg, plan) if zero
+             else adam_state_spec_pp(cfg, stage, dp))
+    dspec = plan.data_spec()
+    body = train_step_plan_fn(
+        cfg, plan.n_micro, lr, b1, b2, eps, sp=sp, dp=dp, stage=stage,
+        zero=zero, overlap_blocks=plan.overlap_blocks,
+        with_grad_norm=with_grad_norm, guard=guard, fused=fused,
+    )
+    if counter is not None:
+        body = counter.wrap(body)
+    if guard is not None:
+        in_specs = (pspec, ospec, dspec, dspec, P())
+        out = (pspec, ospec, P(), P(), P())
+    else:
+        in_specs = (pspec, ospec, dspec, dspec)
+        out = (
+            (pspec, ospec, P(), P()) if with_grad_norm
+            else (pspec, ospec, P())
+        )
+    return run_spmd(
+        mesh,
+        body,
+        in_specs,
+        out,
+        donate_argnums=(1,) if (donate and zero) else (),
     )

@@ -32,8 +32,12 @@ __all__ = ["Finding", "compare", "format_findings", "index_rows",
            "load_rows", "main"]
 
 #: name substrings ⇒ bigger is better
+#: ("achieved" covers the ledger-derived achieved-fraction/-rate rows
+#: of the overlap ablation, config 14 — checked before "_s"/"ratio"
+#: could mislabel them)
 _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
-           "throughput", "updates", "tokens_per", "accept", "speedup")
+           "throughput", "updates", "tokens_per", "accept", "speedup",
+           "achieved")
 #: name substrings ⇒ smaller is better (checked after _HIGHER)
 #: (note the ordering: ``accept_len_mean`` and ``spec_speedup`` match
 #: _HIGHER before "ratio"/"bytes" substrings could ever mislabel them —

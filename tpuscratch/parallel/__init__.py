@@ -25,11 +25,21 @@ sequence-parallel attention schemes:
 - ``ssm``: sequence-parallel linear recurrence — local associative scan
   plus an exclusive scan of shard aggregates (distributed Blelloch-style
   prefix structure, O(n*d_state) bytes regardless of sequence length).
+- ``plan``: the ONE ShardingPlan composing all of the above — a
+  pytree-path -> mesh-axes mapping with named dp/sp/pp(/ep) roles,
+  validated against the live mesh at construction, that the trainer and
+  the ZeRO step consume instead of hardcoded dp x sp assumptions (the
+  moral successor of the reference's cartesian-topology layer).
 """
 
 from tpuscratch.parallel.expert import expert_parallel_ffn, topk_routing  # noqa: F401
 from tpuscratch.parallel.fft import fft2_sharded, ifft2_sharded  # noqa: F401
-from tpuscratch.parallel.pipeline import bubble_fraction, pipeline_apply  # noqa: F401
+from tpuscratch.parallel.pipeline import (  # noqa: F401
+    bubble_fraction,
+    gpipe_scan,
+    pipeline_apply,
+)
+from tpuscratch.parallel.plan import ShardingPlan  # noqa: F401
 from tpuscratch.parallel.ring import ring_scan  # noqa: F401
 from tpuscratch.parallel.ring_attention import ring_attention  # noqa: F401
 from tpuscratch.parallel.ssm import ssm_scan  # noqa: F401

@@ -28,6 +28,62 @@ from jax import lax
 StageFn = Callable[[Any, jax.Array], jax.Array]
 
 
+def gpipe_scan(
+    stage_fn: Callable[[jax.Array], tuple[jax.Array, jax.Array]],
+    micro: jax.Array,
+    axis: str,
+) -> tuple[jax.Array, jax.Array]:
+    """The GPipe tick loop itself — the ONE schedule implementation both
+    :func:`pipeline_apply` and the trainer's pipelined loss
+    (``models.transformer._pp_loss_fn``) run, so the pipeline bench and
+    the training hot path measure the same code.
+
+    ``stage_fn(x) -> (y, aux)``: this rank's stage (close over its
+    parameters), shape-preserving, plus a scalar auxiliary term (the
+    MoE load-balance loss; return ``0.0`` when unused).  ``micro``:
+    (M, ...) microbatch stack, replicated across ``axis``.  Runs
+    ``M + n - 1`` ticks of the open ppermute chain and returns
+
+    - ``out``: the (M, ...) outputs of the full stage chain, replicated
+      over ``axis`` (masked psum from the last stage);
+    - ``aux``: the sum over (stage, valid tick) of ``stage_fn``'s aux
+      term — warmup/drain ticks where a stage holds no real microbatch
+      are masked out, so bubble compute never pollutes the loss.
+
+    Call inside shard_map over ``axis``.
+    """
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    M = micro.shape[0]
+    ticks = M + n - 1
+    shift = [(i, i + 1) for i in range(n - 1)]  # open chain: stage i -> i+1
+
+    out0 = jnp.zeros_like(micro)
+    act0 = jnp.zeros_like(micro[0])
+
+    def tick(state, t):
+        act, out, aux_acc = state
+        incoming = lax.ppermute(act, axis, shift) if n > 1 else act
+        inject = jnp.where(t < M, micro[jnp.clip(t, 0, M - 1)], 0.0)
+        x = jnp.where(me == 0, inject, incoming)
+        y, aux = stage_fn(x)
+        valid = jnp.logical_and(t - me >= 0, t - me < M)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+        emit = t - (n - 1)  # microbatch index leaving the last stage
+        upd = lax.dynamic_update_slice(
+            out, y[None], (jnp.clip(emit, 0, M - 1),) + (0,) * y.ndim
+        )
+        out = jnp.where((me == n - 1) & (emit >= 0), upd, out)
+        return (y, out, aux_acc), ()
+
+    (_, out, aux_acc), _ = lax.scan(
+        tick, (act0, out0, jnp.float32(0.0)), jnp.arange(ticks)
+    )
+    # only the last stage's buffer holds results; replicate it
+    out = lax.psum(jnp.where(me == n - 1, out, 0.0), axis)
+    return out, lax.psum(aux_acc, axis)
+
+
 def pipeline_apply(
     stage_fn: StageFn,
     params: Any,
@@ -44,32 +100,12 @@ def pipeline_apply(
     replicated. Call inside shard_map over ``axis``.
     """
     n = lax.axis_size(axis)
-    me = lax.axis_index(axis)
-    M = micro.shape[0]
     if n == 1:
         return jax.vmap(lambda x: stage_fn(params, x))(micro)
-    ticks = M + n - 1
-    shift = [(i, i + 1) for i in range(n - 1)]  # open chain: stage i -> i+1
-
-    out_buf = jnp.zeros_like(micro)
-    act0 = jnp.zeros_like(micro[0])
-
-    def tick(state, t):
-        act, out = state
-        incoming = lax.ppermute(act, axis, shift)
-        inject = jnp.where(t < M, micro[jnp.clip(t, 0, M - 1)], 0.0)
-        x = jnp.where(me == 0, inject, incoming)
-        y = stage_fn(params, x)
-        emit = t - (n - 1)  # microbatch index leaving the last stage
-        upd = lax.dynamic_update_slice(
-            out, y[None], (jnp.clip(emit, 0, M - 1),) + (0,) * y.ndim
-        )
-        out = jnp.where((me == n - 1) & (emit >= 0), upd, out)
-        return (y, out), ()
-
-    (_, out_buf), _ = lax.scan(tick, (act0, out_buf), jnp.arange(ticks))
-    # only the last stage's buffer holds results; replicate it
-    return lax.psum(jnp.where(me == n - 1, out_buf, 0.0), axis)
+    out, _ = gpipe_scan(
+        lambda x: (stage_fn(params, x), jnp.float32(0.0)), micro, axis
+    )
+    return out
 
 
 def bubble_fraction(n_stages: int, n_micro: int) -> float:

@@ -1,0 +1,217 @@
+"""One ShardingPlan for dp x sp x pp (x ep): the pytree -> mesh-axes layer.
+
+The reference's flagship structural idea is a single cartesian process
+topology that every kernel composes against (mpi10.cpp builds ONE
+``MPI_Cart_create`` communicator; stencil2D.h addresses every exchange
+through it).  This module is that layer for the training stack: a
+**ShardingPlan** names the mesh axes once — data parallel (``dp``),
+sequence parallel (``sp``), pipeline stages (``pp``), experts (``ep``,
+riding the dp axis in the supported EP-groups==DP-groups layout) — and
+the step builders (``models.trainer.train``, ``models.zero``) consume
+the plan instead of hardcoding a dp x sp mesh.  ``train(plan=...)``
+then composes dp x sp x pp (x ep) with ZeRO-sharded optimizer moments
+in one compiled step.
+
+Axes are validated against the live mesh AT CONSTRUCTION: a plan naming
+an axis the mesh does not have fails here with the axis named, instead
+of surfacing later as an opaque ``shard_map`` binding error three
+layers down.
+
+The plan also carries the comm/compute **overlap** policy for the
+ZeRO sync legs: ``overlap=True`` decomposes the one flat gradient
+reduce-scatter and the one trailing param all-gather into
+``prefetch_blocks`` independent per-block chains (block i's all-gather
+in flight while block i+1's update computes — the ``parallel.ring``
+hop-overlap idiom applied to the sync legs; MegaScale NSDI'24 /
+Wang et al. ASPLOS'23's decomposed-collective pattern).  Total wire
+bytes are unchanged — only the collective count/schedule moves — which
+``obs.ledger`` asserts statically (tests/test_plan.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ShardingPlan"]
+
+#: the logical axis roles a plan can map onto mesh axes
+_LOGICAL = ("dp", "sp", "pp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """pytree-path -> mesh-axes mapping with named axes dp/sp/pp(/ep).
+
+    ``dp``/``sp``/``pp``/``ep`` are MESH AXIS NAMES (strings); ``pp``
+    and ``ep`` are optional.  ``ep`` defaults to the dp axis — the
+    EP-groups==DP-groups layout the MoE dispatch is built on (different
+    dp ranks hold different experts).  ``n_micro`` is the GPipe
+    microbatch count per step when a pp axis is in play; ``overlap``
+    turns the blockwise sync decomposition on (``prefetch_blocks``
+    chains), off reproduces the serial RS -> update -> AG schedule.
+
+    The plan is the unit the checkpoint layer records: its
+    :meth:`describe` dict joins the resume identity, and a
+    mismatched-plan resume raises the same ``CommError`` contract as a
+    mismatched-|dp| ZeRO restore.
+    """
+
+    mesh: Mesh
+    dp: str = "dp"
+    sp: str = "sp"
+    pp: Optional[str] = None
+    ep: Optional[str] = None
+    n_micro: int = 1
+    overlap: bool = True
+    prefetch_blocks: int = 4
+
+    def __post_init__(self):
+        named = {"dp": self.dp, "sp": self.sp, "pp": self.pp,
+                 "ep": self.ep}
+        axis_names = tuple(self.mesh.axis_names)
+        for logical in _LOGICAL:
+            name = named[logical]
+            if name is None:
+                continue
+            if name not in axis_names:
+                raise ValueError(
+                    f"ShardingPlan {logical}={name!r} is not an axis of "
+                    f"the mesh (axes: {axis_names}) — the plan validates "
+                    f"against the live mesh at construction so this "
+                    f"surfaces here, not as a shard_map binding failure"
+                )
+        if self.n_micro < 1:
+            raise ValueError(f"n_micro must be >= 1, got {self.n_micro}")
+        if self.pp is None and self.n_micro != 1:
+            raise ValueError(
+                "n_micro > 1 is the GPipe microbatch count: it needs a "
+                "pp axis (pass pp=<stage axis name>)"
+            )
+        if self.prefetch_blocks < 1:
+            raise ValueError(
+                f"prefetch_blocks must be >= 1, got {self.prefetch_blocks}"
+            )
+
+    # -- axis sizes ----------------------------------------------------
+    @property
+    def ep_axis(self) -> str:
+        """The mesh axis carrying experts (the dp axis unless a distinct
+        ep axis was named)."""
+        return self.ep if self.ep is not None else self.dp
+
+    def axis_size(self, logical: str) -> int:
+        """|axis| of a logical role ('dp'|'sp'|'pp'|'ep'); 1 for an
+        absent pp axis."""
+        name = {"dp": self.dp, "sp": self.sp, "pp": self.pp,
+                "ep": self.ep_axis}[logical]
+        return 1 if name is None else int(self.mesh.shape[name])
+
+    @property
+    def dp_size(self) -> int:
+        return self.axis_size("dp")
+
+    @property
+    def sp_size(self) -> int:
+        return self.axis_size("sp")
+
+    @property
+    def pp_size(self) -> int:
+        return self.axis_size("pp")
+
+    @property
+    def pipelined(self) -> bool:
+        """True when this plan selects the pipelined (stacked-stage)
+        step: a pp axis with more than one stage or more than one
+        microbatch.  A pp=1, n_micro=1 plan runs the EXACT legacy
+        dp x sp program (bit-identical, test-gated)."""
+        return self.pp is not None and (self.pp_size > 1 or self.n_micro > 1)
+
+    @property
+    def overlap_blocks(self) -> int:
+        """Block count for the decomposed sync legs; 0 = serial (the
+        unchunked RS -> update -> AG schedule)."""
+        return self.prefetch_blocks if self.overlap else 0
+
+    # -- pytree-path -> mesh-axes --------------------------------------
+    def spec(self, *logical) -> P:
+        """PartitionSpec from LOGICAL axis roles: each entry is None,
+        one of 'dp'/'sp'/'pp'/'ep', or a tuple of them (sharding one
+        array dim over several mesh axes) — resolved onto this plan's
+        mesh axis names.  The one place logical roles become mesh
+        axes."""
+        table = {"dp": self.dp, "sp": self.sp, "pp": self.pp,
+                 "ep": self.ep_axis, None: None}
+
+        def resolve(entry):
+            if isinstance(entry, tuple):
+                return tuple(resolve(e) for e in entry)
+            if entry not in table:
+                raise ValueError(
+                    f"unknown logical axis {entry!r}: one of {_LOGICAL}"
+                )
+            name = table[entry]
+            if name is None and entry is not None:
+                raise ValueError(
+                    f"logical axis {entry!r} is not mapped by this plan"
+                )
+            return name
+
+        return P(*(resolve(e) for e in logical))
+
+    def tree_spec(self, tree, rule: Callable) -> object:
+        """The pytree-path -> mesh-axes mapping in tree form: build a
+        PartitionSpec pytree for ``tree`` by mapping each leaf's path
+        through ``rule(path, leaf) -> (logical axes...)`` and resolving
+        the logical roles onto this plan's mesh axes via
+        :meth:`spec`."""
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: self.spec(*rule(path, leaf)), tree
+        )
+
+    def data_spec(self, accum_steps: int = 1) -> P:
+        """Spec of a (batch, seq, d) batch — batch over dp, sequence
+        over sp (a leading unsharded microbatch axis under
+        accumulation)."""
+        return (P(self.dp, self.sp) if accum_steps == 1
+                else P(None, self.dp, self.sp))
+
+    # -- identity ------------------------------------------------------
+    def describe(self) -> dict:
+        """Normalized plan identity for checkpoint metadata: axis sizes
+        plus the microbatch schedule.  A pp=1, n_micro=1 plan describes
+        identically to the legacy (plan-less) dp x sp run — they ARE
+        the same program — so resumes interoperate; anything else
+        mismatching raises the trainer's CommError contract."""
+        return {
+            "dp": self.dp_size,
+            "sp": self.sp_size,
+            "pp": self.pp_size if self.pipelined else 1,
+            "n_micro": self.n_micro if self.pipelined else 1,
+        }
+
+    # -- programs ------------------------------------------------------
+    def pipeline_program(self, stage_fn):
+        """Compiled GPipe program over this plan's pp axis: jit'd
+        fn(stage_params, micro) -> (M, ...) outputs, stage parameters
+        sharded over pp on their leading axis.  ``bench.pipeline_bench``
+        routes here so the schedule it measures is the one the
+        trainer's pipelined loss runs (both are
+        ``parallel.pipeline.gpipe_scan``), reached through the same
+        plan validation."""
+        if self.pp is None:
+            raise ValueError(
+                "pipeline_program needs a pp axis (pass pp=<axis name>)"
+            )
+        from tpuscratch.comm import run_spmd
+        from tpuscratch.parallel.pipeline import pipeline_apply
+
+        return run_spmd(
+            self.mesh,
+            lambda W, m: pipeline_apply(stage_fn, W, m, self.pp),
+            (P(self.pp), P()),
+            P(),
+        )
