@@ -1,0 +1,286 @@
+"""tpuscratch.serve.disagg: prefill/decode split with KV-page migration.
+
+The correctness anchors:
+- greedy bit-identity: the disaggregated engine (staged prefill ->
+  ppermute page migration -> decode-side ``admit_prefilled``) emits
+  EXACTLY the monolithic engine's tokens on the 1x1 and 2x2 CPU meshes,
+  fp32 and int8 (scale planes ride the same permutation as their
+  pages), at temperature too;
+- pool hygiene: staging and decode pools both drain back to full, with
+  queueing exercised (more requests than decode slots);
+- the static wire proof: the compiled migration program's
+  collective-permute payload equals the engine's analytic
+  ``handoff_wire_bytes`` (the ledger pattern the ZeRO grad-leg and the
+  int8 cache rows use);
+- fault tolerance (the PR 3 idioms): a transient ``CommError`` at the
+  ``serve/handoff`` chaos site is retried through ``ft.retry`` and the
+  drain stays byte-identical; a persistent fault DEGRADES the handoff
+  to a local monolithic re-prefill — byte-identical again, pools clean.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tpuscratch.ft.chaos import ChaosPlan, Fault
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.obs.ledger import analyze
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import (
+    DisaggEngine,
+    Request,
+    ServeConfig,
+    ServeEngine,
+)
+
+pytestmark = pytest.mark.disagg
+
+D = 32
+
+#: monolithic baselines shared across tests — every chaos/identity test
+#: compares against the same reference drain, so it runs ONCE per
+#: (dims, workload) instead of once per test (tier-1 time budget)
+_BASE_CACHE: dict = {}
+
+
+def cfg_for(**kw):
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=D, n_heads=4, n_experts=4, d_ff=48, n_layers=2, **kw
+    )
+
+
+def mono_baseline(dims, reqs_key):
+    """Cached monolithic drain for the canonical workloads."""
+    key = (dims, reqs_key)
+    if key not in _BASE_CACHE:
+        reqs = _WORKLOADS[reqs_key]()
+        _BASE_CACHE[key] = ServeEngine(
+            mesh_for(dims), cfg_for(), scfg_for()
+        ).run(reqs)
+    return _BASE_CACHE[key]
+
+
+def scfg_for(**kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("n_pages", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq", 24)
+    kw.setdefault("vocab", 16)
+    return ServeConfig(**kw)
+
+
+def mesh_for(dims):
+    return make_mesh(dims, ("dp", "sp"),
+                     jax.devices()[: dims[0] * dims[1]])
+
+
+def mixed_requests(n=7):
+    return [
+        Request(rid=i, prompt=tuple(range(1, 2 + i % 5)),
+                max_new=1 + (i * 3) % 6)
+        for i in range(n)
+    ]
+
+
+def short_requests():
+    return [Request(rid=i, prompt=(1 + i, 2), max_new=4) for i in range(5)]
+
+
+_WORKLOADS = {"mixed": mixed_requests, "short": short_requests}
+
+
+class TestDisaggBitIdentity:
+    @pytest.mark.parametrize("dims", [(1, 1), (2, 2)])
+    def test_greedy_matches_monolithic_with_queueing(self, dims):
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for(dims)
+        reqs = mixed_requests()          # > n_slots: handoff queue works
+        base = mono_baseline(dims, "mixed")
+        d = DisaggEngine(mesh, cfg, scfg)
+        rep = d.run(reqs)
+        assert rep.outputs == base.outputs
+        assert rep.tokens_generated == base.tokens_generated
+        assert rep.degraded == 0
+        # every multi-token request went through the migration path
+        assert rep.handoffs == sum(r.max_new > 1 for r in reqs)
+        assert rep.stage_prefills == len(reqs)
+        assert rep.stage_prefill_tokens == sum(len(r.prompt) for r in reqs)
+        # both pools drain back to full
+        assert d.engine.free_pages() == [scfg.n_pages] * dims[0]
+        assert d.stage_free_pages() == d.stage_geom.n_pages
+
+    def test_int8_scale_planes_migrate(self):
+        # 2x2: the cross-group permutation is what must carry the
+        # scale planes (the 1x1 self-pair is covered by the fp32 case)
+        cfg = cfg_for()
+        scfg = scfg_for(kv_dtype="int8")
+        mesh = mesh_for((2, 2))
+        reqs = mixed_requests(5)
+        base = ServeEngine(mesh, cfg, scfg).run(reqs)
+        rep = DisaggEngine(mesh, cfg, scfg).run(reqs)
+        assert rep.outputs == base.outputs
+        assert rep.degraded == 0
+
+    @pytest.mark.slow
+    def test_temperature_stream_identical(self):
+        cfg = cfg_for()
+        scfg = scfg_for(temperature=0.8, top_k=5, seed=7)
+        mesh = mesh_for((1, 1))
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4)
+                for i in range(5)]
+        base = ServeEngine(mesh, cfg, scfg).run(reqs)
+        rep = DisaggEngine(mesh, cfg, scfg).run(reqs)
+        assert rep.outputs == base.outputs
+
+    @pytest.mark.slow
+    def test_small_stage_pool_backpressures_but_drains(self):
+        # a staging pool holding ONE prompt at a time serializes the
+        # prefill slice without losing anything
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((1, 1))
+        reqs = mixed_requests()
+        base = mono_baseline((1, 1), "mixed")
+        d = DisaggEngine(mesh, cfg, scfg, stage_pages=2)
+        rep = d.run(reqs)
+        assert rep.outputs == base.outputs
+        assert d.stage_free_pages() == 2
+
+    def test_failed_stage_prefill_recovers_without_duplicating(self):
+        # a raising staged prefill resets the (donated) staging pool;
+        # the request stays queued EXACTLY ONCE (the caller never
+        # popped it) and the replay matches the monolithic run
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((1, 1))
+        reqs = short_requests()
+        base = mono_baseline((1, 1), "short")
+        d = DisaggEngine(mesh, cfg, scfg)
+
+        class Boom(RuntimeError):
+            pass
+
+        def exploding(*a, **k):
+            raise Boom("transient stage device error")
+
+        d._stage_prefills = {8: exploding}   # reqs' prompts bucket to 8
+        for r in reqs:
+            d.submit(r)
+        with pytest.raises(Boom):
+            d.step()
+        assert d.n_queued == len(reqs)       # no duplicate requeue
+        assert d.stage_free_pages() == d.stage_geom.n_pages
+        d._stage_prefills = {}               # heal: real programs rebuild
+        rep = d.run([])
+        assert rep.outputs == base.outputs
+
+    def test_validation(self):
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((1, 1))
+        with pytest.raises(ValueError):
+            DisaggEngine(mesh, cfg, scfg, prefill_group=3)
+        with pytest.raises(ValueError):
+            DisaggEngine(mesh, cfg, dataclasses.replace(
+                scfg, prefix_share=True))
+        with pytest.raises(ValueError):
+            DisaggEngine(mesh, cfg, dataclasses.replace(
+                scfg, chunk_prefill=2))
+        d = DisaggEngine(mesh, cfg, scfg)
+        d.submit(Request(rid=0, prompt=(1,), max_new=2))
+        with pytest.raises(ValueError):
+            d.submit(Request(rid=0, prompt=(2,), max_new=2))
+        with pytest.raises(ValueError):
+            d.submit(Request(rid=1, prompt=(99,), max_new=2))
+
+
+class TestMigrationLedger:
+    def test_collective_permute_payload_matches_analytic(self):
+        # the static half of the handoff claim: the compiled migration
+        # program ships exactly the analytic per-device payload — one
+        # ppermute per cache leaf (int8: pages AND scale planes), each
+        # carrying the footprint-ceiling page table
+        cfg = cfg_for()
+        mesh = mesh_for((2, 2))
+        for kv_dtype, n_leaves in (("float32", 2), ("int8", 4)):
+            scfg = scfg_for(kv_dtype=kv_dtype)
+            d = DisaggEngine(mesh, cfg, scfg)
+            prog = d._migrate_program(1)
+            rows = jnp.zeros((2, scfg.max_pages), jnp.int32)
+            led = analyze(prog, d.engine._kv, d._stage_kv, rows, rows)
+            counts = led.counts()
+            assert counts.get("collective-permute") == n_leaves
+            payload = led.payload_bytes()["collective-permute"]
+            assert payload == d.handoff_wire_bytes
+
+    def test_migrated_pages_hold_identical_bytes(self):
+        # migration is a byte copy: the decode pool's migrated pages
+        # equal the staging pool's source pages exactly
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((1, 1))
+        d = DisaggEngine(mesh, cfg, scfg)
+        req = Request(rid=0, prompt=(1, 2, 3, 4, 5, 6), max_new=2)
+        d.submit(req)
+        staged = d._stage_prefill(d._queue[0])
+        stage_k = np.asarray(d._stage_kv["k"])
+        assert d._try_handoff(staged)
+        st = d.engine._slots[0]
+        assert st is not None and st.rid == 0
+        serve_k = np.asarray(d.engine._kv["k"])
+        n_pg = d.stage_geom.pages_for(len(req.prompt))
+        for src, dst in zip(staged.pages[:n_pg], st.pages[:n_pg]):
+            np.testing.assert_array_equal(serve_k[:, dst], stage_k[:, src])
+        d._queue.popleft()
+        d.run([])
+
+
+class TestHandoffChaos:
+    def test_transient_commerror_retried_byte_identical(self):
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((2, 2))
+        reqs = short_requests()
+        base = mono_baseline((2, 2), "short")
+        plan = ChaosPlan(0, [Fault(site="serve/handoff", at=(0,),
+                                   times=2)])
+        d = DisaggEngine(mesh, cfg, scfg, chaos=plan)
+        rep = d.run(reqs)
+        assert rep.outputs == base.outputs
+        assert rep.handoff_retries >= 1
+        assert rep.degraded == 0
+        assert plan.stats().get("serve/handoff") == 2
+
+    def test_persistent_fault_degrades_to_local_prefill(self):
+        # a never-healing migration fault for ONE rid: its handoff
+        # exhausts the retry budget and falls back to the decode
+        # engine's own monolithic prefill — byte-identical output,
+        # clean pools, everyone else unaffected
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((2, 2))
+        reqs = short_requests()
+        base = mono_baseline((2, 2), "short")
+        plan = ChaosPlan(0, [Fault(site="serve/handoff", key=2, p=1.0,
+                                   times=None)])
+        d = DisaggEngine(mesh, cfg, scfg, chaos=plan)
+        rep = d.run(reqs)
+        assert rep.outputs == base.outputs
+        assert rep.degraded == 1
+        assert rep.handoffs == 4          # the other four migrated
+        assert d.engine.free_pages() == [scfg.n_pages] * 2
+        assert d.stage_free_pages() == d.stage_geom.n_pages
+
+    @pytest.mark.slow
+    def test_all_handoffs_down_still_serves(self):
+        # total migration outage: EVERY request degrades — the system
+        # gracefully collapses into the monolithic engine
+        cfg, scfg = cfg_for(), scfg_for()
+        mesh = mesh_for((1, 1))
+        reqs = short_requests()
+        base = mono_baseline((1, 1), "short")
+        plan = ChaosPlan(0, [Fault(site="serve/handoff", p=1.0,
+                                   times=None)])
+        d = DisaggEngine(mesh, cfg, scfg, chaos=plan)
+        rep = d.run(reqs)
+        assert rep.outputs == base.outputs
+        assert rep.handoffs == 0 and rep.degraded == len(reqs)
+        assert d.engine.free_pages() == [scfg.n_pages]

@@ -98,6 +98,179 @@ def accept_friendly_prompt(length: int, vocab: int,
     return tuple((t % period) + 1 for t in range(length))
 
 
+def shared_prefix_prompts(n: int, length: int, share_ratio: float,
+                          vocab: int, seed: int = 0) -> list[tuple[int, ...]]:
+    """``n`` prompts of ``length`` tokens sharing their first
+    ``round(share_ratio * length)`` tokens — the controllable
+    system-prompt workload the prefix-sharing sweep measures (config
+    12: prefill FLOPs and fresh-KV bytes vs share ratio).
+
+    The shared prefix and each prompt's private tail are seeded draws,
+    so a sweep's workload is a pure function of its arguments; the
+    EFFECTIVE page-level share is ``floor(shared_len / page_size)``
+    full pages (sharing is full-page-aligned by construction)."""
+    import numpy as np
+
+    if not 0.0 <= share_ratio <= 1.0:
+        raise ValueError(f"share_ratio must be in [0, 1], got {share_ratio}")
+    rng = np.random.default_rng(seed)
+    shared_len = round(share_ratio * length)
+    prefix = tuple(int(t) for t in rng.integers(0, vocab, shared_len))
+    out = []
+    for _ in range(n):
+        tail = tuple(
+            int(t) for t in rng.integers(0, vocab, length - shared_len)
+        )
+        out.append(prefix + tail)
+    return out
+
+
+def bench_serve_stream(mesh, cfg, scfg, prompts, max_new: int = 8,
+                       disagg: bool = False, sink=None,
+                       warmup: bool = True) -> dict:
+    """Drain one request stream through a fresh engine, timing every
+    tick: the ADMISSION-inclusive serving measurement the steady-state
+    :func:`bench_decode` deliberately excludes.  This is where the
+    prefix-sharing and disaggregation wins live — both change what an
+    admission costs, not what a steady decode tick costs.
+
+    Returns a dict of drain-level facts: wall seconds, tokens/s,
+    per-TICK latency percentiles, and the engine report's static
+    sharing accounting (prefilled vs shared prompt tokens, fresh KV
+    bytes — exact counters, not samples).  ``disagg=True`` runs the
+    same stream through a :class:`~tpuscratch.serve.disagg.
+    DisaggEngine` and adds the handoff accounting.
+
+    ``warmup`` drains one slot-bank's worth of throwaway requests
+    first, so every compiled program the measured window touches
+    (decode, context/bucket prefill, per-group migration) is already
+    built — compile time must not masquerade as admission latency.
+    Warmup pages all free back (and their prefix-trie entries die with
+    them), so the measured stream's sharing starts cold."""
+    from tpuscratch.serve import DisaggEngine, Request, ServeEngine
+
+    eng = (
+        DisaggEngine(mesh, cfg, scfg, sink=sink) if disagg
+        else ServeEngine(mesh, cfg, scfg, sink=sink)
+    )
+    if warmup:
+        eng.run([
+            Request(rid=900_000 + i, prompt=tuple(prompts[0]), max_new=2)
+            for i in range(scfg.n_slots)
+        ])
+    for i, p in enumerate(prompts):
+        eng.submit(Request(rid=i, prompt=tuple(p), max_new=max_new))
+    inner = eng.engine if disagg else eng
+    ptok0, stok0 = inner.prefill_tokens, inner.shared_tokens
+    cow0, fresh0 = inner.cow_pages, inner.fresh_kv_bytes
+    stage0 = eng._stage_tokens if disagg else 0
+    hand0 = eng._handoffs if disagg else 0
+    outputs = {}
+    times = []
+    t0 = time.perf_counter()
+    max_steps = 100_000   # the engines' own did-not-drain guard
+    while eng.n_queued or eng.n_active or getattr(eng, "n_staged", 0):
+        if len(times) >= max_steps:
+            raise RuntimeError(
+                f"stream did not drain in {max_steps} ticks "
+                f"({eng.n_queued} queued, {eng.n_active} active)"
+            )
+        t1 = time.perf_counter()
+        for rid, toks in eng.step():
+            outputs[rid] = toks
+        times.append(time.perf_counter() - t1)
+    wall = time.perf_counter() - t0
+    tokens = sum(len(t) for t in outputs.values())
+    prefill_tokens = inner.prefill_tokens - ptok0
+    shared_tokens = inner.shared_tokens - stok0
+    fresh_bytes = inner.fresh_kv_bytes - fresh0
+    out = {
+        "requests": len(prompts),
+        "tokens": tokens,
+        "wall_s": wall,
+        "tokens_per_s": tokens / wall if wall else 0.0,
+        "p50_tick_s": percentile(times, 50),
+        "p99_tick_s": percentile(times, 99),
+        "prefill_tokens": prefill_tokens,
+        "shared_tokens": shared_tokens,
+        "cow_pages": inner.cow_pages - cow0,
+        "fresh_kv_bytes": fresh_bytes,
+        "fresh_kv_bytes_per_token": fresh_bytes / tokens if tokens else 0.0,
+        "prefill_frac": (
+            prefill_tokens / max(1, prefill_tokens + shared_tokens)
+        ),
+        "outputs": tuple(sorted(outputs.items())),
+    }
+    if disagg:
+        out["prefill_tokens"] = eng._stage_tokens - stage0
+        out["prefill_frac"] = 1.0
+        out["handoffs"] = eng._handoffs - hand0
+        out["degraded"] = eng._degraded
+        out["handoff_wire_bytes"] = (
+            eng.handoff_wire_bytes * (eng._handoffs - hand0)
+        )
+    return out
+
+
+def bench_chunk_longmix(mesh, cfg, scfg, chunk: int, long_len: int = 32,
+                        n_resident: int = None, max_new: int = 24) -> dict:
+    """The chunked-prefill p99 claim, measured: resident short-prompt
+    streams decode while ONE long prompt arrives mid-stream; per-tick
+    latency (== resident per-token latency) is compared between the
+    monolithic engine (the long prefill lands inside one tick — the
+    p99 spike) and ``chunk_prefill=chunk`` (the same compute spread
+    over ``ceil(long_len / chunk)`` ticks).  Greedy outputs are
+    asserted IDENTICAL across the two runs — the p99 win is scheduling,
+    not numerics."""
+    import dataclasses as _dc
+
+    from tpuscratch.serve import Request, ServeEngine
+
+    n_res = (scfg.n_slots - 1) if n_resident is None else n_resident
+    long_prompt = tuple(1 + t % (scfg.vocab - 1) for t in range(long_len))
+
+    def drive(sc) -> tuple[dict, list[float]]:
+        eng = ServeEngine(mesh, cfg, sc)
+        # warmup drain compiles EVERY program the measured window will
+        # touch (short bucket, long bucket / context chunks, decode) —
+        # compile time must not masquerade as the p99 being measured
+        eng.run([Request(rid=900_000, prompt=(1, 2), max_new=2),
+                 Request(rid=900_001, prompt=long_prompt, max_new=2)])
+        for i in range(n_res):
+            eng.submit(Request(rid=i, prompt=(1 + i % 4, 2), max_new=max_new))
+        outputs, times = {}, []
+        arrived = False
+        while eng.n_queued or eng.n_active:
+            if len(times) >= 100_000:
+                raise RuntimeError("long-mix stream did not drain")
+            # the long prompt arrives once the residents are in steady
+            # decode
+            if not arrived and len(times) == 4:
+                eng.submit(Request(rid=10_000, prompt=long_prompt,
+                                   max_new=4))
+                arrived = True
+            t0 = time.perf_counter()
+            for rid, toks in eng.step():
+                outputs[rid] = toks
+            times.append(time.perf_counter() - t0)
+        return outputs, times
+
+    base_out, base_t = drive(scfg)
+    chunk_out, chunk_t = drive(_dc.replace(scfg, chunk_prefill=chunk))
+    if base_out != chunk_out:
+        raise RuntimeError("chunked long-mix outputs diverged from "
+                           "monolithic — the p99 comparison is void")
+    return {
+        "long_len": long_len,
+        "chunk": chunk,
+        "p99_s_mono": percentile(base_t, 99),
+        "p99_s_chunked": percentile(chunk_t, 99),
+        "p99_ratio": percentile(chunk_t, 99) / percentile(base_t, 99),
+        "max_s_mono": max(base_t),
+        "max_s_chunked": max(chunk_t),
+    }
+
+
 def bench_decode(
     mesh,
     cfg,
@@ -267,6 +440,18 @@ def main(argv=None) -> int:
                          "(0 = off); sweeps use an accept-friendly "
                          "periodic prompt so the amortization regime "
                          "is what gets measured")
+    ap.add_argument("--share-ratio", default=None, metavar="R[,R...]",
+                    help="run the PREFIX-SHARING stream workload at "
+                         "these prompt share ratios (comma-separated, "
+                         "e.g. 0,0.5,0.9) instead of the steady-state "
+                         "sweep: shared-prefix prompts, prefix_share "
+                         "engines, admission-inclusive timing — the "
+                         "prefill-FLOPs/fresh-KV-bytes-vs-ratio curve")
+    ap.add_argument("--chunk-prefill", type=int, default=0, metavar="N",
+                    help="prefill chunk tokens per tick (0 = off): with "
+                         "--share-ratio it rides the stream engines; "
+                         "alone it runs the long-prompt-mix p99 "
+                         "comparison (monolithic vs chunked)")
     ap.add_argument("--cpu-devices", type=int, default=0)
     args = ap.parse_args(argv)
     if args.cpu_devices:
@@ -281,6 +466,59 @@ def main(argv=None) -> int:
     cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
     scfg = dataclasses.replace(scfg, kv_dtype=args.kv_dtype,
                                spec_k=args.spec)
+
+    if args.share_ratio is not None:
+        ratios = [float(r) for r in args.share_ratio.split(",")]
+        # >= 4 pages of prompt so the swept ratios differ at page
+        # granularity (sharing is full-page-aligned)
+        length = max(4 * scfg.page_size, kwargs.get("prompt_len", 8))
+        n_req = scfg.n_slots * 2
+        max_new = 8
+        scfg = dataclasses.replace(
+            scfg, prefix_share=True, chunk_prefill=args.chunk_prefill,
+            max_seq=max(scfg.max_seq, length + max_new),
+        )
+        rows = []
+        for r in ratios:
+            prompts = shared_prefix_prompts(n_req, length, r, scfg.vocab)
+            row = bench_serve_stream(mesh, cfg, scfg, prompts,
+                                     max_new=max_new)
+            row.pop("outputs")
+            row["share_ratio"] = r
+            print(f"# share {r}: prefill_frac "
+                  f"{row['prefill_frac']:.3f}, fresh "
+                  f"{row['fresh_kv_bytes_per_token']:.0f} B/token, "
+                  f"p99 tick {row['p99_tick_s'] * 1e3:.3f} ms",
+                  file=sys.stderr)
+            rows.append(row)
+        payload = {"platform": jax.default_backend(),
+                   "share_sweep": rows}
+        print(json.dumps(payload))
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(json.dumps(payload) + "\n")
+        return 0
+
+    if args.chunk_prefill:
+        long_len = 256 if on_tpu else 32
+        row = bench_chunk_longmix(
+            mesh, cfg,
+            dataclasses.replace(
+                scfg, max_seq=max(scfg.max_seq, long_len + 32),
+                n_pages=max(scfg.n_pages, 64),
+            ),
+            chunk=args.chunk_prefill,
+            long_len=long_len,
+        )
+        print(f"# long-mix p99: mono {row['p99_s_mono'] * 1e3:.3f} ms -> "
+              f"chunked {row['p99_s_chunked'] * 1e3:.3f} ms "
+              f"({row['p99_ratio']:.3f}x)", file=sys.stderr)
+        payload = {"platform": jax.default_backend(), "longmix": row}
+        print(json.dumps(payload))
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(json.dumps(payload) + "\n")
+        return 0
     if args.spec:
         kwargs["prompt"] = accept_friendly_prompt(
             kwargs.pop("prompt_len", 8), scfg.vocab

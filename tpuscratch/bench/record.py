@@ -826,6 +826,123 @@ def config12_decode(out: list, obs_path=None) -> None:
             detail=r_spec.summary(),
         )
 
+        # disaggregated serving rows (ISSUE 8).  serve_prefix_share:
+        # the share-ratio sweep's STATIC accounting — the fraction of
+        # prompt tokens actually prefilled and the fresh-KV bytes per
+        # emitted token are exact engine counters, so their monotone
+        # drop with the share ratio is a proof, not a measurement —
+        # plus the chunked-prefill long-mix p99 comparison (identical
+        # greedy outputs asserted inside the bench; the p99 drop is
+        # pure scheduling).  serve_disagg_tokens_per_s: the same
+        # stream drained monolithic vs prefill/decode-split, with the
+        # static per-handoff migration payload beside it.
+        from tpuscratch.bench.decode_bench import (
+            bench_chunk_longmix,
+            bench_serve_stream,
+            shared_prefix_prompts,
+        )
+
+        length = max(4 * scfg.page_size, kwargs.get("prompt_len", 8))
+        max_new = 8
+        stream_scfg = _dc.replace(
+            scfg, max_seq=max(scfg.max_seq, length + max_new)
+        )
+        share_scfg = _dc.replace(stream_scfg, prefix_share=True)
+        share_rows = {}
+        for ratio in (0.0, 0.5, 0.9):
+            prompts = shared_prefix_prompts(
+                scfg.n_slots * 2, length, ratio, scfg.vocab
+            )
+            share_rows[ratio] = bench_serve_stream(
+                mesh, cfg, share_scfg, prompts, max_new=max_new, sink=sink
+            )
+            print(
+                f"# share {ratio}: prefill_frac "
+                f"{share_rows[ratio]['prefill_frac']:.3f}, fresh "
+                f"{share_rows[ratio]['fresh_kv_bytes_per_token']:.0f} "
+                f"B/token, p99 "
+                f"{share_rows[ratio]['p99_tick_s'] * 1e3:.2f} ms",
+                file=sys.stderr,
+            )
+        long_len = 256 if on_tpu else 32
+        longmix = bench_chunk_longmix(
+            mesh, cfg,
+            _dc.replace(scfg, max_seq=max(scfg.max_seq, long_len + 32),
+                        n_pages=max(scfg.n_pages, 64)),
+            chunk=scfg.page_size,
+            long_len=long_len,
+        )
+        print(
+            f"# long-mix p99: mono {longmix['p99_s_mono'] * 1e3:.2f} ms "
+            f"-> chunked {longmix['p99_s_chunked'] * 1e3:.2f} ms "
+            f"({longmix['p99_ratio']:.3f}x)", file=sys.stderr,
+        )
+        _emit(
+            out,
+            config=12,
+            metric="serve_prefix_share",
+            prefill_frac_r50=share_rows[0.5]["prefill_frac"],
+            prefill_frac_r90=share_rows[0.9]["prefill_frac"],
+            fresh_kv_bytes_per_token_r0=share_rows[0.0][
+                "fresh_kv_bytes_per_token"],
+            fresh_kv_bytes_per_token_r50=share_rows[0.5][
+                "fresh_kv_bytes_per_token"],
+            fresh_kv_bytes_per_token_r90=share_rows[0.9][
+                "fresh_kv_bytes_per_token"],
+            p99_s_r0=share_rows[0.0]["p99_tick_s"],
+            p99_s_r90=share_rows[0.9]["p99_tick_s"],
+            p99_s_longmix_mono=longmix["p99_s_mono"],
+            p99_s_longmix_chunked=longmix["p99_s_chunked"],
+            longmix_p99_ratio=longmix["p99_ratio"],
+            detail=(
+                f"prefill_frac 1 -> "
+                f"{share_rows[0.5]['prefill_frac']:.3f} -> "
+                f"{share_rows[0.9]['prefill_frac']:.3f} at share "
+                f"0/0.5/0.9; long-mix p99 "
+                f"{longmix['p99_ratio']:.3f}x chunked"
+            ),
+        )
+
+        prompts0 = shared_prefix_prompts(
+            scfg.n_slots * 2, length, 0.0, scfg.vocab
+        )
+        mono_stream = bench_serve_stream(
+            mesh, cfg, stream_scfg, prompts0, max_new=max_new, sink=sink
+        )
+        disagg_stream = bench_serve_stream(
+            mesh, cfg, stream_scfg, prompts0, max_new=max_new,
+            disagg=True, sink=sink,
+        )
+        if disagg_stream["outputs"] != mono_stream["outputs"]:
+            raise RuntimeError(
+                "disaggregated outputs diverged from monolithic"
+            )
+        print(
+            f"# disagg: {disagg_stream['tokens_per_s']:.3e} tok/s vs "
+            f"{mono_stream['tokens_per_s']:.3e} monolithic, "
+            f"{disagg_stream['handoffs']} handoffs, "
+            f"{disagg_stream['degraded']} degraded", file=sys.stderr,
+        )
+        _emit(
+            out,
+            config=12,
+            metric="serve_disagg_tokens_per_s",
+            value=disagg_stream["tokens_per_s"],
+            mono_tokens_per_s=mono_stream["tokens_per_s"],
+            p99_s=disagg_stream["p99_tick_s"],
+            handoff_bytes_per_token=(
+                disagg_stream["handoff_wire_bytes"]
+                / max(1, disagg_stream["tokens"])
+            ),
+            handoffs=disagg_stream["handoffs"],
+            degraded=disagg_stream["degraded"],
+            detail=(
+                f"{disagg_stream['handoffs']} handoffs, "
+                f"{disagg_stream['degraded']} degraded, "
+                f"{disagg_stream['handoff_wire_bytes']:.0f} B shipped"
+            ),
+        )
+
 
 def config13_zero_train(out: list, iters: int = 3) -> None:
     """Replicated vs ZeRO-sharded training (ISSUE 4): tokens/s of the

@@ -70,6 +70,22 @@ def send_pairs(x, axis: str, pairs: Sequence[tuple[int, int]]):
     return lax.ppermute(x, axis, list(pairs))
 
 
+def send_tree(tree, axis: str, pairs: Sequence[tuple[int, int]]):
+    """:func:`send_pairs` over a whole pytree: every leaf rides the same
+    static permutation (one ppermute per leaf; XLA schedules them as
+    independent nonblocking transfers and the consumer's data
+    dependencies are the waitall — the mpi5.cpp Isend/Irecv/Waitall
+    shape for a multi-buffer payload).  The serve-side KV-page handoff
+    ships ``{k, v[, k_scale, v_scale]}`` page payloads this way: the
+    int8 scale planes travel in the SAME permutation as their pages, so
+    a migrated page can never arrive separated from its dequantization
+    metadata."""
+    import jax
+
+    pairs = list(pairs)
+    return jax.tree.map(lambda t: lax.ppermute(t, axis, pairs), tree)
+
+
 def pingpong(x, axis: str, a: int = 0, b: int = 1, rounds: int = 1):
     """Bounce a value between ranks a and b ``rounds`` times (one round =
     a->b->a). The latency-probe primitive (test-benchmark pingpong).

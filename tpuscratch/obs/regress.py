@@ -41,9 +41,12 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 #: name substrings ⇒ smaller is better (checked after _HIGHER)
 #: (note the ordering: ``accept_len_mean`` and ``spec_speedup`` match
 #: _HIGHER before "ratio"/"bytes" substrings could ever mislabel them —
-#: accepted draft length and speculative speedup regress DOWNWARD)
+#: accepted draft length and speculative speedup regress DOWNWARD;
+#: ``prefill_frac`` is the prefix-sharing row's fraction of prompt
+#: tokens actually prefilled and ``degraded`` counts disaggregated
+#: handoffs that fell back to local prefill — both regress UPWARD)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
-          "overhead", "bubble", "crossover")
+          "overhead", "bubble", "crossover", "prefill_frac", "degraded")
 #: fields that are identity/configuration, never compared
 _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
          "flops_per_token", "degenerate"}

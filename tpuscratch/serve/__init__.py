@@ -1,19 +1,29 @@
 """tpuscratch.serve — sharded autoregressive inference.
 
 The serving layer over the training stack: a block-paged KV cache
-sharded on the SAME (dp, sp) mesh the train step uses (kvcache), a
+sharded on the SAME (dp, sp) mesh the train step uses (kvcache, with
+per-page refcounts + a prefix trie for cross-request sharing), a
 cached single-token decode step numerically equivalent to the full
 forward (decode + ops.attention.decode_attention), deterministic
-per-request sampling (sampling), and a continuous-batching engine with
-free-page-watermark admission and zero steady-state recompiles (engine).
+per-request sampling (sampling), a continuous-batching engine with
+free-page-watermark admission and zero steady-state recompiles
+(engine; opt-in prefix sharing and chunked prefill), and a
+prefill/decode-disaggregated front end shipping finished KV pages
+between mesh slices through comm/p2p (disagg).
 """
 
 from tpuscratch.serve.decode import (  # noqa: F401
     CompileCounter,
+    build_context_prefill,
     build_decode_step,
     build_prefill,
     build_verify_step,
     propose_draft,
+)
+from tpuscratch.serve.disagg import (  # noqa: F401
+    DisaggEngine,
+    DisaggReport,
+    build_migrate,
 )
 from tpuscratch.serve.engine import (  # noqa: F401
     GenerateReport,
@@ -25,6 +35,7 @@ from tpuscratch.serve.engine import (  # noqa: F401
 from tpuscratch.serve.kvcache import (  # noqa: F401
     CacheGeometry,
     PageAllocator,
+    PrefixCache,
     dequantize_pages,
     init_kv_cache,
     kv_cache_spec,

@@ -405,6 +405,61 @@ def build_verify_step(mesh: Mesh, cfg: TransformerConfig,
     )
 
 
+def build_context_prefill(mesh: Mesh, cfg: TransformerConfig,
+                          geom: CacheGeometry, chunk: int,
+                          dp: str = "dp", sp: str = "sp",
+                          counter: CompileCounter | None = None,
+                          quantized: bool = False):
+    """Compiled CONTEXT prefill over ``mesh``: a slot-banked program
+    scoring up to ``chunk`` new prompt tokens per slot against the
+    slot's already-cached prefix — jit'd fn(params, kv, x (B, chunk, d),
+    page_tables, write_pages (B, chunk), write_offs (B, chunk),
+    seq_lens) -> (out (B, chunk, d), kv'), cache donated.
+
+    This is :func:`verify_step_fn`'s program pointed at prefill instead
+    of speculation — the realization that chunked prefill and
+    speculative verify are the SAME compiled shape: K queued tokens per
+    slot, K/V written before attention, position ``j`` ragged-causally
+    attending the first ``seq_len + j`` cache entries
+    (``ops.attention.verify_attention``: one page gather amortized over
+    the whole chunk).  Two serving layers ride it:
+
+    - **chunked prefill**: a long prompt advances ``chunk`` tokens per
+      engine tick instead of monopolizing one tick for its whole
+      length, so resident decode streams keep their per-token cadence
+      (``seq_lens = n_cached + 1`` makes the first chunk degenerate to
+      plain causal self-attention — nothing cached yet);
+    - **prefix-shared admission**: a prompt whose full-page prefix was
+      matched in the :class:`~tpuscratch.serve.kvcache.PrefixCache`
+      prefills only its TAIL through this program, attending the
+      shared pages it never recomputed.
+
+    Tokens past a slot's real chunk length carry the out-of-range write
+    sentinel (drop-mode scatter / quantized-write drop) and zero
+    vectors, and the token-level idle-last MoE permutation keeps that
+    padding out of expert capacity competition — the verify step's
+    contract, unchanged.  ``chunk >= 1``: unlike ``build_verify_step``
+    (which needs a draft to verify), a one-token chunk is legitimate —
+    it is exactly the re-score step a fully-shared aligned prompt pays
+    for its last-position logits."""
+    if chunk < 1:
+        raise ValueError(f"chunk must be >= 1, got {chunk}")
+    check_serve_mesh(mesh, cfg, dp, sp)
+    _check_geometry(cfg, geom)
+    body = verify_step_fn(cfg, chunk - 1, sp=sp, dp=dp, quantized=quantized)
+    if counter is not None:
+        body = counter.wrap(body)
+    pspec = param_spec(cfg, dp)
+    kspec = kv_cache_spec(dp, sp, quantized)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, kspec, P(dp), P(dp), P(dp), P(dp), P(dp)),
+        (P(dp), kspec),
+        donate_argnums=(1,),
+    )
+
+
 def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
                sp: str = "sp", dp: str = "dp", quantized: bool = False):
     """The prefill shard_map body: (params, kv, x, pages, n_tok) ->

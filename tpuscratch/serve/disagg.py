@@ -1,0 +1,545 @@
+"""Prefill/decode disaggregation: staged prefill, KV-page migration
+over ``comm/p2p``, and a handoff queue in front of the decode engine.
+
+The DistServe (OSDI '24) split on this framework's mesh: prefill and
+decode have opposite resource shapes — prefill is compute-bound and
+bursty, decode is bandwidth-bound and latency-sensitive — so a
+monolithic engine lets every long admission perturb every resident
+stream.  This module separates them into two POOLS on the same mesh:
+
+- **prefill slice**: prompts prefill into a STAGING page pool whose
+  writes land on one designated dp group (``prefill_group``) — the
+  mpi9.cpp sub-communicator idea (a rank subset owning one phase of the
+  computation) expressed as the dp-group ownership the paged cache
+  already has (``build_prefill``'s owner-local drop-mode writes);
+- **handoff**: finished prompt pages (and, for int8 pools, their scale
+  planes) ship from the staging pool into the decode engine's pool
+  through ONE compiled migration program per destination group — a
+  ``lax.ppermute`` pair transfer over the dp axis
+  (``comm.p2p.send_tree``), the reference's nonblocking neighbor
+  exchange (mpi5.cpp Isend/Irecv/Waitall) applied to cache migration;
+- **decode slice**: the unchanged :class:`~tpuscratch.serve.engine.
+  ServeEngine` decodes migrated requests via ``admit_prefilled`` —
+  its own prefill programs never run for a handed-off request.
+
+Migration is EXACT (ppermute moves bytes, the staged pages hold the
+same projections monolithic prefill writes, and the first token was
+sampled from the same ``request_key(seed, rid, 0)`` draw), so greedy
+output is bit-identical to the monolithic engine — test-gated on 1x1
+and 2x2 CPU meshes (on 1x1 the permutation is the self-pair
+``[(0, 0)]``: the handoff machinery runs unchanged, the wire is loop-
+back).  A mid-handoff failure (a :class:`~tpuscratch.runtime.errors.
+CommError`, chaos site ``serve/handoff``) is retried through
+``ft.retry``; a handoff that exhausts its retry budget DEGRADES: the
+staged pages are dropped and the request re-enters the decode engine's
+own queue for a LOCAL monolithic prefill — graceful degradation to the
+single-engine path, with byte-identical output (the PR 3 replay
+contract).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tpuscratch.comm import run_spmd
+from tpuscratch.comm.p2p import send_tree
+from tpuscratch.ft.retry import RetryPolicy, retry
+from tpuscratch.serve.decode import build_prefill
+from tpuscratch.serve.engine import (
+    GenerateReport,
+    Request,
+    ServeConfig,
+    ServeEngine,
+    _bucket,
+)
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.serve.kvcache import (
+    CacheGeometry,
+    PageAllocator,
+    init_kv_cache,
+    kv_cache_spec,
+)
+from tpuscratch.serve.sampling import request_key
+
+#: the handoff retry contract: absorb transient migration faults fast,
+#: then degrade to the local monolithic path within ~a tenth of a second
+DEFAULT_HANDOFF_RETRY = RetryPolicy(max_attempts=3, base_s=0.01, max_s=0.1)
+
+
+def build_migrate(mesh: Mesh, stage_geom: CacheGeometry,
+                  src_group: int, dst_group: int,
+                  dp: str = "dp", sp: str = "sp",
+                  quantized: bool = False):
+    """Compiled KV-page migration over ``mesh``: jit'd
+    fn(serve_kv, stage_kv, src_rows, dst_rows) -> serve_kv', with the
+    serve pool donated (pages land in place).
+
+    ``src_rows``/``dst_rows`` are ``(dp_size, n_rows)`` int32 page-id
+    tables in the engine's owner-row idiom: real LOCAL ids on the
+    participating group's row, the pool-size sentinel everywhere else
+    (and on padding entries past the request's true page count).  The
+    body gathers the staged page payloads — every cache leaf, so int8
+    scale planes ride the same transfer — ships them ``src_group ->
+    dst_group`` with ONE static ppermute pair per leaf
+    (``comm.p2p.send_tree``), and scatters them into the destination
+    group's serve pool with drop-mode writes (sentinel rows vanish,
+    exactly like prefill's owner-local page writes).
+
+    The row width is static (the engine passes its page-footprint
+    ceiling ``max_pages``), so there is ONE migration program per
+    destination group — migration can never recompile in steady state,
+    at the cost of shipping the footprint ceiling rather than the exact
+    page count (the ledger test pins that payload analytically)."""
+    if not 0 <= src_group < mesh.shape[dp]:
+        raise ValueError(f"src_group {src_group} not in mesh dp axis")
+    if not 0 <= dst_group < mesh.shape[dp]:
+        raise ValueError(f"dst_group {dst_group} not in mesh dp axis")
+    pair = [(src_group, dst_group)]
+
+    def body(serve_kv, stage_kv, src_rows, dst_rows):
+        src = jnp.clip(src_rows[0], 0, stage_geom.n_pages - 1)
+        dst = dst_rows[0]
+        payload = {
+            name: leaf[:, src] for name, leaf in stage_kv.items()
+        }
+        shipped = send_tree(payload, dp, pair)
+        return {
+            name: serve_kv[name].at[:, dst].set(shipped[name], mode="drop")
+            for name in serve_kv
+        }
+
+    kspec = kv_cache_spec(dp, sp, quantized)
+    return run_spmd(
+        mesh,
+        body,
+        (kspec, kspec, P(dp), P(dp)),
+        kspec,
+        donate_argnums=(0,),
+    )
+
+
+@dataclasses.dataclass
+class _Staged:
+    """One prefilled request waiting in the handoff queue."""
+
+    req: Request
+    pages: list[int]        # staging-pool ids (prefill group local)
+    first_token: int        # sampled at prefill (stream position 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class DisaggReport:
+    """A disaggregated drain: the decode engine's report plus the
+    prefill-slice and handoff accounting."""
+
+    engine: GenerateReport          # decode-side (outputs live here)
+    stage_prefills: int             # prompts prefilled on the slice
+    stage_prefill_tokens: int
+    handoffs: int                   # page migrations that landed
+    migrated_pages: int             # real pages shipped (excl. padding)
+    handoff_retries: int            # failed attempts that were retried
+    degraded: int                   # handoffs that fell back to local
+    handoff_wire_bytes: float       # static per-migration payload x handoffs
+
+    @property
+    def outputs(self):
+        return self.engine.outputs
+
+    @property
+    def completed(self) -> int:
+        return self.engine.completed
+
+    @property
+    def tokens_generated(self) -> int:
+        return self.engine.tokens_generated
+
+
+class DisaggEngine:
+    """Prefill/decode-disaggregated serving over one mesh.
+
+    Wraps an UNCHANGED :class:`ServeEngine` (the decode slice) with a
+    staging prefill pool owned by dp group ``prefill_group`` and a
+    handoff queue.  ``submit`` queues requests; each ``step`` (1)
+    prefills queued prompts into the staging pool, (2) migrates
+    finished prompt pages into decode groups that have a free slot +
+    pages, (3) runs one decode tick.  ``run`` drains.
+
+    The decode engine's admission machinery is bypassed for handed-off
+    requests (``admit_prefilled``) but fully alive: a handoff that
+    exhausts its migration retries degrades into ``engine.submit`` — a
+    local monolithic prefill — so disaggregation can only ever ADD a
+    path, never lose a request.
+
+    ``stage_pages`` sizes the staging pool (default: the serve pool's
+    ``n_pages``); it bounds how far prefill can run ahead of decode —
+    the disaggregation headroom knob."""
+
+    def __init__(self, mesh: Mesh, cfg: TransformerConfig,
+                 scfg: ServeConfig, params: Optional[dict] = None,
+                 embed=None, dp: str = "dp", sp: str = "sp",
+                 sink=None, chaos=None, recorder=None,
+                 prefill_group: int = 0,
+                 stage_pages: Optional[int] = None,
+                 handoff_retry: RetryPolicy = DEFAULT_HANDOFF_RETRY):
+        if scfg.prefix_share or scfg.chunk_prefill:
+            raise ValueError(
+                "DisaggEngine stages MONOLITHIC prefills; run prefix "
+                "sharing / chunked prefill on the ServeEngine directly"
+            )
+        self.engine = ServeEngine(
+            mesh, cfg, scfg, params=params, embed=embed, dp=dp, sp=sp,
+            sink=sink, chaos=chaos, recorder=recorder,
+        )
+        self.mesh, self.cfg, self.scfg = mesh, cfg, scfg
+        self._dp, self._sp = dp, sp
+        self._dp_size = mesh.shape[dp]
+        if not 0 <= prefill_group < self._dp_size:
+            raise ValueError(
+                f"prefill_group {prefill_group} not in [0, {self._dp_size})"
+            )
+        self.prefill_group = prefill_group
+        self._quantized = self.engine._quantized
+        self.stage_geom = CacheGeometry(
+            cfg.n_layers, stage_pages or scfg.n_pages, scfg.page_size,
+            cfg.n_heads, cfg.d_head,
+        )
+        self._stage_kv = self._fresh_stage_kv()
+        self._stage_alloc = PageAllocator(self.stage_geom.n_pages)
+        self._stage_prefills: dict[int, object] = {}  # bucket -> program
+        self._migrates: dict[int, object] = {}        # dst group -> program
+        self._queue: collections.deque[Request] = collections.deque()
+        self._handoff: collections.deque[_Staged] = collections.deque()
+        self._seen: set[int] = set()
+        self._chaos = chaos
+        self._retry = handoff_retry
+        self._stage_count = 0
+        self._stage_tokens = 0
+        self._handoffs = 0
+        self._migrated_pages = 0
+        self._retried = 0
+        self._degraded = 0
+        self._stage_s = 0.0
+
+    # ---- introspection --------------------------------------------------
+
+    @property
+    def n_staged(self) -> int:
+        """Requests prefilled and waiting in the handoff queue."""
+        return len(self._handoff)
+
+    @property
+    def n_queued(self) -> int:
+        return len(self._queue) + self.engine.n_queued
+
+    @property
+    def n_active(self) -> int:
+        return self.engine.n_active
+
+    def stage_free_pages(self) -> int:
+        return self._stage_alloc.n_free
+
+    @property
+    def handoff_wire_bytes(self) -> float:
+        """Static payload bytes ONE migration ships per device: the
+        footprint-ceiling (``max_pages``) page payload of every cache
+        leaf at the device-local head slice — exactly the
+        collective-permute payload the obs ledger reads off the
+        compiled migration program (test-pinned)."""
+        M = self.scfg.max_pages
+        sp_size = self.mesh.shape[self._sp]
+        total = 0.0
+        for leaf in self._stage_kv.values():
+            # elements one page id drags across all layers, heads local
+            per_page = (leaf.size // leaf.shape[1]) / sp_size
+            total += per_page * leaf.dtype.itemsize * M
+        return total
+
+    # ---- request lifecycle ----------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Validate and queue for the prefill slice (the decode engine's
+        validation rules, applied before staging)."""
+        if req.max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {req.max_new}")
+        if req.rid < 0:
+            raise ValueError(f"rid must be >= 0, got {req.rid}")
+        if not req.prompt:
+            raise ValueError("empty prompt")
+        if len(req.prompt) + req.max_new > self.scfg.max_seq:
+            raise ValueError(
+                f"request {req.rid}: prompt {len(req.prompt)} + max_new "
+                f"{req.max_new} exceeds max_seq {self.scfg.max_seq}"
+            )
+        if any(t < 0 or t >= self.scfg.vocab for t in req.prompt):
+            raise ValueError(f"request {req.rid}: token id out of vocab")
+        if (self.stage_geom.pages_for(len(req.prompt))
+                > self.stage_geom.n_pages):
+            # would never fit the staging pool: refusing now beats the
+            # silent forever-requeue a too-small pool would otherwise be
+            raise ValueError(
+                f"request {req.rid}: prompt needs "
+                f"{self.stage_geom.pages_for(len(req.prompt))} staging "
+                f"pages, pool holds {self.stage_geom.n_pages}"
+            )
+        if req.rid in self._seen:
+            raise ValueError(f"request id {req.rid} already used")
+        self._seen.add(req.rid)
+        self._queue.append(req)
+
+    def _stage_prefill(self, req: Request) -> Optional[_Staged]:
+        """Prefill ``req`` into the staging pool (prompt pages only —
+        the generation budget is the decode side's reservation).  None
+        when the staging pool cannot cover the prompt right now."""
+        eng, geom = self.engine, self.stage_geom
+        n_tok = len(req.prompt)
+        pages = self._stage_alloc.alloc(geom.pages_for(n_tok))
+        if pages is None:
+            return None
+        bucket = _bucket(n_tok)
+        if bucket not in self._stage_prefills:
+            self._stage_prefills[bucket] = build_prefill(
+                self.mesh, self.cfg, geom, dp=self._dp, sp=self._sp,
+                counter=eng.prefill_counter, quantized=self._quantized,
+            )
+        x = np.zeros((bucket, self.cfg.d_model), np.float32)
+        x[:n_tok] = eng._embed_np[list(req.prompt)]
+        page_rows = np.full(
+            (self._dp_size, self.scfg.max_pages), geom.n_pages, np.int32
+        )
+        page_rows[self.prefill_group, : len(pages)] = pages
+        try:
+            with eng.timeline.span("serve/stage_prefill"):
+                out, self._stage_kv = self._stage_prefills[bucket](
+                    eng.params, self._stage_kv, jnp.asarray(x),
+                    jnp.asarray(page_rows), jnp.int32(n_tok),
+                )
+                logits = eng._unembed(out[n_tok - 1][None], eng.embed)
+                tok = int(eng._sample(
+                    request_key(self.scfg.seed, req.rid, 0)[None], logits
+                )[0])
+        except Exception:
+            # the staged pool was donated and may be consumed: reset it
+            # and drop every staged-but-not-handed-off request back to
+            # the queue for deterministic replay (the engine recovery
+            # contract, staging-side).  ``req`` itself is still at the
+            # queue head — the caller only pops on success
+            self._recover_stage()
+            self._stage_alloc = PageAllocator(geom.n_pages)
+            raise
+        self._stage_count += 1
+        self._stage_tokens += n_tok
+        self._stage_s += eng._last_span_s()
+        return _Staged(req=req, pages=pages, first_token=tok)
+
+    def _fresh_stage_kv(self) -> dict:
+        """A zeroed staging pool committed to the engine's canonical
+        cache sharding (the engine's one-sharding-one-compile rule,
+        staging-side)."""
+        import jax
+
+        return {
+            name: jax.device_put(leaf, self.engine._kv_sharding[name])
+            for name, leaf in init_kv_cache(
+                self.stage_geom, self._dp_size, self.engine._kv_jnp_dtype
+            ).items()
+        }
+
+    def _recover_stage(self) -> None:
+        """Reset the staging pool and requeue staged requests (their
+        pages no longer hold valid K/V)."""
+        while self._handoff:
+            st = self._handoff.pop()
+            self._queue.appendleft(st.req)
+        self._stage_kv = self._fresh_stage_kv()
+
+    def _find_decode_slot(self, req: Request) -> Optional[tuple[int, int]]:
+        """(slot, group) of a free decode slot whose group can cover the
+        request's WHOLE footprint — the engine's admission watermark,
+        applied at handoff time."""
+        eng = self.engine
+        need = eng.geom.pages_for(len(req.prompt) + req.max_new)
+        for s, slot in enumerate(eng._slots):
+            if slot is None:
+                g = eng._group_of(s)
+                if eng._allocators[g].n_free >= need:
+                    return s, g
+        return None
+
+    def _migrate_program(self, dst_group: int):
+        if dst_group not in self._migrates:
+            self._migrates[dst_group] = build_migrate(
+                self.mesh, self.stage_geom, self.prefill_group, dst_group,
+                dp=self._dp, sp=self._sp, quantized=self._quantized,
+            )
+        return self._migrates[dst_group]
+
+    def _try_handoff(self, staged: _Staged) -> bool:
+        """Migrate one staged request into the decode slice; False when
+        no decode slot/pages are free yet (it stays queued).  Raises
+        nothing for migration failures: retries absorb transients and
+        the exhausted case degrades to a local monolithic prefill."""
+        eng, req = self.engine, staged.req
+        found = self._find_decode_slot(req)
+        if found is None:
+            return False
+        slot, group = found
+        need = eng.geom.pages_for(len(req.prompt) + req.max_new)
+        dst_pages = eng._allocators[group].alloc(need)
+        assert dst_pages is not None  # _find_decode_slot checked
+        n_pg = self.stage_geom.pages_for(len(req.prompt))
+        src_rows = np.full(
+            (self._dp_size, self.scfg.max_pages),
+            self.stage_geom.n_pages, np.int32,
+        )
+        src_rows[self.prefill_group, :n_pg] = staged.pages
+        dst_rows = np.full(
+            (self._dp_size, self.scfg.max_pages),
+            eng.geom.n_pages, np.int32,
+        )
+        dst_rows[group, :n_pg] = dst_pages[:n_pg]
+        program = self._migrate_program(group)
+        attempts = {"n": 0}
+
+        def attempt() -> None:
+            attempts["n"] += 1
+            if self._chaos is not None:
+                self._chaos.maybe_fail("serve/handoff", key=req.rid,
+                                       op="comm/migrate")
+            try:
+                with eng.timeline.span("serve/handoff"):
+                    eng._kv = program(
+                        eng._kv, self._stage_kv,
+                        jnp.asarray(src_rows), jnp.asarray(dst_rows),
+                    )
+            except Exception:
+                # the donated decode pool may be consumed mid-program:
+                # reset it (in-flight decode requests replay) so the
+                # NEXT attempt migrates into a valid pool
+                eng._recover_cache()
+                raise
+
+        try:
+            retry(attempt, self._retry, op="serve/handoff")
+        except Exception as exc:
+            # graceful degradation: drop the staged copy, hand the
+            # request to the decode engine's own (monolithic) admission
+            # — outputs stay byte-identical because rids key the
+            # sampling streams and prefill is deterministic
+            eng._allocators[group].free(dst_pages)
+            self._stage_alloc.free(staged.pages)
+            self._retried += attempts["n"] - 1
+            self._degraded += 1
+            eng.metrics.counter("serve/handoff_degraded").inc()
+            eng.sink.emit(
+                "ft/degrade", rid=req.rid, attempts=attempts["n"],
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            eng.submit(req)
+            return True
+        self._retried += attempts["n"] - 1
+        self._stage_alloc.free(staged.pages)
+        eng.admit_prefilled(req, slot, dst_pages, staged.first_token)
+        self._handoffs += 1
+        self._migrated_pages += n_pg
+        eng.metrics.counter("serve/handoffs").inc()
+        if attempts["n"] > 1:
+            eng.metrics.counter("serve/handoff_retries").inc(
+                attempts["n"] - 1
+            )
+        return True
+
+    # ---- the tick -------------------------------------------------------
+
+    def step(self) -> list[tuple[int, tuple[int, ...]]]:
+        """One disaggregated tick: stage what the prefill pool can hold,
+        hand off what the decode pool can seat, decode one sweep."""
+        finished: list[tuple[int, tuple[int, ...]]] = []
+        while self._queue:
+            staged = self._stage_prefill(self._queue[0])
+            if staged is None:
+                break
+            req = self._queue.popleft()
+            if req.max_new == 1:
+                # budget spent at prefill (the monolithic engine's
+                # evict-at-admission case): nothing to decode, nothing
+                # to migrate — the staged pages retire right here
+                self._stage_alloc.free(staged.pages)
+                self.engine._tokens_generated += 1
+                finished.append((req.rid, (staged.first_token,)))
+                continue
+            self._handoff.append(staged)
+        while self._handoff:
+            if not self._try_handoff(self._handoff[0]):
+                break
+            self._handoff.popleft()
+        finished.extend(self.engine.step())
+        return finished
+
+    def run(self, requests: Sequence[Request] = (),
+            max_steps: int = 100_000) -> DisaggReport:
+        """Submit ``requests`` and drain everything — queue, staging,
+        handoff, decode slots — to empty."""
+        for r in requests:
+            self.submit(r)
+        outputs: dict[int, tuple[int, ...]] = {}
+        eng = self.engine
+        tokens0 = eng._tokens_generated
+        decode0, prefill0 = eng._decode_steps, eng._prefill_count
+        prefill_s0, decode_s0 = eng._prefill_s, eng._decode_s
+        slot0, drafted0 = eng._slot_steps, eng._spec_drafted
+        accepted0 = eng._spec_accepted
+        eptok0, estok0 = eng._prefill_tokens, eng._shared_tokens
+        efresh0, ecow0 = eng._fresh_tokens, eng._cow_pages
+        quarantined0 = set(eng._quarantined)
+        stage0, stok0 = self._stage_count, self._stage_tokens
+        hand0, deg0 = self._handoffs, self._degraded
+        retr0, mig0 = self._retried, self._migrated_pages
+        steps = 0
+        while (self._queue or self._handoff or self.engine.n_queued
+               or self.engine.n_active):
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"disagg engine did not drain in {max_steps} steps "
+                    f"({self.n_queued} queued, {self.n_staged} staged, "
+                    f"{self.n_active} active)"
+                )
+            for rid, toks in self.step():
+                outputs[rid] = toks
+            steps += 1
+        # the full ServeEngine.run baseline set, so EVERY field of the
+        # wrapped report is a this-drain delta (a reused DisaggEngine's
+        # second report must not carry the first drain's counters) and
+        # a degraded request quarantined by the decode side shows up
+        report = eng._report(outputs, tokens0, decode0, prefill0,
+                             prefill_s0, decode_s0, slot0, drafted0,
+                             accepted0,
+                             tuple(sorted(set(eng._quarantined)
+                                          - quarantined0)),
+                             eptok0, estok0, efresh0, ecow0)
+        out = DisaggReport(
+            engine=report,
+            stage_prefills=self._stage_count - stage0,
+            stage_prefill_tokens=self._stage_tokens - stok0,
+            handoffs=self._handoffs - hand0,
+            migrated_pages=self._migrated_pages - mig0,
+            handoff_retries=self._retried - retr0,
+            degraded=self._degraded - deg0,
+            handoff_wire_bytes=self.handoff_wire_bytes
+            * (self._handoffs - hand0),
+        )
+        eng.sink.emit(
+            "serve/disagg_report",
+            completed=out.completed, tokens_generated=out.tokens_generated,
+            stage_prefills=out.stage_prefills,
+            stage_prefill_tokens=out.stage_prefill_tokens,
+            handoffs=out.handoffs, migrated_pages=out.migrated_pages,
+            handoff_retries=out.handoff_retries, degraded=out.degraded,
+        )
+        eng.sink.flush()
+        return out

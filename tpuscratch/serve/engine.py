@@ -33,7 +33,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
 
 from tpuscratch.ft.chaos import bind_sink
 from tpuscratch.models.transformer import TransformerConfig, init_params
@@ -42,13 +42,19 @@ from tpuscratch.obs.sink import NullSink
 from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
 from tpuscratch.runtime.profiling import Timeline
 from tpuscratch.serve.decode import (
+    build_context_prefill,
     build_decode_step,
     build_prefill,
     build_verify_step,
     check_serve_mesh,
     propose_draft,
 )
-from tpuscratch.serve.kvcache import CacheGeometry, PageAllocator, init_kv_cache
+from tpuscratch.serve.kvcache import (
+    CacheGeometry,
+    PageAllocator,
+    PrefixCache,
+    init_kv_cache,
+)
 from tpuscratch.serve.sampling import (
     accept_speculative,
     request_key,
@@ -92,6 +98,18 @@ class ServeConfig:
     spec_k: int = 0
     # suffix length for the self-drafting prompt-lookup match
     spec_ngram: int = 2
+    # cross-request KV prefix sharing (off by default): admissions whose
+    # prompts share a full-page-aligned prefix with LIVE cached pages
+    # attach to them (allocator refcount +1) instead of re-prefilling —
+    # only the unshared tail runs through the context-prefill program,
+    # so prefill FLOPs and freshly-written KV bytes drop with the share
+    # ratio; copy-on-write protects shared pages from in-place writes
+    prefix_share: bool = False
+    # chunked prefill (0 = off): prompts advance at most N tokens per
+    # engine tick through the context-prefill program instead of paying
+    # their whole length inside one tick — one long admission stops
+    # blocking every resident decode stream (bounds per-token p99)
+    chunk_prefill: int = 0
 
     @property
     def max_pages(self) -> int:
@@ -129,6 +147,15 @@ class GenerateReport:
     slot_steps: int = 0   # active-slot decode/verify invocations
     drafted: int = 0      # speculative draft tokens scored
     accepted: int = 0     # draft tokens accepted into outputs
+    # prefix-sharing accounting (the static half of the sharing claim):
+    # every prompt token is either COMPUTED through a prefill program
+    # (prefill_tokens) or SERVED from a shared page (shared_tokens), so
+    # prefill_tokens + shared_tokens == sum of admitted prompt lengths
+    # and both legs drop deterministically with the share ratio
+    prefill_tokens: int = 0
+    shared_tokens: int = 0
+    cow_pages: int = 0          # copy-on-write page copies this drain
+    fresh_kv_bytes: float = 0.0  # K/V bytes freshly written this drain
 
     @property
     def accept_len_mean(self) -> Optional[float]:
@@ -136,6 +163,12 @@ class GenerateReport:
         if self.slot_steps == 0:
             return None
         return self.accepted / self.slot_steps
+
+    @property
+    def shared_frac(self) -> float:
+        """Fraction of admitted prompt tokens served from shared pages."""
+        total = self.prefill_tokens + self.shared_tokens
+        return self.shared_tokens / total if total else 0.0
 
 
 @dataclasses.dataclass
@@ -147,6 +180,10 @@ class _Slot:
     max_new: int
     last_token: int
     generated: list[int]
+    # prompt tokens NOT yet prefilled (context-prefill admissions only):
+    # a slot with pending tokens is PREFILLING — it advances one chunk
+    # per tick and joins the decode bank when the tail drains
+    pending: tuple[int, ...] = ()
 
 
 #: profiling spans kept on the engine's Timeline — a recent window, not
@@ -218,6 +255,16 @@ class ServeEngine:
             raise ValueError(
                 f"spec_ngram must be >= 1, got {scfg.spec_ngram}"
             )
+        if scfg.chunk_prefill < 0:
+            raise ValueError(
+                f"chunk_prefill must be >= 0, got {scfg.chunk_prefill}"
+            )
+        if (scfg.prefix_share or scfg.chunk_prefill) and scfg.retry_budget:
+            raise ValueError(
+                "retry_budget composes with the monolithic admission "
+                "path only; context-prefill admissions (prefix_share / "
+                "chunk_prefill) keep the legacy raise-through contract"
+            )
         self.mesh, self.cfg, self.scfg = mesh, cfg, scfg
         self._kv_jnp_dtype = _KV_DTYPES[scfg.kv_dtype]
         self._quantized = scfg.kv_dtype == "int8"
@@ -237,8 +284,21 @@ class ServeEngine:
                 f"embed {self.embed.shape} != ({scfg.vocab}, {cfg.d_model})"
             )
         self._embed_np = np.asarray(self.embed)
-        self._kv = init_kv_cache(self.geom, self._dp_size,
-                                 self._kv_jnp_dtype)
+        # the fresh pool COMMITS to its canonical sharding up front:
+        # an uncommitted zeros pytree carries SingleDeviceSharding, so
+        # the first admission would compile each prefill program against
+        # THAT and the second against the program-output NamedSharding —
+        # a hidden per-bucket XLA recompile (~100s of ms) on the second
+        # admission that CompileCounter cannot see (the jaxpr is cached;
+        # only the sharding key changed).  Committing makes every
+        # invocation see one sharding, so each program compiles once.
+        from tpuscratch.serve.kvcache import kv_cache_spec
+
+        self._kv_sharding = {
+            name: NamedSharding(mesh, spec)
+            for name, spec in kv_cache_spec(dp, sp, self._quantized).items()
+        }
+        self._kv = self._fresh_kv()
         self._allocators = [
             PageAllocator(scfg.n_pages) for _ in range(self._dp_size)
         ]
@@ -286,6 +346,23 @@ class ServeEngine:
             )
         self._prefills: dict[int, object] = {}  # bucket len -> program
         self._dp, self._sp = dp, sp
+        # context-prefill layers (both OFF by default: self._ctx stays
+        # None and the admission path is byte-for-byte the legacy one)
+        self._ctx_mode = scfg.prefix_share or scfg.chunk_prefill > 0
+        self._chunk = (
+            scfg.chunk_prefill if scfg.chunk_prefill > 0 else scfg.page_size
+        )
+        self._ctx = (
+            build_context_prefill(
+                mesh, cfg, self.geom, self._chunk, dp=dp, sp=sp,
+                counter=self.prefill_counter, quantized=self._quantized,
+            )
+            if self._ctx_mode else None
+        )
+        self._tries: Optional[list[PrefixCache]] = (
+            [PrefixCache(scfg.page_size) for _ in range(self._dp_size)]
+            if scfg.prefix_share else None
+        )
         self._unembed = jax.jit(lambda o, e: o @ e.T)
         self._decode_steps = 0
         self._prefill_count = 0
@@ -295,6 +372,10 @@ class ServeEngine:
         self._spec_accepted = 0
         self._prefill_s = 0.0
         self._decode_s = 0.0
+        self._prefill_tokens = 0
+        self._shared_tokens = 0
+        self._fresh_tokens = 0   # tokens whose K/V this engine wrote
+        self._cow_pages = 0
 
     # ---- introspection (tests + report) --------------------------------
 
@@ -344,6 +425,34 @@ class ServeEngine:
         return self._spec_accepted
 
     @property
+    def prefill_tokens(self) -> int:
+        """Engine-lifetime prompt tokens COMPUTED through a prefill
+        program (monolithic or context-chunk) — the prefill-FLOP leg
+        prefix sharing shrinks."""
+        return self._prefill_tokens
+
+    @property
+    def shared_tokens(self) -> int:
+        """Engine-lifetime prompt tokens served from shared pages."""
+        return self._shared_tokens
+
+    @property
+    def cow_pages(self) -> int:
+        """Engine-lifetime copy-on-write page copies."""
+        return self._cow_pages
+
+    @property
+    def fresh_kv_bytes(self) -> float:
+        """Engine-lifetime K/V bytes freshly written into the pool
+        (prefilled prompt tokens + generated tokens, at this pool's
+        exact per-token byte cost incl. quantization scales) — shared
+        admissions write none for their shared prefix, so this drops
+        with the share ratio.  Static accounting, not sampled: token
+        counts are exact and the per-token bytes come from the pool
+        geometry (``obs.ledger.kv_cache_bytes`` over capacity)."""
+        return self._fresh_tokens * self.kv_bytes_per_token
+
+    @property
     def n_active(self) -> int:
         return sum(s is not None for s in self._slots)
 
@@ -367,23 +476,43 @@ class ServeEngine:
             del self.timeline.spans[: -_MAX_SPANS]
         return s
 
+    def _fresh_kv(self) -> dict:
+        """A zeroed pool committed to the canonical cache sharding."""
+        return {
+            name: jax.device_put(leaf, self._kv_sharding[name])
+            for name, leaf in init_kv_cache(
+                self.geom, self._dp_size, self._kv_jnp_dtype
+            ).items()
+        }
+
+    def _free_slot_pages(self, slot: int, st: _Slot) -> None:
+        """Drop this slot's holds; pages whose LAST holder left leave
+        the prefix trie too (a dead page must never be matched)."""
+        group = self._group_of(slot)
+        released = self._allocators[group].free(st.pages)
+        if self._tries is not None and released:
+            self._tries[group].drop(released)
+
     def _recover_cache(self) -> None:
         """A compiled call raised mid-flight: its DONATED cache buffers
         may already be consumed, so serving cannot continue on the old
         pool.  Reset it and requeue every in-flight request from its
         original prompt — rids key the PRNG streams, so the replay
         regenerates the SAME tokens and a caller that catches the error
-        and drains again loses nothing."""
+        and drains again loses nothing.  The prefix trie clears with the
+        pool: a zeroed page holds no one's prefix."""
         for s, st in enumerate(self._slots):
             if st is None:
                 continue
-            self._allocators[self._group_of(s)].free(st.pages)
+            self._free_slot_pages(s, st)
             self._slots[s] = None
             self._queue.appendleft(
                 Request(rid=st.rid, prompt=st.prompt, max_new=st.max_new)
             )
-        self._kv = init_kv_cache(self.geom, self._dp_size,
-                                 self._kv_jnp_dtype)
+        if self._tries is not None:
+            for trie in self._tries:
+                trie.clear()
+        self._kv = self._fresh_kv()
 
     # ---- request lifecycle ---------------------------------------------
 
@@ -409,13 +538,73 @@ class ServeEngine:
         self._seen_rids.add(req.rid)
         self._queue.append(req)
 
-    def _find_slot(self, req: Request) -> Optional[int]:
+    def admit_prefilled(self, req: Request, slot: int, pages: list[int],
+                        first_token: int) -> None:
+        """Install an EXTERNALLY-prefilled request directly into
+        ``slot`` — the disaggregated handoff path (serve/disagg.py):
+        the request's whole prompt K/V already sits in THIS engine's
+        cache pool under ``pages`` (migrated in from the prefill
+        slice), and ``first_token`` is the token its prefill sampled
+        (stream position 0), so decode continues exactly where the
+        monolithic admission would.  ``pages`` must have been allocated
+        from the slot's group allocator by the caller and must cover
+        the request's full footprint (prompt + budget); the slot must
+        be free.  Counted as an emitted token but NOT as an engine
+        prefill — this engine ran no prefill program for it."""
+        if self._slots[slot] is not None:
+            raise ValueError(f"slot {slot} is busy")
+        if req.rid in self._seen_rids:
+            raise ValueError(f"request id {req.rid} already used")
         need = self.geom.pages_for(len(req.prompt) + req.max_new)
+        if len(pages) < need:
+            raise ValueError(
+                f"request {req.rid} needs {need} pages, got {len(pages)}"
+            )
+        self._seen_rids.add(req.rid)
+        self._tokens_generated += 1
+        self._slots[slot] = _Slot(
+            rid=req.rid, prompt=req.prompt, pages=list(pages),
+            n_cached=len(req.prompt), max_new=req.max_new,
+            last_token=first_token, generated=[first_token],
+        )
+
+    def _share_plan(self, req: Request,
+                    group: int) -> tuple[list[int], bool, int]:
+        """(shared pages, full_aligned, pages to NEWLY allocate) for
+        admitting ``req`` into ``group`` — the refcount-aware admission
+        arithmetic the watermark gate and ``_admit_ctx`` share, so the
+        gate can never promise pages the admission then over-draws.
+
+        ``full_aligned`` marks the whole-prompt page-aligned match: the
+        admission must RE-SCORE the last prompt position for its
+        logits, and that write needs a private copy of the last shared
+        page — so one page of the allocation is the copy-on-write
+        budget (the shared page itself stays untouched for its other
+        holders)."""
+        shared = (
+            self._tries[group].match(req.prompt)
+            if self._tries is not None else []
+        )
+        m = len(shared)
+        n_tok = len(req.prompt)
+        full_aligned = m > 0 and m * self.geom.page_size == n_tok
+        total = self.geom.pages_for(n_tok + req.max_new)
+        need = total - m + (1 if full_aligned else 0)
+        return shared, full_aligned, need
+
+    def _find_slot(self, req: Request) -> Optional[int]:
+        needs: dict[int, int] = {}  # the plan depends only on the group
         for s, slot in enumerate(self._slots):
-            if slot is None and (
-                self._allocators[self._group_of(s)].n_free >= need
-            ):
-                return s
+            if slot is None:
+                group = self._group_of(s)
+                # refcount-aware watermark: a shared-prefix admission
+                # allocates only its UNSHARED pages, so the gate counts
+                # those — not the request's whole footprint (shared
+                # pages are already live and consume no free capacity)
+                if group not in needs:
+                    needs[group] = self._share_plan(req, group)[2]
+                if self._allocators[group].n_free >= needs[group]:
+                    return s
         return None
 
     def _sample(self, keys, logits):
@@ -423,8 +612,13 @@ class ServeEngine:
             keys, logits, self.scfg.temperature, self.scfg.top_k
         )
 
-    def _admit(self, req: Request, slot: int) -> bool:
+    def _admit(self, req: Request, slot: int,
+               finished: Optional[list] = None) -> bool:
         """Prefill ``req`` into ``slot``; True when the slot was taken.
+
+        With ``prefix_share`` or ``chunk_prefill`` set the admission
+        routes through :meth:`_admit_ctx` (context-prefill path);
+        otherwise this is the legacy monolithic program, byte-for-byte.
 
         With ``scfg.retry_budget == 0`` (default) a prefill failure keeps
         the legacy contract: grant returned, request requeued at the
@@ -435,6 +629,8 @@ class ServeEngine:
         ``1 + retry_budget`` attempts is QUARANTINED: its grant is
         returned, it never requeues, and the engine moves on — the
         deterministic-poison livelock the unconditional requeue had."""
+        if self._ctx_mode:
+            return self._admit_ctx(req, slot, finished)
         geom, scfg = self.geom, self.scfg
         group = self._group_of(slot)
         pages = self._allocators[group].alloc(
@@ -513,16 +709,207 @@ class ServeEngine:
         self._prefill_s += self._last_span_s()
         self._prefill_count += 1
         self._tokens_generated += 1
+        self._prefill_tokens += n_tok
+        self._fresh_tokens += n_tok
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_tok,
             max_new=req.max_new, last_token=tok, generated=[tok],
         )
         return True
 
+    def _admit_ctx(self, req: Request, slot: int,
+                   finished: Optional[list] = None) -> bool:
+        """Context-prefill admission: attach to shared prefix pages (if
+        ``prefix_share`` matched any), allocate only the unshared
+        footprint, and queue the unshared prompt tail as the slot's
+        ``pending`` chunk stream.
+
+        - tail path: the tail (>= 1 token) prefills through the
+          context program, attending the shared pages it skipped;
+        - full-aligned path: EVERY prompt page was matched, so the only
+          compute left is re-scoring the last prompt position for its
+          logits — and since that write lands in the last shared page,
+          the page is copy-on-written into this admission's reserved
+          budget first (the other holders' view is untouched).
+
+        With ``chunk_prefill == 0`` (prefix sharing alone) the whole
+        tail drains inside this call — monolithic admission latency
+        semantics, chunked numerics; with a chunk budget the tail
+        advances one chunk per engine tick instead (``_ctx_step``).
+
+        Failures keep the legacy contract: the compiled-call exception
+        path resets the donated pool and requeues every in-flight
+        request (this one included) for deterministic replay."""
+        geom, scfg = self.geom, self.scfg
+        group = self._group_of(slot)
+        alloc = self._allocators[group]
+        if self._chaos is not None:
+            try:
+                self._chaos.maybe_fail("serve/prefill", key=req.rid,
+                                       op="serve/prefill")
+            except Exception:
+                self._queue.appendleft(req)
+                raise
+        n_tok = len(req.prompt)
+        shared, full_aligned, need = self._share_plan(req, group)
+        priv = alloc.alloc(need)
+        assert priv is not None  # _find_slot ran the same arithmetic
+        if shared:
+            alloc.share(shared)
+        if full_aligned:
+            # copy-on-write: the re-score must write position
+            # n_tok - 1, which lives in the last shared page
+            self._copy_page(group, shared[-1], priv[0])
+            if self._tries is not None:
+                self._tries[group].drop(alloc.free([shared[-1]]))
+            pages = shared[:-1] + priv
+            n_cached = n_tok - 1
+            self._cow_pages += 1
+        else:
+            pages = shared + priv
+            n_cached = len(shared) * geom.page_size
+        self._shared_tokens += n_cached
+        self._slots[slot] = _Slot(
+            rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_cached,
+            max_new=req.max_new, last_token=0, generated=[],
+            pending=req.prompt[n_cached:],
+        )
+        self._prefill_count += 1
+        if scfg.chunk_prefill == 0:
+            # share-only mode: the tail drains inside the admission
+            while (self._slots[slot] is not None
+                   and self._slots[slot].pending):
+                self._ctx_step([slot], finished)
+        return True
+
+    def _ensure_private(self, slot: int, page_index: int) -> None:
+        """Copy-on-write guard on the write paths: a slot about to
+        write into table entry ``page_index`` must hold that page
+        EXCLUSIVELY — if other requests share it, the payload is copied
+        into a fresh page, the table entry swapped, and this slot's
+        hold on the shared page dropped.  Unreachable in the supported
+        admission flows (writes always land past the shared prefix;
+        the full-aligned re-score pre-copies at admission), so a grant
+        failure here is a logic error, not back-pressure."""
+        st = self._slots[slot]
+        group = self._group_of(slot)
+        alloc = self._allocators[group]
+        page = st.pages[page_index]
+        if alloc.refcount(page) <= 1:
+            return
+        fresh = alloc.alloc(1)
+        if fresh is None:
+            raise RuntimeError(
+                f"copy-on-write of shared page {page} (slot {slot}) "
+                "found an empty pool — admission reserved too little"
+            )
+        self._copy_page(group, page, fresh[0])
+        st.pages[page_index] = fresh[0]
+        if self._tries is not None:
+            self._tries[group].drop(alloc.free([page]))
+        else:
+            alloc.free([page])
+        self._cow_pages += 1
+
+    def _copy_page(self, group: int, src: int, dst: int) -> None:
+        """Copy one page's payload (and, for int8 pools, its scale
+        rows) between group-local ids — the copy-on-write data move.
+        Host-level functional update between compiled steps; rare by
+        construction (once per fully-shared aligned admission)."""
+        off = group * self.geom.n_pages
+        for name, buf in self._kv.items():
+            self._kv[name] = buf.at[:, off + dst].set(buf[:, off + src])
+
+    def _ctx_step(self, slots: list[int], finished: Optional[list]) -> None:
+        """One context-prefill chunk for every PREFILLING slot: each
+        advances up to ``self._chunk`` pending prompt tokens through
+        the ONE compiled context program (K/V written to its pages,
+        ragged-causal attention over its cached prefix).  A slot whose
+        pending tail drains samples its first token (the same
+        ``request_key(seed, rid, 0)`` draw the monolithic prefill
+        makes), registers its full prompt pages in the prefix trie, and
+        joins the decode bank — or is evicted right here when its
+        budget was one token."""
+        scfg, geom = self.scfg, self.geom
+        n, C = scfg.n_slots, self._chunk
+        x = np.zeros((n, C, self.cfg.d_model), np.float32)
+        tables = np.full((n, scfg.max_pages), geom.n_pages, np.int32)
+        write_pages = np.full((n, C), geom.n_pages, np.int32)
+        write_offs = np.zeros((n, C), np.int32)
+        seq_lens = np.zeros((n,), np.int32)
+        takes: dict[int, int] = {}
+        for s in slots:
+            st = self._slots[s]
+            take = min(C, len(st.pending))
+            takes[s] = take
+            # CoW guard BEFORE the tables snapshot: a swapped page must
+            # be what the program gathers
+            for pi in range(st.n_cached // geom.page_size,
+                            (st.n_cached + take - 1) // geom.page_size + 1):
+                self._ensure_private(s, pi)
+            x[s, :take] = self._embed_np[list(st.pending[:take])]
+            tables[s, : len(st.pages)] = st.pages
+            for j in range(take):
+                pos = st.n_cached + j
+                write_pages[s, j] = st.pages[pos // geom.page_size]
+                write_offs[s, j] = pos % geom.page_size
+            seq_lens[s] = st.n_cached + 1
+        done = [s for s in slots
+                if takes[s] == len(self._slots[s].pending)]
+        try:
+            with self.timeline.span("serve/prefill"):
+                out, self._kv = self._ctx(
+                    self.params, self._kv, jnp.asarray(x),
+                    jnp.asarray(tables), jnp.asarray(write_pages),
+                    jnp.asarray(write_offs), jnp.asarray(seq_lens),
+                )
+                if done:
+                    # STATIC shapes over the whole slot bank (the
+                    # decode tick's rule): a variable done-set length
+                    # would key fresh unembed/key/sample compiles mid-
+                    # stream; idle rows sample with dummy keys, results
+                    # discarded
+                    last = np.zeros((n,), np.int64)
+                    rids = np.zeros((n,), np.int32)
+                    for s in done:
+                        last[s] = takes[s] - 1
+                        rids[s] = self._slots[s].rid
+                    logits = self._unembed(
+                        out[jnp.arange(n), jnp.asarray(last)], self.embed
+                    )
+                    keys = request_keys(
+                        self._seed_key, jnp.asarray(rids),
+                        jnp.zeros((n,), jnp.int32),
+                    )
+                    first = np.asarray(self._sample(keys, logits))
+        except Exception:
+            self._recover_cache()  # donated kv may be consumed; replay
+            raise
+        self._prefill_s += self._last_span_s()
+        for s in slots:
+            st = self._slots[s]
+            take = takes[s]
+            st.n_cached += take
+            st.pending = st.pending[take:]
+            self._prefill_tokens += take
+            self._fresh_tokens += take
+        for s in done:
+            st = self._slots[s]
+            tok = int(first[s])
+            st.last_token = tok
+            st.generated = [tok]
+            self._tokens_generated += 1
+            if self._tries is not None:
+                self._tries[self._group_of(s)].insert(st.prompt, st.pages)
+            if len(st.generated) >= st.max_new:
+                out_pair = self._evict(s)
+                if finished is not None:
+                    finished.append(out_pair)
+
     def _evict(self, slot: int) -> tuple[int, tuple[int, ...]]:
         st = self._slots[slot]
         assert st is not None
-        self._allocators[self._group_of(slot)].free(st.pages)
+        self._free_slot_pages(slot, st)
         self._slots[slot] = None
         return st.rid, tuple(st.generated)
 
@@ -538,6 +925,7 @@ class ServeEngine:
         prefills0 = self._prefill_count
         tokens0 = self._tokens_generated
         accepted0 = self._spec_accepted
+        ptok0 = self._prefill_tokens
         finished = self._tick_inner()
         self._observe_tick(
             time.perf_counter() - t0,
@@ -545,11 +933,13 @@ class ServeEngine:
             evicted=len(finished),
             tokens=self._tokens_generated - tokens0,
             accepted=self._spec_accepted - accepted0,
+            prefill_tokens=self._prefill_tokens - ptok0,
         )
         return finished
 
     def _observe_tick(self, tick_s: float, inserted: int, evicted: int,
-                      tokens: int, accepted: int = 0) -> None:
+                      tokens: int, accepted: int = 0,
+                      prefill_tokens: int = 0) -> None:
         m = self.metrics
         self._tick += 1
         free_min = min(a.n_free for a in self._allocators)
@@ -562,6 +952,11 @@ class ServeEngine:
         m.counter("serve/inserts").inc(inserted)
         m.counter("serve/evictions").inc(evicted)
         m.counter("serve/tokens").inc(tokens)
+        if prefill_tokens:
+            # per-tick prefill compute: under chunked prefill its max is
+            # bounded by chunk * slots — the p99-bounding claim as a
+            # live histogram rather than a hope
+            m.histogram("serve/prefill_tokens_tick").observe(prefill_tokens)
         if self.scfg.spec_k > 0:
             m.counter("serve/accepted").inc(accepted)
         m.gauge("serve/decode_compiles").set(self.decode_counter.count)
@@ -573,7 +968,7 @@ class ServeEngine:
                 queue_depth=self.n_queued, active=self.n_active,
                 free_pages_min=free_min,
                 inserted=inserted, evicted=evicted, tokens=tokens,
-                accepted=accepted,
+                accepted=accepted, prefill_tokens=prefill_tokens,
                 decode_compiles=self.decode_counter.count,
                 prefill_compiles=self.prefill_counter.count,
             )
@@ -585,12 +980,27 @@ class ServeEngine:
             if slot is None:
                 break
             req = self._queue.popleft()
-            if not self._admit(req, slot):
+            if not self._admit(req, slot, finished):
                 continue  # quarantined: the slot stays free
-            if req.max_new == 1:
-                finished.append(self._evict(slot))  # budget spent at prefill
+            st = self._slots[slot]
+            # budget spent at prefill (an admission that already drained
+            # its pending tail and emitted its one token); a chunked
+            # admission still prefilling is evicted by _ctx_step later
+            if (st is not None and not st.pending and st.generated
+                    and req.max_new == 1):
+                finished.append(self._evict(slot))
 
-        active = [s for s, st in enumerate(self._slots) if st is not None]
+        # chunked prefill interleaves with decode INSIDE the tick: every
+        # prefilling slot advances one chunk, every decoding slot one
+        # token — a long admission costs each tick at most chunk tokens
+        # of prefill instead of its whole prompt, which is what bounds
+        # the resident streams' per-token p99
+        prefilling = [s for s, st in enumerate(self._slots)
+                      if st is not None and st.pending]
+        if prefilling:
+            self._ctx_step(prefilling, finished)
+        active = [s for s, st in enumerate(self._slots)
+                  if st is not None and not st.pending and st.generated]
         if not active:
             return finished
         if self.scfg.spec_k > 0:
@@ -616,6 +1026,8 @@ class ServeEngine:
         positions = np.zeros((n,), np.int32)
         for s in active:
             st = self._slots[s]
+            if self._tries is not None:  # CoW guard on the write target
+                self._ensure_private(s, st.n_cached // geom.page_size)
             x[s] = self._embed_np[st.last_token]
             tables[s, : len(st.pages)] = st.pages
             write_page[s] = st.pages[st.n_cached // geom.page_size]
@@ -640,6 +1052,7 @@ class ServeEngine:
         self._decode_s += self._last_span_s()
         self._decode_steps += 1
         self._slot_steps += len(active)
+        self._fresh_tokens += len(active)
         for s in active:
             st = self._slots[s]
             st.n_cached += 1
@@ -683,6 +1096,11 @@ class ServeEngine:
             )[: remaining - 1]
             drafts[s] = draft
             toks = (st.last_token,) + draft
+            if self._tries is not None:  # CoW guard on the write targets
+                for pi in range(st.n_cached // geom.page_size,
+                                (st.n_cached + len(toks) - 1)
+                                // geom.page_size + 1):
+                    self._ensure_private(s, pi)
             x[s, : len(toks)] = self._embed_np[list(toks)]
             tables[s, : len(st.pages)] = st.pages
             for j in range(len(toks)):
@@ -714,6 +1132,7 @@ class ServeEngine:
             accept_hist.observe(a)
             self._spec_drafted += len(drafts[s])
             self._spec_accepted += a
+            self._fresh_tokens += a + 1
             st.n_cached += a + 1
             st.generated.extend(toks)
             st.last_token = toks[-1]
@@ -734,6 +1153,8 @@ class ServeEngine:
         prefill_s0, decode_s0 = self._prefill_s, self._decode_s
         slot0, drafted0 = self._slot_steps, self._spec_drafted
         accepted0 = self._spec_accepted
+        ptok0, stok0 = self._prefill_tokens, self._shared_tokens
+        fresh0, cow0 = self._fresh_tokens, self._cow_pages
         quarantined0 = set(self._quarantined)
         for r in requests:
             self.submit(r)
@@ -752,7 +1173,8 @@ class ServeEngine:
                               prefill_s0, decode_s0, slot0, drafted0,
                               accepted0,
                               tuple(sorted(set(self._quarantined)
-                                           - quarantined0)))
+                                           - quarantined0)),
+                              ptok0, stok0, fresh0, cow0)
         self.sink.emit(
             "serve/report",
             completed=report.completed,
@@ -765,6 +1187,10 @@ class ServeEngine:
             quarantined=len(report.quarantined),
             slot_steps=report.slot_steps,
             drafted=report.drafted, accepted=report.accepted,
+            prefill_tokens=report.prefill_tokens,
+            shared_tokens=report.shared_tokens,
+            cow_pages=report.cow_pages,
+            fresh_kv_bytes=round(report.fresh_kv_bytes, 3),
         )
         emit_phase_totals(self.sink, self.recorder)
         self.sink.emit_metrics(self.metrics.snapshot(),
@@ -774,7 +1200,8 @@ class ServeEngine:
 
     def _report(self, outputs, tokens0, decode0, prefill0, prefill_s0,
                 decode_s0, slot0=0, drafted0=0, accepted0=0,
-                quarantined=()) -> GenerateReport:
+                quarantined=(), ptok0=0, stok0=0, fresh0=0,
+                cow0=0) -> GenerateReport:
         return GenerateReport(
             completed=len(outputs),
             tokens_generated=self._tokens_generated - tokens0,
@@ -789,4 +1216,9 @@ class ServeEngine:
             slot_steps=self._slot_steps - slot0,
             drafted=self._spec_drafted - drafted0,
             accepted=self._spec_accepted - accepted0,
+            prefill_tokens=self._prefill_tokens - ptok0,
+            shared_tokens=self._shared_tokens - stok0,
+            cow_pages=self._cow_pages - cow0,
+            fresh_kv_bytes=(self._fresh_tokens - fresh0)
+            * self.kv_bytes_per_token,
         )

@@ -19,6 +19,14 @@ the same (dp, sp) mesh the training step uses:
   shard, so the sequence-parallel ranks hold head slices instead — the
   Ulysses layout (parallel/ulysses.py) applied to the cache.
 
+**Shared pages (prefix caching):** the allocator refcounts every live
+page and :class:`PrefixCache` maps full-page-aligned token prefixes to
+the live pages holding their K/V, so admissions whose prompts share a
+system prefix attach to existing pages (refcount +1) instead of
+re-prefilling them; a page is reclaimed only when its last holder
+frees it, and the engine copy-on-writes before any write into a page
+with more than one holder (serve/engine.py).
+
 The allocator is deliberately HOST-side Python: page grant/release is
 scheduler work that happens between compiled steps (the engine's
 admission/eviction loop), never inside one — the compiled decode step
@@ -131,15 +139,23 @@ def dequantize_pages(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
 
 
 class PageAllocator:
-    """LIFO free-list over one group's ``n_pages`` page ids.
+    """LIFO free-list over one group's ``n_pages`` page ids, with
+    per-page REFCOUNTS so live pages can be shared across requests
+    (PagedAttention block sharing, Kwon et al. SOSP '23).
 
     Invariants (test-gated in tests/test_serve.py):
     - every id handed out is in ``[0, n_pages)`` and unique among live ids;
     - :meth:`alloc` is all-or-nothing — a request it cannot fully satisfy
       grants nothing and returns None (no partial reservations to unwind);
-    - :meth:`free` of an id that is not currently live (double free, or a
-      foreign id) raises instead of corrupting the list;
-    - after every live id is freed, ``n_free`` returns to ``n_pages``.
+    - :meth:`alloc` grants refcount 1; :meth:`share` adds a holder to an
+      already-live page; :meth:`free` drops ONE holder, and a page
+      returns to the free list only when its LAST holder frees it — so
+      eviction can never reclaim a page another request still reads;
+    - :meth:`free`/:meth:`share` of an id that is not currently live
+      (double free, or a foreign id) raise instead of corrupting state;
+    - ``n_free`` counts UNIQUE reclaimable pages (sharing a page does
+      not consume free-list capacity): after every holder of every live
+      page frees, ``n_free`` returns to ``n_pages``.
 
     LIFO keeps recently-freed (cache-warm, recently-DMA'd) pages hot —
     the same reuse policy as the native host pool's size-class lists
@@ -151,7 +167,7 @@ class PageAllocator:
             raise ValueError(f"n_pages must be >= 1, got {n_pages}")
         self.n_pages = n_pages
         self._free = list(range(n_pages - 1, -1, -1))  # pop() hands out 0 first
-        self._live: set[int] = set()
+        self._refs: dict[int, int] = {}                # live page -> holders
 
     @property
     def n_free(self) -> int:
@@ -159,25 +175,139 @@ class PageAllocator:
 
     @property
     def n_live(self) -> int:
-        return len(self._live)
+        """UNIQUE live pages (a page shared k ways counts once) — the
+        quantity the engine's free-page watermark law is stated over."""
+        return len(self._refs)
+
+    def refcount(self, page: int) -> int:
+        """Current holder count (0 for a free page) — the engine's
+        copy-on-write trigger reads this before any in-place write."""
+        return self._refs.get(page, 0)
 
     def alloc(self, n: int = 1) -> Optional[list[int]]:
-        """Grant ``n`` pages, or None (and grant nothing) if fewer are free."""
+        """Grant ``n`` pages at refcount 1, or None (and grant nothing)
+        if fewer are free."""
         if n < 0:
             raise ValueError(f"cannot allocate {n} pages")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._live.update(pages)
+        for p in pages:
+            self._refs[p] = 1
         return pages
 
-    def free(self, pages: Iterable[int]) -> None:
-        """Return pages to the free list; rejects ids not currently live."""
+    def share(self, pages: Iterable[int]) -> None:
+        """Add one holder to each LIVE page — the prefix-cache hit path.
+        Rejects non-live ids: sharing a freed page would resurrect it."""
+        pages = list(pages)
         for p in pages:
-            if p not in self._live:
+            if p not in self._refs:
+                raise ValueError(
+                    f"page {p} is not live (cannot share a freed page; "
+                    f"{len(self._refs)} live of {self.n_pages})"
+                )
+        for p in pages:
+            self._refs[p] += 1
+
+    def free(self, pages: Iterable[int]) -> list[int]:
+        """Drop one holder per page; pages whose LAST holder left return
+        to the free list and are listed in the return value (the engine
+        drops exactly those from its prefix trie).  Rejects ids not
+        currently live."""
+        released = []
+        for p in pages:
+            if p not in self._refs:
                 raise ValueError(
                     f"page {p} is not live (double free or foreign id; "
-                    f"{len(self._live)} live of {self.n_pages})"
+                    f"{len(self._refs)} live of {self.n_pages})"
                 )
-            self._live.discard(p)
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
+                released.append(p)
+        return released
+
+
+class PrefixCache:
+    """Token-block trie over one group's LIVE pages: full-page-aligned
+    prompt prefixes -> the page id holding that block's K/V.
+
+    The cross-request sharing index (PagedAttention prefix caching):
+    a key is the WHOLE token prefix up to a page boundary (tuple of
+    ``i * page_size`` token ids), so two prompts match a page only when
+    everything before it is identical too — the residual stream at a
+    position depends on the entire prefix, so K/V values are reusable
+    exactly when the full prefix matches (this model has no positional
+    encoding beyond the causal mask, and cached projections depend only
+    on the prefix).
+
+    Entries index pages whose holders are tracked by
+    :class:`PageAllocator` refcounts — the trie itself holds NO
+    reference: a mapping dies with its page (``drop`` on the
+    allocator's released list), so only pages some live request still
+    holds are ever matched, and the watermark law keeps counting unique
+    live pages.  A key tracks ALTERNATE physical copies: two identical
+    prompts prefilled in the same tick each register their own pages
+    (neither could share — sharing needs a COMPLETED prefill), matches
+    land on the oldest live copy, and when that copy's owner dies the
+    next alternate takes over instead of the whole chain vanishing
+    while an equivalent live copy exists.
+    """
+
+    def __init__(self, page_size: int):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self._map: dict[tuple, list[int]] = {}  # prefix -> live copies
+        self._rev: dict[int, set[tuple]] = {}   # page id -> its keys
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self._map)
+
+    def match(self, prompt: Iterable[int]) -> list[int]:
+        """Page ids of the LONGEST cached full-page-aligned prefix of
+        ``prompt`` (possibly empty).  The chain walks block by block, so
+        a match is always a contiguous prefix."""
+        prompt = tuple(prompt)
+        pages = []
+        for i in range(self.page_size, len(prompt) + 1, self.page_size):
+            alts = self._map.get(prompt[:i])
+            if not alts:
+                break
+            pages.append(alts[0])
+        return pages
+
+    def insert(self, prompt: Iterable[int], pages: Iterable[int]) -> None:
+        """Register ``prompt``'s full-page blocks against the pages that
+        hold them (``pages`` in sequence order, one per full block;
+        extra tail entries ignored).  A key that already indexes other
+        copies gains an alternate; matches keep landing on the oldest."""
+        prompt, pages = tuple(prompt), list(pages)
+        for blk, page in zip(range(len(prompt) // self.page_size), pages):
+            key = prompt[: (blk + 1) * self.page_size]
+            alts = self._map.setdefault(key, [])
+            if page not in alts:
+                alts.append(page)
+                self._rev.setdefault(page, set()).add(key)
+
+    def drop(self, pages: Iterable[int]) -> None:
+        """Forget every mapping onto ``pages`` — called with the
+        allocator's released list, so dead pages cannot be matched;
+        keys with surviving alternate copies stay matchable."""
+        for p in pages:
+            for key in self._rev.pop(p, ()):
+                alts = self._map.get(key)
+                if alts is None:
+                    continue
+                if p in alts:
+                    alts.remove(p)
+                if not alts:
+                    del self._map[key]
+
+    def clear(self) -> None:
+        """Forget everything — the engine's cache-recovery path (a reset
+        pool holds no valid K/V, so no prefix may be matched)."""
+        self._map.clear()
+        self._rev.clear()
