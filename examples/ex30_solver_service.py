@@ -1,0 +1,118 @@
+"""Solver service: a supervised, communication-avoiding multigrid run.
+
+ROADMAP item 5 closed end-to-end: the reference repo's actual workload
+(3D stencil solve) operated the way the serving stack is — the solve
+runs in checkpointed chunks under the ft supervisor, a chaos plan
+injects a preemption AND a transient comm fault mid-run, and the result
+is BIT-IDENTICAL to the fault-free run.  The obs sink's event stream
+then yields the goodput breakdown (solver chunks -> step bucket,
+checkpoint saves -> checkpoint bucket, buckets summing to wall exactly),
+and a config-15-style measurement records the communication-avoiding
+ablation: s-step smoothing halves the per-sweep ppermute launches
+(ledger-read off the compiled HLO) at an unchanged cycle count.
+"""
+
+import pathlib
+import sys
+import tempfile
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from examples._common import banner, ensure_devices
+
+
+def main(argv=None) -> None:
+    ensure_devices()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from tpuscratch.comm import run_spmd
+    from tpuscratch.ft import ChaosPlan, Fault
+    from tpuscratch.halo.halo3d import HaloSpec3D, TileLayout3D
+    from tpuscratch.obs import ledger as obs_ledger
+    from tpuscratch.obs.goodput import goodput_report
+    from tpuscratch.obs.metrics import MetricsRegistry
+    from tpuscratch.obs.report import load_events
+    from tpuscratch.obs.sink import open_sink
+    from tpuscratch.runtime.mesh import make_mesh, topology_of
+    from tpuscratch.solvers import (
+        checkpointed_mg3d_solve,
+        supervised_mg3d_solve,
+    )
+    from tpuscratch.solvers.multigrid3d import rbgs_smooth3, rbgs_smooth3_deep
+
+    n = 16  # 8^3 per rank on the 2x2x2 mesh
+    mesh = make_mesh((2, 2, 2), ("z", "row", "col"), jax.devices()[:8])
+    rng = np.random.default_rng(0)
+    b = rng.standard_normal((n, n, n)).astype(np.float32)
+    b -= b.mean()
+    workdir = tempfile.mkdtemp(prefix="tpuscratch_ex30_")
+
+    banner("solver service: supervised + communication-avoiding multigrid")
+
+    # 1. the fault-free oracle (chunked, checkpointed, s-step smoothing)
+    clean, rep0 = checkpointed_mg3d_solve(
+        b, f"{workdir}/clean", mesh=mesh, tol=1e-6, chunk_cycles=3, s_step=2
+    )
+    print(f"oracle: {rep0.cycles} cycles to relres {rep0.relres:.2e} "
+          f"in {rep0.chunks} chunks")
+
+    # 2. the same solve through chaos: preempted after the first chunk's
+    #    save, then a transient CommError before the third chunk
+    plan = ChaosPlan(0, [
+        Fault("solver/preempt", at=(3,), kind="preempt"),
+        Fault("comm/solver_chunk", at=(6,)),
+    ])
+    metrics = MetricsRegistry()
+    sink_path = f"{workdir}/obs.jsonl"
+    sink = open_sink(sink_path)
+    chaotic, rep = supervised_mg3d_solve(
+        b, f"{workdir}/chaos", mesh=mesh, tol=1e-6, chunk_cycles=3,
+        s_step=2, chaos=plan, metrics=metrics, sink=sink,
+        log=lambda s: print(f"  [ft] {s}"),
+    )
+    restarts = int(metrics.counter("ft/restarts").value)
+    print(f"faults injected: {plan.stats()}  restarts: {restarts}")
+    assert sum(plan.stats().values()) == 2 and restarts == 2
+    assert rep.converged and rep.resumed_at > 0
+    assert np.array_equal(clean, chaotic), "chaos run diverged from oracle"
+    print("preempted+faulted run bit-identical to the fault-free oracle")
+
+    # 3. what the wall time bought: the solver's goodput breakdown
+    gp = goodput_report(load_events([sink_path]))
+    gp.check()  # buckets sum to wall EXACTLY, by construction
+    print(f"goodput: {100 * gp.goodput_fraction:.1f}% of "
+          f"{gp.wall_s:.3f}s wall; badput "
+          + ", ".join(f"{k}={v:.3f}s" for k, v in gp.badput.items()))
+
+    # 4. config-15-style CA measurement: the s-step smoother's collective
+    #    budget, ledger-read off the compiled HLO (per-sweep launches)
+    topo = topology_of(mesh, periodic=True)
+    spec = HaloSpec3D(
+        layout=TileLayout3D((n // 2,) * 3, (1, 1, 1)), topology=topo,
+        axes=("z", "row", "col"), neighbors=6,
+    )
+    sp = P("z", "row", "col", None, None, None)
+    arg = jnp.zeros((2, 2, 2) + (n // 2,) * 3, jnp.float32)
+
+    def permutes(fn, sweeps):
+        prog = run_spmd(
+            mesh, lambda a, f: fn(a[0, 0, 0], f[0, 0, 0])[None, None, None],
+            (sp, sp), sp,
+        )
+        led = obs_ledger.analyze(prog, arg, arg)
+        return led.count("collective-permute") / sweeps
+
+    per_sweep = permutes(lambda u, f: rbgs_smooth3(u, f, spec, 1), 1)
+    deep = permutes(lambda u, f: rbgs_smooth3_deep(u, f, spec, 2, 2), 2)
+    print(f"rbgs smoothing ppermute launches/sweep: {per_sweep:.0f} "
+          f"(exchange-every-half-sweep) -> {deep:.0f} (s-step, s=2)")
+    assert per_sweep == 12 and deep == 6
+
+    print("solver service survived chaos bit-identically, goodput "
+          "accounted, CA launch drop ledger-proven: PASSED")
+
+
+if __name__ == "__main__":
+    main()

@@ -182,6 +182,29 @@ class TestHaloTraffic:
         b4, _ = halo_traffic_per_chip((2, 2), (64, 64), impl="deep:4")
         assert b4 == ((2 * 4 * 64 + 2 * 64 * 4 + 4 * 4 * 4) * 4) / 4
 
+    def test_analytic_halo3d_bytes(self):
+        from tpuscratch.bench.weak_scaling import halo3d_traffic_per_chip
+
+        # 1x1x1 torus: everything self-wraps, zero ICI bytes
+        b, cells = halo3d_traffic_per_chip((1, 1, 1), (16, 16, 16))
+        assert b == 0.0 and cells == 16 ** 3
+        # 2x2x2 torus, faces-only, halo 1, f32: 6 face slabs of 16x16
+        b, _ = halo3d_traffic_per_chip((2, 2, 2), (16, 16, 16))
+        assert b == 6 * 16 * 16 * 4
+        # 2x1x1 slab mesh: only the z faces leave the rank
+        b, _ = halo3d_traffic_per_chip((2, 1, 1), (16, 16, 16))
+        assert b == 2 * 16 * 16 * 4
+        # axis-sequential deep exchange at depth s: z slabs carry core
+        # extents, y slabs the z-padded extent, x slabs both paddings —
+        # amortized over s sweeps (the s-step smoother's accounting)
+        s, c = 2, 16
+        b, _ = halo3d_traffic_per_chip((2, 2, 2), (c, c, c), depth=s,
+                                       sweeps_per_exchange=s)
+        expect = (2 * s * c * c
+                  + 2 * s * (c + 2 * s) * c
+                  + 2 * s * (c + 2 * s) * (c + 2 * s)) * 4
+        assert b == expect / s
+
 
 class TestCollectiveBench:
     def test_verify_all_collectives(self, devices):

@@ -668,6 +668,21 @@ class TestRegress:
         assert ("serve_decode_tokens_per_s", "p99_s") in bad
         assert ("zero_vs_replicated_dp4", "grad_sync_bytes_zero") in bad
 
+    def test_solver_field_directions(self):
+        """Config 15's solver fields: counts/bytes/times/iterations
+        regress UPWARD, rates/efficiency/speedups DOWNWARD — including
+        the per-SWEEP collective-budget fields, which must not be
+        mislabeled by _HIGHER's "per_s" (per-second) substring."""
+        lower = ("ppermutes_per_sweep_s2", "halo_bytes_per_sweep_s2",
+                 "psums_per_iter_pipelined", "iterations_pipelined",
+                 "cycles", "comm_ratio", "solve_s_classic")
+        higher = ("cells_per_s", "efficiency", "deep_speedup",
+                  "pipelined_speedup")
+        for name in lower:
+            assert regress.direction(name) == "lower", name
+        for name in higher:
+            assert regress.direction(name) == "higher", name
+
     def test_improvement_and_missing_are_not_failures(self):
         base = regress.index_rows(self.BASE)
         new = regress.index_rows([dict(self.BASE[0], value=200000.0)])

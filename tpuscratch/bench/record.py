@@ -32,6 +32,10 @@ the ones this repo establishes. Configs follow BASELINE.md:
     step time at pp x dp in {1,2}^2, decomposed (overlap) vs serial
     sync schedule, ledger-asserted equal wire bytes
                                                  (CPU proxy off-chip)
+15. solver weak-scaling + communication-avoiding ablation: supervised
+    3D multigrid cells/s over growing meshes with analytic comm_ratio,
+    s-step smoothing vs per-sweep (ledger ppermutes/cycle), classic vs
+    pipelined CG (ledger psums/iter)             (CPU proxy off-chip)
 
 Each config prints one JSON line with the platform recorded, so CPU-proxy
 numbers can never masquerade as chip numbers.
@@ -1222,6 +1226,269 @@ def config14_plan_overlap(out: list, iters: int = 2) -> None:
         raise RuntimeError("all config-14 grid points failed")
 
 
+def config15_solver(out: list, iters: int = 2) -> None:
+    """Solver weak-scaling + communication-avoiding ablation (ISSUE 10):
+    the reference repo's actual workload (stencil + benchmarking,
+    PAPER.md capabilities 7-8) operated through the production runner.
+
+    Three row families, every new field direction-registered in
+    ``obs.regress``:
+
+    - ``solver_weak_mg3d_<n>dev``: fixed per-chip 3D tile over growing
+      meshes through the SUPERVISED runner — cells/s, V-cycles to
+      tolerance, analytic ``comm_ratio`` (halo bytes per computed cell
+      per sweep, from the exchange plan — the number that transfers to
+      a real slice), per-chip ``efficiency`` vs the 1-device point.
+    - ``solver_ca_smoothing``: s_step=1 vs s_step=2 (damped Jacobi,
+      the smoother whose fold reaches the launch-bound coarse levels)
+      on the largest mesh — measured cells/s + ``deep_speedup``,
+      identical cycle counts, ledger ppermutes/sweep and halo
+      bytes/sweep (exact).
+    - ``solver_ca_cg``: classic vs pipelined CG — time-to-tolerance,
+      iterations, and the static psum counts (3 vs 2 total; 2 vs ONE
+      per iteration).  CPU-proxy caveat: on the virtual CPU mesh psum
+      latency is a thread rendezvous, so the pipelined variant's extra
+      vector work can outweigh the saved collective — the LEDGER
+      column is the claim that transfers to a slice (the config-14
+      discipline), and the smoothing row carries the measured CPU win.
+    """
+    import time
+
+    import jax
+    import numpy as np
+
+    from tpuscratch.bench.weak_scaling import halo3d_traffic_per_chip
+    from tpuscratch.obs import ledger as obs_ledger
+    from tpuscratch.runtime.mesh import make_mesh, make_mesh_2d
+    from tpuscratch.runtime.topology import factor2d
+
+    on_tpu = jax.default_backend() == "tpu"
+    per_chip = 32 if on_tpu else 16
+    tol = 1e-6
+    avail = len(jax.devices())
+    rng = np.random.default_rng(0)
+
+    def solve_timed(b, mesh, dims, **kw):
+        import shutil
+        import tempfile
+
+        from tpuscratch.solvers import checkpointed_mg3d_solve
+
+        best = None
+        for _ in range(iters):
+            wd = tempfile.mkdtemp(prefix="tpuscratch_c15_")
+            try:
+                t0 = time.perf_counter()
+                _, rep = checkpointed_mg3d_solve(
+                    b, f"{wd}/ck", mesh=mesh, tol=tol,
+                    chunk_cycles=64, **kw,
+                )
+                wall = time.perf_counter() - t0
+            finally:
+                shutil.rmtree(wd, ignore_errors=True)
+            if best is None or wall < best[0]:
+                best = (wall, rep)
+        return best
+
+    # --- weak scaling through the supervised runner -------------------
+    shapes = [(1, 1, 1), (2, 1, 1), (2, 2, 1), (2, 2, 2)]
+    points = []
+    for dims in shapes:
+        n = dims[0] * dims[1] * dims[2]
+        if n > avail:
+            print(f"# config 15 mesh {dims} skipped: {avail} device(s)",
+                  file=sys.stderr)
+            continue
+        world = tuple(d * per_chip for d in dims)
+        b = rng.standard_normal(world).astype(np.float32)
+        b -= b.mean()
+        mesh = make_mesh(dims, ("z", "row", "col"), jax.devices()[:n])
+        try:
+            wall, rep = solve_timed(b, mesh, dims)
+        except Exception as e:
+            print(f"# config 15 mesh {dims} failed: {e}", file=sys.stderr)
+            continue
+        cells = float(np.prod(world))
+        rate = cells * rep.cycles / wall
+        halo_b, cells_chip = halo3d_traffic_per_chip(dims, (per_chip,) * 3)
+        points.append({
+            "dims": dims, "n": n, "rate": rate, "cycles": rep.cycles,
+            "comm_ratio": halo_b / cells_chip, "wall": wall,
+        })
+    if not points:
+        raise RuntimeError("all config-15 weak-scaling points failed")
+    base_rate = points[0]["rate"] / points[0]["n"]
+    for p in points:
+        per_chip_rate = p["rate"] / p["n"]
+        _emit(
+            out,
+            config=15,
+            metric=f"solver_weak_mg3d_{p['n']}dev",
+            value=p["rate"],
+            cells_per_s=p["rate"],
+            cycles=p["cycles"],
+            comm_ratio=p["comm_ratio"],
+            efficiency=per_chip_rate / base_rate,
+            solve_s=p["wall"],
+            n_devices=p["n"],
+            detail=(
+                f"{p['dims'][0]}x{p['dims'][1]}x{p['dims'][2]} mesh, "
+                f"{per_chip}^3/chip, {p['cycles']} cycles, "
+                f"{p['comm_ratio']:.3f} B/cell analytic"
+            ),
+        )
+
+    # --- CA smoothing ablation on the largest mesh --------------------
+    big = points[-1]
+    dims = big["dims"]
+    n = big["n"]
+    world = tuple(d * per_chip for d in dims)
+    b = rng.standard_normal(world).astype(np.float32)
+    b -= b.mean()
+    mesh = make_mesh(dims, ("z", "row", "col"), jax.devices()[:n])
+    cells = float(np.prod(world))
+    row = {}
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from tpuscratch.comm import run_spmd
+    from tpuscratch.halo.halo3d import HaloSpec3D, TileLayout3D
+    from tpuscratch.runtime.mesh import topology_of
+    from tpuscratch.solvers.multigrid3d import (
+        jacobi_smooth3,
+        jacobi_smooth3_deep,
+    )
+
+    # smoother collective budget, ledger-read and normalized to
+    # PER-SWEEP launches at the coarse-smoothing regime (16 sweeps —
+    # where launches dominate and the fold bites): the per-sweep
+    # program's fori_loop body is exactly one sweep (6 ppermutes), the
+    # deep program is fully unrolled so its static count IS its dynamic
+    # count (ceil(16/s) state exchanges + one rhs fill)
+    sweeps = 16
+    topo15 = topology_of(mesh, periodic=True)
+    spec15 = HaloSpec3D(
+        layout=TileLayout3D((per_chip,) * 3, (1, 1, 1)), topology=topo15,
+        axes=tuple(mesh.axis_names), neighbors=6,
+    )
+    sp15 = P(*mesh.axis_names, None, None, None)
+    smooth_arg = jnp.zeros(dims + (per_chip,) * 3, jnp.float32)
+
+    def smoother_ledger(fn, sweeps_in_program):
+        prog = run_spmd(
+            mesh,
+            lambda a, f: fn(a[0, 0, 0], f[0, 0, 0])[None, None, None],
+            (sp15, sp15), sp15,
+        )
+        led = obs_ledger.analyze(prog, smooth_arg, smooth_arg)
+        return (led.count("collective-permute") / sweeps_in_program,
+                led.wire_bytes().get("collective-permute", 0.0)
+                / sweeps_in_program)
+
+    for s_step in (1, 2):
+        try:
+            wall, rep = solve_timed(b, mesh, dims, s_step=s_step,
+                                    smoother="jacobi")
+        except Exception as e:
+            print(f"# config 15 s_step={s_step} failed: {e}",
+                  file=sys.stderr)
+            continue
+        tag = f"s{s_step}"
+        row[f"cells_per_s_{tag}"] = cells * rep.cycles / wall
+        row[f"cycles_{tag}"] = rep.cycles
+        row[f"solve_s_{tag}"] = wall
+        if s_step == 1:
+            ppermutes, wire = smoother_ledger(
+                lambda u, f: jacobi_smooth3(u, f, spec15, 6 / 7, 1), 1
+            )
+        else:
+            ppermutes, wire = smoother_ledger(
+                lambda u, f: jacobi_smooth3_deep(u, f, spec15, 6 / 7,
+                                                 sweeps, s_step),
+                sweeps,
+            )
+        row[f"ppermutes_per_sweep_{tag}"] = ppermutes
+        row[f"halo_bytes_per_sweep_{tag}"] = wire
+    if "cells_per_s_s1" in row and "cells_per_s_s2" in row:
+        row["deep_speedup"] = row["cells_per_s_s2"] / row["cells_per_s_s1"]
+        _emit(
+            out,
+            config=15,
+            metric="solver_ca_smoothing",
+            value=row["deep_speedup"],
+            **row,
+            detail=(
+                f"s-step smoothing {row['deep_speedup']:.3f}x cells/s, "
+                f"ppermutes/sweep {row['ppermutes_per_sweep_s1']:.0f} -> "
+                f"{row['ppermutes_per_sweep_s2']:.0f} (ledger), cycles "
+                f"{row['cycles_s1']} == {row['cycles_s2']}"
+            ),
+        )
+
+    # --- CG ablation: classic vs pipelined ----------------------------
+    from tpuscratch.halo.driver import _setup
+    from tpuscratch.solvers import poisson_solve
+    from tpuscratch.solvers.cg import _poisson_program
+
+    n2 = 256 if on_tpu else 64
+    cg_tol = 1e-5
+    b2 = rng.standard_normal((n2, n2)).astype(np.float32)
+    mesh2 = make_mesh_2d(factor2d(min(4, avail)))
+    cg_row = {}
+    mesh_s, topo_s, layout_s, spec_s = _setup(
+        (n2, n2), mesh2, (1, 1), periodic=False, neighbors=4
+    )
+    for method in ("cg", "pipelined"):
+        try:
+            poisson_solve(b2, mesh2, tol=cg_tol, max_iters=4 * n2,
+                          method=method)  # warm the program cache
+            best = None
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                _, k, relres = poisson_solve(
+                    b2, mesh2, tol=cg_tol, max_iters=4 * n2, method=method
+                )
+                best = min(best or np.inf, time.perf_counter() - t0)
+        except Exception as e:
+            print(f"# config 15 {method} failed: {e}", file=sys.stderr)
+            continue
+        tag = "classic" if method == "cg" else "pipelined"
+        cg_row[f"solve_s_{tag}"] = best
+        cg_row[f"iterations_{tag}"] = int(k)
+        led = obs_ledger.analyze(
+            _poisson_program(mesh_s, spec_s, cg_tol, 4 * n2, method),
+            jnp.zeros(
+                tuple(topo_s.dims) + (n2 // topo_s.dims[0],
+                                      n2 // topo_s.dims[1]),
+                jnp.float32,
+            ),
+        )
+        # 1 init + per-iteration psums (while body appears once)
+        cg_row[f"psums_total_{tag}"] = led.count("all-reduce")
+        cg_row[f"psums_per_iter_{tag}"] = led.count("all-reduce") - 1
+    if "solve_s_classic" in cg_row and "solve_s_pipelined" in cg_row:
+        cg_row["pipelined_speedup"] = (
+            cg_row["solve_s_classic"] / cg_row["solve_s_pipelined"]
+        )
+        _emit(
+            out,
+            config=15,
+            metric="solver_ca_cg",
+            value=cg_row["psums_per_iter_pipelined"],
+            **cg_row,
+            detail=(
+                f"psums/iter {cg_row['psums_per_iter_classic']} -> "
+                f"{cg_row['psums_per_iter_pipelined']} (ledger), iters "
+                f"{cg_row['iterations_classic']} -> "
+                f"{cg_row['iterations_pipelined']} (restart-segment "
+                f"penalty), time-to-tol {cg_row['pipelined_speedup']:.3f}x "
+                f"[{_platform()} proxy: psum latency is a thread "
+                f"rendezvous off-chip — the saved launch is the slice-"
+                f"side claim]"
+            ),
+        )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -1237,12 +1504,14 @@ CONFIGS = {
     12: config12_decode,
     13: config13_zero_train,
     14: config14_plan_overlap,
+    15: config15_solver,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14")
+    ap.add_argument("--configs",
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

@@ -78,6 +78,50 @@ def halo_traffic_per_chip(
     return per_chip_bytes, per_chip[0] * per_chip[1]
 
 
+def halo3d_traffic_per_chip(
+    dims: tuple[int, int, int],
+    per_chip: tuple[int, int, int],
+    itemsize: int = 4,
+    depth: int = 1,
+    sweeps_per_exchange: int = 1,
+) -> tuple[float, int]:
+    """(off-chip halo bytes per chip per sweep, cells per chip per
+    sweep) for 3D solver tiles — the 2D :func:`halo_traffic_per_chip`
+    one dimension up, computed EXACTLY from the exchange plan.
+
+    ``depth=1`` prices the per-sweep faces exchange (6 slabs,
+    ``halo.halo3d.FACES`` plan); ``depth>1`` prices the deep
+    AXIS-SEQUENTIAL exchange (``halo.halo3d.halo_exchange3d_seq``: 6
+    slabs whose extents grow by the earlier axes' ghost bands — the
+    edge/corner data rides transitively), amortized over
+    ``sweeps_per_exchange`` sweeps.  The s-step smoothers use
+    ``depth=s, sweeps_per_exchange=s`` (Jacobi) or ``depth=2s,
+    sweeps_per_exchange=s`` (red-black GS, two half-updates per sweep);
+    self-wrap pairs on 1-wide axes move nothing over the wire, exactly
+    as in 2D."""
+    from tpuscratch.halo.halo3d import (
+        HaloSpec3D,
+        TileLayout3D,
+        seq_exchange_wire_bytes,
+    )
+    from tpuscratch.runtime.topology import CartTopology
+
+    topo = CartTopology(tuple(dims), (True, True, True))
+    lay = TileLayout3D(tuple(per_chip), (depth,) * 3)
+    spec = HaloSpec3D(layout=lay, topology=topo,
+                      axes=("z", "row", "col"), neighbors=6)
+    if depth == 1:
+        total = 0
+        for t in spec.plan():
+            total += t.send.size * itemsize * sum(
+                1 for s, d in t.perm if s != d)
+        per_chip_bytes = total / topo.size
+    else:
+        per_chip_bytes = seq_exchange_wire_bytes(spec, itemsize)
+    cells = per_chip[0] * per_chip[1] * per_chip[2]
+    return per_chip_bytes / sweeps_per_exchange, cells
+
+
 def bench_weak_scaling(
     per_chip: tuple[int, int] = (1024, 1024),
     steps: int = 10,

@@ -14,8 +14,9 @@ Site vocabulary (what the instrumented layers query):
 
 - ``"train/grad"``    — corrupt a step's batch so its gradients go
   NaN/Inf through the unmodified compiled step (``kind="nan"|"inf"``).
-- ``"train/preempt"`` / ``"halo/preempt"`` — simulated scheduler
-  preemption at a chunk boundary, AFTER the save (``kind="preempt"``).
+- ``"train/preempt"`` / ``"halo/preempt"`` / ``"solver/preempt"`` —
+  simulated scheduler preemption at a chunk boundary, AFTER the save
+  (``kind="preempt"``).
 - ``"ckpt/save"``     — checkpoint IO: fail (``"error"``), stall
   (``"stall"``), or SIGKILL the process (``"kill"``) at a named stage
   inside :func:`runtime.checkpoint.save` (``stage=``).
@@ -23,7 +24,9 @@ Site vocabulary (what the instrumented layers query):
   (``key=rid`` targets one request; ``times`` bounds transience).
 - ``"comm/<op>"``     — a transient :class:`InjectedFault` (a
   ``CommError``) raised from a collective wrapper around a compiled
-  program (:meth:`ChaosPlan.wrap_collective`).
+  program (:meth:`ChaosPlan.wrap_collective`); the chunked drivers
+  query ``comm/halo_chunk`` / ``comm/solver_chunk`` before each
+  compiled chunk.
 
 The reference has nothing to compare: its faults all funnel into
 ``MPI_Abort`` (mpierr.h:37-43).  This module is the part of fault

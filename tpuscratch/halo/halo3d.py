@@ -205,6 +205,100 @@ def halo_exchange3d(tile: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
     return halo_scatter(tile, spec, halo_arrivals(tile, spec))
 
 
+def halo_exchange3d_seq(tile: jnp.ndarray, spec: HaloSpec3D) -> jnp.ndarray:
+    """Fill the FULL ghost shell — faces, edges, AND corners — with SIX
+    ppermutes at ANY halo depth: the axis-sequential deep exchange.
+
+    The 26-neighbor plan pays one collective per region (26 launches);
+    here axis ``a``'s slab carries the PADDED extent of every
+    already-exchanged axis, so edge and corner data arrives transitively
+    (the z ghosts ride the y slabs, both ride the x slabs) in two or
+    three single-axis hops — the classic axis-by-axis deep-halo trick,
+    and the launch-count lever the s-step smoother amortizes: one
+    6-ppermute exchange at depth ``s`` buys ``s`` sweeps where the
+    per-sweep path pays 6 launches per sweep.
+
+    Wire-byte accounting (``bench.weak_scaling.halo3d_traffic_per_chip``
+    carries the same formula): slab bytes grow by the earlier axes'
+    ghost bands, so a depth-``s`` exchange moves ``(1 + eps)`` times the
+    bytes of ``s`` stacked face exchanges, ``eps = O(s / core)`` — the
+    redundant-boundary trade the trapezoid scheme prices in.
+
+    Open-boundary semantics differ from :func:`halo_exchange3d`: a rank
+    with no sender gets ``ppermute`` ZEROS in that slab (the zero-ghost
+    convention the solvers' padded embeds already rely on), not its
+    prior ghost values.
+    """
+    lay = spec.layout
+    topo = spec.topology
+    core, halo = lay.core, lay.halo
+    for a in range(3):
+        h = halo[a]
+        if h == 0:
+            continue
+        ext = []
+        for b in range(3):
+            if b < a:          # already exchanged: ship ghosts too
+                ext.append(slice(0, core[b] + 2 * halo[b]))
+            elif b > a:        # not yet exchanged: core only
+                ext.append(slice(halo[b], halo[b] + core[b]))
+            else:
+                ext.append(None)
+        for d_a in (-1, 1):    # the face whose ghosts this transfer fills
+            flow = [0, 0, 0]
+            flow[a] = -d_a     # data travels opposite the ghost face
+            perm = tuple(topo.send_permutation(tuple(flow)))
+            send_a = (slice(core[a], core[a] + h) if flow[a] > 0
+                      else slice(h, 2 * h))
+            recv_a = (slice(0, h) if d_a < 0
+                      else slice(h + core[a], 2 * h + core[a]))
+            src = tuple(send_a if b == a else ext[b] for b in range(3))
+            dst = tuple(recv_a if b == a else ext[b] for b in range(3))
+            if not perm:
+                # fully open 1-wide axis: nobody sends anywhere — zero
+                # the slab so the no-sender convention is uniform (a
+                # multi-rank open axis gets the same zeros via
+                # ppermute's non-receiver fill)
+                arrived = jnp.zeros_like(tile[dst])
+            elif len(perm) == topo.size and all(s == d for s, d in perm):
+                arrived = tile[src]   # pure self-wrap: skip the collective
+            else:
+                arrived = lax.ppermute(tile[src], spec.axes, list(perm))
+            tile = tile.at[dst].set(arrived)
+    return tile
+
+
+def seq_exchange_wire_bytes(spec: HaloSpec3D, itemsize: int = 4) -> float:
+    """Analytic per-rank OFF-RANK wire bytes of one
+    :func:`halo_exchange3d_seq` at this spec's halo depth — the exact
+    number the obs ledger reads off the compiled program (tests assert
+    equality).  Self-wrap pairs move nothing over the wire; open-edge
+    ranks that send nowhere are averaged out exactly as
+    ``bench.weak_scaling.halo_traffic_per_chip`` does for 2D."""
+    lay = spec.layout
+    topo = spec.topology
+    core, halo = lay.core, lay.halo
+    total = 0
+    for a in range(3):
+        h = halo[a]
+        if h == 0:
+            continue
+        elems = h
+        for b in range(3):
+            if b < a:
+                elems *= core[b] + 2 * halo[b]
+            elif b > a:
+                elems *= core[b]
+        for d_a in (-1, 1):
+            flow = [0, 0, 0]
+            flow[a] = -d_a
+            perm = tuple(topo.send_permutation(tuple(flow)))
+            if len(perm) == topo.size and all(s == d for s, d in perm):
+                continue
+            total += elems * itemsize * sum(1 for s, d in perm if s != d)
+    return total / topo.size
+
+
 #: 7-point Jacobi default: equal face weights, no center term.
 JACOBI7 = (1 / 6,) * 6 + (0.0,)
 

@@ -26,6 +26,7 @@ Duration sources (event kind → field → bucket):
 ==============  ============  ==========
 train/chunk     chunk_s       step  (its ``compile_s`` share → compile)
 halo/chunk      wall_s        step  (its ``compile_s`` share → compile)
+solver/chunk    wall_s        step  (its ``compile_s`` share → compile)
 serve/tick      tick_s        step  (compile-ticked ticks → compile)
 ckpt/save       wall_s        checkpoint
 ft/rollback     lost_s        rollback
@@ -61,6 +62,7 @@ BUCKETS = ("step", "compile", "checkpoint", "rollback", "restart",
 _DURATION_EVENTS = {
     "train/chunk": ("chunk_s", "step"),
     "halo/chunk": ("wall_s", "step"),
+    "solver/chunk": ("wall_s", "step"),
     "serve/tick": ("tick_s", "step"),
     "ckpt/save": ("wall_s", "checkpoint"),
     "ft/rollback": ("lost_s", "rollback"),
@@ -155,7 +157,7 @@ def _account_group(events: Sequence[dict]) -> tuple[float, dict, int, int]:
             continue
         start = max(t0, end - dur)
         parts = {bucket: end - start}
-        if kind in ("train/chunk", "halo/chunk"):
+        if kind in ("train/chunk", "halo/chunk", "solver/chunk"):
             comp = _num(rec, "compile_s") or 0.0
             comp = min(comp, parts["step"])
             if comp > 0:

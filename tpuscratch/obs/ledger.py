@@ -220,6 +220,16 @@ class Ledger:
             out[op.kind] = out.get(op.kind, 0) + 1
         return out
 
+    def count(self, kind: str) -> int:
+        """Instruction count of ONE collective kind (0 when absent) —
+        the solver proofs' working form: a ``lax.while_loop`` body
+        appears exactly once in the optimized HLO, so a solver whose
+        iteration loop is a while_loop exposes its per-iteration
+        collective budget statically (pipelined CG's one-psum claim and
+        the s-step smoother's exchange count are asserted through
+        this, the way ``grad_sync_wire_bytes`` pinned the ZeRO leg)."""
+        return self.counts().get(kind, 0)
+
     def payload_bytes(self) -> dict[str, int]:
         """{collective kind: summed result-payload bytes}."""
         out: dict[str, int] = {}

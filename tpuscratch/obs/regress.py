@@ -44,9 +44,25 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 #: accepted draft length and speculative speedup regress DOWNWARD;
 #: ``prefill_frac`` is the prefix-sharing row's fraction of prompt
 #: tokens actually prefilled and ``degraded`` counts disaggregated
-#: handoffs that fell back to local prefill — both regress UPWARD)
+#: handoffs that fell back to local prefill — both regress UPWARD.
+#: The config-15 solver rows add: ``iterations``/``cycles`` — a solver
+#: taking more V-cycles/CG iterations to tolerance regressed;
+#: ``psum``/``ppermute`` — the communication-avoiding claims are
+#: per-iteration collective COUNTS (one fused psum per pipelined-CG
+#: iteration, 6/s ppermutes per s-step sweep), so a count creeping up
+#: is a regression of the proof itself.  ``halo_bytes`` rides the
+#: existing "bytes" substring; ``deep_speedup``/``pipelined_speedup``
+#: ride "speedup"; ``comm_ratio`` (halo bytes per computed cell) rides
+#: "ratio" — down.)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
-          "overhead", "bubble", "crossover", "prefill_frac", "degraded")
+          "overhead", "bubble", "crossover", "prefill_frac", "degraded",
+          "iterations", "cycles", "psum", "ppermute")
+
+#: checked BEFORE _HIGHER: the config-15 per-SWEEP collective budget
+#: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would
+#: otherwise be mislabeled higher-is-better by _HIGHER's "per_s"
+#: substring (meant for per-second rates) — these are costs, down.
+_LOWER_FIRST = ("per_sweep",)
 #: fields that are identity/configuration, never compared
 _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
          "flops_per_token", "degenerate"}
@@ -55,6 +71,8 @@ _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
 def direction(name: str) -> Optional[str]:
     """'higher' | 'lower' | None for a metric/field name."""
     low = name.lower()
+    if any(s in low for s in _LOWER_FIRST):
+        return "lower"
     if any(s in low for s in _HIGHER):
         return "higher"
     if any(s in low for s in _LOWER):

@@ -10,9 +10,23 @@ both reference flagships in one loop — its spectral sibling, the periodic
 Poisson solve by distributed FFT diagonalization — and geometric
 multigrid, the O(1)-cycle solver built from halo-exchanged smoothing and
 local inter-level transfers.
+
+The composition is also communication-avoiding and production-operated:
+``pipelined_cg`` is the Ghysels–Vanroose single-reduction loop (ONE
+stacked psum per iteration where classic CG pays two),
+``mg_poisson3d_solve(s_step=...)`` folds ``s_step`` smoothing sweeps
+into each deep halo exchange (the trapezoid scheme of the 2D stencil
+library, applied to solvers), and ``solvers.runner`` drives long solves
+through the trainer/halo-driver chunk loop — checkpointed, chaos-tested,
+supervised, goodput-accounted.
 """
 
-from tpuscratch.solvers.cg import cg, dirichlet_laplacian, poisson_solve
+from tpuscratch.solvers.cg import (
+    cg,
+    dirichlet_laplacian,
+    pipelined_cg,
+    poisson_solve,
+)
 from tpuscratch.solvers.multigrid import (
     mg_poisson_solve,
     pcg_poisson_solve,
@@ -23,6 +37,11 @@ from tpuscratch.solvers.multigrid3d import (
     pcg_poisson3d_solve,
     v_cycle3,
 )
+from tpuscratch.solvers.runner import (
+    SolveReport,
+    checkpointed_mg3d_solve,
+    supervised_mg3d_solve,
+)
 from tpuscratch.solvers.spectral import (
     periodic_poisson3d_fft,
     periodic_poisson_fft,
@@ -30,6 +49,7 @@ from tpuscratch.solvers.spectral import (
 
 __all__ = [
     "cg",
+    "pipelined_cg",
     "dirichlet_laplacian",
     "poisson_solve",
     "mg_poisson_solve",
@@ -38,6 +58,9 @@ __all__ = [
     "pcg_poisson3d_solve",
     "v_cycle",
     "v_cycle3",
+    "SolveReport",
+    "checkpointed_mg3d_solve",
+    "supervised_mg3d_solve",
     "periodic_poisson3d_fft",
     "periodic_poisson_fft",
 ]

@@ -90,11 +90,15 @@ def cg(
         return lax.psum(jnp.sum(u * v), axes)
 
     def rz_rs(r, z):
-        """(r.z, r.r) as ONE collective — the preconditioned loop would
-        otherwise pay a third all-reduce latency per iteration."""
-        if precond is None:
-            rs = gdot(r, r)
-            return rs, rs
+        """(r.z, r.r) as ONE stacked collective, UNCONDITIONALLY — the
+        mpicuda2-4 discipline (fold scalars into one reduction) applied
+        to both variants: the preconditioned loop would otherwise pay a
+        third all-reduce latency per iteration, and the plain loop keeps
+        the same single-psum schedule (the redundant r.z=r.r lane costs
+        one local multiply, never a collective).  The ledger pins the
+        count: classic CG is exactly TWO all-reduces per iteration
+        (p.Ap is data-dependent on this one and cannot fold — the gap
+        pipelined_cg closes)."""
         both = lax.psum(jnp.stack([jnp.sum(r * z), jnp.sum(r * r)]), axes)
         return both[0], both[1]
 
@@ -125,13 +129,143 @@ def cg(
     return x, k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
 
 
+def pipelined_cg(
+    matvec: Callable[[jnp.ndarray], jnp.ndarray],
+    b: jnp.ndarray,
+    axes,
+    *,
+    tol: float = 1e-5,
+    max_iters: int = 1000,
+    precond: Optional[Callable[[jnp.ndarray], jnp.ndarray]] = None,
+    replace_every: int = 96,
+):
+    """Ghysels–Vanroose pipelined CG: ONE stacked ``psum`` per iteration.
+
+    Same contract as :func:`cg` (``(x, iters, relres)``, SPD ``matvec``
+    inside ``shard_map``), different recurrence structure: auxiliary
+    vectors ``w = A u``, ``z = A q`` etc. are carried so that the three
+    scalars an iteration needs — ``gamma = r.u``, ``delta = w.u``, and
+    ``r.r`` for the stop rule — are all products of ALREADY-AVAILABLE
+    vectors and fold into a single length-3 stacked ``psum``.  Classic
+    CG cannot do this: ``p.Ap`` depends on the ``beta`` that the
+    previous reduction produced, forcing two serialized collectives per
+    iteration.  This is the mpicuda2-4 progression (separate dots ->
+    timed spans -> one fused reduction, mpicuda4.cu:157-185) taken to
+    its limit at the collective-schedule level — the same
+    collective-decomposition discipline as Wang et al.'s overlap work,
+    applied to latency instead of bandwidth.
+
+    The price (the reason classic CG stays the default): two extra
+    vector recurrences' worth of FLOPs and storage, and ALL state is
+    maintained by recurrence — in f32 the joint drift of the auxiliary
+    vectors stalls convergence on ill-conditioned systems, so every
+    ``replace_every`` iterations the residual chain is REFRESHED from
+    its definition (``r = b - Ax``, ``u = Mr``, ``w = Au``) and the
+    next iteration RESTARTS the Krylov process (``beta = 0``) — each
+    segment is genuine CG warm-started from the refreshed true
+    residual, the restarted form of Ghysels & Vanroose's
+    residual-replacement remedy (splicing a replaced residual into
+    live conjugacy recurrences can break convergence; a restart cannot).
+    The refresh is matvec-only (no collectives beyond the matvec's own
+    halo ppermutes, NO extra psum — the one-reduction-per-iteration
+    claim is unchanged), costs 2 matvecs once per segment, and fires
+    inside a ``lax.cond`` whose predicate is replicated (every rank
+    takes the same branch, so the collective schedule stays uniform).
+    Convergence is tolerance-gated against classic CG in the tests
+    rather than asserted bit-equal: the restart discards Krylov
+    history, so the iteration count carries a conditioning-dependent
+    penalty over classic CG (~1.1x at the config-15 64^2 geometry,
+    growing on harder systems) — the per-iteration collective saving
+    must beat it, which is the latency-bound-slice regime (one psum
+    launch per iteration where classic pays two serialized), not the
+    single-host one.  Classic CG stays the default.
+    ``precond`` must be SPD, exactly as for :func:`cg`.
+    """
+    dtype = b.dtype
+    apply_m = (lambda v: v) if precond is None else precond
+
+    def fused3(r, u, w):
+        """(r.u, w.u, r.r) — THE one collective per iteration."""
+        out = lax.psum(
+            jnp.stack([jnp.sum(r * u), jnp.sum(w * u), jnp.sum(r * r)]),
+            axes,
+        )
+        return out[0], out[1], out[2]
+
+    x0 = jnp.zeros_like(b)
+    r0 = b
+    u0 = apply_m(r0)
+    w0 = matvec(u0)
+    gamma0, delta0, rs0 = fused3(r0, u0, w0)
+    stop2 = jnp.asarray(tol, dtype) ** 2 * rs0
+    zero_v = jnp.zeros_like(b)
+    one = jnp.asarray(1.0, dtype)
+
+    def cond(st):
+        rs, k = st[10], st[13]
+        return jnp.logical_and(k < max_iters, rs > stop2)
+
+    def body(st):
+        (x, r, u, w, zv, q, s, p, gamma, delta, rs,
+         gamma_prev, alpha_prev, k) = st
+        m = apply_m(w)
+        n = matvec(m)
+        # a segment start (k = 0 or just-refreshed state) restarts the
+        # Krylov process: beta = 0 discards the stale direction history,
+        # so each segment is genuine CG warm-started from the refreshed
+        # TRUE residual — monotone by construction, where splicing a
+        # replaced residual into live conjugacy recurrences is not
+        first = (k % replace_every) == 0
+        beta = jnp.where(first, jnp.zeros((), dtype), gamma / gamma_prev)
+        denom = jnp.where(first, delta,
+                          delta - beta * gamma / alpha_prev)
+        alpha = gamma / denom
+        zv = n + beta * zv
+        q = m + beta * q
+        s = w + beta * s
+        p = u + beta * p
+        x = x + alpha * p
+        r = r - alpha * s
+        u = u - alpha * q
+        w = w - alpha * zv
+
+        def refresh(x_r):
+            r_r = b - matvec(x_r)
+            u_r = apply_m(r_r)
+            return (r_r, u_r, matvec(u_r))
+
+        r, u, w = lax.cond(
+            (k + 1) % replace_every == 0,
+            refresh,
+            lambda x_r: (r, u, w),
+            x,
+        )
+        gamma_n, delta_n, rs_n = fused3(r, u, w)
+        return (x, r, u, w, zv, q, s, p, gamma_n, delta_n, rs_n,
+                gamma, alpha, k + 1)
+
+    st = (x0, r0, u0, w0, zero_v, zero_v, zero_v, zero_v,
+          gamma0, delta0, rs0, one, one, jnp.asarray(0, jnp.int32))
+    st = lax.while_loop(cond, body, st)
+    x, rs, k = st[0], st[10], st[13]
+    tiny = jnp.asarray(np.finfo(np.dtype(dtype)).tiny, dtype)
+    return x, k, jnp.sqrt(rs / jnp.maximum(rs0, tiny))
+
+
+#: poisson_solve method name -> solver loop
+METHODS = {"cg": cg, "pipelined": pipelined_cg}
+
+
 @functools.lru_cache(maxsize=64)
-def _poisson_program(mesh: Mesh, spec, tol: float, iters: int):
+def _poisson_program(mesh: Mesh, spec, tol: float, iters: int,
+                     method: str = "cg"):
     """Compiled-per-config CG program: repeat solves with the same mesh,
     layout, and knobs reuse the jitted program instead of re-tracing
     (~10 s of recompilation per 1024^2 solve otherwise)."""
+    solver = METHODS[method]
+
     def local(b_tile):
-        x, k, relres = cg(
+        x, k, relres = solver(
             lambda p: dirichlet_laplacian(p, spec),
             b_tile[0, 0],
             tuple(mesh.axis_names),
@@ -154,21 +288,26 @@ def poisson_solve(
     *,
     tol: float = 1e-5,
     max_iters: Optional[int] = None,
+    method: str = "cg",
 ):
     """Solve ``A x = b`` (zero-Dirichlet 5-point Laplacian) distributed.
 
     Whole-grid driver in the style of ``halo.driver``: decompose ``b``
     over a 2D device mesh, run the compiled CG program, reassemble.
-    Returns ``(x_world, iters, relres)``.
+    Returns ``(x_world, iters, relres)``.  ``method='pipelined'``
+    selects the single-reduction Ghysels–Vanroose loop
+    (:func:`pipelined_cg`) — one ``psum`` per iteration instead of two.
     """
     from tpuscratch.halo.driver import _setup, assemble, decompose
 
+    if method not in METHODS:
+        raise ValueError(f"unknown method {method!r}; have {tuple(METHODS)}")
     gh, gw = b_world.shape
     mesh, topo, layout, spec = _setup(
         b_world.shape, mesh, (1, 1), periodic=False, neighbors=4
     )
     iters = max_iters if max_iters is not None else gh * gw
-    program = _poisson_program(mesh, spec, float(tol), int(iters))
+    program = _poisson_program(mesh, spec, float(tol), int(iters), method)
     # CG state vectors are core tiles (no ghost ring): decompose/assemble
     # with a halo-0 view of the same layout
     flat = TileLayout(layout.core_h, layout.core_w, 0, 0)

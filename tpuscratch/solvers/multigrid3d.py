@@ -17,7 +17,13 @@ decisions as 2D, same reasons:
   faces-only plan (6 ppermutes), only the two inter-level transfers per
   cycle pay the 26-transfer plan;
 - one trace: unrolled level recursion, while_loop cycle iteration,
-  psum'd residuals, zero host round trips.
+  psum'd residuals, zero host round trips;
+- communication-avoiding smoothing on request (``s_step > 1``): the
+  s-step / trapezoid scheme — one deep axis-sequential exchange
+  (``halo_exchange3d_seq``, 6 ppermutes at any depth) buys ``s`` Jacobi
+  sweeps (ghost depth ``s``) or ``s`` red-black sweeps (depth ``2s``),
+  bit-identical to exchange-every-sweep, clamped per level to what the
+  tile seats.
 
 Measured (tests assert the bounds): cycle count flat in grid size —
 7-8 cycles to 1e-6 from 16^3 to 128^3 (chip-verified) — the same O(1)
@@ -41,6 +47,7 @@ from tpuscratch.halo.halo3d import (
     decompose3d_cores,
     assemble3d_cores,
     halo_exchange3d,
+    halo_exchange3d_seq,
 )
 from tpuscratch.runtime.mesh import make_mesh, topology_of
 from tpuscratch.runtime.topology import factor3d
@@ -167,6 +174,169 @@ def jacobi_smooth3_stream(u, f, spec: HaloSpec3D, omega: float,
     return out
 
 
+def _deep_spec(spec: HaloSpec3D, depth: int) -> HaloSpec3D:
+    """The depth-``depth`` twin of a level's faces spec (the s-step
+    smoother's ghost geometry; plans are cached per (layout, topology))."""
+    return HaloSpec3D(
+        layout=TileLayout3D(spec.layout.core, (depth,) * 3),
+        topology=spec.topology, axes=spec.axes, neighbors=6,
+    )
+
+
+def _embed_seq(core: jnp.ndarray, dspec: HaloSpec3D) -> jnp.ndarray:
+    """Zero-embed a core tile at the deep spec's depth and fill the FULL
+    ghost shell (edges/corners transitively) with the 6-ppermute
+    axis-sequential exchange."""
+    d = dspec.layout.halo[0]
+    p = jnp.zeros(dspec.layout.padded_shape, core.dtype)
+    p = lax.dynamic_update_slice(p, core, (d, d, d))
+    return halo_exchange3d_seq(p, dspec)
+
+
+def _require_periodic_deep(spec: HaloSpec3D, name: str) -> None:
+    if not all(spec.topology.periodic):
+        # an open physical end's ghost rings would need re-zeroing every
+        # substep (the 2D deep path's open_side_flags machinery); the mg
+        # solvers are periodic-only, so refuse rather than smooth wrong
+        raise ValueError(f"{name} is periodic-only; use the per-sweep "
+                         "smoother for open boundaries")
+
+
+def jacobi_smooth3_deep(u, f, spec: HaloSpec3D, omega: float, sweeps: int,
+                        s: int):
+    """``sweeps`` damped-Jacobi sweeps, ``s`` per halo exchange — the
+    s-step / trapezoid (ghost-zone) scheme of ``halo.stencil``'s
+    ``run_stencil_deep``, one dimension up and fused with the rhs.
+
+    One depth-``s`` axis-sequential exchange fills the full ghost shell;
+    substep ``j`` then updates every cell at least ``j`` rings in from
+    the padded border with EXACTLY the per-sweep arithmetic (same op
+    order as :func:`jacobi_smooth3`, so the result is bit-identical —
+    the trapezoid-validity law the tests pin).  The ledger-visible trade:
+    ``ceil(sweeps/s)`` state exchanges plus ONE rhs ghost fill per call
+    (depth ``s-1``; ``f`` never changes across sweeps) instead of one
+    exchange per sweep — ~``s``x fewer ppermute launches, per-sweep wire
+    bytes within an ``O(s/core)`` redundant-boundary factor of the
+    per-sweep path.  Rounds are python-unrolled so the static collective
+    count in the compiled HLO IS the dynamic launch count (the proof
+    obligation), which keeps ``sweeps`` a trace-time constant.
+    """
+    _require_periodic_deep(spec, "jacobi_smooth3_deep")
+    if s < 1:
+        raise ValueError(f"s-step depth must be >= 1, got {s}")
+    if s == 1:
+        return jacobi_smooth3(u, f, spec, omega, sweeps)
+    if s > min(spec.layout.core):
+        raise ValueError(
+            f"s={s} deeper than core {spec.layout.core}: neighbor slabs "
+            "would overlap"
+        )
+    dspec = _deep_spec(spec, s)
+    fp = _embed_seq(f, _deep_spec(spec, s - 1))
+
+    def lap(a):
+        # periodic_laplacian3's exact op order, on the shrinking window
+        return (
+            6.0 * a[1:-1, 1:-1, 1:-1]
+            - a[:-2, 1:-1, 1:-1] - a[2:, 1:-1, 1:-1]
+            - a[1:-1, :-2, 1:-1] - a[1:-1, 2:, 1:-1]
+            - a[1:-1, 1:-1, :-2] - a[1:-1, 1:-1, 2:]
+        )
+
+    def trapezoid(core, k):
+        a = _embed_seq(core, dspec)
+        for j in range(1, k + 1):
+            # substep j's output spans ghost ring s-j; the rhs tile is
+            # ghosted to depth s-1, so crop j-1 rings to align
+            c = j - 1
+            fw = fp[c:-c, c:-c, c:-c] if c else fp
+            a = a[1:-1, 1:-1, 1:-1] + (omega / 6.0) * (fw - lap(a))
+        crop = s - k
+        return a[crop:-crop, crop:-crop, crop:-crop] if crop else a
+
+    q, r = divmod(sweeps, s)
+    out = u
+    for _ in range(q):
+        out = trapezoid(out, s)
+    if r:
+        out = trapezoid(out, r)
+    return out
+
+
+def _parity_masks(shape, offset: int):
+    ii = jnp.arange(shape[0])[:, None, None]
+    jj = jnp.arange(shape[1])[None, :, None]
+    kk = jnp.arange(shape[2])[None, None, :]
+    red = (ii + jj + kk + offset) % 2 == 0
+    return red
+
+
+def rbgs_smooth3_deep(u, f, spec: HaloSpec3D, sweeps: int, s: int,
+                      reverse: bool = False):
+    """``sweeps`` red-black GS sweeps, ``s`` per halo exchange.
+
+    Each RBGS sweep is TWO masked half-updates and the per-sweep path
+    exchanges before each (12 ppermutes/sweep), so the trapezoid needs
+    ghost depth ``2*s`` and wins ``2*s``x on launches: one 6-ppermute
+    exchange per ``s`` sweeps plus one depth-``2s-1`` rhs fill per call.
+    Masks use GLOBAL parity: even core extents make every rank's tile
+    start even, so parity in window coordinates is rank-independent and
+    only shifts by the crop count (odd per crop — 3 axes each advance
+    one) — exactly the per-sweep smoother's (i+j+k) mod 2 coloring seen
+    through the shrinking window.  Same op order as
+    :func:`rbgs_smooth3`, so bit-identical (the tests pin it).
+    """
+    _require_periodic_deep(spec, "rbgs_smooth3_deep")
+    cz, cy, cx = spec.layout.core
+    if cz % 2 or cy % 2 or cx % 2:
+        raise ValueError(
+            f"red-black smoothing needs even core extents, got {spec.layout.core}"
+        )
+    if s < 1:
+        raise ValueError(f"s-step depth must be >= 1, got {s}")
+    d = 2 * s
+    if d > min(spec.layout.core):
+        raise ValueError(
+            f"s={s} needs ghost depth {d} > core {spec.layout.core}"
+        )
+    dspec = _deep_spec(spec, d)
+    fp = _embed_seq(f, _deep_spec(spec, d - 1))
+
+    def nbsum(a):
+        # _neighbor_sum3's exact op order, on the shrinking window
+        return (
+            a[:-2, 1:-1, 1:-1] + a[2:, 1:-1, 1:-1]
+            + a[1:-1, :-2, 1:-1] + a[1:-1, 2:, 1:-1]
+            + a[1:-1, 1:-1, :-2] + a[1:-1, 1:-1, 2:]
+        )
+
+    def trapezoid(core, k):
+        # k sweeps = 2k half-updates; half t's output sits t+1 crops in,
+        # so its window parity offset is (t+1) mod 2 (d is even, rank
+        # starts even, each crop shifts i+j+k's parity by 3 == 1 mod 2)
+        a = _embed_seq(core, dspec)
+        for t in range(2 * k):
+            # half t's output spans ghost ring d-t-1; the rhs tile is
+            # ghosted to depth d-1, so crop t rings to align
+            fw = fp[t:-t, t:-t, t:-t] if t else fp
+            red = _parity_masks(
+                tuple(n - 2 for n in a.shape), (t + 1) % 2
+            )
+            update_red = (t % 2 == 0) != reverse
+            mask = red if update_red else ~red
+            a = jnp.where(mask, (fw + nbsum(a)) / 6.0, a[1:-1, 1:-1, 1:-1])
+        crop = d - 2 * k
+        return a[crop:-crop, crop:-crop, crop:-crop] if crop else a
+
+    q, r = divmod(sweeps, s)
+    out = u
+    for _ in range(q):
+        out = trapezoid(out, s)
+    if r:
+        out = trapezoid(out, r)
+    return out
+
+
 def _stream_smoothable(spec: HaloSpec3D, sweeps: int) -> bool:
     """True when the streamed smoother serves this level: a z-slab
     periodic mesh, a core deep enough for >= 2 bands of >= the fold
@@ -188,16 +358,35 @@ def _stream_smoothable(spec: HaloSpec3D, sweeps: int) -> bool:
     )
 
 
-def _smooth3(u, f, spec, omega, sweeps, smoother, reverse=False):
+def _smooth3(u, f, spec, omega, sweeps, smoother, reverse=False,
+             s_step: int = 1):
+    """One smoothing pass; ``s_step > 1`` requests the s-step deep-halo
+    variants (s sweeps per exchange), clamped per level to what the tile
+    supports — coarse levels whose cores cannot seat the ghost depth
+    fall back to the per-sweep path, which is also where the fold buys
+    least (coarse sweeps are launch-bound on tiny arrays either way)."""
     cz, cy, cx = spec.layout.core
     if smoother == "jacobi-stream":
         if _stream_smoothable(spec, sweeps):
             return jacobi_smooth3_stream(u, f, spec, omega, sweeps)
         return jacobi_smooth3(u, f, spec, omega, sweeps)
+    deep = (
+        s_step > 1
+        and all(spec.topology.periodic)
+        and sweeps > 1
+    )
     if smoother == "rbgs" and not (cz % 2 or cy % 2 or cx % 2):
+        if deep:
+            s_eff = min(s_step, sweeps, min(cz, cy, cx) // 2)
+            if s_eff > 1:
+                return rbgs_smooth3_deep(u, f, spec, sweeps, s_eff, reverse)
         return rbgs_smooth3(u, f, spec, sweeps, reverse)
     if smoother not in ("jacobi", "rbgs"):
         raise ValueError(f"unknown smoother {smoother!r}")
+    if deep:
+        s_eff = min(s_step, sweeps, min(cz, cy, cx))
+        if s_eff > 1:
+            return jacobi_smooth3_deep(u, f, spec, omega, sweeps, s_eff)
     return jacobi_smooth3(u, f, spec, omega, sweeps)
 
 
@@ -279,25 +468,32 @@ def level_specs3(
 def v_cycle3(
     u, f, specs, level: int = 0,
     nu: int = 2, coarse_sweeps: int = 32, omega: float = 6 / 7,
-    smoother: str = "rbgs",
+    smoother: str = "rbgs", s_step: int = 1,
 ):
     """One 3D V-cycle (recursion unrolls at trace time); post-smoothing
     reverses color order so the cycle is symmetric. ``specs`` is the
-    ``level_specs3`` list of (faces, all-26) pairs."""
+    ``level_specs3`` list of (faces, all-26) pairs.  ``s_step > 1`` runs
+    every smoothing pass communication-avoiding: ``s_step`` sweeps per
+    (deep, axis-sequential) halo exchange.  Each smoothing pass is
+    BIT-identical to its per-sweep twin (tests assert it); the composed
+    cycle agrees to roundoff (whole-program fusion may re-round) at an
+    identical cycle count."""
     s6, s26 = specs[level]
     if level == len(specs) - 1:
         half = (coarse_sweeps + 1) // 2
-        u = _smooth3(u, f, s6, omega, half, smoother)
-        return _smooth3(u, f, s6, omega, half, smoother, reverse=True)
-    u = _smooth3(u, f, s6, omega, nu, smoother)
+        u = _smooth3(u, f, s6, omega, half, smoother, s_step=s_step)
+        return _smooth3(u, f, s6, omega, half, smoother, reverse=True,
+                        s_step=s_step)
+    u = _smooth3(u, f, s6, omega, nu, smoother, s_step=s_step)
     r = f - periodic_laplacian3(u, s6)
     rc = 4.0 * restrict_fw3(r, s26)
     ec = v_cycle3(
         jnp.zeros_like(rc), rc, specs, level + 1, nu, coarse_sweeps, omega,
-        smoother,
+        smoother, s_step,
     )
     u = u + prolong_trilinear(ec, specs[level + 1][1])
-    return _smooth3(u, f, s6, omega, nu, smoother, reverse=True)
+    return _smooth3(u, f, s6, omega, nu, smoother, reverse=True,
+                    s_step=s_step)
 
 
 def _mg_prologue3(b_world: np.ndarray, mesh: Optional[Mesh], levels: Optional[int]):
@@ -328,7 +524,7 @@ def _mg_prologue3(b_world: np.ndarray, mesh: Optional[Mesh], levels: Optional[in
 
 @functools.lru_cache(maxsize=16)
 def _mg3_program(mesh, specs, axes, cells, tol, max_cycles, nu,
-                 coarse_sweeps, omega, smoother):
+                 coarse_sweeps, omega, smoother, s_step=1):
     """Compiled-per-config 3D V-cycle solver program."""
     def local(b_tile):
         b = b_tile[0, 0, 0]
@@ -347,7 +543,8 @@ def _mg3_program(mesh, specs, axes, cells, tol, max_cycles, nu,
 
         def body(st):
             u, rs, _, k = st
-            u = v_cycle3(u, f, specs, 0, nu, coarse_sweeps, omega, smoother)
+            u = v_cycle3(u, f, specs, 0, nu, coarse_sweeps, omega, smoother,
+                         s_step)
             return u, rs_of(u), rs, k + 1
 
         u0 = jnp.zeros_like(f)
@@ -378,17 +575,22 @@ def mg_poisson3d_solve(
     coarse_sweeps: int = 32,
     omega: float = 6 / 7,
     smoother: str = "rbgs",
+    s_step: int = 1,
 ):
     """Solve ``A x = b - mean(b)`` (periodic 7-point Laplacian) by 3D
     V-cycles over a 3-axis mesh. Returns ``(x_world, cycles, relres)``
     with zero-mean ``x`` (same contract as the 2D solver, including the
-    check-``relres`` convergence caveat on ``mg_poisson_solve``)."""
+    check-``relres`` convergence caveat on ``mg_poisson_solve``).
+    ``s_step > 1`` runs the smoothers communication-avoiding (``s_step``
+    sweeps per deep halo exchange) — smoother-level bit-identical by
+    the trapezoid validity law, same cycle count, solution equal to
+    roundoff."""
     from tpuscratch.solvers.multigrid import warn_unconverged
 
     mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
     program = _mg3_program(
         mesh, tuple(specs), axes, cells, float(tol), int(max_cycles),
-        int(nu), int(coarse_sweeps), float(omega), smoother,
+        int(nu), int(coarse_sweeps), float(omega), smoother, int(s_step),
     )
     x_tiles, k, relres = program(
         jnp.asarray(decompose3d_cores(b_world, dims))
@@ -408,17 +610,20 @@ def pcg_poisson3d_solve(
     coarse_sweeps: int = 16,
     omega: float = 6 / 7,
     smoother: str = "rbgs",
+    s_step: int = 1,
 ):
     """Multigrid-preconditioned CG on the 3D periodic Poisson problem —
     the 2D ``pcg_poisson_solve`` one dimension up, same contract:
     ``(x_world, iters, relres)``, nullspace-projected symmetric V-cycle
-    preconditioner, true-residual stopping."""
+    preconditioner, true-residual stopping.  ``s_step`` folds smoothing
+    sweeps per halo exchange inside the preconditioner, exactly as in
+    ``mg_poisson3d_solve``."""
     from tpuscratch.solvers.multigrid import warn_unconverged
 
     mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
     program = _pcg3_program(
         mesh, tuple(specs), axes, cells, float(tol), int(max_iters),
-        int(nu), int(coarse_sweeps), float(omega), smoother,
+        int(nu), int(coarse_sweeps), float(omega), smoother, int(s_step),
     )
     x_tiles, k, relres = program(
         jnp.asarray(decompose3d_cores(b_world, dims))
@@ -429,7 +634,7 @@ def pcg_poisson3d_solve(
 
 @functools.lru_cache(maxsize=16)
 def _pcg3_program(mesh, specs, axes, cells, tol, max_iters, nu,
-                  coarse_sweeps, omega, smoother):
+                  coarse_sweeps, omega, smoother, s_step=1):
     """Compiled-per-config 3D MG-preconditioned CG program."""
     from tpuscratch.solvers.cg import cg
 
@@ -443,7 +648,7 @@ def _pcg3_program(mesh, specs, axes, cells, tol, max_iters, nu,
         def precond(r):
             z = v_cycle3(
                 jnp.zeros_like(r), project(r), specs, 0, nu,
-                coarse_sweeps, omega, smoother,
+                coarse_sweeps, omega, smoother, s_step,
             )
             return project(z)
 
