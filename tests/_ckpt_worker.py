@@ -8,7 +8,11 @@ Runs ``checkpointed_stencil`` and dies mid-flight when asked:
 - ``TPUSCRATCH_CHAOS_KILL=<stage>:<save_idx>`` SIGKILLs the process AT a
   named stage INSIDE ``checkpoint.save`` on the given save occurrence,
   through the ft chaos hook — the kill-mid-save matrix (every internal
-  stage must leave a valid resumable step behind).
+  stage must leave a valid resumable step behind).  The ``write:``
+  prefix (``write:<stage>:<idx>``) targets the ``ckpt/write`` site
+  instead — the ASYNC background writer's stages;
+- ``TPUSCRATCH_ASYNC_CKPT=1`` runs the driver with async checkpointing
+  (snapshot-then-publish) instead of blocking saves.
 
 Usage:
 
@@ -21,6 +25,7 @@ import sys
 ckpt_dir, steps, save_every = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
 die_after = int(os.environ.get("TPUSCRATCH_DIE_AFTER_SAVES", "0"))
 chaos_kill = os.environ.get("TPUSCRATCH_CHAOS_KILL", "")
+async_ckpt = bool(int(os.environ.get("TPUSCRATCH_ASYNC_CKPT", "0")))
 
 from tpuscratch.runtime.hostenv import force_cpu_devices
 
@@ -50,16 +55,19 @@ chaos = None
 if chaos_kill:
     from tpuscratch.ft.chaos import ChaosPlan, Fault
 
-    stage, save_idx = chaos_kill.rsplit(":", 1)
+    site, spec = "ckpt/save", chaos_kill
+    if spec.startswith("write:"):
+        site, spec = "ckpt/write", spec[len("write:"):]
+    stage, save_idx = spec.rsplit(":", 1)
     chaos = ChaosPlan(0, [
-        Fault("ckpt/save", stage=stage, at=(int(save_idx),), kind="kill"),
+        Fault(site, stage=stage, at=(int(save_idx),), kind="kill"),
     ])
 
 rng = np.random.default_rng(123)  # same world every invocation
 world = rng.standard_normal((16, 16)).astype(np.float32)
 out = driver.checkpointed_stencil(
     world, steps=steps, ckpt_dir=ckpt_dir, save_every=save_every,
-    mesh=make_mesh_2d((2, 2)), chaos=chaos,
+    mesh=make_mesh_2d((2, 2)), chaos=chaos, async_ckpt=async_ckpt,
 )
 np.save(os.path.join(ckpt_dir, "result.npy"), out)
 print(f"WORKER done at step {checkpoint.latest_step(ckpt_dir)}", flush=True)

@@ -21,7 +21,7 @@ REPO = pathlib.Path(__file__).parent.parent
 
 
 def _run_worker(ckpt_dir, steps, save_every, die_after=0, chaos_kill="",
-                timeout=180):
+                async_ckpt=False, timeout=180):
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(
         [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
@@ -34,6 +34,10 @@ def _run_worker(ckpt_dir, steps, save_every, die_after=0, chaos_kill="",
         env["TPUSCRATCH_CHAOS_KILL"] = chaos_kill
     else:
         env.pop("TPUSCRATCH_CHAOS_KILL", None)
+    if async_ckpt:
+        env["TPUSCRATCH_ASYNC_CKPT"] = "1"
+    else:
+        env.pop("TPUSCRATCH_ASYNC_CKPT", None)
     p = subprocess.run(
         [sys.executable, str(WORKER), str(ckpt_dir), str(steps), str(save_every)],
         capture_output=True, text=True, timeout=timeout, env=env,
@@ -120,6 +124,78 @@ def test_sigkill_inside_save_always_leaves_valid_step(tmp_path,
         np.testing.assert_array_equal(
             np.load(kill_dir / "result.npy"), clean
         )
+
+
+@pytest.mark.chaos
+@pytest.mark.elastic
+def test_sigkill_during_background_write_resumes_bit_identical(
+        tmp_path, clean_result):
+    """The async half of the kill-mid-save matrix: the worker runs with
+    snapshot-then-publish checkpointing and is SIGKILLed AT named stages
+    INSIDE the BACKGROUND writer's ``checkpoint.save`` (the ``ckpt/write``
+    chaos site) across write occurrences.  Because writes are serialized
+    behind the snapshot barrier, a kill at write k's pre-publish stages
+    leaves exactly writes 0..k-1 published (its own step after
+    ``publish``); resume must always find a valid step and the re-invoked
+    async run must finish bit-identical to the uninterrupted blocking
+    oracle — published checkpoints are byte-identical across paths, so
+    one oracle serves both."""
+    from tpuscratch.runtime import checkpoint
+
+    steps, save_every = STEPS, SAVE_EVERY
+    clean = clean_result
+
+    for stage, write_idx in [("begin", 1), ("manifest", 2), ("publish", 3)]:
+        kill_dir = tmp_path / f"wkill_{stage}_{write_idx}"
+        p = _run_worker(kill_dir, steps, save_every,
+                        chaos_kill=f"write:{stage}:{write_idx}",
+                        async_ckpt=True)
+        assert p.returncode == -9, (stage, p.returncode,
+                                    p.stdout + p.stderr)
+        latest = checkpoint.latest_step(kill_dir)
+        expected = write_idx * save_every if stage != "publish" \
+            else (write_idx + 1) * save_every
+        assert latest == (expected or None), (stage, latest)
+        if latest is not None:
+            # the surviving step must be fully loadable, not torn
+            tiles, s, _ = checkpoint.restore(
+                kill_dir, np.zeros((2, 2, 10, 10), np.float32)
+            )
+            assert s == latest
+        p = _run_worker(kill_dir, steps, save_every, async_ckpt=True)
+        assert p.returncode == 0, (stage, p.stdout + p.stderr)
+        np.testing.assert_array_equal(
+            np.load(kill_dir / "result.npy"), clean
+        )
+
+
+@pytest.mark.elastic
+def test_async_run_matches_blocking_and_checkpoints_byte_identical(
+        tmp_path, clean_result):
+    """Async on, no faults: the worker's result bit-matches the blocking
+    oracle and the final published checkpoint directory is BYTE-identical
+    to a blocking save of the same state (same leaf files, same
+    manifest payload modulo nothing — the writer goes through the one
+    ``checkpoint.save``)."""
+    from tpuscratch.runtime import checkpoint
+
+    d = tmp_path / "async"
+    p = _run_worker(d, STEPS, SAVE_EVERY, async_ckpt=True)
+    assert p.returncode == 0, p.stdout + p.stderr
+    np.testing.assert_array_equal(np.load(d / "result.npy"), clean_result)
+
+    # re-save the restored final step through the BLOCKING path and
+    # compare the published bytes file-for-file
+    step = checkpoint.latest_step(d)
+    tiles, s, meta = checkpoint.restore(
+        d, np.zeros((2, 2, 10, 10), np.float32)
+    )
+    blocking = tmp_path / "blocking_ref"
+    checkpoint.save(blocking, s, tiles, metadata=meta)
+    a_dir = pathlib.Path(d) / f"step_{s:09d}"
+    b_dir = blocking / f"step_{s:09d}"
+    for f in sorted(p.name for p in b_dir.iterdir()):
+        assert (a_dir / f).read_bytes() == (b_dir / f).read_bytes(), f
 
 
 def test_save_hook_crash_at_any_stage_keeps_published_step(tmp_path):

@@ -1,0 +1,516 @@
+"""Elastic fault tolerance (ISSUE 11 acceptance anchors):
+
+- **reshard-on-resume**: ``models.zero.reshard_state`` regroups flat
+  dp-sharded (and pp x dp stage-grouped) moment vectors across plan
+  identities exactly (element-identical on the true region, fresh
+  alignment padding zeroed), round-trips, and refuses cross-family
+  moves; ``checkpoint.restore(mesh_shape=)`` names BOTH identities and
+  the ``reshard=True`` escape hatch in its mismatch ``CommError``; a
+  run preempted on dp=4 RESUMES on dp=2 where it previously raised —
+  with the trainer's internal regroup proven leaf-for-leaf equal to the
+  manual ``reshard_state`` path, and the shrunk resume bit-identical to
+  its own replay.
+- **elastic supervision**: ``ft.supervise_train_elastic`` rebuilds the
+  mesh from the surviving devices after a preemption and completes on
+  the shrunk plan, replay-deterministically.
+- **async checkpointing**: ``runtime.async_ckpt.AsyncCheckpointer``
+  publishes checkpoints byte-identical to the blocking path, keeps at
+  most one write in flight behind the snapshot barrier, absorbs
+  transient ``ckpt/write`` chaos under retry, surfaces persistent
+  failures at the drain, and the async trainer run emits the split
+  ``ckpt/snapshot``/``ckpt/write`` events that ``obs.goodput`` books
+  into an exactly-summing partition.
+"""
+
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpuscratch.ft.chaos import ChaosPlan, Fault, InjectedFault
+from tpuscratch.models.trainer import synthetic_batch, train
+from tpuscratch.models.transformer import (
+    TransformerConfig,
+    init_params,
+    nonexpert_size,
+    stack_layers,
+)
+from tpuscratch.models.zero import (
+    init_zero_adam_state,
+    put_zero_state,
+    reshard_state,
+    train_step_zero,
+    zero_flat_size,
+)
+from tpuscratch.runtime import checkpoint
+from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
+from tpuscratch.runtime.errors import CommError
+from tpuscratch.runtime.mesh import make_mesh
+
+pytestmark = pytest.mark.elastic
+
+
+def _cfg(n_experts=2, n_layers=2):
+    return TransformerConfig(
+        d_model=16, n_heads=2, n_experts=n_experts, d_ff=32,
+        n_layers=n_layers, capacity_factor=2.0,
+    )
+
+
+def _mesh(shape):
+    return make_mesh(shape, ("dp", "sp"),
+                     jax.devices()[:shape[0] * shape[1]])
+
+
+def _leaves_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(p), np.asarray(q))
+        for p, q in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _plan(dp, sp=1, pp=1, n_micro=1):
+    return {"dp": dp, "sp": sp, "pp": pp, "n_micro": n_micro}
+
+
+def _fake_zero_state(params, dp, seed=0):
+    """A saved-layout ZeRO state with DISTINCT recognizable moment
+    values on the true (non-padding) region — the regroup tests' probe.
+    Padding slots are zero, the invariant the real state maintains."""
+    n = nonexpert_size(params)
+    flat = zero_flat_size(n, dp)
+    rng = np.random.default_rng(seed)
+    mu = np.zeros((flat,), np.float32)
+    nu = np.zeros((flat,), np.float32)
+    mu[:n] = rng.standard_normal(n).astype(np.float32)
+    nu[:n] = rng.standard_normal(n).astype(np.float32) ** 2
+    from tpuscratch.models.transformer import expert_leaves
+
+    exp = expert_leaves(params)
+    return {
+        "mu_flat": mu, "nu_flat": nu,
+        "mu_exp": [rng.standard_normal(x.shape).astype(np.float32)
+                   for x in exp],
+        "nu_exp": [rng.standard_normal(x.shape).astype(np.float32) ** 2
+                   for x in exp],
+        "t": np.asarray(7, np.int32),
+    }
+
+
+class TestReshardState:
+    def test_flat_dp_regroup_is_exact_and_roundtrips(self, devices):
+        """dp=4 -> dp=2: the true region is element-identical (the flat
+        vector is layout-invariant modulo alignment padding), fresh
+        padding is zero, and the round trip back to dp=4 reproduces the
+        original vector bit-for-bit."""
+        cfg = _cfg()
+        params = init_params(0, cfg)
+        n = nonexpert_size(params)
+        a = _fake_zero_state(params, dp=4)
+        b = reshard_state(a, params, _plan(4), _plan(2))
+        assert b["mu_flat"].shape == (zero_flat_size(n, 2),)
+        np.testing.assert_array_equal(b["mu_flat"][:n], a["mu_flat"][:n])
+        assert not b["mu_flat"][n:].any()
+        for x, y in zip(a["mu_exp"], b["mu_exp"]):
+            np.testing.assert_array_equal(x, y)
+        assert int(b["t"]) == int(a["t"])
+        back = reshard_state(b, params, _plan(2), _plan(4))
+        np.testing.assert_array_equal(back["mu_flat"], a["mu_flat"])
+        np.testing.assert_array_equal(back["nu_flat"], a["nu_flat"])
+
+    def test_identical_plans_pass_through(self, devices):
+        cfg = _cfg()
+        params = init_params(0, cfg)
+        a = _fake_zero_state(params, dp=2)
+        assert reshard_state(a, params, _plan(2), _plan(2)) is a
+
+    def test_cross_family_raises(self, devices):
+        cfg = _cfg()
+        params = init_params(0, cfg)
+        a = _fake_zero_state(params, dp=2)
+        with pytest.raises(CommError, match="famil"):
+            reshard_state(a, params, _plan(2), _plan(2, pp=2, n_micro=2))
+
+    def test_pp_stage_regroup_is_path_independent(self, devices):
+        """Within the stage-stacked family, regrouping pp=1 -> pp=2 ->
+        pp=4 equals regrouping pp=1 -> pp=4 directly — the flat vector
+        is a pure function of the per-leaf moments and the grouping."""
+        cfg = _cfg(n_layers=4)
+        stacked = stack_layers(init_params(0, cfg))
+        # the canonical (one stage group) pipelined layout: n_micro>1
+        # keeps it in-family while pp=1 gives a single flat group
+        canon = _fake_zero_state(stacked, dp=2)
+        p1 = _plan(2, pp=1, n_micro=2)
+        p2 = _plan(1, pp=2, n_micro=2)
+        p4 = _plan(1, pp=4, n_micro=2)
+        via = reshard_state(reshard_state(canon, stacked, p1, p2),
+                            stacked, p2, p4)
+        direct = reshard_state(canon, stacked, p1, p4)
+        np.testing.assert_array_equal(via["mu_flat"], direct["mu_flat"])
+        np.testing.assert_array_equal(via["nu_flat"], direct["nu_flat"])
+        # and back: pp=4 -> pp=1 reproduces the canonical layout
+        back = reshard_state(direct, stacked, p4, p1)
+        np.testing.assert_array_equal(back["mu_flat"], canon["mu_flat"])
+
+    def test_wrong_length_vector_raises(self, devices):
+        cfg = _cfg()
+        params = init_params(0, cfg)
+        a = _fake_zero_state(params, dp=2)
+        a["mu_flat"] = a["mu_flat"][:-8]  # not plan(2)'s padded length
+        with pytest.raises(CommError, match="does not match"):
+            reshard_state(a, params, _plan(2), _plan(4))
+
+
+TRAIN_KW = dict(save_every=5, lr=0.005, seed=5, optimizer="adam",
+                zero=True, batch=8, seq=8)
+
+
+class TestRestoreReshard:
+    def test_mismatch_error_names_both_plans_and_escape_hatch(
+            self, devices, tmp_path):
+        """Satellite: the restore-time mismatch CommError must name the
+        saved AND the live identity and point at reshard=True — not
+        just say resharding is unsupported."""
+        cfg = _cfg(n_experts=4)
+        d = str(tmp_path / "mm")
+        train(_mesh((4, 1)), cfg, steps=5, ckpt_dir=d, **TRAIN_KW)
+        params = init_params(5, cfg)
+        ex = {"params": params, "opt": init_zero_adam_state(params, 2)}
+        with pytest.raises(CommError) as ei:
+            checkpoint.restore(d, ex, mesh_shape={"dp": 2, "sp": 1})
+        msg = str(ei.value)
+        assert "reshard=True" in msg
+        assert "'dp': 4" in msg and "'dp': 2" in msg
+        # the trainer-layer error carries the same escape hatch
+        with pytest.raises(CommError, match="reshard=True"):
+            train(_mesh((2, 1)), cfg, steps=10, ckpt_dir=d, **TRAIN_KW)
+
+    def test_reshard_true_loads_saved_layout(self, devices, tmp_path):
+        cfg = _cfg(n_experts=4)
+        d = str(tmp_path / "rl")
+        train(_mesh((4, 1)), cfg, steps=5, ckpt_dir=d, **TRAIN_KW)
+        params = init_params(5, cfg)
+        n = nonexpert_size(params)
+        ex = {"params": params, "opt": init_zero_adam_state(params, 2)}
+        state, step, meta = checkpoint.restore(
+            d, ex, mesh_shape={"dp": 2, "sp": 1}, reshard=True
+        )
+        assert step == 5
+        # the leaves come back in their SAVED (dp=4) layout
+        assert state["opt"]["mu_flat"].shape == (zero_flat_size(n, 4),)
+        assert meta["mesh_shape"] == {"dp": 4, "sp": 1}
+
+
+class TestShrunkResume:
+    def test_dp4_to_dp2_resume_completes_and_matches_manual_regroup(
+            self, devices, tmp_path):
+        """THE flagship: a run checkpointed on dp=4 resumes on dp=2 via
+        reshard=True (previously a hard CommError) and the state it
+        trains from is EXACTLY the manual regroup — proven leaf-for-leaf
+        by replaying the same 5 steps from the manually-resharded state
+        through the raw compiled step and comparing the final params
+        bit-for-bit with the trainer's."""
+        cfg = _cfg(n_experts=4)
+        d = str(tmp_path / "shrink")
+        train(_mesh((4, 1)), cfg, steps=10, ckpt_dir=d, **TRAIN_KW)
+
+        live_mesh = _mesh((2, 1))
+        resumed, rep = train(live_mesh, cfg, steps=15, ckpt_dir=d,
+                             reshard=True, **TRAIN_KW)
+        assert rep.steps_run == 5 and rep.final_step == 15
+
+        # --- the manual path: restore saved layout, regroup, replay ---
+        params0 = init_params(5, cfg)
+        ex = {"params": params0, "opt": init_zero_adam_state(params0, 2)}
+        state, step, _ = checkpoint.restore(
+            d, ex, step=10, mesh_shape={"dp": 2, "sp": 1}, reshard=True
+        )
+        opt = reshard_state(state["opt"], state["params"],
+                            _plan(4), _plan(2))
+        opt = put_zero_state(opt, live_mesh, cfg)
+        params = state["params"]
+        step_fn = train_step_zero(live_mesh, cfg, lr=TRAIN_KW["lr"])
+        for i in range(10, 15):
+            x, y = synthetic_batch(TRAIN_KW["seed"], i, TRAIN_KW["batch"],
+                                   TRAIN_KW["seq"], cfg.d_model)
+            params, opt, _ = step_fn(params, opt, x, y)
+        assert _leaves_equal(resumed, params)
+
+    def test_shrunk_resume_is_bit_identical_to_its_replay(
+            self, devices, tmp_path):
+        cfg = _cfg(n_experts=4)
+        src = tmp_path / "src"
+        train(_mesh((4, 1)), cfg, steps=10, ckpt_dir=str(src), **TRAIN_KW)
+        finals = []
+        for tag in ("a", "b"):
+            d = tmp_path / f"replay_{tag}"
+            shutil.copytree(src, d)
+            p, _ = train(_mesh((2, 1)), cfg, steps=20, ckpt_dir=str(d),
+                         reshard=True, **TRAIN_KW)
+            finals.append(p)
+        assert _leaves_equal(finals[0], finals[1])
+
+
+class TestElasticSupervisor:
+    def _run(self, ckpt_dir, metrics=None):
+        from tpuscratch.ft.supervisor import (
+            RestartBudget,
+            supervise_train_elastic,
+        )
+
+        cfg = _cfg(n_experts=4)
+        calls = {"n": 0}
+
+        def devices_fn():
+            # the preemption takes half the slice with it: attempt 1
+            # sees 4 devices, every restart sees the surviving 2
+            calls["n"] += 1
+            return jax.devices()[: (4 if calls["n"] == 1 else 2)]
+
+        def mesh_of(devs):
+            return make_mesh((len(devs), 1), ("dp", "sp"), devs)
+
+        chaos = ChaosPlan(0, [Fault("train/preempt", at=(4,),
+                                    kind="preempt")])
+        return supervise_train_elastic(
+            cfg, 8, str(ckpt_dir), mesh_of=mesh_of,
+            devices_fn=devices_fn,
+            budget=RestartBudget(max_restarts=2, backoff_s=0.0),
+            metrics=metrics, chaos=chaos, save_every=2, lr=0.005,
+            seed=5, optimizer="adam", zero=True, batch=8, seq=8,
+        )
+
+    def test_preempted_and_shrunk_run_completes_under_supervision(
+            self, devices, tmp_path):
+        from tpuscratch.obs.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
+        params, rep = self._run(tmp_path / "el", metrics=metrics)
+        assert rep.final_step == 8
+        snap = metrics.snapshot()
+        assert snap["ft/restarts"]["value"] == 1
+        assert snap["ft/elastic_reshards"]["value"] == 1
+        # the whole elastic scenario replays bit-identically
+        params2, _ = self._run(tmp_path / "el2")
+        assert _leaves_equal(params, params2)
+
+
+class TestAsyncCheckpointer:
+    def _tree(self, seed=0):
+        rng = np.random.default_rng(seed)
+        return {
+            "a": rng.standard_normal((4, 6)).astype(np.float32),
+            "b": rng.integers(0, 100, (3,)).astype(np.int32),
+            "t": np.asarray(2, np.int32),
+        }
+
+    def test_publishes_byte_identical_to_blocking(self, tmp_path):
+        tree = self._tree()
+        meta = {"who": "async-test"}
+        with AsyncCheckpointer() as ck:
+            ck.snapshot(tmp_path / "a", 3, tree, metadata=meta)
+        checkpoint.save(tmp_path / "b", 3, tree, metadata=meta)
+        a_dir = tmp_path / "a" / "step_000000003"
+        b_dir = tmp_path / "b" / "step_000000003"
+        names = sorted(p.name for p in b_dir.iterdir())
+        assert names == sorted(p.name for p in a_dir.iterdir())
+        for f in names:
+            assert (a_dir / f).read_bytes() == (b_dir / f).read_bytes()
+        got, s, m = checkpoint.restore(tmp_path / "a", tree)
+        assert s == 3 and m == meta
+        assert _leaves_equal(got, tree)
+
+    def test_barrier_serializes_writes_and_prunes(self, tmp_path):
+        ck = AsyncCheckpointer()
+        for step in (1, 2, 3, 4, 5):
+            ck.snapshot(tmp_path / "ck", step, self._tree(step), keep=3)
+        ck.drain()
+        assert not ck.in_flight()
+        assert checkpoint.steps(tmp_path / "ck") == [3, 4, 5]
+        assert ck.writes == 5
+
+    def test_snapshot_is_immune_to_source_mutation(self, tmp_path):
+        """The staging copy is OWNED: mutating (or reusing) the source
+        buffer after snapshot() returns must not corrupt the published
+        bytes — the donation-safety contract of the async path."""
+        arr = np.ones((64,), np.float32)
+        ck = AsyncCheckpointer()
+        ck.snapshot(tmp_path / "ck", 1, {"x": arr})
+        arr[:] = -1.0  # the donated-buffer-reuse stand-in
+        ck.drain()
+        got, _, _ = checkpoint.restore(tmp_path / "ck",
+                                       {"x": np.zeros((64,), np.float32)})
+        np.testing.assert_array_equal(got["x"], np.ones((64,), np.float32))
+
+    def test_transient_write_fault_absorbed_by_retry(self, tmp_path):
+        chaos = ChaosPlan(0, [Fault("ckpt/write", stage="publish",
+                                    at=(0,), kind="error", times=1)])
+        ck = AsyncCheckpointer(chaos=chaos)
+        ck.snapshot(tmp_path / "ck", 1, self._tree())
+        ck.drain()  # the retry's second attempt published
+        assert checkpoint.latest_step(tmp_path / "ck") == 1
+
+    def test_persistent_write_fault_surfaces_at_drain(self, tmp_path):
+        chaos = ChaosPlan(0, [Fault("ckpt/write", stage="begin", p=1.0,
+                                    times=None, kind="error")])
+        ck = AsyncCheckpointer(chaos=chaos)
+        ck.snapshot(tmp_path / "ck", 1, self._tree())
+        with pytest.raises(OSError, match="injected"):
+            ck.drain()
+        # the error is consumed: the checkpointer is reusable
+        ck2_tree = self._tree()
+        ck._chaos = None
+        ck.snapshot(tmp_path / "ck", 2, ck2_tree)
+        ck.drain()
+        assert checkpoint.latest_step(tmp_path / "ck") == 2
+
+    def test_snapshot_chaos_site_fires(self, tmp_path):
+        chaos = ChaosPlan(0, [Fault("ckpt/snapshot", at=(0,),
+                                    kind="error")])
+        ck = AsyncCheckpointer(chaos=chaos)
+        with pytest.raises(InjectedFault):
+            ck.snapshot(tmp_path / "ck", 1, self._tree())
+
+    def test_hostpool_footprint_is_observable(self, tmp_path):
+        """Satellite: the snapshot-buffer footprint lands in a metrics
+        snapshot — HostPool.stats() gauges (live buffers, bytes, trims)
+        plus the staged byte count — instead of being silent."""
+        from tpuscratch.obs.metrics import MetricsRegistry
+
+        metrics = MetricsRegistry()
+        ck = AsyncCheckpointer(metrics=metrics)
+        ck.snapshot(tmp_path / "ck", 1, self._tree())
+        ck.drain()
+        snap = metrics.snapshot()
+        assert snap["ckpt/snapshot_bytes"]["value"] > 0
+        assert snap["ckpt/async_writes"]["value"] == 1
+        from tpuscratch.native import hostpool
+
+        if hostpool.available():
+            assert "hostpool/bytes_in_use" in snap
+            assert "hostpool/live_buffers" in snap
+            st = hostpool.default_pool().stats()
+            assert "live_buffers" in st and "trim_calls" in st
+
+
+class TestAsyncTrainer:
+    def test_async_train_matches_blocking_and_books_goodput(
+            self, devices, tmp_path):
+        """async_ckpt=True changes WHEN the bytes hit disk, nothing
+        else: the trajectory and final params equal the blocking run's,
+        the sink carries the split ckpt/snapshot + ckpt/write events,
+        and obs.goodput books them into an exactly-summing partition."""
+        from tpuscratch.obs.goodput import goodput_report
+        from tpuscratch.obs.report import load_events
+        from tpuscratch.obs.sink import Sink
+
+        cfg = _cfg()
+        mesh = _mesh((2, 2))
+        kw = dict(save_every=2, lr=0.005, seed=5, optimizer="adam",
+                  batch=4, seq=16)
+        blocking, _ = train(mesh, cfg, steps=6,
+                            ckpt_dir=str(tmp_path / "blk"), **kw)
+        path = str(tmp_path / "obs.jsonl")
+        with Sink(path) as sink:
+            asynced, _ = train(mesh, cfg, steps=6,
+                               ckpt_dir=str(tmp_path / "asy"),
+                               obs=sink, async_ckpt=True, **kw)
+        assert _leaves_equal(blocking, asynced)
+        events = load_events([path])
+        kinds = {e.get("event") for e in events}
+        assert "ckpt/snapshot" in kinds and "ckpt/write" in kinds
+        assert "ckpt/save" not in kinds
+        assert len([e for e in events if e.get("event") == "ckpt/write"]) \
+            == 3
+        rep = goodput_report(events)
+        rep.check()
+        assert rep.buckets["checkpoint"] >= 0
+
+    def test_async_resume_after_preemption_is_bit_identical(
+            self, devices, tmp_path):
+        """Preempted mid-run with async saves: the drained barrier at
+        the preemption point guarantees the successor finds the step
+        published, and the supervised run finishes bit-identical to an
+        uninterrupted async run."""
+        from tpuscratch.ft.supervisor import RestartBudget, supervise_train
+
+        cfg = _cfg()
+        mesh = _mesh((2, 2))
+        kw = dict(save_every=2, lr=0.005, seed=5, optimizer="adam",
+                  batch=4, seq=16, async_ckpt=True)
+        straight, _ = train(mesh, cfg, steps=8,
+                            ckpt_dir=str(tmp_path / "st"), **kw)
+        chaos = ChaosPlan(0, [Fault("train/preempt", at=(4,),
+                                    kind="preempt")])
+        params, rep = supervise_train(
+            mesh, cfg, 8, str(tmp_path / "pre"),
+            budget=RestartBudget(max_restarts=2, backoff_s=0.0),
+            chaos=chaos, **kw,
+        )
+        assert rep.final_step == 8
+        assert _leaves_equal(straight, params)
+
+
+class TestElasticChunkRuntimes:
+    def test_halo_driver_reshards_tiles_onto_smaller_mesh(
+            self, devices, tmp_path):
+        """The stencil's elastic resume: tiles cut for a 2x2 grid are
+        reassembled and re-cut for a 1x2 grid mid-run; the computed
+        cells are decomposition-invariant, so the result bit-matches the
+        uninterrupted 2x2 run."""
+        from tpuscratch.halo import driver
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        rng = np.random.default_rng(5)
+        world = rng.standard_normal((8, 8)).astype(np.float32)
+        big = make_mesh_2d((2, 2))
+        small = make_mesh_2d((1, 2))
+        oracle = driver.checkpointed_stencil(
+            world, 8, str(tmp_path / "full"), save_every=4, mesh=big)
+        d = str(tmp_path / "elastic")
+        driver.checkpointed_stencil(world, 4, d, save_every=4, mesh=big)
+        # without reshard, the mismatched decomposition fails loudly
+        with pytest.raises(ValueError, match="structure drifted"):
+            driver.checkpointed_stencil(world, 8, d, save_every=4,
+                                        mesh=small)
+        out = driver.checkpointed_stencil(world, 8, d, save_every=4,
+                                          mesh=small, reshard=True)
+        np.testing.assert_array_equal(out, oracle)
+
+    def test_solver_runner_reshards_and_replays_deterministically(
+            self, devices, tmp_path):
+        """The solver's elastic resume: cores cut for (2,2,1) re-cut
+        for (1,1,1) mid-solve; the resumed solve completes and is
+        bit-identical to its own replay (cross-mesh psum regroupings
+        reassociate, so the ORACLE comparison is tolerance, the replay
+        comparison exact)."""
+        from tpuscratch.ft.chaos import Preempted
+        from tpuscratch.solvers import checkpointed_mg3d_solve
+
+        rng = np.random.default_rng(3)
+        b = rng.standard_normal((16, 16, 16)).astype(np.float32)
+        b -= b.mean()
+        big = make_mesh((2, 2, 1), ("z", "row", "col"), jax.devices()[:4])
+        small = make_mesh((1, 1, 1), ("z", "row", "col"),
+                          jax.devices()[:1])
+        kw = dict(tol=1e-6, max_cycles=20, chunk_cycles=4)
+        x_full, _ = checkpointed_mg3d_solve(
+            b, str(tmp_path / "full"), mesh=big, **kw)
+        src = tmp_path / "src"
+        chaos = ChaosPlan(0, [Fault("solver/preempt", at=(4,),
+                                    kind="preempt")])
+        with pytest.raises(Preempted):
+            checkpointed_mg3d_solve(b, str(src), mesh=big, chaos=chaos,
+                                    **kw)
+        outs = []
+        for tag in ("a", "b"):
+            d = tmp_path / f"re_{tag}"
+            shutil.copytree(src, d)
+            x, rep = checkpointed_mg3d_solve(b, str(d), mesh=small,
+                                             reshard=True, **kw)
+            assert rep.resumed_at == 4 and rep.converged
+            outs.append(x)
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_allclose(outs[0], x_full, rtol=1e-4, atol=1e-5)

@@ -1489,6 +1489,188 @@ def config15_solver(out: list, iters: int = 2) -> None:
         )
 
 
+def config16_elastic_goodput(out: list) -> None:
+    """Elastic fault tolerance under chaos (ISSUE 11): an ex26-style
+    preempt-and-restart run for each of the three chunked workloads
+    (trainer, halo driver, solver runner), once with BLOCKING saves and
+    once with ASYNC checkpointing, each accounted by ``obs.goodput``
+    from its own JSONL artifact — buckets summing to wall exactly
+    (``GoodputReport.check`` is called live).  One row per workload,
+    with the ``checkpoint``/``restart`` badput shares and the goodput
+    fraction direction-registered in ``obs.regress`` (shares down,
+    goodput up), so ``record.py --check`` gates the async win the way
+    the ZeRO 0.5x grad leg is gated."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from tpuscratch.ft.chaos import ChaosPlan, Fault
+    from tpuscratch.ft.supervisor import (
+        RestartBudget,
+        supervise,
+        supervise_train,
+    )
+    from tpuscratch.obs.goodput import goodput_report
+    from tpuscratch.obs.report import load_events
+    from tpuscratch.obs.sink import Sink
+    from tpuscratch.runtime.mesh import make_mesh, make_mesh_2d
+    from tpuscratch.runtime.topology import factor2d
+
+    avail = len(jax.devices())
+    budget = RestartBudget(max_restarts=3, backoff_s=0.05,
+                           max_backoff_s=0.2)
+    rng = np.random.default_rng(0)
+
+    def run_train(ck, sink, async_on):
+        from tpuscratch.models.transformer import TransformerConfig
+
+        # the state must be big enough that SERIALIZATION is the cost
+        # (the regime real checkpoints live in): ~16 MB params+moments
+        n = min(4, avail)
+        mesh = make_mesh((n, 1), ("dp", "sp"), jax.devices()[:n])
+        cfg = TransformerConfig(d_model=256, n_heads=2, n_experts=n,
+                                d_ff=512, n_layers=2,
+                                capacity_factor=2.0)
+        chaos = ChaosPlan(0, [Fault("train/preempt", at=(10,),
+                                    kind="preempt")])
+        supervise_train(mesh, cfg, 20, ck, budget=budget, sink=sink,
+                        obs=sink, chaos=chaos, save_every=2,
+                        batch=2 * n, seq=32, optimizer="adam",
+                        async_ckpt=async_on)
+
+    def run_halo(ck, sink, async_on):
+        from tpuscratch.halo.driver import checkpointed_stencil
+
+        mesh = make_mesh_2d(factor2d(min(4, avail)))
+        world = rng.standard_normal((1024, 1024)).astype(np.float32)
+        chaos = ChaosPlan(0, [Fault("halo/preempt", at=(20,),
+                                    kind="preempt")])
+        supervise(
+            lambda: checkpointed_stencil(
+                world, 40, ck, save_every=5, mesh=mesh, sink=sink,
+                chaos=chaos, async_ckpt=async_on,
+            ),
+            budget=budget, sink=sink,
+        )
+
+    def make_solver():
+        """Built (and WARMED) before any mode's sink exists: the
+        lru-cached chunk program is shared across both modes, and its
+        compile must not land inside either mode's accounting window
+        (the sink's wall starts at its `run` header) — otherwise the
+        first-measured mode eats the whole compile and the shares
+        compare compile, not saves."""
+        import shutil as _sh
+        import tempfile as _tf
+
+        from tpuscratch.solvers import (
+            checkpointed_mg3d_solve,
+            supervised_mg3d_solve,
+        )
+
+        dims = (2, 2, 1) if avail >= 4 else (1, 1, 1)
+        n = dims[0] * dims[1] * dims[2]
+        world = tuple(d * 32 for d in dims)
+        b = rng.standard_normal(world).astype(np.float32)
+        b -= b.mean()
+        mesh = make_mesh(dims, ("z", "row", "col"), jax.devices()[:n])
+        solve_kw = dict(mesh=mesh, tol=1e-7, max_cycles=24,
+                        chunk_cycles=4)
+        wwd = _tf.mkdtemp(prefix="tpuscratch_c16_warm_")
+        try:
+            checkpointed_mg3d_solve(b, f"{wwd}/ck", **solve_kw)
+        finally:
+            _sh.rmtree(wwd, ignore_errors=True)
+
+        def run_solver(ck, sink, async_on):
+            chaos = ChaosPlan(0, [Fault("solver/preempt", at=(8,),
+                                        kind="preempt")])
+            supervised_mg3d_solve(
+                b, ck, sink=sink, chaos=chaos, budget=budget,
+                async_ckpt=async_on, **solve_kw,
+            )
+
+        return run_solver
+
+    def share(rep, bucket):
+        return rep.buckets.get(bucket, 0.0) / rep.wall_s if rep.wall_s \
+            else 0.0
+
+    emitted = 0
+    for name, make_body in (("train", lambda: run_train),
+                            ("halo", lambda: run_halo),
+                            ("solver", make_solver)):
+        reports = {}
+        write_s = 0.0
+        try:
+            body = make_body()
+            for mode, async_on in (("blocking", False), ("async", True)):
+                wd = tempfile.mkdtemp(prefix=f"tpuscratch_c16_{name}_")
+                try:
+                    path = f"{wd}/obs.jsonl"
+                    sink = Sink(path, run={
+                        "bench": f"record/config16/{name}", "mode": mode,
+                        "platform": jax.default_backend(),
+                    })
+                    body(f"{wd}/ck", sink, async_on)
+                    sink.close()
+                    events = load_events([path])
+                    rep = goodput_report(events)
+                    rep.check()  # buckets sum to wall EXACTLY, or raise
+                    reports[mode] = rep
+                    if async_on:
+                        # the overlapped background write wall — NOT
+                        # badput (it ran concurrently; what stalled the
+                        # loop is inside the snapshot brackets), shown
+                        # for scale
+                        write_s = sum(
+                            e.get("wall_s", 0.0) for e in events
+                            if e.get("event") == "ckpt/write"
+                        )
+                finally:
+                    shutil.rmtree(wd, ignore_errors=True)
+        except Exception as e:
+            print(f"# config 16 {name} failed: {e}", file=sys.stderr)
+            continue
+        blk, asy = reports["blocking"], reports["async"]
+        row = {
+            "checkpoint_share_blocking": share(blk, "checkpoint"),
+            "checkpoint_share_async": share(asy, "checkpoint"),
+            "restart_share_blocking": share(blk, "restart"),
+            "restart_share_async": share(asy, "restart"),
+            "goodput_fraction_blocking": blk.goodput_fraction,
+            "goodput_fraction_async": asy.goodput_fraction,
+            "wall_s_blocking": blk.wall_s,
+            "wall_s_async": asy.wall_s,
+            "overlapped_write_s": write_s,
+        }
+        _emit(
+            out,
+            config=16,
+            metric=f"elastic_goodput_{name}",
+            # the headline is the async GOODPUT fraction (matching the
+            # metric name's inferred direction, higher); the gated
+            # badput shares ride as direction-registered fields
+            value=row["goodput_fraction_async"],
+            **row,
+            detail=(
+                f"checkpoint badput share "
+                f"{100 * row['checkpoint_share_blocking']:.1f}% -> "
+                f"{100 * row['checkpoint_share_async']:.1f}% "
+                f"(blocking -> async), goodput "
+                f"{100 * row['goodput_fraction_blocking']:.1f}% -> "
+                f"{100 * row['goodput_fraction_async']:.1f}%, one "
+                f"injected preemption + supervised restart, buckets "
+                f"sum-checked"
+            ),
+        )
+        emitted += 1
+    if not emitted:
+        raise RuntimeError("all config-16 workloads failed")
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -1505,13 +1687,14 @@ CONFIGS = {
     13: config13_zero_train,
     14: config14_plan_overlap,
     15: config15_solver,
+    16: config16_elastic_goodput,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
-                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15")
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

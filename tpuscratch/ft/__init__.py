@@ -29,6 +29,12 @@ deterministic chaos harness that proves it:
   resumes from ``latest_step`` (the bit-identical replay the trainer
   already proves), enforces a restart budget with backoff, and emits
   ``ft/restart`` / ``ft/rollback`` / ``ft/fault`` events through obs.
+  ``supervise_elastic`` / ``supervise_train_elastic`` are the
+  PREEMPTED-AND-SHRUNK form: each restart re-queries the surviving
+  devices, rebuilds the mesh, and resumes with the ZeRO moment shards
+  regrouped onto the shrunk plan (``models.zero.reshard_state`` via
+  ``train(reshard=True)``) — capacity loss becomes a continuation, not
+  a terminal ``CommError``.
 """
 
 from tpuscratch.ft.chaos import (  # noqa: F401
@@ -57,5 +63,7 @@ from tpuscratch.ft.supervisor import (  # noqa: F401
     RestartBudget,
     RestartsExhausted,
     supervise,
+    supervise_elastic,
     supervise_train,
+    supervise_train_elastic,
 )

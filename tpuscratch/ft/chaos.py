@@ -20,6 +20,16 @@ Site vocabulary (what the instrumented layers query):
 - ``"ckpt/save"``     — checkpoint IO: fail (``"error"``), stall
   (``"stall"``), or SIGKILL the process (``"kill"``) at a named stage
   inside :func:`runtime.checkpoint.save` (``stage=``).
+- ``"ckpt/snapshot"`` — the BLOCKING device→host staging half of an
+  async checkpoint (:class:`runtime.async_ckpt.AsyncCheckpointer`):
+  fail/stall/SIGKILL before the copy (occurrences auto-counted per
+  snapshot).
+- ``"ckpt/write"``    — the BACKGROUND writer half of an async
+  checkpoint: the same named-stage vocabulary as ``ckpt/save``
+  (``begin``/``leaf_<i>``/``manifest``/``swap``/``publish``/``end``),
+  fired from inside the writer thread's ``checkpoint.save`` — so the
+  async path gets the same deterministic kill-mid-save matrix coverage
+  the blocking path has.
 - ``"serve/prefill"`` — fail a request's prefill admission
   (``key=rid`` targets one request; ``times`` bounds transience).
 - ``"comm/<op>"``     — a transient :class:`InjectedFault` (a
@@ -216,14 +226,17 @@ class ChaosPlan:
         if self.should_fire(site, index=index) is not None:
             raise Preempted(site, index)
 
-    def save_hook(self) -> Callable[[str], None]:
-        """The ``checkpoint.save(hook=...)`` adapter: each named stage
-        inside ``save`` queries a ``ckpt/save`` clause (occurrences
+    def stage_hook(self, site: str) -> Callable[[str], None]:
+        """A ``checkpoint.save(hook=...)``-shaped adapter for ``site``:
+        each named stage queries a clause of that site (occurrences
         auto-counted PER STAGE, so ``Fault(stage="publish", at=(1,))``
-        means "the second save's publish point")."""
+        means "the second occurrence's publish point").  ``ckpt/save``
+        is the blocking save path's site; ``ckpt/write`` the async
+        background writer's — same stage vocabulary, separately
+        injectable."""
 
         def hook(stage: str) -> None:
-            f = self.should_fire("ckpt/save", stage=stage)
+            f = self.should_fire(site, stage=stage)
             if f is None:
                 return
             if f.kind == "stall":
@@ -234,6 +247,10 @@ class ChaosPlan:
             raise OSError(f"injected checkpoint IO failure at {stage!r}")
 
         return hook
+
+    def save_hook(self) -> Callable[[str], None]:
+        """:meth:`stage_hook` bound to the blocking ``ckpt/save`` site."""
+        return self.stage_hook("ckpt/save")
 
     def wrap_collective(self, fn, op: str):
         """Wrap a compiled program (host-level): each call first queries

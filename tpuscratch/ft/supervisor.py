@@ -126,6 +126,108 @@ def supervise(
         return out
 
 
+def supervise_elastic(
+    make_attempt: Callable[[list], T],
+    *,
+    devices_fn: Callable[[], list],
+    budget: RestartBudget = RestartBudget(),
+    restartable: tuple = RESTARTABLE,
+    sink=None,
+    metrics: Optional[MetricsRegistry] = None,
+    recorder=None,
+    log: Callable[[str], None] = lambda s: None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> T:
+    """:func:`supervise` for PREEMPTED-AND-SHRUNK capacity: each
+    (re)invocation first re-queries ``devices_fn()`` for the SURVIVING
+    devices and rebuilds the attempt on them via
+    ``make_attempt(devices)`` — so a run that lost part of its slice
+    restarts on what is left instead of failing its mesh build forever.
+    A capacity change between attempts is emitted as one ``ft/elastic``
+    event (and counted in ``ft/elastic_reshards``); the attempt body is
+    responsible for making the shrunk resume legal (the trainer's
+    ``reshard=True`` restore-time regroup)."""
+    sink_ = sink if sink is not None else NullSink()
+    metrics_ = metrics if metrics is not None else MetricsRegistry()
+    seen = {"n": None}
+
+    def attempt():
+        devices = list(devices_fn())
+        if not devices:
+            raise RuntimeError("supervise_elastic: no surviving devices")
+        if seen["n"] is not None and len(devices) != seen["n"]:
+            metrics_.counter("ft/elastic_reshards").inc()
+            sink_.emit("ft/elastic", devices=len(devices),
+                       previous=seen["n"])
+            log(f"elastic restart: {seen['n']} -> {len(devices)} "
+                f"device(s)")
+        seen["n"] = len(devices)
+        return make_attempt(devices)
+
+    return supervise(attempt, budget=budget, restartable=restartable,
+                     sink=sink_, metrics=metrics_, recorder=recorder,
+                     log=log, sleep=sleep)
+
+
+def supervise_train_elastic(cfg, steps: int, ckpt_dir: str, *,
+                            mesh_of: Optional[Callable] = None,
+                            devices_fn: Optional[Callable] = None,
+                            budget: RestartBudget = RestartBudget(),
+                            restartable: tuple = RESTARTABLE,
+                            sink=None,
+                            metrics: Optional[MetricsRegistry] = None,
+                            recorder=None,
+                            log: Callable[[str], None] = lambda s: None,
+                            sleep: Callable[[float], None] = time.sleep,
+                            **train_kw):
+    """The elastic ``supervise_train``: each restart rebuilds the mesh
+    from the surviving devices (``mesh_of(devices)``; default: an
+    all-dp ``(n, 1)`` dp x sp mesh) and resumes training on it with
+    ``reshard=True`` — a preempted-and-shrunk slice continues from
+    ``latest_step`` with the ZeRO moment shards regrouped onto the
+    shrunk plan instead of dying on the mesh-mismatch ``CommError``.
+
+    The data trajectory must survive the mesh change, so ``batch`` and
+    ``seq`` are pinned up front: from an existing checkpoint's metadata
+    when one is present, else from the INITIAL mesh's defaults — a
+    shrunk restart then replays the same stream (global batch constant;
+    it must stay divisible by every surviving ``|dp|``)."""
+    import jax
+
+    from tpuscratch.runtime import checkpoint
+    from tpuscratch.runtime.mesh import make_mesh
+
+    devices_fn = devices_fn if devices_fn is not None else jax.devices
+    if mesh_of is None:
+        def mesh_of(devices):
+            return make_mesh((len(devices), 1), ("dp", "sp"), devices)
+    train_kw.setdefault("reshard", True)
+    if recorder is not None:
+        train_kw.setdefault("recorder", recorder)
+    if "batch" not in train_kw or "seq" not in train_kw:
+        if checkpoint.latest_step(ckpt_dir) is not None:
+            _, meta = checkpoint.peek_metadata(ckpt_dir)
+            batch, seq = meta.get("batch"), meta.get("seq")
+        else:
+            shape = dict(mesh_of(list(devices_fn())).shape)
+            batch = 2 * shape.get("dp", 1)
+            seq = 8 * shape.get("sp", 1)
+        if batch is not None:
+            train_kw.setdefault("batch", batch)
+        if seq is not None:
+            train_kw.setdefault("seq", seq)
+
+    from tpuscratch.models.trainer import train  # lazy: avoids the cycle
+
+    def make_attempt(devices):
+        return train(mesh_of(devices), cfg, steps, ckpt_dir, **train_kw)
+
+    return supervise_elastic(make_attempt, devices_fn=devices_fn,
+                             budget=budget, restartable=restartable,
+                             sink=sink, metrics=metrics,
+                             recorder=recorder, log=log, sleep=sleep)
+
+
 def supervise_train(mesh, cfg, steps: int, ckpt_dir: str, *,
                     budget: RestartBudget = RestartBudget(),
                     restartable: tuple = RESTARTABLE,

@@ -154,10 +154,28 @@ def checkpointed_stencil(
     sink=None,
     chaos=None,
     recorder=None,
+    reshard: bool = False,
+    async_ckpt: bool = False,
 ) -> np.ndarray:
     """``distributed_stencil`` with preemption survival: the tile state is
     checkpointed every ``save_every`` steps and the run RESUMES from the
     newest checkpoint in ``ckpt_dir`` when one exists.
+
+    ``reshard=True`` makes the resume ELASTIC over the mesh shape: a
+    checkpoint whose tiles were decomposed for a different process grid
+    (a preempted-and-shrunk slice) is loaded in its saved layout,
+    reassembled to the world, and re-decomposed onto THIS mesh — the
+    cores round-trip exactly (ghosts are refilled by every step's
+    leading exchange), so the continued run computes the same cells.
+    Off (the default), a mismatched-mesh resume fails loudly at leaf
+    validation.
+
+    ``async_ckpt=True`` switches the saves to the snapshot-then-publish
+    path (``runtime.async_ckpt``): the loop pays only the host-copy
+    wall (``ckpt/snapshot`` events), the serialize+publish overlaps the
+    next chunk on a background writer (``ckpt/write``), and the barrier
+    drains before each snapshot, at preemption points, and at exit —
+    published checkpoints byte-identical to the blocking path's.
 
     ``sink`` (an ``obs.sink.Sink``) receives one ``halo/chunk`` event
     per save chunk — step reached, fenced wall seconds, cell-updates/s —
@@ -200,13 +218,26 @@ def checkpointed_stencil(
     tiles = decompose(world, topo, layout)
     start = 0
     if checkpoint.latest_step(ckpt_dir) is not None:
-        tiles, start, _meta = checkpoint.restore(ckpt_dir, tiles)
+        tiles, start, _meta = checkpoint.restore(ckpt_dir, tiles,
+                                                 reshard=reshard)
         if start > steps:
             raise ValueError(
                 f"checkpoint in {ckpt_dir} is at step {start}, beyond the "
                 f"requested {steps} — refusing to return an over-stepped "
                 "state as the answer (use a fresh ckpt_dir)"
             )
+        if tiles.shape[:2] != tuple(topo.dims):
+            # elastic resume: the saved decomposition was for another
+            # process grid — reassemble the world from the old layout
+            # (cores only; ghosts are refilled by the leading exchange
+            # of every step) and re-cut it for THIS mesh
+            r0, c0 = tiles.shape[:2]
+            old_layout = TileLayout(world.shape[0] // r0,
+                                    world.shape[1] // c0,
+                                    layout.halo_y, layout.halo_x)
+            old_topo = CartTopology((r0, c0), (periodic, periodic))
+            tiles = decompose(assemble(tiles, old_topo, old_layout),
+                              topo, layout)
     state = jnp.asarray(tiles)
 
     sink.emit(
@@ -223,12 +254,22 @@ def checkpointed_stencil(
 
         bind_sink(chaos, sink)
         save_hook = chaos.save_hook()
+    ckp = None
+    if async_ckpt:
+        from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
+
+        ckp = AsyncCheckpointer(chaos=chaos, sink=sink)
     programs: dict[int, object] = {}  # chunk size -> compiled program
     # a preempted/failed invocation still files its flight data (the
     # trainer's hardening): in-flight spans closed at their partial
     # wall, cumulative trace/phase totals scoped by this recorder's
-    # id, plus the buffered event tail
-    with file_flight_data(sink, rec):
+    # id, plus the buffered event tail; the async checkpointer's
+    # context is the exit barrier (drain on success, abandon-with-log
+    # while unwinding)
+    import contextlib
+
+    with file_flight_data(sink, rec), \
+            (ckp if ckp is not None else contextlib.nullcontext()):
         while start < steps:
             chunk = min(save_every, steps - start)
             fresh = chunk not in programs
@@ -254,24 +295,33 @@ def checkpointed_stencil(
                 compile_s=round(chunk_s, 6) if fresh else 0.0,
             )
 
-            def do_save(snap=np.asarray(state), at=start):
-                return checkpoint.save(
-                    ckpt_dir, at, snap,
-                    metadata={"steps_total": steps, "impl": impl},
-                    hook=save_hook,
-                )
-
-            save_sp = rec.open_span("ckpt/save", step=start)
-            if chaos is not None:
-                retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
+            meta = {"steps_total": steps, "impl": impl}
+            if ckp is not None:
+                snap_sp = rec.open_span("ckpt/snapshot", step=start)
+                ckp.snapshot(ckpt_dir, start, np.asarray(state),
+                             metadata=meta, keep=keep)
+                rec.close_span(snap_sp)
+                sink.emit("ckpt/snapshot", step=start,
+                          wall_s=round(snap_sp.seconds, 6))
             else:
-                do_save()
-            checkpoint.prune(ckpt_dir, keep)
-            rec.close_span(save_sp)
-            sink.emit("ckpt/save", step=start,
-                      wall_s=round(save_sp.seconds, 6))
+                def do_save(snap=np.asarray(state), at=start):
+                    return checkpoint.save(ckpt_dir, at, snap,
+                                           metadata=meta, hook=save_hook)
+
+                save_sp = rec.open_span("ckpt/save", step=start)
+                if chaos is not None:
+                    retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
+                else:
+                    do_save()
+                checkpoint.prune(ckpt_dir, keep)
+                rec.close_span(save_sp)
+                sink.emit("ckpt/save", step=start,
+                          wall_s=round(save_sp.seconds, 6))
             if chaos is not None:
-                # AFTER the save: the restarted run resumes exactly here
+                # AFTER the save: the restarted run resumes exactly
+                # here (a fired preemption unwinds through the async
+                # checkpointer's context, which completes the in-flight
+                # write before the supervisor re-invokes)
                 chaos.maybe_preempt("halo/preempt", index=start)
     emit_phase_totals(sink, rec)
     sink.flush()

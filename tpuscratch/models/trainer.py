@@ -20,6 +20,7 @@ model layer.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import math
@@ -95,19 +96,47 @@ def _cfg_fingerprint(cfg: TransformerConfig) -> str:
     return ",".join(f"{k}={fields[k]}" for k in sorted(fields))
 
 
-def _restore_state(ckpt_dir: str, params, opt, step, mesh_shape=None):
+def _saved_plan_identity(meta: dict) -> dict:
+    """The normalized plan identity a checkpoint's metadata implies:
+    the recorded ``plan`` when present (PR-7 checkpoints), else the
+    ZeRO ``mesh_shape`` as a single-microbatch plan (PR-4 checkpoints
+    predate plan metadata but record the sharded layout)."""
+    plan = meta.get("plan")
+    if plan is not None:
+        return {"dp": int(plan.get("dp", 1)), "sp": int(plan.get("sp", 1)),
+                "pp": int(plan.get("pp", 1)),
+                "n_micro": int(plan.get("n_micro", 1))}
+    ms = meta.get("mesh_shape") or {}
+    return {"dp": int(ms.get("dp", 1)), "sp": int(ms.get("sp", 1)),
+            "pp": int(ms.get("pp", 1)), "n_micro": 1}
+
+
+def _restore_state(ckpt_dir: str, params, opt, step, mesh_shape=None,
+                   reshard=False, live_plan=None):
     """Restore the full training state at ``step`` (params alone for
     SGD, params+moments for Adam/ZeRO) — the ONE restore/unpack sequence
     the entry resume and the guard rollback share.  ``mesh_shape`` (the
     ZeRO path) makes the checkpoint layer itself reject a checkpoint
     whose dp-sharded optimizer leaves were laid out for a different
-    mesh.  Returns (params, opt, step, metadata)."""
+    mesh — unless ``reshard`` is set, in which case the saved layout is
+    loaded as-is and the ZeRO moment shards are REGROUPED onto
+    ``live_plan`` via ``models.zero.reshard_state`` (the elastic resume
+    path).  Returns (params, opt, step, metadata)."""
     state = {"params": params, "opt": opt} if opt is not None else params
     state, step, meta = checkpoint.restore(ckpt_dir, state, step=step,
-                                           mesh_shape=mesh_shape)
-    if opt is not None:
-        return state["params"], state["opt"], step, meta
-    return state, opt, step, meta
+                                           mesh_shape=mesh_shape,
+                                           reshard=reshard)
+    if opt is None:
+        return state, opt, step, meta
+    params_r, opt_r = state["params"], state["opt"]
+    if reshard and live_plan is not None and isinstance(opt_r, dict) \
+            and "mu_flat" in opt_r:
+        from tpuscratch.models.zero import reshard_state
+
+        saved_plan = _saved_plan_identity(meta)
+        if saved_plan != live_plan:
+            opt_r = reshard_state(opt_r, params_r, saved_plan, live_plan)
+    return params_r, opt_r, step, meta
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,6 +173,8 @@ def train(
     zero: bool = False,
     accum_steps: int = 1,
     plan: Optional[ShardingPlan] = None,
+    reshard: bool = False,
+    async_ckpt: bool = False,
 ) -> tuple[dict, TrainReport]:
     """Run (or resume) ``steps`` training steps, checkpointing every
     ``save_every``. Returns (params, report). ``optimizer`` is 'sgd' or
@@ -216,7 +247,28 @@ def train(
     ``optimizer='adam'`` required.  The checkpoint records the
     normalized plan identity; resuming under a mismatched plan raises
     a ``CommError``, the same contract as a mismatched-|dp| ZeRO
-    restore."""
+    restore.
+
+    ``reshard=True`` is the elastic escape hatch for exactly those two
+    ``CommError``s: a checkpoint whose ZeRO moments were laid out for a
+    DIFFERENT plan/mesh (a preempted-and-shrunk slice) is loaded in its
+    saved layout and regrouped onto this run's plan at restore time
+    (``models.zero.reshard_state`` — gather-by-manifest, re-split by
+    the live ``zero_state_spec``, recommitted to canonical shardings).
+    The layout FAMILY must match (stage-stacked vs flat dp x sp), and
+    ``batch``/``seq``/``seed``/... stay part of the resume identity —
+    the regroup changes the layout of the state, never the trajectory.
+    The resumed run is bit-identical to its own replay on the new plan.
+
+    ``async_ckpt=True`` replaces the blocking checkpoint saves with the
+    snapshot-then-publish path (``runtime.async_ckpt``): the step loop
+    only pays the device→pinned-host copy (emitted as ``ckpt/snapshot``)
+    while a background writer serializes and publishes through the same
+    crash-consistent protocol (emitted as ``ckpt/write`` at its true
+    end stamp) — published checkpoints are byte-identical to the
+    blocking path's, at most one write is in flight, and the barrier is
+    drained before each next snapshot, at preemption points, and at
+    exit."""
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if optimizer not in ("sgd", "adam"):
@@ -319,8 +371,40 @@ def train(
                 f"checkpoint in {ckpt_dir} is at step {start}, beyond the "
                 f"requested {steps} (use a fresh ckpt_dir)"
             )
-        if zero and meta.get("mesh_shape") is not None \
-                and meta["mesh_shape"] != mesh_shape:
+        mesh_mismatch = (zero and meta.get("mesh_shape") is not None
+                         and meta["mesh_shape"] != mesh_shape)
+        # the plan is part of the state's meaning: stage-stacked params,
+        # (pp, dp)-sharded moments, and the microbatched data schedule
+        # all depend on it — a mismatched plan fails with the same
+        # CommError contract as a mismatched-|dp| ZeRO restore, unless
+        # reshard=True regroups the state onto the live plan
+        stored_plan = meta.get("plan")
+        live_pipelined = plan_id["pp"] > 1 or plan_id["n_micro"] > 1
+        stored_pipelined = stored_plan is not None and (
+            stored_plan.get("pp", 1) > 1
+            or stored_plan.get("n_micro", 1) > 1
+        )
+        if stored_plan is None and live_pipelined:
+            raise CommError(
+                "train/resume",
+                f"checkpoint in {ckpt_dir} predates ShardingPlan "
+                f"metadata (a legacy dp x sp run) — it cannot resume "
+                f"under the pipelined plan {plan_id}, with or without "
+                f"reshard (the stage-stacked params are a different "
+                f"state structure, not a re-layout)",
+            )
+        plan_mismatch = stored_plan is not None and stored_plan != plan_id
+        if (mesh_mismatch or plan_mismatch) \
+                and stored_pipelined != live_pipelined:
+            raise CommError(
+                "train/resume",
+                f"checkpoint in {ckpt_dir} was trained under plan "
+                f"{stored_plan}, this run's plan is {plan_id} — the "
+                f"stage-stacked and the flat dp x sp layouts are "
+                f"different state STRUCTURES; reshard=True regroups "
+                f"shards within a family, it cannot cross one",
+            )
+        if mesh_mismatch and not reshard:
             # the dp-sharded flat moments are laid out for ONE |dp|;
             # CommError (not ValueError) — this is a sharding-layout
             # failure, the class the comm/runtime layer owns
@@ -329,29 +413,19 @@ def train(
                 f"checkpoint in {ckpt_dir} holds ZeRO optimizer state "
                 f"sharded for mesh {meta['mesh_shape']}, this run's mesh "
                 f"is {mesh_shape} — dp-sharded moments cannot be "
-                f"re-laid-out implicitly (re-train or resume on a "
+                f"re-laid-out implicitly; pass reshard=True to regroup "
+                f"them onto this mesh at restore time (or resume on a "
                 f"matching mesh)",
             )
-        # the plan is part of the state's meaning: stage-stacked params,
-        # (pp, dp)-sharded moments, and the microbatched data schedule
-        # all depend on it — a mismatched plan fails with the same
-        # CommError contract as a mismatched-|dp| ZeRO restore
-        stored_plan = meta.get("plan")
-        if stored_plan is None:
-            if plan_id["pp"] > 1 or plan_id["n_micro"] > 1:
-                raise CommError(
-                    "train/resume",
-                    f"checkpoint in {ckpt_dir} predates ShardingPlan "
-                    f"metadata (a legacy dp x sp run) — it cannot resume "
-                    f"under the pipelined plan {plan_id}",
-                )
-        elif stored_plan != plan_id:
+        if plan_mismatch and not reshard:
             raise CommError(
                 "train/resume",
                 f"checkpoint in {ckpt_dir} was trained under plan "
                 f"{stored_plan}, this run's plan is {plan_id} — the "
                 f"stage/mesh layout of the state cannot be re-laid-out "
-                f"implicitly (re-train or resume under a matching plan)",
+                f"implicitly; pass reshard=True to regroup it onto this "
+                f"plan at restore time (or resume under a matching "
+                f"plan)",
             )
         for key, val in (
             ("lr", lr), ("seed", seed), ("batch", batch), ("seq", seq),
@@ -377,7 +451,8 @@ def train(
                     f"this run asked for {val} (use a fresh ckpt_dir)"
                 )
         params, opt, start, meta = _restore_state(
-            ckpt_dir, params, opt, start, mesh_shape=mesh_shape
+            ckpt_dir, params, opt, start, mesh_shape=mesh_shape,
+            reshard=reshard, live_plan=plan_id,
         )
         opt = commit_opt(opt)
         log(f"resumed at step {start} (meta {meta})")
@@ -438,6 +513,12 @@ def train(
     save_policy = save_retry if save_retry is not None else (
         DEFAULT_SAVE_RETRY if chaos is not None else None
     )
+    ckp = None
+    if async_ckpt:
+        from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
+
+        ckp = AsyncCheckpointer(retry=save_policy, chaos=chaos, sink=sink,
+                                metrics=metrics, log=log)
     losses = []
     ran = 0
     ref_loss = float("nan")  # spike baseline: previous chunk's loss
@@ -445,8 +526,13 @@ def train(
     # a preempted/failed invocation still files its flight data: in-flight
     # spans closed at their partial wall, the cumulative trace/phase
     # totals (scoped by this recorder's id, so a restart's fresh recorder
-    # ADDS instead of replacing), and the buffered event tail
-    with file_flight_data(sink, rec):
+    # ADDS instead of replacing), and the buffered event tail.  The
+    # async checkpointer's context is the exit barrier: drained on a
+    # clean exit (a write failure surfaces here), abandoned-with-log
+    # when already unwinding (a secondary writer error must not mask
+    # the primary failure)
+    with file_flight_data(sink, rec), \
+            (ckp if ckp is not None else contextlib.nullcontext()):
         while start < steps:
             chunk = min(save_every, steps - start)
             loss = gnorm = None
@@ -511,13 +597,19 @@ def train(
                     guard_state.rolled_back()  # GuardFailure past the budget
                     metrics.counter("ft/rollbacks").inc()
                     rb_sp = rec.open_span("train/rollback", from_step=start + chunk)
+                    if ckp is not None:
+                        # the in-flight write must publish before we ask
+                        # "what is the last committed step"
+                        ckp.drain()
                     rb_to = checkpoint.latest_step(ckpt_dir)
                     if rb_to is None:
                         params, opt = fresh_state()
                         rb_to = 0
                     else:
                         params, opt, rb_to, _ = _restore_state(
-                            ckpt_dir, params, opt, rb_to, mesh_shape=mesh_shape
+                            ckpt_dir, params, opt, rb_to,
+                            mesh_shape=mesh_shape, reshard=reshard,
+                            live_plan=plan_id,
                         )
                         opt = commit_opt(opt)
                     rec.close_span(rb_sp)
@@ -561,22 +653,39 @@ def train(
                 {"params": params, "opt": opt} if opt is not None else params
             )
 
-            def do_save(snap=jax.tree.map(np.asarray, state), at=start):
-                return checkpoint.save(ckpt_dir, at, snap, metadata=metadata,
-                                       hook=save_hook)
-
-            save_sp = rec.open_span("ckpt/save", step=start)
-            if save_policy is not None:
-                retry(do_save, save_policy, op="ckpt/save", log=log)
+            if ckp is not None:
+                # async: pay only the device→pinned-host copy here; the
+                # serialize+publish runs on the background writer (its
+                # ckpt/write event is stamped when it truly finishes)
+                snap_sp = rec.open_span("ckpt/snapshot", step=start)
+                ckp.snapshot(ckpt_dir, start, state, metadata=metadata,
+                             keep=keep)
+                rec.close_span(snap_sp)
+                sink.emit("ckpt/snapshot", step=start,
+                          wall_s=round(snap_sp.seconds, 6))
             else:
-                do_save()
-            checkpoint.prune(ckpt_dir, keep)
-            rec.close_span(save_sp)
-            sink.emit("ckpt/save", step=start,
-                      wall_s=round(save_sp.seconds, 6))
+                def do_save(snap=jax.tree.map(np.asarray, state), at=start):
+                    return checkpoint.save(ckpt_dir, at, snap,
+                                           metadata=metadata,
+                                           hook=save_hook)
+
+                save_sp = rec.open_span("ckpt/save", step=start)
+                if save_policy is not None:
+                    retry(do_save, save_policy, op="ckpt/save", log=log)
+                else:
+                    do_save()
+                checkpoint.prune(ckpt_dir, keep)
+                rec.close_span(save_sp)
+                sink.emit("ckpt/save", step=start,
+                          wall_s=round(save_sp.seconds, 6))
             log(f"step {start}/{steps}: loss {loss_f:.5f}")
             if chaos is not None:
-                # AFTER the save: the restarted run resumes exactly here
+                # AFTER the save: the restarted run resumes exactly
+                # here.  No async drain here — an unconditional barrier
+                # would serialize every write behind the loop; when the
+                # preemption DOES fire, the checkpointer's context exit
+                # completes the in-flight write before the supervisor
+                # re-invokes
                 chaos.maybe_preempt("train/preempt", index=start)
     sink.emit(
         "train/run",

@@ -58,6 +58,12 @@ Two extensions land on top (ISSUE 7, driven by ``parallel.plan``):
   by construction, so under overlap the decomposed reduce-scatters
   drain into the schedule alongside other stages' chains instead of
   serializing after the pipeline flush (the bubble-filling grad sync).
+
+The elastic third extension (ISSUE 11): :func:`reshard_state` is the
+restore-time transform that regroups a checkpoint's flat dp-sharded
+(or pp x dp stage-grouped) moment vectors onto a DIFFERENT live plan —
+the piece that turns the mismatched-plan resume ``CommError`` into a
+``reshard=True`` continuation for preempted-and-shrunk meshes.
 """
 
 from __future__ import annotations
@@ -99,6 +105,7 @@ __all__ = [
     "plan_zero_state_spec",
     "put_plan_state",
     "put_zero_state",
+    "reshard_state",
     "train_step_plan",
     "train_step_plan_fn",
     "train_step_zero",
@@ -196,6 +203,126 @@ def local_zero_state(params_local, n_dp: int) -> dict:
         "mu_exp": [jnp.zeros_like(x) for x in exp],
         "nu_exp": [jnp.zeros_like(x) for x in exp],
         "t": jnp.zeros((), jnp.int32),
+    }
+
+
+def _plan_groups(plan: dict) -> tuple[int, int, bool]:
+    """(pp, dp, pipelined-family?) of a normalized plan identity
+    ``{dp, sp, pp, n_micro}`` — ``pp`` is the flat vector's STAGE-group
+    count, the family flag whether the state rides the stage-stacked
+    params layout (``ShardingPlan.pipelined``'s rule)."""
+    pp = int(plan.get("pp", 1))
+    n_micro = int(plan.get("n_micro", 1))
+    return pp, int(plan["dp"]), (pp > 1 or n_micro > 1)
+
+
+def reshard_state(opt, params, saved: dict, live: dict):
+    """Regroup a restored ZeRO optimizer state from the checkpointed
+    plan identity ``saved`` onto a DIFFERENT live plan ``live`` (both
+    normalized ``{dp, sp, pp, n_micro}`` dicts, the shape
+    ``ShardingPlan.describe`` / the trainer's checkpoint metadata
+    record) — the elastic restore-time transform: a run preempted on
+    plan A resumes on plan B with state element-identical to A's.
+
+    The flat moment vectors are pure relayouts of the SAME elements:
+
+    - gather-by-manifest: each of ``saved``'s pp stage groups is
+      unpacked back into per-leaf moment arrays (the stage's packed
+      non-expert leaves in tree order, padding dropped — padded slots
+      carry zero moments forever, so truncation is exact);
+    - re-split: the per-leaf moments are re-packed under ``live``'s
+      stage grouping and re-padded to ``zero_flat_size`` of the live
+      ``|dp|`` (every live rank's shard equal-sized and aligned again);
+    - the expert moments and the step count are layout-invariant
+      (saved global, mesh-sharded only at ``device_put`` time) and pass
+      through untouched.
+
+    Host-side and numpy-pure; the result is UNCOMMITTED — feed it to
+    ``put_zero_state`` / ``put_plan_state`` to land the live
+    ``NamedSharding``s (the donation-aliasing contract).  Only
+    within-family regroups are possible: the pipelined (stage-stacked)
+    and the flat dp x sp layouts store different PARAM structures, so a
+    cross-family resume is a real format change and raises
+    ``CommError``.
+    """
+    import numpy as np
+
+    from tpuscratch.runtime.errors import CommError
+
+    pp_a, dp_a, fam_a = _plan_groups(saved)
+    pp_b, dp_b, fam_b = _plan_groups(live)
+    if fam_a != fam_b:
+        raise CommError(
+            "ckpt/reshard",
+            f"checkpointed plan {saved} and live plan {live} are "
+            f"different state-layout families (stage-stacked vs flat "
+            f"dp x sp) — reshard_state regroups shards, it cannot "
+            f"migrate the params structure",
+        )
+    if pp_a == pp_b and dp_a == dp_b:
+        return opt
+    n = nonexpert_size(params)
+    leaves = [
+        leaf for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+        if not _is_expert_leaf(path)
+    ]
+    shapes = [tuple(np.shape(x)) for x in leaves]
+    for pp in {pp_a, pp_b}:
+        if pp > 1 and any(s[0] % pp for s in shapes):
+            raise CommError(
+                "ckpt/reshard",
+                f"a stacked leaf's layer axis is not divisible by "
+                f"pp={pp} (shapes {shapes})",
+            )
+    flat_a = zero_flat_size(n // pp_a, dp_a)
+
+    def gather(vec):
+        """saved-layout flat vector -> per-leaf moment arrays."""
+        vec = np.asarray(vec, np.float32)
+        if vec.shape != (pp_a * flat_a,):
+            raise CommError(
+                "ckpt/reshard",
+                f"flat moment vector has {vec.shape[0]} elements, plan "
+                f"{saved} implies {pp_a} stage(s) x {flat_a} — the "
+                f"checkpoint does not match its recorded plan",
+            )
+        per = n // pp_a
+        parts: list[list] = [[] for _ in leaves]
+        for s in range(pp_a):
+            seg = vec[s * flat_a: s * flat_a + per]
+            off = 0
+            for i, shape in enumerate(shapes):
+                ln = int(np.prod(shape)) // pp_a
+                sub = ((shape[0] // pp_a,) + shape[1:]) if pp_a > 1 \
+                    else shape
+                parts[i].append(seg[off:off + ln].reshape(sub))
+                off += ln
+        return [
+            np.concatenate(p, axis=0) if pp_a > 1 else p[0] for p in parts
+        ]
+
+    def resplit(moments):
+        """per-leaf moment arrays -> live-layout flat vector."""
+        per = n // pp_b
+        flat_b = zero_flat_size(per, dp_b)
+        out = np.zeros((pp_b * flat_b,), np.float32)
+        for s in range(pp_b):
+            segs = []
+            for m, shape in zip(moments, shapes):
+                if pp_b > 1:
+                    ls = shape[0] // pp_b
+                    segs.append(np.ravel(m[s * ls:(s + 1) * ls]))
+                else:
+                    segs.append(np.ravel(m))
+            out[s * flat_b: s * flat_b + per] = np.concatenate(segs)
+        return out
+
+    return {
+        "mu_flat": resplit(gather(opt["mu_flat"])),
+        "nu_flat": resplit(gather(opt["nu_flat"])),
+        "mu_exp": [np.asarray(x) for x in opt["mu_exp"]],
+        "nu_exp": [np.asarray(x) for x in opt["nu_exp"]],
+        "t": np.asarray(opt["t"]),
     }
 
 

@@ -15,6 +15,7 @@ staging is ``view[:] = np.asarray(device_arr)`` in and
 from __future__ import annotations
 
 import ctypes
+import threading
 import weakref
 from typing import Optional
 
@@ -150,6 +151,12 @@ class HostPool:
                 "or `make -C native` first"
             )
         self._retry = retry
+        # lifecycle counters cross threads under async checkpointing
+        # (alloc on the step loop, free on the background writer):
+        # += / -= are non-atomic read-modify-writes, so lock them
+        self._stats_lock = threading.Lock()
+        self._live = 0        # buffers handed out and not yet freed
+        self._trims = 0       # trim() calls (retry pressure + manual)
         self._handle = lib.ts_pool_create(1 if lock_pages else 0)
         if not self._handle:
             raise MemoryError("ts_pool_create failed")
@@ -179,20 +186,33 @@ class HostPool:
             ptr = _ft_retry(attempt, self._retry, op="hostpool.alloc")
         if not ptr:
             raise MemoryError(f"host pool exhausted allocating {nbytes} B")
+        with self._stats_lock:
+            self._live += 1
         return HostBuffer(self, ptr, nbytes)
 
     def _free(self, ptr: int) -> None:
         if self._handle:
             _lib().ts_pool_free(self._handle, ptr)
+            with self._stats_lock:
+                self._live -= 1
 
     def trim(self) -> None:
         """Release cached (free-listed) buffers back to the OS."""
         _lib().ts_pool_trim(self._handle)
+        with self._stats_lock:
+            self._trims += 1
 
     def stats(self) -> dict:
+        """Native pool counters plus the Python-side lifecycle view
+        (``live_buffers``: handed-out and unfreed, ``trim_calls``) — the
+        snapshot ``obs`` surfaces so a staging path's host-buffer
+        footprint is observable rather than silent."""
         out = (ctypes.c_uint64 * len(_STATS_FIELDS))()
         _lib().ts_pool_stats(self._handle, out)
-        return dict(zip(_STATS_FIELDS, (int(v) for v in out)))
+        stats = dict(zip(_STATS_FIELDS, (int(v) for v in out)))
+        stats["live_buffers"] = self._live
+        stats["trim_calls"] = self._trims
+        return stats
 
     def close(self) -> None:
         if self._handle:

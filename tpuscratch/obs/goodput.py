@@ -29,9 +29,22 @@ halo/chunk      wall_s        step  (its ``compile_s`` share → compile)
 solver/chunk    wall_s        step  (its ``compile_s`` share → compile)
 serve/tick      tick_s        step  (compile-ticked ticks → compile)
 ckpt/save       wall_s        checkpoint
+ckpt/snapshot   wall_s        checkpoint
 ft/rollback     lost_s        rollback
 ft/restart      backoff_s     restart
 ==============  ============  ==========
+
+The async-checkpoint split (``runtime.async_ckpt``): ``ckpt/snapshot``
+is the BLOCKING cost the step loop actually paid — the device→host
+copy plus, crucially, the barrier drain of a still-running previous
+write (the snapshot bracket opens before the drain), so a write too
+slow to hide behind the next chunk books here automatically.
+``ckpt/write`` is deliberately NOT an interval in the partition: it
+runs on a background thread CONCURRENTLY with whatever the loop does
+next, so its wall is not the loop's wall — counting it would book time
+the run never lost.  The event exists for visibility (count, wall, the
+config-16 write totals); the partition sees the async path only
+through what it blocked.
 
 Compile detection is per layer: the trainer brackets each step and sums
 the walls of steps whose ``CompileCounter`` ticked into ``compile_s``;
@@ -65,6 +78,7 @@ _DURATION_EVENTS = {
     "solver/chunk": ("wall_s", "step"),
     "serve/tick": ("tick_s", "step"),
     "ckpt/save": ("wall_s", "checkpoint"),
+    "ckpt/snapshot": ("wall_s", "checkpoint"),
     "ft/rollback": ("lost_s", "rollback"),
     "ft/restart": ("backoff_s", "restart"),
 }

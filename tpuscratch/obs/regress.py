@@ -35,9 +35,11 @@ __all__ = ["Finding", "compare", "format_findings", "index_rows",
 #: ("achieved" covers the ledger-derived achieved-fraction/-rate rows
 #: of the overlap ablation, config 14 — checked before "_s"/"ratio"
 #: could mislabel them)
+#: ("goodput" covers the config-16 elastic-FT rows' goodput_fraction —
+#: the share of wall spent on committed steps, up)
 _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
            "throughput", "updates", "tokens_per", "accept", "speedup",
-           "achieved")
+           "achieved", "goodput")
 #: name substrings ⇒ smaller is better (checked after _HIGHER)
 #: (note the ordering: ``accept_len_mean`` and ``spec_speedup`` match
 #: _HIGHER before "ratio"/"bytes" substrings could ever mislabel them —
@@ -54,9 +56,15 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 #: existing "bytes" substring; ``deep_speedup``/``pipelined_speedup``
 #: ride "speedup"; ``comm_ratio`` (halo bytes per computed cell) rides
 #: "ratio" — down.)
+#: (the config-16 elastic-FT badput directions: ``checkpoint`` and
+#: ``restart`` bucket SHARES — and any other badput share — regress
+#: UPWARD; a lost-capacity/goodput win is their going down.  The
+#: trailing ``restarts``/``checkpoint_s`` style fields ride the same
+#: substrings.)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
           "overhead", "bubble", "crossover", "prefill_frac", "degraded",
-          "iterations", "cycles", "psum", "ppermute")
+          "iterations", "cycles", "psum", "ppermute", "checkpoint",
+          "restart", "badput")
 
 #: checked BEFORE _HIGHER: the config-15 per-SWEEP collective budget
 #: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would

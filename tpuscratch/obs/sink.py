@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import weakref
 from typing import Optional
@@ -36,18 +37,22 @@ from typing import Optional
 __all__ = ["NullSink", "Sink", "open_sink"]
 
 
-def _close_file(f, buf: list) -> None:
+def _close_file(f, buf: list, lock) -> None:
     """Flush the buffered tail and close — the finalizer body.  A plain
-    function over (file, buffer) so ``weakref.finalize`` holds no
+    function over (file, buffer, lock) so ``weakref.finalize`` holds no
     reference to the Sink itself (a dropped unclosed sink is collectable
-    and closes at GC; survivors close at interpreter exit)."""
-    if f.closed:
-        return
-    if buf:
-        f.write("\n".join(buf) + "\n")
-        buf.clear()
-    f.flush()
-    f.close()
+    and closes at GC; survivors close at interpreter exit).  Takes the
+    sink's emit lock: a background writer thread's in-flight emit must
+    not race the close (a dropped line, or a write to a closed file
+    raising inside the writer)."""
+    with lock:
+        if f.closed:
+            return
+        if buf:
+            f.write("\n".join(buf) + "\n")
+            buf.clear()
+        f.flush()
+        f.close()
 
 
 class NullSink:
@@ -84,6 +89,11 @@ class Sink:
     (``run.jsonl`` -> ``run.h3.jsonl``) so hosts never interleave writes
     in one file — the per-host half of "per-host JSONL sink"; merging is
     the reader's job (``obs.report`` accepts several files).
+
+    Emits are THREAD-SAFE (one lock around the buffer + file): the async
+    checkpointer's background writer stamps its ``ckpt/write`` event
+    from its own thread at the moment the write actually finishes — the
+    goodput end-stamp convention — while the step loop keeps emitting.
     """
 
     enabled = True
@@ -95,6 +105,7 @@ class Sink:
             path = f"{root}.h{host}{ext or '.jsonl'}"
         self.path = path
         self.host = host
+        self._lock = threading.Lock()
         self._buf: list[str] = []
         self._flush_every = max(1, flush_every)
         self._t0 = time.time()
@@ -111,7 +122,7 @@ class Sink:
         # process lifetime).  SIGKILL still loses the tail — that torn
         # final line is why obs.report tolerates corrupt lines.
         self._finalizer = weakref.finalize(
-            self, _close_file, self._f, self._buf
+            self, _close_file, self._f, self._buf, self._lock
         )
         self.emit("run", host=host, **(run or {}))
 
@@ -120,9 +131,16 @@ class Sink:
         **fields}``.  Fields must be JSON-serializable."""
         rec = {"event": event, "t": round(time.time() - self._t0, 6)}
         rec.update(fields)
-        self._buf.append(json.dumps(rec))
-        if len(self._buf) >= self._flush_every:
-            self.flush()
+        line = json.dumps(rec)
+        with self._lock:
+            if self._f.closed:
+                # a background writer outliving the sink: drop the line
+                # rather than raise inside a daemon thread (the same
+                # tolerance the torn-tail reader grants a SIGKILL)
+                return
+            self._buf.append(line)
+            if len(self._buf) >= self._flush_every:
+                self._flush_locked()
 
     def emit_metrics(self, snapshot: dict, event: str = "metrics",
                      scope=None) -> None:
@@ -137,11 +155,17 @@ class Sink:
         else:
             self.emit(event, metrics=snapshot, scope=scope)
 
-    def flush(self) -> None:
+    def _flush_locked(self) -> None:
+        if self._f.closed:
+            return
         if self._buf:
             self._f.write("\n".join(self._buf) + "\n")
             self._buf.clear()
         self._f.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
 
     def close(self) -> None:
         self._finalizer()  # runs at most once: flush the tail + close

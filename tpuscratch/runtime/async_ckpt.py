@@ -1,0 +1,271 @@
+"""Asynchronous checkpointing: device→pinned-host snapshot, background
+publish.
+
+The blocking save path (``runtime.checkpoint.save`` called inline by
+the trainer, the halo driver, and the solver runner) holds the step
+loop for the FULL serialize+publish wall — the largest measurable
+goodput sink the chaos accounting surfaces (``obs.goodput``'s
+``checkpoint`` bucket; the MegaScale NSDI'24 observation that recovery
+and checkpoint COST, not failure count, set effective throughput).
+This module splits that wall in two:
+
+- **snapshot** (blocking, cheap): every leaf is copied device→host into
+  a pooled pinned buffer from ``native.hostpool`` — the PAPER L2
+  ``host_allocator`` lineage (mpi-pingpong-gpu-async.cpp's staging
+  role), until now only backing benches.  Control returns to the step
+  loop as soon as the copy lands; the snapshot is immutable host memory,
+  so later steps may donate/overwrite the device buffers freely.
+- **write** (background): one daemon thread serializes the host
+  snapshot through the UNCHANGED crash-consistent aside-rename protocol
+  in ``runtime.checkpoint.save`` (so published checkpoints are
+  byte-identical to the blocking path's), under ``ft.retry`` with the
+  per-attempt stall watchdog, then prunes.
+
+Concurrency contract: **at most one write in flight**.  ``snapshot``
+drains the previous write before staging the next (a slow disk degrades
+toward the blocking path instead of queueing unbounded pinned memory);
+the chunk runtimes drain at supervisor preemption points and at exit,
+so a ``Preempted`` run hands its successor a fully-published directory.
+A writer failure (post-retry) is re-raised at the next barrier — the
+step loop's normal failure surface, where the supervisor's restart
+class catches it.
+
+Telemetry: the runtimes emit the blocking half as ``ckpt/snapshot``
+(they own the span); the writer emits ``ckpt/write`` from its own
+thread at completion (the goodput end-stamp convention — ``Sink`` is
+thread-safe), so ``obs.goodput`` books the residual blocking cost and
+the overlapped write separately and the badput buckets still sum to
+wall exactly.  Chaos sites: ``ckpt/snapshot`` (fail/stall/SIGKILL
+before the copy) and ``ckpt/write`` (the full named-stage matrix inside
+the background save) — see ``ft.chaos``.
+
+When the native pool is unavailable (no ``libtpuscratch_native.so``)
+the stage degrades to plain copied numpy buffers: the overlap is kept,
+only the page-locking is lost.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from tpuscratch.ft.retry import RetryPolicy, retry
+from tpuscratch.runtime import checkpoint
+
+__all__ = ["DEFAULT_WRITE_RETRY", "AsyncCheckpointer"]
+
+#: the background writer's policy: absorb transient IO faults fast (the
+#: DEFAULT_SAVE_RETRY curve) and abandon a stalled attempt via the
+#: thread watchdog — a hung filesystem must surface at the next barrier
+#: as a retryable failure, never wedge the drain
+DEFAULT_WRITE_RETRY = RetryPolicy(max_attempts=3, base_s=0.01, max_s=0.1,
+                                  attempt_timeout_s=60.0)
+
+
+class AsyncCheckpointer:
+    """Snapshot-then-publish checkpointing with one background writer.
+
+    ``pool``: a ``native.hostpool.HostPool`` for the pinned staging
+    buffers (default: the process-wide ``default_pool()`` when the
+    native library is available, else plain numpy copies).  ``retry``:
+    the writer's ``ft.RetryPolicy`` (default
+    :data:`DEFAULT_WRITE_RETRY`).  ``chaos``: an ``ft.ChaosPlan`` —
+    plugs the ``ckpt/snapshot`` / ``ckpt/write`` injection sites in.
+    ``sink``: receives one ``ckpt/write`` event per completed
+    background write (emitted from the writer thread at its true end
+    stamp).  ``metrics``: a ``MetricsRegistry`` — each snapshot updates
+    the ``hostpool/*`` gauges from ``HostPool.stats()`` plus
+    ``ckpt/snapshot_bytes``/``ckpt/async_writes``, so the staging
+    footprint is observable.
+    """
+
+    def __init__(self, *, pool=None, retry: Optional[RetryPolicy] = None,
+                 chaos=None, sink=None, metrics=None,
+                 log: Callable[[str], None] = lambda s: None):
+        if pool is None:
+            try:
+                from tpuscratch.native import hostpool
+
+                if hostpool.available():
+                    pool = hostpool.default_pool()
+            except Exception:
+                pool = None
+        self._pool = pool
+        self._retry = retry if retry is not None else DEFAULT_WRITE_RETRY
+        self._chaos = chaos
+        self._sink = sink
+        self._metrics = metrics
+        self._log = log
+        # ONE persistent daemon writer + a one-slot handoff (per-save
+        # thread spawn would cost ~1 ms under load — more than a small
+        # state's entire blocking save)
+        self._jobs: Optional[queue.SimpleQueue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._done = threading.Event()
+        self._done.set()
+        self._error: Optional[BaseException] = None
+        self.writes = 0          # completed background writes
+        self.snapshot_bytes = 0  # bytes staged by the LAST snapshot
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._jobs.get()
+            if job is None:
+                return
+            try:
+                job()
+            finally:
+                self._done.set()
+
+    def _submit(self, job: Callable[[], None]) -> None:
+        if self._worker is None or not self._worker.is_alive():
+            self._jobs = queue.SimpleQueue()
+            self._worker = threading.Thread(
+                target=self._worker_loop, daemon=True, name="ckpt-writer"
+            )
+            self._worker.start()
+        self._done.clear()
+        self._jobs.put(job)
+
+    # ---- the barrier ---------------------------------------------------
+
+    def in_flight(self) -> bool:
+        return not self._done.is_set()
+
+    def drain(self) -> None:
+        """Block until the in-flight write (if any) finishes; re-raise
+        its failure here — the caller's thread is the step loop, whose
+        failure surface the supervisor already owns."""
+        self._done.wait()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def close(self) -> None:
+        """Drain, then retire the worker thread."""
+        try:
+            self.drain()
+        finally:
+            if self._worker is not None and self._worker.is_alive():
+                self._jobs.put(None)
+            self._worker = None
+
+    def abandon(self) -> None:
+        """Close, but SWALLOW a write failure (logged) — the exit path
+        of a loop already unwinding on a primary exception, which a
+        secondary writer error must not mask."""
+        try:
+            self.close()
+        except BaseException as exc:  # noqa: BLE001 — logged, not lost
+            self._log(f"ckpt/write failed during unwind: "
+                      f"{type(exc).__name__}: {exc}")
+
+    # ---- snapshot + background publish ---------------------------------
+
+    def _stage(self, leaf):
+        """One leaf device→host: a pooled pinned buffer when available
+        (zero-size and pool-exhausted leaves fall back to a plain
+        copy).  Returns (host_array, buffer_or_None)."""
+        arr = np.asarray(leaf)
+        if self._pool is not None and arr.nbytes > 0:
+            try:
+                buf = self._pool.alloc(arr.nbytes)
+            except MemoryError:
+                buf = None
+            if buf is not None:
+                view = buf.view(arr.dtype, arr.shape)
+                np.copyto(view, arr)
+                return view, buf
+        # fallback: an owned copy — REQUIRED even here; a zero-copy view
+        # of a donated device buffer would be clobbered by later steps
+        return np.array(arr, copy=True), None
+
+    def snapshot(self, ckpt_dir, step: int, tree, *,
+                 metadata: Optional[dict] = None, tag: str = "state",
+                 keep: Optional[int] = None) -> float:
+        """Stage ``tree`` to host and hand it to the background writer;
+        returns the blocking (staging) seconds.  Drains any previous
+        write first — at most one in flight."""
+        self.drain()
+        if self._chaos is not None:
+            self._chaos.maybe_fail("ckpt/snapshot", op="ckpt/snapshot")
+        t0 = time.perf_counter()
+        leaves, treedef = jax.tree.flatten(tree)
+        staged = [self._stage(leaf) for leaf in leaves]
+        host_tree = jax.tree.unflatten(treedef, [v for v, _ in staged])
+        bufs = [b for _, b in staged if b is not None]
+        self.snapshot_bytes = sum(v.nbytes for v, _ in staged)
+        blocking_s = time.perf_counter() - t0
+        self._observe()
+        write_hook = (self._chaos.stage_hook("ckpt/write")
+                      if self._chaos is not None else None)
+        # the closure holds the ONLY references to the host snapshot; a
+        # dict box lets the writer drop them before freeing the buffers
+        box = {"tree": host_tree}
+
+        def write():
+            w0 = time.perf_counter()
+
+            def do_save():
+                path = checkpoint.save(ckpt_dir, step, box["tree"],
+                                       metadata=metadata, tag=tag,
+                                       hook=write_hook)
+                if keep is not None:
+                    checkpoint.prune(ckpt_dir, keep)
+                return path
+
+            try:
+                retry(do_save, self._retry, op="ckpt/write", log=self._log)
+            except BaseException as exc:  # surfaced at the next drain
+                self._error = exc
+                return
+            finally:
+                # drop the snapshot refs, then return the pinned buffers;
+                # a watchdog-abandoned attempt's zombie thread may still
+                # hold views — free() refuses then, and the buffer leaks
+                # to the pool finalizer instead of corrupting a reuse
+                box.clear()
+                for b in bufs:
+                    try:
+                        b.free()
+                    except ValueError:
+                        self._log("ckpt/write: leaked a staging buffer "
+                                  "still viewed by an abandoned attempt")
+            self.writes += 1
+            if self._metrics is not None:
+                self._metrics.counter("ckpt/async_writes").inc()
+            if self._sink is not None:
+                self._sink.emit(
+                    "ckpt/write", step=step,
+                    wall_s=round(time.perf_counter() - w0, 6),
+                )
+
+        self._submit(write)
+        return blocking_s
+
+    def _observe(self) -> None:
+        if self._metrics is None:
+            return
+        self._metrics.gauge("ckpt/snapshot_bytes").set(self.snapshot_bytes)
+        if self._pool is not None:
+            try:
+                stats = self._pool.stats()
+            except Exception:
+                return
+            for key in ("bytes_in_use", "bytes_cached", "high_water",
+                        "live_buffers", "trim_calls", "locked_bytes"):
+                self._metrics.gauge(f"hostpool/{key}").set(stats[key])
+
+    def __enter__(self) -> "AsyncCheckpointer":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is None:
+            self.close()
+        else:
+            self.abandon()

@@ -195,7 +195,8 @@ def latest_step(ckpt_dir: str | os.PathLike) -> Optional[int]:
 
 def restore(ckpt_dir: str | os.PathLike, example_tree: Any,
             step: Optional[int] = None,
-            mesh_shape: Optional[dict] = None) -> tuple[Any, int, dict]:
+            mesh_shape: Optional[dict] = None,
+            reshard: bool = False) -> tuple[Any, int, dict]:
     """Load (tree, step, metadata); ``example_tree`` supplies the treedef.
 
     Defaults to the latest step. Validation is per-leaf, not just a
@@ -211,19 +212,33 @@ def restore(ckpt_dir: str | os.PathLike, example_tree: Any,
     restore raises a :class:`runtime.errors.CommError` BEFORE any leaf
     load (the sharded layout is part of the data's meaning, and a
     shape-coincidence mis-load would silently scramble shards).
+
+    ``reshard=True`` is the elastic escape hatch: the mesh-shape gate is
+    waived and each leaf is loaded in its SAVED layout — validated
+    against the manifest's recorded shape/dtype instead of the example
+    tree where the two disagree — so the caller can regroup it onto the
+    live mesh explicitly (``models.zero.reshard_state`` for ZeRO
+    moments; the chunk drivers re-decompose their tiles).  The treedef
+    and leaf count must still match: resharding re-lays-out data, it
+    does not migrate structures.
     """
     step, manifest = _read_manifest(ckpt_dir, step)
-    if mesh_shape is not None:
+    if mesh_shape is not None and not reshard:
         saved = manifest.get("metadata", {}).get("mesh_shape")
         if saved is not None and saved != mesh_shape:
             from tpuscratch.runtime.errors import CommError
 
+            saved_plan = manifest.get("metadata", {}).get("plan")
             raise CommError(
                 "ckpt/restore",
                 f"checkpoint step {step} in {ckpt_dir} holds leaves "
-                f"sharded for mesh {saved}, caller's mesh is "
-                f"{mesh_shape} — dp-sharded optimizer state cannot be "
-                f"re-laid-out implicitly across mesh shapes",
+                f"sharded for mesh {saved}"
+                + (f" (plan {saved_plan})" if saved_plan else "")
+                + f", caller's mesh is {mesh_shape} — mesh-sharded "
+                f"state cannot be re-laid-out implicitly; pass "
+                f"reshard=True to load the saved layout and regroup it "
+                f"onto the live mesh (models.zero.reshard_state for "
+                f"ZeRO optimizer moments)",
             )
     path = _step_dir(pathlib.Path(ckpt_dir), step)
     leaves, treedef = jax.tree.flatten(example_tree)
@@ -250,6 +265,13 @@ def restore(ckpt_dir: str | os.PathLike, example_tree: Any,
             getattr(example, "dtype", None) or np.asarray(example).dtype
         )
         if arr.shape != ex_shape or arr.dtype != ex_dtype:
+            if reshard and leaf_meta is not None \
+                    and list(arr.shape) == leaf_meta[i]["shape"] \
+                    and str(arr.dtype) == leaf_meta[i]["dtype"]:
+                # the saved layout, intact per the manifest: hand it to
+                # the caller's explicit regroup
+                loaded.append(arr)
+                continue
             raise ValueError(
                 f"checkpoint leaf {i} has shape {arr.shape} dtype "
                 f"{arr.dtype}; example tree expects {ex_shape} "

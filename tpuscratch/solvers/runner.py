@@ -130,6 +130,8 @@ def checkpointed_mg3d_solve(
     chaos=None,
     recorder=None,
     log=lambda s: None,
+    reshard: bool = False,
+    async_ckpt: bool = False,
 ) -> tuple[np.ndarray, SolveReport]:
     """``mg_poisson3d_solve`` with preemption survival: V-cycles run in
     compiled chunks of ``chunk_cycles``, the solver state is saved at
@@ -147,6 +149,18 @@ def checkpointed_mg3d_solve(
     ``sink``/``recorder`` receive the same chunk/save telemetry the
     trainer and halo driver emit, in the ``solver/*`` namespace.
     ``s_step`` passes through to the communication-avoiding smoothers.
+
+    ``reshard=True`` makes the resume elastic over the mesh shape: a
+    checkpoint whose solution tiles were cut for a different 3D process
+    grid is reassembled and re-decomposed onto THIS mesh (the core
+    tiles round-trip exactly; the convergence scalars are replicated).
+    The continued solve is replay-deterministic on the new mesh — its
+    psum groupings differ from the old mesh's, so cross-mesh residual
+    trajectories agree to reassociation tolerance, not bitwise.
+    ``async_ckpt=True`` switches the chunk-boundary saves to the
+    snapshot-then-publish path (``runtime.async_ckpt``), with the
+    barrier drained before each snapshot, at preemption points, and at
+    exit.
     """
     from tpuscratch.obs.sink import NullSink
     from tpuscratch.obs.trace import (
@@ -182,12 +196,20 @@ def checkpointed_mg3d_solve(
     }
     resumed_at = 0
     if checkpoint.latest_step(ckpt_dir) is not None:
-        state, resumed_at, _meta = checkpoint.restore(ckpt_dir, state)
+        state, resumed_at, _meta = checkpoint.restore(ckpt_dir, state,
+                                                      reshard=reshard)
         if resumed_at > max_cycles:
             raise ValueError(
                 f"checkpoint in {ckpt_dir} is at cycle {resumed_at}, beyond "
                 f"the requested {max_cycles} — refusing to return an "
                 "over-stepped state (use a fresh ckpt_dir)"
+            )
+        if np.shape(state["u"])[:3] != tuple(dims):
+            # elastic resume: the tiles were cut for another process
+            # grid — the core decomposition is a pure relayout, so
+            # reassemble the world and re-cut it for THIS mesh
+            state["u"] = decompose3d_cores(
+                assemble3d_cores(np.asarray(state["u"])), dims
             )
         log(f"resuming at cycle {resumed_at}")
 
@@ -205,6 +227,11 @@ def checkpointed_mg3d_solve(
 
         bind_sink(chaos, sink)
         save_hook = chaos.save_hook()
+    ckp = None
+    if async_ckpt:
+        from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
+
+        ckp = AsyncCheckpointer(chaos=chaos, sink=sink)
 
     u = jnp.asarray(state["u"])
     rs = jnp.asarray(state["rs"])
@@ -214,7 +241,10 @@ def checkpointed_mg3d_solve(
     chunks = 0
     compiled_once = not fresh_program
     cells_total = float(np.prod(b_world.shape))
-    with file_flight_data(sink, rec):
+    import contextlib
+
+    with file_flight_data(sink, rec), \
+            (ckp if ckp is not None else contextlib.nullcontext()):
         while k < max_cycles:
             if chaos is not None:
                 # a transient CommError here is the supervisor's
@@ -243,30 +273,43 @@ def checkpointed_mg3d_solve(
                 compile_s=round(chunk_s, 6) if fresh else 0.0,
             )
 
-            def do_save(at=k_new):
-                return checkpoint.save(
-                    ckpt_dir, at,
-                    {"u": np.asarray(u), "rs": np.asarray(rs),
-                     "prev": np.asarray(prev),
-                     "k": np.asarray(k_new, np.int32)},
-                    metadata={"solver": "mg3d", "tol": tol,
-                              "max_cycles": max_cycles},
-                    hook=save_hook,
-                )
-
-            save_sp = rec.open_span("ckpt/save", cycle=k_new)
-            if chaos is not None:
-                from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY, retry
-
-                retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
+            snap_state = {"u": np.asarray(u), "rs": np.asarray(rs),
+                          "prev": np.asarray(prev),
+                          "k": np.asarray(k_new, np.int32)}
+            snap_meta = {"solver": "mg3d", "tol": tol,
+                         "max_cycles": max_cycles}
+            if ckp is not None:
+                snap_sp = rec.open_span("ckpt/snapshot", cycle=k_new)
+                ckp.snapshot(ckpt_dir, k_new, snap_state,
+                             metadata=snap_meta, keep=keep)
+                rec.close_span(snap_sp)
+                sink.emit("ckpt/snapshot", step=k_new,
+                          wall_s=round(snap_sp.seconds, 6))
             else:
-                do_save()
-            checkpoint.prune(ckpt_dir, keep)
-            rec.close_span(save_sp)
-            sink.emit("ckpt/save", step=k_new,
-                      wall_s=round(save_sp.seconds, 6))
+                def do_save(at=k_new, snap=snap_state):
+                    return checkpoint.save(ckpt_dir, at, snap,
+                                           metadata=snap_meta,
+                                           hook=save_hook)
+
+                save_sp = rec.open_span("ckpt/save", cycle=k_new)
+                if chaos is not None:
+                    from tpuscratch.ft.retry import (
+                        DEFAULT_SAVE_RETRY,
+                        retry,
+                    )
+
+                    retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
+                else:
+                    do_save()
+                checkpoint.prune(ckpt_dir, keep)
+                rec.close_span(save_sp)
+                sink.emit("ckpt/save", step=k_new,
+                          wall_s=round(save_sp.seconds, 6))
             if chaos is not None:
-                # AFTER the save: the restarted run resumes exactly here
+                # AFTER the save: the restarted run resumes exactly
+                # here (a fired preemption unwinds through the async
+                # checkpointer's context, which completes the in-flight
+                # write before the supervisor re-invokes)
                 chaos.maybe_preempt("solver/preempt", index=k_new)
             stop2 = float(tol) ** 2 * float(rs0)
             if float(rs) <= stop2:
