@@ -247,3 +247,198 @@ class TestCompactSquareAndBf16:
         np.testing.assert_allclose(
             np.asarray(out, np.float32), np.asarray(ref), rtol=0.05, atol=0.05
         )
+
+
+# ---- fused paged-attention kernel family (ISSUE 12) -----------------------
+#
+# The fused kernel (ops.attention.paged_attention) runs here in Pallas
+# interpret mode against the dense XLA formulation — the ORACLE the
+# dispatch keeps as the CPU/fallback path — across the serve dtype
+# ladder (fp32 / int8 / fp8-e4m3) and the operand edge cases the engine
+# produces: ragged seq_lens, idle seq_len == 0 slots, sentinel
+# page-table tails, and page-count boundaries.  The two formulations
+# differ only in summation order (online-softmax accumulation vs one
+# dense softmax), so equivalence is pinned at reassociation-ulp
+# tolerance (FUSED_PAGED_ATOL; measured ~2e-7 at these geometries).
+
+from tpuscratch.ops.attention import (  # noqa: E402
+    decode_attention,
+    fused_attention_default,
+    paged_attention,
+    paged_attention_supported,
+    verify_attention,
+)
+from tpuscratch.serve.kvcache import quantize_pages  # noqa: E402
+
+#: fused-vs-dense bound: fp32 reassociation only (both paths dequantize
+#: identically before their contractions), measured ~2e-7
+FUSED_PAGED_ATOL = 1e-5
+
+
+def _paged_case(rng, n_pages=8, page=4, H=2, Dh=8, B=3, max_pages=4,
+                dtype=None):
+    """Pools + a table exercising scrambled page order, sentinel tails,
+    and (via the lens the callers pick) ragged/idle/page-edge slots."""
+    kf = rng.standard_normal((n_pages, page, H, Dh)).astype(np.float32)
+    vf = rng.standard_normal((n_pages, page, H, Dh)).astype(np.float32)
+    table = np.full((B, max_pages), n_pages, np.int32)  # sentinel tails
+    order = rng.permutation(n_pages)
+    used = 0
+    for b in range(B):
+        n = min(max_pages, 1 + (b * 2) % max_pages)
+        table[b, :n] = order[used:used + n] if used + n <= n_pages else (
+            order[:n]
+        )
+        used = (used + n) % max(1, n_pages - max_pages)
+    if dtype is None:
+        return jnp.asarray(kf), jnp.asarray(vf), jnp.asarray(table), None, None
+    qk, sk = quantize_pages(jnp.asarray(kf), dtype)
+    qv, sv = quantize_pages(jnp.asarray(vf), dtype)
+    return qk, qv, jnp.asarray(table), sk, sv
+
+
+PAGED_DTYPES = (None, jnp.int8, jnp.float8_e4m3fn)  # None = fp32 rung
+
+
+class TestPagedFusedOracle:
+    """Interpret-mode fused kernel == dense oracle, the dtype ladder x
+    the engine's operand edge cases."""
+
+    @pytest.mark.parametrize("dtype", PAGED_DTYPES)
+    def test_decode_matches_oracle_ragged_idle_sentinel(self, dtype):
+        rng = np.random.default_rng(3)
+        k_p, v_p, table, sk, sv = _paged_case(rng, dtype=dtype)
+        B, H, Dh, page = 3, 2, 8, 4
+        # ragged: mid-page, exactly at the table's full capacity
+        # (16 == max_pages * page), and an idle slot
+        lens = jnp.asarray([9, 16, 0], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, H, Dh)).astype(np.float32))
+        dense = decode_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=False)
+        fused = decode_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)
+        assert float(jnp.abs(fused[2]).max()) == 0.0  # idle -> zeros
+
+    @pytest.mark.parametrize("dtype", PAGED_DTYPES)
+    def test_verify_matches_oracle_ragged_causal(self, dtype):
+        """The verify/context-prefill shape: K queries ride one sweep,
+        position j attending seq_len + j entries (ragged-causal), with
+        lens straddling page boundaries (3 + K - 1 crosses into a
+        fresh page mid-sweep) and a len exactly one page in."""
+        rng = np.random.default_rng(4)
+        k_p, v_p, table, sk, sv = _paged_case(rng, dtype=dtype)
+        B, H, Dh, K = 3, 2, 8, 3
+        lens = jnp.asarray([3, 4, 0], jnp.int32)
+        q = jnp.asarray(
+            rng.standard_normal((B, K, H, Dh)).astype(np.float32)
+        )
+        dense = verify_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=False)
+        fused = verify_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)
+        assert float(jnp.abs(fused[2]).max()) == 0.0
+
+    def test_idle_slot_zeros_when_K_exceeds_page(self):
+        """Review regression: an idle slot whose K exceeds page_size+1
+        has n_need > 1 even with nothing cached (the ragged frontier
+        reaches past page 0), and the update branch must keep the
+        seq_len > 0 guard — without it the kernel accumulated garbage
+        from the sentinel-clamped page while the oracle returns
+        zeros."""
+        rng = np.random.default_rng(8)
+        k_p, v_p, table, sk, sv = _paged_case(rng)
+        K = 6  # > page_size + 1 = 5
+        lens = jnp.asarray([5, 0, 0], jnp.int32)
+        q = jnp.asarray(
+            rng.standard_normal((3, K, 2, 8)).astype(np.float32)
+        )
+        dense = verify_attention(q, k_p, v_p, table, lens, fused=False)
+        fused = verify_attention(q, k_p, v_p, table, lens, fused=True)
+        assert float(jnp.abs(fused[1:]).max()) == 0.0
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)
+
+    def test_single_page_single_slot(self):
+        """Page-count lower edge: one page, one slot, len == 1."""
+        rng = np.random.default_rng(5)
+        kf = jnp.asarray(rng.standard_normal((1, 4, 2, 8)).astype(np.float32))
+        vf = jnp.asarray(rng.standard_normal((1, 4, 2, 8)).astype(np.float32))
+        table = jnp.zeros((1, 1), jnp.int32)
+        lens = jnp.ones((1,), jnp.int32)
+        q = jnp.asarray(rng.standard_normal((1, 2, 8)).astype(np.float32))
+        dense = decode_attention(q, kf, vf, table, lens, fused=False)
+        fused = decode_attention(q, kf, vf, table, lens, fused=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)
+
+    def test_paged_attention_property_random_ragged_lens(self):
+        """Property draw: random ragged lens (idles included) stay
+        within the stated bound for every dtype rung, through the
+        public :func:`paged_attention` entry directly."""
+        rng = np.random.default_rng(6)
+        for dtype in PAGED_DTYPES:
+            k_p, v_p, table, sk, sv = _paged_case(rng, dtype=dtype)
+            lens = jnp.asarray(rng.integers(0, 16, size=3).astype(np.int32))
+            q = jnp.asarray(
+                rng.standard_normal((3, 1, 2, 8)).astype(np.float32)
+            )
+            dense = verify_attention(q, k_p, v_p, table, lens, sk, sv,
+                                     fused=False)
+            fused = paged_attention(q, k_p, v_p, table, lens, sk, sv)
+            np.testing.assert_allclose(
+                np.asarray(fused), np.asarray(dense),
+                atol=FUSED_PAGED_ATOL,
+            )
+
+    def test_dispatch_policy(self, monkeypatch):
+        """The gating contract: env override wins, otherwise the dense
+        oracle off-TPU; fused=True forces the kernel anywhere."""
+        monkeypatch.delenv("TPUSCRATCH_FUSED_ATTN", raising=False)
+        assert fused_attention_default() == (
+            jax.default_backend() == "tpu"
+        )
+        monkeypatch.setenv("TPUSCRATCH_FUSED_ATTN", "on")
+        assert fused_attention_default() is True
+        monkeypatch.setenv("TPUSCRATCH_FUSED_ATTN", "off")
+        assert fused_attention_default() is False
+        # interpret mode supports any geometry
+        assert paged_attention_supported(2, 8, 4, jnp.float32) is None
+
+
+@pytest.mark.pallas_tpu
+@pytest.mark.skipif(jax.default_backend() != "tpu",
+                    reason="compiled Mosaic paged kernel needs a TPU")
+class TestPagedFusedChip:
+    """Chip-geometry fused kernel (collected-but-skipped under the
+    JAX_PLATFORMS=cpu tier-1 run; interpret-mode equivalence above
+    covers the same kernel source — the one-source contract of
+    ops/common.use_interpret)."""
+
+    @pytest.mark.parametrize("dtype", PAGED_DTYPES)
+    def test_chip_geometry_matches_oracle(self, dtype):
+        rng = np.random.default_rng(7)
+        n_pages, page, H, Dh = 32, 16, 8, 128
+        kf = rng.standard_normal((n_pages, page, H, Dh)).astype(np.float32)
+        vf = rng.standard_normal((n_pages, page, H, Dh)).astype(np.float32)
+        if dtype is None:
+            k_p, v_p, sk, sv = jnp.asarray(kf), jnp.asarray(vf), None, None
+        else:
+            k_p, sk = quantize_pages(jnp.asarray(kf), dtype)
+            v_p, sv = quantize_pages(jnp.asarray(vf), dtype)
+        B, max_pages = 8, 8
+        table = jnp.asarray(
+            rng.permutation(n_pages)[: B * max_pages].reshape(B, max_pages)
+        ).astype(jnp.int32)
+        lens = jnp.asarray(rng.integers(0, 128, size=B).astype(np.int32))
+        q = jnp.asarray(rng.standard_normal((B, H, Dh)).astype(np.float32))
+        assert paged_attention_supported(H, Dh, page, k_p.dtype) is None
+        dense = decode_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=False)
+        fused = decode_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)

@@ -205,3 +205,104 @@ class TestHIndexedSpec:
         restored = spec.unpack(payload, arrays)
         for orig, back in zip(arrays, restored):
             np.testing.assert_array_equal(orig, back)
+
+
+# ---- serve KV dtype ladder (ISSUE 12: the fp8-e4m3 rung) ------------------
+#
+# Not a slice-spec concern, but this file is the repo's dtype-contract
+# home: the KV ladder's quantization round trips and the ledger byte
+# proof live beside the wire-format round trips above.
+
+from tpuscratch.obs.ledger import kv_cache_bytes  # noqa: E402
+from tpuscratch.serve.kvcache import (  # noqa: E402
+    FP8_QMAX,
+    CacheGeometry,
+    dequantize_pages,
+    init_kv_cache,
+    is_quantized_kv_dtype,
+    quantize_pages,
+)
+
+
+class TestKVDtypeLadder:
+    def test_fp8_roundtrip_error_bound(self):
+        """e4m3 floating grid: relative error <= 2^-4 at any magnitude
+        (3 mantissa bits), absolute error below scale * 2^-9 in the
+        subnormal tail; the amax entry scales to exactly 448 and
+        round-trips exactly."""
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(
+            rng.standard_normal((5, 4, 3, 8)).astype(np.float32) * 3.0
+        )
+        q, s = quantize_pages(x, jnp.float8_e4m3fn)
+        assert q.dtype == jnp.float8_e4m3fn and s.shape == (5, 3)
+        back = np.asarray(dequantize_pages(q, s))
+        err = np.abs(back - np.asarray(x))
+        bound = (np.abs(np.asarray(x)) * 2.0 ** -4
+                 + np.asarray(s)[:, None, :, None] * 2.0 ** -9 + 1e-7)
+        assert (err <= bound).all()
+        amax = np.abs(np.asarray(x)).max(axis=(1, 3))
+        np.testing.assert_allclose(np.asarray(s) * FP8_QMAX, amax,
+                                   rtol=1e-6)
+        # the amax entry is exact (448 is representable in e4m3)
+        per_page_amax_err = np.abs(
+            np.abs(back).max(axis=(1, 3)) - amax
+        )
+        np.testing.assert_allclose(per_page_amax_err, 0.0, atol=1e-6)
+
+    def test_fp8_zero_page_quantizes_to_zero(self):
+        q, s = quantize_pages(jnp.zeros((2, 4, 2, 8)), jnp.float8_e4m3fn)
+        assert float(jnp.abs(dequantize_pages(q, s)).max()) == 0.0
+
+    def test_fp8_beats_int8_on_outlier_pages(self):
+        """The regime fp8 exists for: one large outlier per page costs
+        int8's uniform grid its whole-page resolution (error ~scale/2
+        everywhere) but costs the e4m3 floating grid nothing for the
+        inliers (relative grid).  Same bytes, complementary error."""
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((4, 8, 2, 16)).astype(np.float32) * 0.1
+        x[:, 0, :, 0] = 50.0  # one outlier entry per (page, head)
+        xj = jnp.asarray(x)
+        qi, si = quantize_pages(xj, jnp.int8)
+        qf, sf = quantize_pages(xj, jnp.float8_e4m3fn)
+        inlier = np.ones_like(x, bool)
+        inlier[:, 0, :, 0] = False
+        err_i = np.abs(np.asarray(dequantize_pages(qi, si)) - x)[inlier]
+        err_f = np.abs(np.asarray(dequantize_pages(qf, sf)) - x)[inlier]
+        assert err_f.max() < err_i.max() / 5, (
+            f"fp8 inlier error {err_f.max():.4f} not well below int8's "
+            f"{err_i.max():.4f} on outlier pages"
+        )
+
+    def test_quantize_rejects_non_ladder_dtype(self):
+        with pytest.raises(ValueError):
+            quantize_pages(jnp.zeros((1, 4, 2, 8)), jnp.int4)
+        with pytest.raises(ValueError):
+            init_kv_cache(CacheGeometry(1, 4, 4, 2, 8), dtype=jnp.bfloat16)
+
+    def test_ladder_predicate(self):
+        assert is_quantized_kv_dtype(jnp.int8)
+        assert is_quantized_kv_dtype(jnp.float8_e4m3fn)
+        assert not is_quantized_kv_dtype(jnp.float32)
+
+    def test_fp8_ledger_bytes_match_int8_and_pin(self):
+        """The ledger proof at the new rung: fp8 cache bytes == int8
+        cache bytes EXACTLY (both 1 byte/element + identical fp32 scale
+        planes) and <= 0.30x fp32 at both record geometries — the
+        ISSUE-12 acceptance bound, tighter than int8's 0.55x pin."""
+        from tpuscratch.bench.decode_bench import default_decode_setup
+
+        for on_tpu in (False, True):
+            cfg, scfg, _, _ = default_decode_setup(on_tpu)
+            geom = CacheGeometry(cfg.n_layers, scfg.n_pages,
+                                 scfg.page_size, cfg.n_heads, cfg.d_head)
+            b_f32 = kv_cache_bytes(init_kv_cache(geom))
+            b_int8 = kv_cache_bytes(init_kv_cache(geom, dtype=jnp.int8))
+            b_fp8 = kv_cache_bytes(
+                init_kv_cache(geom, dtype=jnp.float8_e4m3fn)
+            )
+            assert b_fp8 == b_int8, "fp8 must not fatten the cache"
+            ratio = b_fp8 / b_f32
+            analytic = 0.25 + 1.0 / (geom.page_size * geom.d_head)
+            assert abs(ratio - analytic) < 1e-9
+            assert ratio <= 0.30, f"fp8 cache ratio {ratio:.3f} > 0.30"

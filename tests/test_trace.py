@@ -683,6 +683,17 @@ class TestRegress:
         for name in higher:
             assert regress.direction(name) == "higher", name
 
+    def test_roofline_field_directions(self):
+        """Config 12's decode-sweep roofline row (ISSUE 12): the
+        achieved fraction/rate and the fused-vs-dense speedup gate
+        UPWARD (the kernel pin), while the stated peak denominator is
+        configuration — no direction, never compared (restating the
+        peak must not read as a kernel change)."""
+        for name in ("achieved_frac", "achieved_hbm_gbps",
+                     "fused_speedup"):
+            assert regress.direction(name) == "higher", name
+        assert "peak_hbm_gbps" in regress._SKIP
+
     def test_improvement_and_missing_are_not_failures(self):
         base = regress.index_rows(self.BASE)
         new = regress.index_rows([dict(self.BASE[0], value=200000.0)])

@@ -1,12 +1,24 @@
 """Serving-side decode throughput and per-token latency (BASELINE row 12).
 
 ``python -m tpuscratch.bench.decode_bench [--json PATH]
-[--kv-dtype int8] [--spec K]``
+[--kv-dtype int8|fp8] [--spec K] [--fused auto|on|off]``
 
-``--kv-dtype int8`` runs the sweep on quantized KV pages (~1/4 the
-cache bytes per token); ``--spec K`` speculates K draft tokens per
-verify sweep over an accept-friendly periodic prompt — the two serving
-hot-path levers, locally sweepable before a record run.
+``--kv-dtype int8``/``fp8`` runs the sweep on quantized KV pages (~1/4
+the cache bytes per token); ``--spec K`` speculates K draft tokens per
+verify sweep over an accept-friendly periodic prompt; ``--fused``
+selects the decode-sweep kernel (the fused Pallas paged-attention
+kernel vs the dense XLA oracle) — the serving hot-path levers, locally
+sweepable before a record run.
+
+Every row additionally carries the decode-sweep ROOFLINE: the HBM
+bytes the measured sweeps moved (static page-count x ledger
+bytes-per-token accounting, ``engine.cached_pages`` x
+``engine.kv_bytes_per_token``) over the measured wall, as an absolute
+rate and as the achieved fraction of the stated platform peak
+(:func:`peak_hbm_bytes_per_s`; ``TPUSCRATCH_PEAK_HBM_GBPS`` to
+override).  This is the quantity the fused kernel exists to raise —
+the 2.42x stencil pin's residency argument applied to serving — and
+config 12 regression-gates it upward.
 
 Every training-side row measures steps/s of a compiled program; serving
 is judged on different axes — sustained tokens/s at a batch size, and
@@ -35,10 +47,31 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
 from tpuscratch.bench.timing import BenchResult, percentile
+
+#: stated peak HBM bandwidth for the achieved-fraction-of-peak row,
+#: overridable via TPUSCRATCH_PEAK_HBM_GBPS.  The TPU default is the
+#: v5e spec number; the CPU default is a dual-channel DDR4-3200 PROXY
+#: (51.2 GB/s) so CPU artifacts carry a comparable-to-itself fraction —
+#: the absolute CPU value is a proxy, the per-artifact TREND is the
+#: regression-gated quantity (the config-14 CPU-caveat discipline).
+_PEAK_HBM_ENV = "TPUSCRATCH_PEAK_HBM_GBPS"
+_DEFAULT_PEAK_HBM_GBPS = {"tpu": 819.0, "cpu": 51.2, "gpu": 900.0}
+
+
+def peak_hbm_bytes_per_s() -> float:
+    """The roofline denominator for the decode sweep (bytes/s)."""
+    import jax
+
+    env = os.environ.get(_PEAK_HBM_ENV, "").strip()
+    if env:
+        return float(env) * 1e9
+    plat = jax.default_backend()
+    return _DEFAULT_PEAK_HBM_GBPS.get(plat, 51.2) * 1e9
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +99,17 @@ class DecodeBenchResult:
     bytes_per_token: float = 0.0
     accept_len_mean: float | None = None
     times_per_token_s: tuple[float, ...] = ()
+    # the decode-sweep roofline (ISSUE 12): HBM bytes the measured
+    # window's sweeps moved — per tick, each live slot's page footprint
+    # (engine.cached_pages sampled before the tick) times the pool's
+    # exact per-token bytes (pages + amortized scale planes, the
+    # obs.ledger.kv_cache_bytes accounting) — over the measured wall,
+    # against the stated platform peak.  swept_bytes is STATIC
+    # accounting (page counts x ledger bytes), only the wall is sampled.
+    swept_bytes: float = 0.0
+    achieved_bytes_per_s: float = 0.0
+    achieved_frac: float = 0.0
+    fused: str = "auto"
 
     @property
     def tokens_per_s(self) -> float:
@@ -87,6 +131,11 @@ class DecodeBenchResult:
         )
         if self.accept_len_mean is not None:
             out += f", accept len {self.accept_len_mean:.2f}/{self.spec_k}"
+        if self.achieved_bytes_per_s:
+            out += (
+                f", sweep {self.achieved_bytes_per_s / 1e9:.2f} GB/s "
+                f"({100 * self.achieved_frac:.1f}% of peak)"
+            )
         return out
 
 
@@ -328,9 +377,15 @@ def bench_decode(
     compiles_before = engine.decode_compiles
     tokens0, slots0 = engine.tokens_generated, engine.slot_steps
     accepted0 = engine.spec_accepted
+    page_bytes = engine.scfg.page_size * engine.kv_bytes_per_token
     times, tick_tokens = [], []
+    swept_bytes = 0.0
     tprev = engine.tokens_generated
     for _ in range(measure_steps):
+        # pages THIS tick's sweep gathers, sampled before it runs —
+        # static accounting (page counts x exact ledger bytes/token);
+        # one sweep reads them once whether it scores 1 or K queries
+        swept_bytes += engine.cached_pages * page_bytes
         t0 = time.perf_counter()
         engine.step()  # pulls sampled tokens to host: fenced
         times.append(time.perf_counter() - t0)
@@ -355,6 +410,8 @@ def bench_decode(
         times_s=tuple(times),
         items=tokens / measure_steps,  # measured tokens per tick
     )
+    wall = sum(times)
+    achieved = swept_bytes / wall if wall else 0.0
     out = DecodeBenchResult(
         res, scfg.n_slots,
         kv_dtype=scfg.kv_dtype, spec_k=scfg.spec_k,
@@ -364,6 +421,10 @@ def bench_decode(
             t * scfg.n_slots / max(tk, 1)
             for t, tk in zip(times, tick_tokens)
         ),
+        swept_bytes=swept_bytes,
+        achieved_bytes_per_s=achieved,
+        achieved_frac=achieved / peak_hbm_bytes_per_s(),
+        fused=scfg.fused_attention,
     )
     if sink is not None and sink.enabled:
         sink.emit(
@@ -374,6 +435,9 @@ def bench_decode(
             p50_s_per_token=out.p50_s, p99_s_per_token=out.p99_s,
             kv_dtype=scfg.kv_dtype, spec_k=scfg.spec_k,
             bytes_per_token=out.bytes_per_token,
+            achieved_hbm_gbps=out.achieved_bytes_per_s / 1e9,
+            achieved_frac=out.achieved_frac,
+            fused=scfg.fused_attention,
             **({"accept_len_mean": accept_mean}
                if accept_mean is not None else {}),
         )
@@ -432,9 +496,18 @@ def main(argv=None) -> int:
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path (per-tick engine telemetry)")
     ap.add_argument("--kv-dtype", default="float32",
-                    choices=("float32", "int8"),
-                    help="KV-cache page dtype (int8: quantized pages, "
-                         "~1/4 the cache bytes per token)")
+                    choices=("float32", "int8", "fp8"),
+                    help="KV-cache page dtype (int8/fp8: quantized "
+                         "pages, ~1/4 the cache bytes per token; fp8 "
+                         "is the accuracy-per-byte e4m3 rung at the "
+                         "same bytes)")
+    ap.add_argument("--fused", default="auto",
+                    choices=("auto", "on", "off"),
+                    help="decode-sweep kernel: the fused Pallas "
+                         "paged-attention kernel ('auto' uses it on a "
+                         "real TPU; 'on' forces it, interpret-mode "
+                         "off-TPU — orders of magnitude slower, a "
+                         "correctness tool) vs the dense XLA oracle")
     ap.add_argument("--spec", type=int, default=0, metavar="K",
                     help="speculative draft tokens per verify sweep "
                          "(0 = off); sweeps use an accept-friendly "
@@ -465,7 +538,8 @@ def main(argv=None) -> int:
     mesh = make_mesh((1, 1), ("dp", "sp"))
     cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
     scfg = dataclasses.replace(scfg, kv_dtype=args.kv_dtype,
-                               spec_k=args.spec)
+                               spec_k=args.spec,
+                               fused_attention=args.fused)
 
     if args.share_ratio is not None:
         ratios = [float(r) for r in args.share_ratio.split(",")]
@@ -559,6 +633,9 @@ def main(argv=None) -> int:
                 "kv_dtype": r.kv_dtype,
                 "spec_k": r.spec_k,
                 "bytes_per_token": r.bytes_per_token,
+                "achieved_hbm_gbps": r.achieved_bytes_per_s / 1e9,
+                "achieved_frac": r.achieved_frac,
+                "fused": r.fused,
             }
             if r.accept_len_mean is not None:
                 row["accept_len_mean"] = r.accept_len_mean

@@ -775,6 +775,58 @@ def config12_decode(out: list, obs_path=None) -> None:
             + (f" [obs: {obs_path}]" if obs_path else ""),
         )
 
+        # decode-sweep roofline (ISSUE 12): the achieved fraction of
+        # peak HBM bandwidth on the sweep — static swept-byte
+        # accounting (engine.cached_pages x ledger bytes/token) over
+        # the measured wall, against the stated platform peak
+        # (TPUSCRATCH_PEAK_HBM_GBPS to override; the CPU default is a
+        # documented proxy, so the CPU row gates its own trend).  On
+        # TPU the row also measures the fused Pallas kernel against
+        # the dense oracle at the best sweep batch (fused_speedup, the
+        # raw-speed claim of the kernel family); off-TPU "fused" is
+        # interpret-mode — a correctness tool, not a rate — so the
+        # field is absent there, the Needs-style hardware skip.
+        from tpuscratch.bench.decode_bench import peak_hbm_bytes_per_s
+
+        roofline_row = dict(
+            config=12,
+            metric="serve_decode_roofline",
+            value=best.achieved_frac,
+            achieved_frac=best.achieved_frac,
+            achieved_hbm_gbps=best.achieved_bytes_per_s / 1e9,
+            peak_hbm_gbps=peak_hbm_bytes_per_s() / 1e9,
+            kernel=("fused" if on_tpu else "dense"),
+        )
+        if on_tpu:
+            r_fused = bench_decode(
+                mesh, cfg, _dc.replace(scfg, n_slots=best.n_slots,
+                                       fused_attention="on"),
+                sink=sink, **kwargs,
+            )
+            r_dense = bench_decode(
+                mesh, cfg, _dc.replace(scfg, n_slots=best.n_slots,
+                                       fused_attention="off"),
+                sink=sink, **kwargs,
+            )
+            roofline_row["fused_speedup"] = (
+                r_fused.tokens_per_s / r_dense.tokens_per_s
+            )
+            roofline_row["achieved_frac"] = r_fused.achieved_frac
+            roofline_row["value"] = r_fused.achieved_frac
+            roofline_row["achieved_hbm_gbps"] = (
+                r_fused.achieved_bytes_per_s / 1e9
+            )
+        roofline_row["detail"] = (
+            f"{roofline_row['achieved_hbm_gbps']:.3f} GB/s achieved "
+            f"({100 * roofline_row['achieved_frac']:.2f}% of "
+            f"{roofline_row['peak_hbm_gbps']:.0f} GB/s peak, "
+            f"{roofline_row['kernel']} kernel"
+            + (f", fused {roofline_row['fused_speedup']:.2f}x dense"
+               if "fused_speedup" in roofline_row else "")
+            + ")"
+        )
+        _emit(out, **roofline_row)
+
         # static cache-byte proof at this row's geometry: int8 pages +
         # scales vs fp32 pages, per token of pool capacity — exact, not
         # sampled (the ZeRO grad-leg pattern applied to serving HBM)

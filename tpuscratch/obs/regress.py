@@ -34,7 +34,14 @@ __all__ = ["Finding", "compare", "format_findings", "index_rows",
 #: name substrings ⇒ bigger is better
 #: ("achieved" covers the ledger-derived achieved-fraction/-rate rows
 #: of the overlap ablation, config 14 — checked before "_s"/"ratio"
-#: could mislabel them)
+#: could mislabel them — AND the config-12 decode-sweep roofline row's
+#: ``achieved_frac``/``achieved_hbm_gbps`` (ISSUE 12): the fraction of
+#: peak HBM bandwidth the paged-attention sweep reaches must only go
+#: up, the pin on the fused kernel the way the 0.55x byte gate pins
+#: int8.  Its ``fused_speedup`` (fused Pallas kernel over the dense
+#: oracle, TPU-only) rides "speedup" — up.  The row's stated
+#: ``peak_hbm_gbps`` denominator is CONFIGURATION, skipped below —
+#: restating the peak must not masquerade as a kernel change.)
 #: ("goodput" covers the config-16 elastic-FT rows' goodput_fraction —
 #: the share of wall spent on committed steps, up)
 _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
@@ -73,7 +80,7 @@ _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
 _LOWER_FIRST = ("per_sweep",)
 #: fields that are identity/configuration, never compared
 _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
-         "flops_per_token", "degenerate"}
+         "flops_per_token", "degenerate", "peak_hbm_gbps"}
 
 
 def direction(name: str) -> Optional[str]:

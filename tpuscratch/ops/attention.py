@@ -32,11 +32,21 @@ Kernel structure (the canonical TPU flash schedule):
 - bf16 inputs run the MXU passes in bf16 (fp32 accumulation), roughly
   doubling the matmul rate vs the fp32-input path; the online-softmax
   state stays fp32 throughout.
+
+The second half of this module is the SERVING side of the same
+residency argument: a fused Pallas paged-attention kernel family
+(:func:`paged_attention`) streaming block-paged KV pools from HBM
+exactly once per step — page gather, int8/fp8 dequantization, and the
+flash-style online softmax in ONE kernel — dispatched behind the three
+cached entry points (:func:`decode_attention`, :func:`verify_attention`,
+and through them the context-prefill program), with the dense XLA
+formulation kept as the interpret-mode/CPU oracle and fallback.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -1119,21 +1129,31 @@ def flash_attention(
 # ---- cached decode attention ---------------------------------------------
 
 
-def _gather_pages(pages, table, page_scale):
-    """Each sequence's contiguous fp32 cache view: pages (P, page, H, D)
-    gathered by a clipped (B, max_pages) table into (B, T, H, D).
+def _gather_pages(pages, table):
+    """Each sequence's contiguous cache view IN THE POOL DTYPE: pages
+    (P, page, H, D) gathered by a clipped (B, max_pages) table into
+    (B, T, H, D).
 
-    ``page_scale`` (P, H) — present for int8 pools — dequantizes AFTER
-    the gather: the gather itself moves int8 bytes (a quarter of the
-    fp32 sweep, which is the decode roofline) plus H floats of scale per
-    page, and the fp32 expansion happens on the already-local view."""
+    Quantized pools gather raw int8/fp8 bytes — a quarter of the fp32
+    sweep, which is the decode roofline — and dequantization is FOLDED
+    into the score/output contractions by the callers
+    (:func:`_position_scale`): the per-page scale is constant across
+    ``d_head``, so ``q . (k * s) == (q . k) * s`` up to fp
+    reassociation, and the oracle never materializes a fp32
+    ``(B, T, H, D)`` expansion of the pool it reads (its peak memory
+    used to be 4x the int8 pool; now the gathered view stays 1 byte per
+    element and the scale rides as a (B, T, H) plane)."""
     B, max_pages = table.shape
     page_size, H, D = pages.shape[1:]
-    T = max_pages * page_size
-    g = pages[table]                              # (B, max_pages, page, H, D)
-    if page_scale is not None:
-        g = g.astype(jnp.float32) * page_scale[table][:, :, None, :, None]
-    return g.reshape(B, T, H, D)
+    return pages[table].reshape(B, max_pages * page_size, H, D)
+
+
+def _position_scale(page_scale, table, page_size):
+    """Per-POSITION dequantization plane (B, T, H) from the per-page
+    (P, H) scale plane: each page's scale repeated over its tokens —
+    the small operand the dense oracle folds into its contractions
+    instead of dequantizing the full (B, T, H, D) gather."""
+    return jnp.repeat(page_scale[table], page_size, axis=1)
 
 
 def _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens):
@@ -1151,6 +1171,295 @@ def _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens):
         )
 
 
+# ---- fused paged-attention kernel family ---------------------------------
+#
+# The decode sweep is ONE pass over the KV pool per step, and the dense
+# formulation above pays it as three separate XLA ops — page gather,
+# dequantize, attention — each a round trip through HBM.  The fused
+# kernel streams every page exactly once: grid (batch, page), the page
+# table scalar-prefetched so each sequence's pages DMA HBM -> VMEM in
+# table order (Mosaic double-buffers the copies behind the compute),
+# int8/fp8 pages dequantized in VMEM against their per-page scale
+# planes, and the softmax accumulated flash-style (running max /
+# normalizer revisited across page steps — the same online-update
+# algebra as the training kernel above).  One kernel serves all three
+# cached entry points: decode is K=1, speculative verify K=spec_k+1,
+# chunked context prefill K=chunk — the K queries ride the same sweep,
+# which is exactly the amortization argument those paths were built on.
+#
+# The dense formulation stays as the interpret-mode/CPU oracle and the
+# fallback for unsupported geometries (the runtime/compat.py /
+# stencil_kernel.py gating idiom: one numerics contract, the fast path
+# behind a capability check).
+
+_FUSED_ENV = "TPUSCRATCH_FUSED_ATTN"
+
+
+def fused_attention_default() -> bool:
+    """The fused-kernel policy when a caller passes ``fused=None``:
+    ``TPUSCRATCH_FUSED_ATTN`` in {1, on, true} forces the Pallas kernel
+    (interpret mode off-TPU — the oracle-equivalence tests run this),
+    {0, off, false} forces the dense oracle, and unset follows the
+    backend: fused on a real TPU, dense elsewhere (interpret-mode
+    pallas is a correctness tool, not a CPU serving path)."""
+    env = os.environ.get(_FUSED_ENV, "").strip().lower()
+    if env in ("1", "on", "true"):
+        return True
+    if env in ("0", "off", "false"):
+        return False
+    return not use_interpret()
+
+
+def paged_attention_supported(H: int, D: int, page_size: int,
+                              kv_dtype) -> str | None:
+    """None when the fused kernel supports this geometry on the CURRENT
+    backend, else the reason it does not (the ``auto`` dispatch falls
+    back to the dense oracle; ``fused=True`` raises it).
+
+    Interpret mode accepts anything.  Compiled Mosaic wants lane/sublane
+    -aligned blocks: D a multiple of 128 (lanes), H a multiple of 8
+    (fp32 sublanes) so the (page, H, D) page block and the transposed
+    (H, *, D) matmul operands lay out without per-step relayouts, and
+    page_size >= 8 so a page spans at least one sublane tile.  The
+    record-config-12 TPU geometry (H=8, D=128, page=16) qualifies;
+    sub-byte-aligned toy geometries take the oracle.  The query count K
+    is deliberately NOT a constraint (it rides the sublane dim of the
+    (H*K, ·) state scratch, legal at any count)."""
+    del kv_dtype  # quantized pools share the fp32 state layout in VMEM
+    if use_interpret():
+        return None
+    if D % 128:
+        return f"d_head {D} not a multiple of the 128-lane width"
+    if H % 8:
+        return f"n_heads {H} not a multiple of the 8-sublane quantum"
+    if page_size % 8:
+        return f"page_size {page_size} below/off the 8-sublane quantum"
+    return None
+
+
+def _use_paged_kernel(fused: bool | None, hd: tuple[int, int],
+                      k_pages) -> bool:
+    """Resolve the ``fused`` argument of the cached entry points."""
+    H, D = hd
+    page_size = k_pages.shape[1]
+    if fused is False:
+        return False
+    why = paged_attention_supported(H, D, page_size, k_pages.dtype)
+    if fused is None:
+        return fused_attention_default() and why is None
+    if why is not None:
+        raise ValueError(f"fused=True but the paged kernel cannot run: {why}")
+    return True
+
+
+def _paged_kernel(
+    tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+    scale: float, page: int, K: int, H: int, D: int, nj: int,
+    quantized: bool,
+):
+    """One (sequence b, page j) grid step of the fused sweep.
+
+    Scalar-prefetch refs: tbl (B, max_pages) clipped page ids, lens (B,)
+    true cached lengths.  Blocks: q (1, K, H, D) — constant across j;
+    k/v (1, page, H, D) — THE page, in the pool dtype, selected by the
+    prefetched table (the index map clamps past-the-end steps to the
+    last needed page, so masked-out pages cost no DMA — the
+    ``_kv_clamp`` idiom); ks/vs (1, H) scale planes when quantized.
+    Scratch: m/l (H*K, 8) running max/normalizer (lane-broadcast, the
+    ``_STATE_LANES`` layout), acc (H*K, D) fp32 accumulator.
+
+    Rows are ordered head-major (row h*K + kq is head h, query kq) so
+    the per-page score block computes as ONE head-batched MXU pass and
+    the online-softmax state updates stay 2D elementwise."""
+    if quantized:
+        ks_ref, vs_ref, o_ref = rest[0], rest[1], rest[2]
+        m_scr, l_scr, acc_scr = rest[3:]
+    else:
+        ks_ref = vs_ref = None
+        o_ref = rest[0]
+        m_scr, l_scr, acc_scr = rest[1:]
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    seq_len = len_ref[b]
+    # pages this sequence's sweep must read: query position kq attends
+    # cache entries < seq_len + kq, so the frontier is seq_len + K - 1
+    n_need = (seq_len + K - 1 + page - 1) // page
+
+    def dequant(ref, s_ref):
+        x = ref[0].astype(jnp.float32)                 # (page, H, D)
+        if quantized:
+            x = x * s_ref[0][None, :, None]            # (H,) scale plane
+        return x
+
+    def masked_scores():
+        k = dequant(k_ref, ks_ref)
+        qh = jnp.swapaxes(q_ref[0].astype(jnp.float32), 0, 1)  # (H, K, D)
+        kh = jnp.swapaxes(k, 0, 1)                             # (H, page, D)
+        s = lax.dot_general(
+            qh, kh, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        ) * scale                                              # (H, K, page)
+        t = j * page + lax.broadcasted_iota(jnp.int32, (H, K, page), 2)
+        kq = lax.broadcasted_iota(jnp.int32, (H, K, page), 1)
+        s = jnp.where(t < seq_len + kq, s, NEG_INF)
+        s2 = s.reshape(H * K, page)
+        return s2, s2 > NEG_INF * 0.5
+
+    def pv(p2):
+        """(H*K, page) probabilities x the dequantized page -> (H*K, D)."""
+        vh = jnp.swapaxes(dequant(v_ref, vs_ref), 0, 1)        # (H, page, D)
+        c = lax.dot_general(
+            p2.reshape(H, K, page), vh, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return c.reshape(H * K, D)
+
+    # first page fuses init into the accumulation (_online_first's
+    # algebra); an IDLE slot (seq_len == 0) initializes empty state
+    # instead, so the emit divides 0/1 and returns the oracle's zeros
+    @pl.when(jnp.logical_and(j == 0, seq_len > 0))
+    def _first():
+        s2, guard = masked_scores()
+        m_new = s2.max(axis=1)
+        p = jnp.where(guard, jnp.exp(s2 - m_new[:, None]), 0.0)
+        l_new = p.sum(axis=1)
+        acc_scr[...] = pv(p)
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(jnp.logical_and(j == 0, seq_len == 0))
+    def _idle():
+        m_scr[...] = jnp.full(m_scr.shape, NEG_INF, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    # seq_len > 0 guard: an IDLE slot still has n_need = ceil((K-1)/page)
+    # > 1 when K exceeds page_size + 1 (draft/chunk queries extend the
+    # frontier past page 0 even with nothing cached), and its ragged
+    # mask `t < 0 + kq` would admit whatever clamped page the sentinel
+    # table points at — the dense oracle's `seq_lens > 0` guard, here
+    @pl.when(jnp.logical_and(seq_len > 0,
+                             jnp.logical_and(j > 0, j < n_need)))
+    def _update():
+        s2, guard = masked_scores()
+        m_prev = m_scr[:, 0]
+        m_new = jnp.maximum(m_prev, s2.max(axis=1))
+        p = jnp.where(guard, jnp.exp(s2 - m_new[:, None]), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, 0] * corr + p.sum(axis=1)
+        acc_scr[...] = acc_scr[...] * corr[:, None] + pv(p)
+        m_scr[...] = jnp.broadcast_to(m_new[:, None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[:, None], l_scr.shape)
+
+    @pl.when(j == nj - 1)
+    def _emit():
+        l_fin = l_scr[:, 0]
+        safe = jnp.where(l_fin > 0.0, l_fin, 1.0)
+        o = (acc_scr[...] / safe[:, None]).reshape(H, K, D)
+        o_ref[0] = jnp.swapaxes(o, 0, 1).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    page_table: jax.Array,
+    seq_lens: jax.Array,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """The fused Pallas paged-attention sweep: q (B, K, H, D) against a
+    (P, page, H, D) page pool -> (B, K, H, D), streaming each needed
+    page from HBM exactly once — gather + dequantize + flash-style
+    attention in ONE kernel (see the section comment above).  Operand
+    contract (tables, sentinels, ragged ``seq_lens``, idle slots,
+    quantized scale planes) is exactly :func:`verify_attention`'s; the
+    public entry points dispatch here, callers should not need to.
+
+    Numerics: fp32 throughout (quantized pages dequantize in VMEM
+    before the MXU), online-softmax accumulation — equal to the dense
+    oracle up to summation-order reassociation (the oracle-equivalence
+    property tests in tests/test_attention.py pin the bound)."""
+    B, K, H, D = q.shape
+    n_pages, page_size = k_pages.shape[:2]
+    max_pages = page_table.shape[1]
+    quantized = k_scale is not None
+    table = jnp.clip(page_table, 0, n_pages - 1).astype(jnp.int32)
+    lens = seq_lens.astype(jnp.int32)
+
+    def kv_imap(b, j, tbl, ln):
+        last = jnp.maximum((ln[b] + K - 1 + page_size - 1) // page_size - 1, 0)
+        return tbl[b, jnp.minimum(j, last)], 0, 0, 0
+
+    def scale_imap(b, j, tbl, ln):
+        p_, _, _, _ = kv_imap(b, j, tbl, ln)
+        return p_, 0
+
+    qspec = pl.BlockSpec((1, K, H, D), lambda b, j, tbl, ln: (b, 0, 0, 0))
+    kvspec = pl.BlockSpec((1, page_size, H, D), kv_imap)
+    in_specs = [qspec, kvspec, kvspec]
+    inputs = [q, k_pages, v_pages]
+    if quantized:
+        sspec = pl.BlockSpec((1, H), scale_imap)
+        in_specs += [sspec, sspec]
+        inputs += [k_scale, v_scale]
+    kern = functools.partial(
+        _paged_kernel,
+        scale=1.0 / float(D) ** 0.5, page=page_size,
+        K=K, H=H, D=D, nj=max_pages, quantized=quantized,
+    )
+    params = mosaic_params(dimension_semantics=("parallel", "arbitrary"))
+    return pl.pallas_call(
+        kern,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, max_pages),
+            in_specs=in_specs,
+            out_specs=qspec,
+            scratch_shapes=[
+                pltpu.VMEM((H * K, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((H * K, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((H * K, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, H, D), q.dtype),
+        interpret=use_interpret(),
+        **params,
+    )(table, lens, *inputs)
+
+
+def _verify_attention_dense(q, k_pages, v_pages, page_table, seq_lens,
+                            k_scale, v_scale):
+    """The dense-XLA formulation — the interpret-mode/CPU ORACLE and
+    fallback for the fused paged kernel, for BOTH entry points (decode
+    dispatches through it at K=1, exactly as the fused branch does).
+    Quantization scales fold into the score/output contractions (see
+    :func:`_gather_pages`); the clip before gathering lands sentinel
+    table entries on page 0, whose scores the length mask removes."""
+    B, K, H, D = q.shape
+    n_pages, page_size = k_pages.shape[:2]
+    table = jnp.clip(page_table, 0, n_pages - 1)
+    T = page_table.shape[1] * page_size
+    k = _gather_pages(k_pages, table)             # ONE sweep for K queries
+    v = _gather_pages(v_pages, table)
+    scale = 1.0 / float(D) ** 0.5
+    s = jnp.einsum(
+        "bkhd,bthd->bkht", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if k_scale is not None:
+        ks = _position_scale(k_scale, table, page_size)     # (B, T, H)
+        s = s * ks.transpose(0, 2, 1)[:, None]              # (B, 1, H, T)
+    lens = seq_lens[:, None, None, None] + jnp.arange(K)[None, :, None, None]
+    valid = jnp.arange(T)[None, None, None, :] < lens       # (B, K, 1, T)
+    valid = valid & (seq_lens[:, None, None, None] > 0)     # idle slots -> 0
+    p = masked_softmax(jnp.where(valid, s, NEG_INF), valid)
+    if v_scale is not None:
+        vs = _position_scale(v_scale, table, page_size)
+        p = p * vs.transpose(0, 2, 1)[:, None]
+    out = jnp.einsum("bkht,bthd->bkhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
 def decode_attention(
     q: jax.Array,
     k_pages: jax.Array,
@@ -1159,6 +1468,7 @@ def decode_attention(
     seq_lens: jax.Array,
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
+    fused: bool | None = None,
 ) -> jax.Array:
     """Single-token attention over a block-paged KV cache (serve path).
 
@@ -1171,40 +1481,38 @@ def decode_attention(
     (its K/V must already be written). Returns (B, H, D).
 
     ``k_scale``/``v_scale`` (P, H) fp32 — required when the pools are
-    int8 (``serve.kvcache.quantize_pages`` layout): the gather moves the
-    int8 pages (a quarter of the fp32 bytes — and bytes ARE the decode
-    roofline) and dequantizes the gathered view in place.
+    quantized (int8 / fp8-e4m3, ``serve.kvcache.quantize_pages``
+    layout): the gather moves the 1-byte pages (a quarter of the fp32
+    bytes — and bytes ARE the decode roofline) and the scale folds into
+    the score/output contractions.
 
-    Each sequence gathers its pages into a contiguous (max_pages *
-    page_size, H, D) view and masks key positions at or beyond its true
-    length — the ragged-batch analogue of the flash kernel's causal
-    offset masking, sharing its scale (1/sqrt(D)) and mask sentinel so
-    the cached path cannot drift from the training-side score math.
-    Decode moves one query row against the whole cache, so the step is
-    gather-bandwidth-bound, not MXU-bound: the dense XLA formulation IS
-    the roofline shape, and fp32 softmax accumulation matches
-    ``parallel.scores.masked_scores``. Sequences with ``seq_len == 0``
+    Each sequence reads its pages in table order and masks key
+    positions at or beyond its true length — the ragged-batch analogue
+    of the flash kernel's causal offset masking, sharing its scale
+    (1/sqrt(D)) and mask sentinel so the cached path cannot drift from
+    the training-side score math.  Sequences with ``seq_len == 0``
     (empty decode slots) return zeros rather than NaN.
+
+    ``fused`` selects the kernel: ``True`` runs the Pallas paged
+    kernel (:func:`paged_attention` — page gather, dequantize, and
+    flash-style accumulation in ONE pass over the pool, the
+    ``resident:8`` residency idiom applied to serving); ``False`` the
+    dense XLA oracle (three separate ops — gather, dequantize-fold,
+    attention); ``None`` (default) follows :func:`fused_attention_
+    default` — fused on a real TPU when the geometry is supported,
+    dense elsewhere, overridable via ``TPUSCRATCH_FUSED_ATTN``.
     """
     if q.ndim != 3:
         raise ValueError(f"bad decode shapes q={q.shape}")
     _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens)
-    B, H, D = q.shape
-    n_pages, page_size = k_pages.shape[:2]
-    # clip BEFORE gathering (unallocated sentinel entries land on page 0;
-    # the length mask keeps their scores out of the softmax)
-    table = jnp.clip(page_table, 0, n_pages - 1)
-    T = page_table.shape[1] * page_size
-    k = _gather_pages(k_pages, table, k_scale)
-    v = _gather_pages(v_pages, table, v_scale)
-    scale = 1.0 / float(D) ** 0.5
-    s = jnp.einsum(
-        "bhd,bthd->bht", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    valid = jnp.arange(T)[None, None, :] < seq_lens[:, None, None]  # (B,1,T)
-    p = masked_softmax(jnp.where(valid, s, NEG_INF), valid)
-    out = jnp.einsum("bht,bthd->bhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    kernel = (
+        paged_attention if _use_paged_kernel(fused, q.shape[-2:], k_pages)
+        else _verify_attention_dense
+    )
+    out = kernel(
+        q[:, None], k_pages, v_pages, page_table, seq_lens, k_scale, v_scale
+    )
+    return out[:, 0]
 
 
 def verify_attention(
@@ -1215,9 +1523,12 @@ def verify_attention(
     seq_lens: jax.Array,
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
+    fused: bool | None = None,
 ) -> jax.Array:
     """Speculative-verify attention: K queued tokens per sequence attend
-    the paged cache through ONE gather (serve verify path).
+    the paged cache through ONE gather (serve verify path — and, through
+    ``serve.decode.build_context_prefill``, the chunked-prefill path:
+    the two are the same compiled shape).
 
     q (B, K, H, D) — position 0 is the last accepted token, positions
     1..K-1 the draft; pools/table/scales as in :func:`decode_attention`;
@@ -1231,23 +1542,18 @@ def verify_attention(
     decode pays one full cache gather per generated token, the verify
     step pays ONE gather for K scored positions — up to K tokens
     emitted per sweep when the draft holds (Leviathan et al. 2023).
+
+    ``fused`` selects the kernel exactly as in
+    :func:`decode_attention` — the SAME Pallas kernel serves decode
+    (K=1), verify (K=spec_k+1), and context prefill (K=chunk).
     """
     if q.ndim != 4:
         raise ValueError(f"bad verify shapes q={q.shape}")
     _check_decode_operands(q, k_pages, v_pages, page_table, seq_lens)
-    B, K, H, D = q.shape
-    n_pages, page_size = k_pages.shape[:2]
-    table = jnp.clip(page_table, 0, n_pages - 1)
-    T = page_table.shape[1] * page_size
-    k = _gather_pages(k_pages, table, k_scale)    # ONE sweep for K queries
-    v = _gather_pages(v_pages, table, v_scale)
-    scale = 1.0 / float(D) ** 0.5
-    s = jnp.einsum(
-        "bkhd,bthd->bkht", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    lens = seq_lens[:, None, None, None] + jnp.arange(K)[None, :, None, None]
-    valid = jnp.arange(T)[None, None, None, :] < lens       # (B, K, 1, T)
-    valid = valid & (seq_lens[:, None, None, None] > 0)     # idle slots -> 0
-    p = masked_softmax(jnp.where(valid, s, NEG_INF), valid)
-    out = jnp.einsum("bkht,bthd->bkhd", p, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    if _use_paged_kernel(fused, q.shape[-2:], k_pages):
+        return paged_attention(
+            q, k_pages, v_pages, page_table, seq_lens, k_scale, v_scale
+        )
+    return _verify_attention_dense(
+        q, k_pages, v_pages, page_table, seq_lens, k_scale, v_scale
+    )

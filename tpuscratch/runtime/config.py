@@ -55,7 +55,7 @@ class Config:
     decode_slots: int = 8            # continuous-batching decode-batch width
     kv_pages: int = 64               # KV-cache pages per dp group
     page_size: int = 8               # tokens per KV page
-    kv_dtype: str = "float32"        # KV-page dtype: float32 | int8
+    kv_dtype: str = "float32"        # KV-page dtype: float32 | int8 | fp8
     #                                  (int8 = quantized pages, ~1/4 bytes)
     spec: int = 0                    # speculative draft tokens per verify
     #                                  sweep (0 = speculation off)

@@ -38,6 +38,7 @@ from tpuscratch.serve.kvcache import (  # noqa: F401
     PrefixCache,
     dequantize_pages,
     init_kv_cache,
+    is_quantized_kv_dtype,
     kv_cache_spec,
     quantize_pages,
 )

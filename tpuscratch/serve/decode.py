@@ -153,7 +153,12 @@ def _quant_write(pages_q, scales, li, write_page, write_off, new_vals):
     maximal entry dequantizes exactly), so each entry is requantized at
     most once per scale growth.  Sentinel write pages (idle slots,
     beyond-draft positions) gather a clipped page but scatter with drop
-    mode — no write lands."""
+    mode — no write lands.
+
+    Dtype-generic over the quantized rungs: the target rung is read
+    off the pool itself (``pages_q.dtype`` — int8 or fp8-e4m3), so the
+    fp8 ladder extension is a new rung through this unchanged
+    mechanism, not a second write path."""
     n_pages, page_size = pages_q.shape[1], pages_q.shape[2]
     idx = jnp.clip(write_page, 0, n_pages - 1)
     pg = dequantize_pages(pages_q[li, idx], scales[li, idx])  # (B,pg,H,D)
@@ -161,14 +166,14 @@ def _quant_write(pages_q, scales, li, write_page, write_off, new_vals):
     wo = write_off[:, None, None, None]
     pg = jnp.where(offs == wo, new_vals[:, None],
                    jnp.where(offs < wo, pg, 0.0))
-    q, s = quantize_pages(pg)
+    q, s = quantize_pages(pg, pages_q.dtype)
     pages_q = pages_q.at[li, write_page].set(q, mode="drop")
     scales = scales.at[li, write_page].set(s, mode="drop")
     return pages_q, scales
 
 
 def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp",
-                   quantized: bool = False):
+                   quantized: bool = False, fused: bool | None = None):
     """The decode shard_map body:
     (params, kv, x, page_tables, write_page, write_off, seq_lens)
     -> (out, kv').
@@ -178,6 +183,11 @@ def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp",
     (B_loc,) — where this token's K/V lands (write_page >= n_pages for
     idle slots: the scatter's drop mode makes them no-ops); seq_lens
     (B_loc,) — cached length INCLUDING this token (0 idles the slot).
+
+    ``fused`` selects the attention kernel per
+    ``ops.attention.decode_attention``: None follows the backend policy
+    (fused Pallas sweep on TPU, dense oracle elsewhere), True/False
+    force it.
     """
 
     def step(params, kv, x, page_tables, write_page, write_off, seq_lens):
@@ -209,13 +219,13 @@ def decode_step_fn(cfg: TransformerConfig, sp: str = "sp", dp: str = "dp",
                 )
                 attn = decode_attention(
                     q, kv_k[li], kv_v[li], page_tables, seq_lens,
-                    k_scale[li], v_scale[li],
+                    k_scale[li], v_scale[li], fused=fused,
                 )
             else:
                 kv_k = kv_k.at[li, write_page, write_off].set(k, mode="drop")
                 kv_v = kv_v.at[li, write_page, write_off].set(v, mode="drop")
                 attn = decode_attention(
-                    q, kv_k[li], kv_v[li], page_tables, seq_lens
+                    q, kv_k[li], kv_v[li], page_tables, seq_lens, fused=fused
                 )
             x = _attn_residual(p, attn, x, cfg, sp)
             x = _moe_residual(p, x[perm], cfg, dp)[inv]
@@ -235,17 +245,20 @@ def _cache_out(kv_k, kv_v, k_scale, v_scale) -> dict:
 def build_decode_step(mesh: Mesh, cfg: TransformerConfig,
                       geom: CacheGeometry, dp: str = "dp", sp: str = "sp",
                       counter: CompileCounter | None = None,
-                      quantized: bool = False):
+                      quantized: bool = False, fused: bool | None = None):
     """Compiled decode step over ``mesh``: jit'd
     fn(params, kv, x, page_tables, write_page, write_off, seq_lens) ->
     (out (B, d), kv') with slots sharded P(dp) and the cache donated
     (page pools update in place).  One compile per (B, max_pages)
     bucket; the engine holds B fixed at its slot count, so steady-state
     decode never recompiles (``counter`` proves it).  ``quantized``
-    selects the int8-page cache contract (scale leaves in ``kv``)."""
+    selects the quantized-page cache contract (int8/fp8 pools with
+    scale leaves in ``kv``); ``fused`` the attention kernel (see
+    :func:`decode_step_fn`)."""
     check_serve_mesh(mesh, cfg, dp, sp)
     _check_geometry(cfg, geom)
-    body = decode_step_fn(cfg, sp=sp, dp=dp, quantized=quantized)
+    body = decode_step_fn(cfg, sp=sp, dp=dp, quantized=quantized,
+                          fused=fused)
     if counter is not None:
         body = counter.wrap(body)
     pspec = param_spec(cfg, dp)
@@ -299,7 +312,8 @@ def propose_draft(context: Sequence[int], k: int,
 
 
 def verify_step_fn(cfg: TransformerConfig, n_draft: int, sp: str = "sp",
-                   dp: str = "dp", quantized: bool = False):
+                   dp: str = "dp", quantized: bool = False,
+                   fused: bool | None = None):
     """The speculative-verify shard_map body: like
     :func:`decode_step_fn` but scoring ``K = n_draft + 1`` queued tokens
     per slot in one forward —
@@ -354,7 +368,7 @@ def verify_step_fn(cfg: TransformerConfig, n_draft: int, sp: str = "sp",
                     )
                 attn = verify_attention(
                     q, kv_k[li], kv_v[li], page_tables, seq_lens,
-                    k_scale[li], v_scale[li],
+                    k_scale[li], v_scale[li], fused=fused,
                 )
             else:
                 kv_k = kv_k.at[li, write_pages, write_offs].set(
@@ -364,7 +378,7 @@ def verify_step_fn(cfg: TransformerConfig, n_draft: int, sp: str = "sp",
                     v, mode="drop"
                 )
                 attn = verify_attention(
-                    q, kv_k[li], kv_v[li], page_tables, seq_lens
+                    q, kv_k[li], kv_v[li], page_tables, seq_lens, fused=fused
                 )
             x = _attn_residual(p, attn, x, cfg, sp)
             flat = x.reshape(B * K, cfg.d_model)
@@ -380,7 +394,7 @@ def build_verify_step(mesh: Mesh, cfg: TransformerConfig,
                       geom: CacheGeometry, n_draft: int,
                       dp: str = "dp", sp: str = "sp",
                       counter: CompileCounter | None = None,
-                      quantized: bool = False):
+                      quantized: bool = False, fused: bool | None = None):
     """Compiled speculative-verify step over ``mesh``: jit'd
     fn(params, kv, x (B, K, d), page_tables, write_pages (B, K),
     write_offs (B, K), seq_lens) -> (out (B, K, d), kv'), cache donated.
@@ -391,7 +405,8 @@ def build_verify_step(mesh: Mesh, cfg: TransformerConfig,
         raise ValueError(f"n_draft must be >= 1, got {n_draft}")
     check_serve_mesh(mesh, cfg, dp, sp)
     _check_geometry(cfg, geom)
-    body = verify_step_fn(cfg, n_draft, sp=sp, dp=dp, quantized=quantized)
+    body = verify_step_fn(cfg, n_draft, sp=sp, dp=dp, quantized=quantized,
+                          fused=fused)
     if counter is not None:
         body = counter.wrap(body)
     pspec = param_spec(cfg, dp)
@@ -409,7 +424,8 @@ def build_context_prefill(mesh: Mesh, cfg: TransformerConfig,
                           geom: CacheGeometry, chunk: int,
                           dp: str = "dp", sp: str = "sp",
                           counter: CompileCounter | None = None,
-                          quantized: bool = False):
+                          quantized: bool = False,
+                          fused: bool | None = None):
     """Compiled CONTEXT prefill over ``mesh``: a slot-banked program
     scoring up to ``chunk`` new prompt tokens per slot against the
     slot's already-cached prefix — jit'd fn(params, kv, x (B, chunk, d),
@@ -446,7 +462,8 @@ def build_context_prefill(mesh: Mesh, cfg: TransformerConfig,
         raise ValueError(f"chunk must be >= 1, got {chunk}")
     check_serve_mesh(mesh, cfg, dp, sp)
     _check_geometry(cfg, geom)
-    body = verify_step_fn(cfg, chunk - 1, sp=sp, dp=dp, quantized=quantized)
+    body = verify_step_fn(cfg, chunk - 1, sp=sp, dp=dp, quantized=quantized,
+                          fused=fused)
     if counter is not None:
         body = counter.wrap(body)
     pspec = param_spec(cfg, dp)
@@ -509,7 +526,8 @@ def prefill_fn(cfg: TransformerConfig, geom: CacheGeometry,
                 live = jnp.where(tok_live, vals, 0.0)
                 live = jnp.pad(live, ((0, pad), (0, 0), (0, 0)))
                 return quantize_pages(
-                    live.reshape(n_pg, geom.page_size, *vals.shape[1:])
+                    live.reshape(n_pg, geom.page_size, *vals.shape[1:]),
+                    kv_k.dtype,
                 )
         # causal x true-length mask: padded keys never attend, padded
         # query rows produce garbage that nothing reads

@@ -12,7 +12,7 @@ stream.  This module separates them into two POOLS on the same mesh:
   mpi9.cpp sub-communicator idea (a rank subset owning one phase of the
   computation) expressed as the dp-group ownership the paged cache
   already has (``build_prefill``'s owner-local drop-mode writes);
-- **handoff**: finished prompt pages (and, for int8 pools, their scale
+- **handoff**: finished prompt pages (and, for quantized pools, their scale
   planes) ship from the staging pool into the decode engine's pool
   through ONE compiled migration program per destination group — a
   ``lax.ppermute`` pair transfer over the dp axis
@@ -84,8 +84,9 @@ def build_migrate(mesh: Mesh, stage_geom: CacheGeometry,
     tables in the engine's owner-row idiom: real LOCAL ids on the
     participating group's row, the pool-size sentinel everywhere else
     (and on padding entries past the request's true page count).  The
-    body gathers the staged page payloads — every cache leaf, so int8
-    scale planes ride the same transfer — ships them ``src_group ->
+    body gathers the staged page payloads — every cache leaf, so the
+    quantized rungs' scale planes (int8 and fp8 alike) ride the same
+    transfer — ships them ``src_group ->
     dst_group`` with ONE static ppermute pair per leaf
     (``comm.p2p.send_tree``), and scatters them into the destination
     group's serve pool with drop-mode writes (sentinel rows vanish,

@@ -62,8 +62,18 @@ from tpuscratch.serve.sampling import (
     sample_batch,
 )
 
-#: ServeConfig.kv_dtype spellings -> cache buffer dtype
-_KV_DTYPES = {"float32": jnp.float32, "int8": jnp.int8}
+#: ServeConfig.kv_dtype spellings -> cache buffer dtype (the fp32 /
+#: int8 / fp8-e4m3 ladder; both quantized rungs carry scale planes)
+_KV_DTYPES = {
+    "float32": jnp.float32,
+    "int8": jnp.int8,
+    "fp8": jnp.float8_e4m3fn,
+}
+
+#: ServeConfig.fused_attention spellings -> the ops.attention ``fused``
+#: argument ("auto" follows the backend policy: fused Pallas sweep on a
+#: real TPU, dense XLA oracle elsewhere)
+_FUSED_MODES = {"auto": None, "on": True, "off": False}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,10 +95,20 @@ class ServeConfig:
     # the budget is quarantined — reported, never requeued — so one
     # poison request cannot livelock the engine.
     retry_budget: int = 0
-    # cache-byte lever: "float32" (exact) or "int8" (pages quantized
-    # with per-page per-head scales — ~4x fewer cache bytes per token,
-    # the decode gather's roofline; see serve/kvcache.py)
+    # cache-byte lever: "float32" (exact), "int8", or "fp8" (e4m3) —
+    # the quantized rungs store pages at one byte per element with
+    # per-page per-head scales, ~4x fewer cache bytes per token (the
+    # decode gather's roofline); fp8 is the accuracy-per-byte rung
+    # (floating grid, outlier-robust) at the same bytes as int8.  See
+    # serve/kvcache.py for the ladder table.
     kv_dtype: str = "float32"
+    # decode-sweep kernel: "auto" (fused Pallas paged-attention kernel
+    # on a real TPU, dense XLA oracle elsewhere), "on" (force fused —
+    # interpret-mode Pallas off-TPU, the equivalence-test path), "off"
+    # (force the dense oracle).  Applies to decode, speculative verify,
+    # and chunked context prefill — the three paths share one kernel
+    # family (ops.attention.paged_attention).
+    fused_attention: str = "auto"
     # HBM-sweep-amortization lever: draft tokens scored per verify sweep
     # (0 = speculation off).  > 0 replaces the one-token decode program
     # with ONE (spec_k + 1)-token verify program; accepted prefixes emit
@@ -249,6 +269,11 @@ class ServeEngine:
             raise ValueError(
                 f"kv_dtype {scfg.kv_dtype!r} not in {sorted(_KV_DTYPES)}"
             )
+        if scfg.fused_attention not in _FUSED_MODES:
+            raise ValueError(
+                f"fused_attention {scfg.fused_attention!r} not in "
+                f"{sorted(_FUSED_MODES)}"
+            )
         if scfg.spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {scfg.spec_k}")
         if scfg.spec_ngram < 1:
@@ -267,7 +292,8 @@ class ServeEngine:
             )
         self.mesh, self.cfg, self.scfg = mesh, cfg, scfg
         self._kv_jnp_dtype = _KV_DTYPES[scfg.kv_dtype]
-        self._quantized = scfg.kv_dtype == "int8"
+        self._quantized = scfg.kv_dtype != "float32"
+        self._fused = _FUSED_MODES[scfg.fused_attention]
         self.geom = CacheGeometry(
             cfg.n_layers, scfg.n_pages, scfg.page_size, cfg.n_heads,
             cfg.d_head,
@@ -338,11 +364,13 @@ class ServeEngine:
             self._decode = build_verify_step(
                 mesh, cfg, self.geom, scfg.spec_k, dp=dp, sp=sp,
                 counter=self.decode_counter, quantized=self._quantized,
+                fused=self._fused,
             )
         else:
             self._decode = build_decode_step(
                 mesh, cfg, self.geom, dp=dp, sp=sp,
                 counter=self.decode_counter, quantized=self._quantized,
+                fused=self._fused,
             )
         self._prefills: dict[int, object] = {}  # bucket len -> program
         self._dp, self._sp = dp, sp
@@ -356,6 +384,7 @@ class ServeEngine:
             build_context_prefill(
                 mesh, cfg, self.geom, self._chunk, dp=dp, sp=sp,
                 counter=self.prefill_counter, quantized=self._quantized,
+                fused=self._fused,
             )
             if self._ctx_mode else None
         )
@@ -405,6 +434,20 @@ class ServeEngine:
         """Cache bytes per token of pool capacity (pages + scales over
         ``dp_size * n_pages * page_size`` token slots)."""
         return self.kv_cache_bytes / (self._dp_size * self.geom.max_tokens)
+
+    @property
+    def cached_pages(self) -> int:
+        """Pages the NEXT decode sweep will gather: sum over live slots
+        of ceil(cached length / page_size).  The bench's roofline
+        accounting multiplies this by the pool's exact per-token bytes
+        (``kv_bytes_per_token`` — payload + amortized scale planes) to
+        get the HBM bytes one tick's sweep moves, the denominator-free
+        half of the achieved-fraction-of-peak measurement
+        (``bench.decode_bench``)."""
+        page = self.scfg.page_size
+        return sum(
+            -(-s.n_cached // page) for s in self._slots if s is not None
+        )
 
     @property
     def tokens_generated(self) -> int:

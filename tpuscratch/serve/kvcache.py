@@ -32,17 +32,44 @@ scheduler work that happens between compiled steps (the engine's
 admission/eviction loop), never inside one — the compiled decode step
 only ever sees page *tables*, which are plain int32 arrays.
 
-**Quantized pages (int8):** decode is a gather of the whole cached
-prefix per generated token, so cache *bytes* are the decode roofline.
-``init_kv_cache(..., dtype=jnp.int8)`` stores K/V pages as int8 with
-per-page per-head fp32 scales (``k_scale``/``v_scale``, shape
-``(n_layers, pages, n_heads)``) — symmetric absmax quantization,
-``value = q * scale`` with ``scale = absmax / 127``.  Cache bytes per
-token drop ~4x (one int8 byte vs four, plus ``2 * 4 / page_size`` bytes
-of amortized scale), page residency rises accordingly, and the decode
+**Quantized pages (the fp32 / int8 / fp8 dtype ladder):** decode is a
+gather of the whole cached prefix per generated token, so cache *bytes*
+are the decode roofline.  ``init_kv_cache(..., dtype=...)`` selects the
+rung; both quantized rungs store one byte per element with per-page
+per-head fp32 scales (``k_scale``/``v_scale``, shape
+``(n_layers, pages, n_heads)``) — symmetric absmax scaling,
+``value = q * scale``:
+
+- **int8**: ``scale = absmax / 127``, rounded integer grid — uniform
+  quantization, error <= scale/2 everywhere, exact at the amax entry;
+- **fp8 (e4m3)**: ``scale = absmax / 448``, round-to-nearest float8
+  cast — a FLOATING grid: ~6% relative error at every magnitude
+  instead of a page-wide absolute step, so small entries on a page
+  with one large outlier keep their precision (the regime where int8's
+  uniform grid flattens them to zero).  Same bytes as int8 — fp8 is an
+  accuracy-per-byte rung, not a further compression rung.
+
+Bytes per token of pool capacity at the two record-config-12
+geometries (per layer: ``2 * n_heads * d_head`` payload +
+``2 * n_heads * 4 / page_size`` amortized scale; ratio
+``1/4 + 1/(page_size * d_head)`` independent of layer count):
+
+===========  ====================  =====================
+kv dtype     CPU geometry          TPU geometry
+             (1 layer, H2 d16,     (4 layers, H8 d128,
+             page 4)               page 16)
+===========  ====================  =====================
+float32      256 B   (1.000x)      32768 B  (1.000x)
+int8         68 B    (0.266x)      8208 B   (0.2505x)
+fp8 e4m3     68 B    (0.266x)      8208 B   (0.2505x)
+===========  ====================  =====================
+
+Page residency rises ~4x on either quantized rung and the decode
 gather moves a quarter of the wire/HBM bytes.  Scales sit OUTSIDE the
-page payload so the gather stays a dense int8 copy; dequantization
-happens after the gather, inside ``ops.attention.decode_attention``.
+page payload so the gather stays a dense 1-byte copy; dequantization
+happens after the gather — folded into the attention contractions on
+the dense path, in VMEM inside the fused Pallas kernel
+(``ops.attention.paged_attention``).
 """
 
 from __future__ import annotations
@@ -56,9 +83,25 @@ from jax.sharding import PartitionSpec as P
 #: symmetric int8 range: q in [-127, 127], value = q * scale
 INT8_QMAX = 127.0
 
+#: fp8 e4m3fn finite max: q in [-448, 448], value = q * scale (the
+#: "fn" variant has no inf — 448 is the whole representable range)
+FP8_QMAX = 448.0
+
+#: the quantized rungs of the KV dtype ladder and their absmax targets
+#: (fp32 is the unquantized rung: no scale planes, no entry here)
+QUANT_KV_DTYPES = {
+    jnp.dtype(jnp.int8): INT8_QMAX,
+    jnp.dtype(jnp.float8_e4m3fn): FP8_QMAX,
+}
+
 #: absmax floor — an all-zero page quantizes with this scale instead of
 #: dividing by zero (dequantizes back to exact zeros either way)
 _SCALE_FLOOR = 1e-30
+
+
+def is_quantized_kv_dtype(dtype) -> bool:
+    """True for the 1-byte-per-element rungs that carry scale planes."""
+    return jnp.dtype(dtype) in QUANT_KV_DTYPES
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,13 +136,20 @@ def init_kv_cache(geom: CacheGeometry, dp_size: int = 1,
     pages axis carries every group's pool (sharded over dp it splits back
     to ``n_pages`` per group), heads global (sharded over sp).
 
-    ``dtype=jnp.int8`` adds the per-page per-head quantization scales:
-    ``{"k_scale", "v_scale"}`` fp32 buffers of shape
-    ``(n_layers, dp_size * n_pages, n_heads)``."""
+    A quantized dtype (``jnp.int8`` or ``jnp.float8_e4m3fn``) adds the
+    per-page per-head quantization scales: ``{"k_scale", "v_scale"}``
+    fp32 buffers of shape ``(n_layers, dp_size * n_pages, n_heads)``."""
+    if jnp.dtype(dtype) != jnp.dtype(jnp.float32) and not (
+        is_quantized_kv_dtype(dtype)
+    ):
+        raise ValueError(
+            f"kv cache dtype {jnp.dtype(dtype)} not in the ladder "
+            f"(float32, int8, float8_e4m3fn)"
+        )
     shape = (geom.n_layers, dp_size * geom.n_pages, geom.page_size,
              geom.n_heads, geom.d_head)
     cache = {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
-    if dtype == jnp.int8:
+    if is_quantized_kv_dtype(dtype):
         sshape = shape[:2] + (geom.n_heads,)
         cache["k_scale"] = jnp.zeros(sshape, jnp.float32)
         cache["v_scale"] = jnp.zeros(sshape, jnp.float32)
@@ -117,24 +167,43 @@ def kv_cache_spec(dp: str = "dp", sp: str = "sp",
     return out
 
 
-def quantize_pages(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Symmetric absmax int8 quantization of page-shaped values:
-    x ``(..., page_size, n_heads, d_head)`` fp32 ->
-    (q int8 same shape, scale ``(..., n_heads)`` fp32).  The scale is
-    per PAGE per HEAD — one amax over the page's tokens and the head
-    dim — so a page gather drags ``n_heads`` floats of metadata, not a
-    per-token vector.  Exactly invertible at the amax entry
-    (``round(127) * amax/127``), elsewhere within ``scale/2``."""
+def quantize_pages(x: jnp.ndarray,
+                   dtype=jnp.int8) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric absmax quantization of page-shaped values onto a rung
+    of the KV dtype ladder: x ``(..., page_size, n_heads, d_head)``
+    fp32 -> (q in ``dtype`` same shape, scale ``(..., n_heads)`` fp32).
+    The scale is per PAGE per HEAD — one amax over the page's tokens
+    and the head dim — so a page gather drags ``n_heads`` floats of
+    metadata, not a per-token vector.
+
+    ``dtype=jnp.int8``: rounded integer grid, exactly invertible at
+    the amax entry (``round(127) * amax/127``), elsewhere within
+    ``scale/2``.  ``dtype=jnp.float8_e4m3fn``: round-to-nearest float8
+    cast of ``x / scale`` with the scale targeting the e4m3 finite max
+    (448) — relative error ~2^-4 at any magnitude (3 mantissa bits),
+    absolute error below ``scale * 2^-10`` in the subnormal tail; the
+    explicit clip keeps division slop at the amax entry from rounding
+    past 448 (e4m3fn has no inf — the overflow would land on NaN, not
+    saturate)."""
+    dtype = jnp.dtype(dtype)
+    if dtype not in QUANT_KV_DTYPES:
+        raise ValueError(
+            f"quantize_pages dtype {dtype} not a quantized rung "
+            f"(int8, float8_e4m3fn)"
+        )
+    qmax = QUANT_KV_DTYPES[dtype]
     amax = jnp.max(jnp.abs(x), axis=(-3, -1))
-    scale = jnp.maximum(amax, _SCALE_FLOOR) / INT8_QMAX
-    q = jnp.round(x / scale[..., None, :, None])
-    q = jnp.clip(q, -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    scale = jnp.maximum(amax, _SCALE_FLOOR) / qmax
+    y = x / scale[..., None, :, None]
+    if dtype == jnp.dtype(jnp.int8):
+        y = jnp.round(y)
+    q = jnp.clip(y, -qmax, qmax).astype(dtype)
     return q, scale
 
 
 def dequantize_pages(q: jnp.ndarray, scale: jnp.ndarray) -> jnp.ndarray:
-    """Inverse of :func:`quantize_pages`: int8 pages x ``(..., n_heads)``
-    scales -> fp32 values."""
+    """Inverse of :func:`quantize_pages`: int8/fp8 pages x
+    ``(..., n_heads)`` scales -> fp32 values."""
     return q.astype(jnp.float32) * scale[..., None, :, None]
 
 
