@@ -73,6 +73,41 @@ def test_stats_accounting(pool):
     assert s["page_class"] == 4096
 
 
+def test_alloc_pages_bulk_buffer_and_traffic_counters(pool):
+    # the KV paging tier's shape: ONE buffer per spill batch, with the
+    # lock-guarded spill/prefetch byte counters + live high-water the
+    # tier reports through (footprint observable, not silent)
+    with pool.alloc_pages(4, 1000) as buf:
+        assert buf.nbytes == 4000
+        assert pool.stats()["live_buffers"] == 1
+        assert pool.stats()["live_buffers_hw"] >= 1
+        with pool.alloc_pages(2, 1000) as _b2:
+            assert pool.stats()["live_buffers_hw"] >= 2
+    pool.note_spill(4000)
+    pool.note_spill(1000)
+    pool.note_prefetch(2500)
+    s = pool.stats()
+    assert s["spill_bytes"] == 5000
+    assert s["prefetch_bytes"] == 2500
+    with pytest.raises(ValueError):
+        pool.alloc_pages(0, 1000)
+
+
+def test_traffic_counters_are_thread_safe(pool):
+    def worker():
+        for _ in range(500):
+            pool.note_spill(2)
+            pool.note_prefetch(3)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert pool.stats()["spill_bytes"] == 4 * 500 * 2
+    assert pool.stats()["prefetch_bytes"] == 4 * 500 * 3
+
+
 def test_size_class_rounding(pool):
     with pool.alloc(4097) as buf:
         assert buf.nbytes == 4097  # logical size preserved

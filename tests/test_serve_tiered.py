@@ -1,0 +1,620 @@
+"""Tiered KV memory (ISSUE 13): host-offloaded cold pages.
+
+The correctness anchors:
+
+- **HostPageStore laws**: the PageAllocator refcount contract extended
+  to the host tier (put grants 1, share adds, free reclaims at zero;
+  all-or-nothing batches; double-free rejected), byte-exact payload
+  roundtrips, one bulk extent per spill batch (not per page), and
+  empty (unwritten) reservations costing zero backing bytes;
+- **TieredPageAllocator laws**: cross-tier refcounts (a spilled shared
+  page counts one holder per sharer), the residency policy (LRU by
+  last-attended, pinned hot window never spilled except as correctness
+  fallback), spill/prefetch byte exactness, degraded == device-only
+  arithmetic, and full capacity restored after drain;
+- **forced-spill bit-identity**: greedy engine output IDENTICAL with
+  the tier forced into heavy spilling (a device pool several times
+  smaller than the working set), across the dtype ladder and composed
+  with prefix sharing, speculative decode, chunked prefill, and
+  disaggregation, on the 1x1 and 2x2 CPU meshes — per-slot streams
+  depend only on their own pages and PRNG draws, so wave scheduling
+  and page placement must be invisible;
+- **cold-hit fallback**: with prefetch-ahead unable to hide the
+  rotation (thrash regime), the synchronous path completes correctly
+  and counts every cold page;
+- **warm-prefix parking** (the PR-8 retention remainder): an evicted
+  shared chain parks in the host tier, a later trie hit restores it —
+  sharing without a concurrently-live holder — and output still
+  matches the untiered engine;
+- **serve/spill chaos**: transient host-tier faults retry through
+  ft.retry; a TOTAL host-tier outage degrades to no-spill with output
+  BYTE-identical to the untiered engine;
+- **traffic ledger**: host↔device bytes per token from exact page-move
+  counters x the analytic per-page byte form, agreeing exactly with
+  the store's actually-moved byte counters (three independent
+  accountings).
+
+Equivalence holds in the no-token-dropped MoE regime (capacity_factor
+>= n_experts, the test_serve rule), since capacity-bound routing is
+the one component whose per-token output depends on batch composition.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from tpuscratch.ft.chaos import ChaosPlan, Fault
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.obs.ledger import (
+    kv_cache_bytes,
+    kv_host_traffic_bytes,
+    kv_page_bytes,
+)
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import (
+    CacheGeometry,
+    DisaggEngine,
+    HostPageStore,
+    HostTierError,
+    Request,
+    ResidencyPolicy,
+    ServeConfig,
+    ServeEngine,
+    TieredPageAllocator,
+    host_leaf_shapes,
+    init_kv_cache,
+)
+from tpuscratch.serve.decode import plan_sweep_waves
+
+pytestmark = pytest.mark.tiered
+
+GEOM = CacheGeometry(n_layers=1, n_pages=8, page_size=4, n_heads=2,
+                     d_head=4)
+
+
+def store_for(n_pages=8, dtype=jnp.int8, **kw):
+    return HostPageStore(n_pages, host_leaf_shapes(GEOM, dtype), **kw)
+
+
+def payload_batch(store, n, seed=0):
+    rng = np.random.default_rng(seed)
+    out = {}
+    for name, (shape, dt, _off) in store._leaves.items():
+        vals = rng.integers(-100, 100, size=(n,) + shape)
+        out[name] = vals.astype(dt)
+    return out
+
+
+class TestHostPageStore:
+    def test_put_read_roundtrip_is_byte_exact(self):
+        st = store_for()
+        pl = payload_batch(st, 3)
+        slots = st.put(pl)
+        assert len(slots) == 3 and st.n_live == 3
+        back = st.read_batch(slots)
+        for name in pl:
+            assert back[name].dtype == pl[name].dtype
+            assert np.array_equal(
+                back[name].view(np.uint8), pl[name].view(np.uint8)
+            )
+
+    def test_refcount_laws(self):
+        st = store_for()
+        slots = st.put(payload_batch(st, 2))
+        st.share(slots)
+        assert st.free(slots) == []          # one holder each remains
+        assert sorted(st.free(slots)) == sorted(slots)
+        assert st.n_free == st.n_pages
+        with pytest.raises(ValueError):
+            st.free([slots[0]])              # double free
+        with pytest.raises(ValueError):
+            st.share([slots[0]])             # share of a freed slot
+
+    def test_all_or_nothing_capacity(self):
+        st = store_for(n_pages=2)
+        assert st.put(payload_batch(st, 3)) is None
+        assert st.n_live == 0 and st.n_free == 2
+        assert st.put_empty(3) is None
+        assert st.put_empty(2) is not None
+
+    def test_one_extent_per_spill_batch_and_region_reuse(self):
+        st = store_for()
+        slots = st.put(payload_batch(st, 4))
+        assert len(st._extents) == 1         # ONE bulk buffer for 4 pages
+        st.free(slots)
+        again = st.put(payload_batch(st, 4, seed=1))
+        assert len(st._extents) == 1         # freed regions reused
+        assert st.stats()["backed_bytes"] == 4 * st.page_nbytes
+        st.free(again)
+
+    def test_empty_reservation_costs_no_backing(self):
+        st = store_for()
+        slots = st.put_empty(5)
+        assert st.is_empty(slots[0])
+        assert st.stats()["backed_bytes"] == 0
+        assert st.stats()["spill_bytes"] == 0
+        with pytest.raises(ValueError):
+            st.read_batch([slots[0]])        # nothing to read
+
+    def test_alloc_hook_failure_is_hosttiererror_and_atomic(self):
+        def boom(nbytes):
+            raise MemoryError("no pinned pages")
+
+        st = store_for(alloc_hook=boom)
+        with pytest.raises(HostTierError):
+            st.put(payload_batch(st, 2))
+        assert st.n_live == 0 and st.n_free == st.n_pages
+
+    def test_close_restarts_cold_and_refuses_live_pages(self):
+        st = store_for()
+        slots = st.put(payload_batch(st, 3))
+        with pytest.raises(ValueError):
+            st.close()                       # live pages pin the backing
+        st.free(slots)
+        st.close()
+        assert st.n_free == st.n_pages
+        assert st.stats()["backed_bytes"] == 0
+        again = st.put(payload_batch(st, 2, seed=1))  # cold restart works
+        assert len(again) == 2 and len(st._extents) == 1
+        st.free(again)
+
+
+def fake_device(store):
+    """A dict-backed 'device pool' for allocator-level law tests."""
+    dev = {}
+
+    def reader(dids):
+        return {name: np.stack([dev[d][name] for d in dids])
+                for name in store._leaves}
+
+    def writer(dids, payload):
+        for i, d in enumerate(dids):
+            dev[d] = {name: np.array(payload[name][i])
+                      for name in payload}
+
+    return dev, reader, writer
+
+
+def write_dev(store, dev, alloc, lps, seed=0):
+    rng = np.random.default_rng(seed)
+    for lp in lps:
+        dev[alloc.device_page(lp)] = {
+            name: rng.integers(-50, 50, size=shape).astype(dt)
+            for name, (shape, dt, _o) in store._leaves.items()
+        }
+    alloc.mark_written(lps)
+
+
+class TestTieredAllocator:
+    def test_spill_prefetch_roundtrip_is_byte_exact(self):
+        st = store_for()
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(4, st, reader, writer)
+        lps = al.alloc(3)
+        write_dev(st, dev, al, lps)
+        before = {lp: {n: np.array(v) for n, v in
+                       dev[al.device_page(lp)].items()} for lp in lps}
+        # force all three out, then back
+        more = al.alloc(4, keep=[])          # spills the cold three
+        assert more is not None
+        assert not any(al.is_resident(lp) for lp in lps)
+        assert al.refcount(lps[0]) == 1      # holders survive the tier
+        al.ensure_resident(lps)
+        for lp in lps:
+            after = dev[al.device_page(lp)]
+            for name in after:
+                assert np.array_equal(
+                    after[name].view(np.uint8),
+                    before[lp][name].view(np.uint8),
+                )
+        al.free(lps)
+        al.free(more)
+        assert al.n_free == 4 + 8            # both tiers fully restored
+
+    def test_spilled_shared_page_counts_one_holder_per_sharer(self):
+        st = store_for()
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(2, st, reader, writer)
+        lps = al.alloc(2)
+        write_dev(st, dev, al, lps)
+        al.share(lps)                        # two holders each
+        al.alloc(2)                          # spills both
+        assert not al.is_resident(lps[0])
+        assert al.refcount(lps[0]) == 2      # the cross-tier law
+        assert al.free(lps) == []            # first holder: nothing dies
+        released = al.free(lps)
+        assert sorted(released) == sorted(lps)
+
+    def test_pinned_hot_window_never_spills_before_cold(self):
+        st = store_for()
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(4, st, reader, writer)
+        lps = al.alloc(4)
+        write_dev(st, dev, al, lps)
+        al.set_pins([lps[3]])
+        al.tick()
+        al.touch([lps[2]])                   # recently attended
+        al.alloc(2)                          # needs 2 victims
+        # LRU order among unpinned: lps[0], lps[1] (stale) go first;
+        # the pinned tail and the freshly-touched page stay
+        assert not al.is_resident(lps[0]) and not al.is_resident(lps[1])
+        assert al.is_resident(lps[2]) and al.is_resident(lps[3])
+
+    def test_unwritten_spill_moves_zero_bytes(self):
+        st = store_for()
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(2, st, reader, writer)
+        lps = al.alloc(2)                    # never written
+        al.alloc(2)                          # spills both reservations
+        assert al.spilled_pages == 0 and al.spilled_empty == 2
+        assert st.stats()["spill_bytes"] == 0
+        al.ensure_resident(lps)              # comes back copy-free
+        assert al.prefetched_pages == 0
+
+    def test_degraded_is_device_only(self):
+        st = store_for()
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(4, st, reader, writer)
+        al.degrade()
+        assert al.n_free == 4                # host capacity gone
+        assert al.can_alloc(4) and not al.can_alloc(5)
+        lps = al.alloc(4, resident=1)        # norm: everything resident
+        assert all(al.is_resident(lp) for lp in lps)
+        assert al.alloc(1) is None
+
+    def test_parked_chain_restores_and_evicts_lru(self):
+        st = store_for(n_pages=2)
+        dev, reader, writer = fake_device(st)
+        evicted = []
+        al = TieredPageAllocator(4, st, reader, writer,
+                                 on_parked_evict=evicted.extend)
+        lps = al.alloc(2)
+        write_dev(st, dev, al, lps)
+        assert al.free(lps, park=lps) == []  # both park, nothing dies
+        assert al.n_parked == 2 and al.n_live == 0
+        al.tick()                            # the restore refreshes lps[0]
+        fresh = al.restore_parked(lps[0])
+        assert fresh is not None and al.refcount(fresh) == 1
+        assert al.is_parked(lps[0])          # the original stays parked
+        assert np.array_equal(
+            dev[al.device_page(fresh)]["k"], dev[al.device_page(fresh)]["k"]
+        )
+        # host pressure evicts the LRU parked page (lps[1]: older stamp)
+        more = al.alloc(3)
+        write_dev(st, dev, al, more, seed=2)
+        al.alloc(1)                          # forces spill -> host room
+        assert lps[1] in evicted
+
+    def test_failed_restore_keeps_traffic_accounting_exact(self):
+        # a transient extent fault inside restore_parked's room-making
+        # alloc must un-count the speculative host read, or ft.retry's
+        # re-entry double-counts prefetch bytes and breaks the
+        # three-way agreement (page counters x page bytes == store bytes)
+        arm = {"on": False}
+
+        def hook(nbytes):
+            if arm["on"]:
+                raise MemoryError("transient pinned-page outage")
+
+        st = store_for(n_pages=4, alloc_hook=hook)
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(2, st, reader, writer)
+        lps = al.alloc(1)
+        write_dev(st, dev, al, lps)
+        assert al.free(lps, park=lps) == []   # parks: spills to the host
+        live = al.alloc(2)
+        write_dev(st, dev, al, live, seed=5)  # device pool fully live
+        before = st.stats()["prefetch_bytes"]
+        arm["on"] = True
+        with pytest.raises(HostTierError):
+            al.restore_parked(lps[0])         # room-making spill faults
+        assert st.stats()["prefetch_bytes"] == before
+        arm["on"] = False
+        fresh = al.restore_parked(lps[0])     # the ft.retry re-entry
+        assert fresh is not None
+        assert st.spill_bytes == al.spilled_pages * st.page_nbytes
+        assert st.prefetch_bytes == al.prefetched_pages * st.page_nbytes
+
+    def test_restore_parked_survives_evicting_itself(self):
+        # regression: both tiers exactly full and the restored page is
+        # the host LRU — the restore's own room-making spill evicts the
+        # parked original.  The payload must be read BEFORE the alloc,
+        # or the relocation lands on a dead host slot (KeyError).
+        st = store_for(n_pages=1)
+        dev, reader, writer = fake_device(st)
+        al = TieredPageAllocator(2, st, reader, writer)
+        lps = al.alloc(1)
+        write_dev(st, dev, al, lps)
+        before = {n: np.array(v)
+                  for n, v in dev[al.device_page(lps[0])].items()}
+        assert al.free(lps, park=lps) == []   # parked: fills the host slot
+        live = al.alloc(2)
+        write_dev(st, dev, al, live, seed=3)  # device pool fully live
+        fresh = al.restore_parked(lps[0])
+        assert fresh is not None and al.refcount(fresh) == 1
+        after = dev[al.device_page(fresh)]
+        for name in after:
+            assert np.array_equal(after[name].view(np.uint8),
+                                  before[name].view(np.uint8))
+
+    def test_wave_planner_packs_unique_pages_first_fit(self):
+        needs = [(0, 0, frozenset({1, 2})), (1, 0, frozenset({2, 3})),
+                 (2, 0, frozenset({4, 5, 6})), (3, 1, frozenset({1, 2}))]
+        # capacity 4: slots 0+1 share page 2 (union 3), slot 2 would
+        # push group 0 to 6 -> new wave; slot 3 is group 1 (own pool)
+        waves = plan_sweep_waves(needs, 4)
+        assert waves == [[0, 1], [2, 3]]
+        assert plan_sweep_waves(needs, 16) == [[0, 1, 2, 3]]
+        assert plan_sweep_waves([], 4) == []
+
+
+D = 32
+
+
+def cfg_for(**kw):
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=D, n_heads=4, n_experts=4, d_ff=48, n_layers=1, **kw
+    )
+
+
+BASE_KW = dict(n_slots=4, n_pages=6, page_size=4, max_seq=24, vocab=16)
+
+
+def engines(dims, tier_pages=16, **kw):
+    """(untiered, forced-spill tiered) engine pair on one mesh."""
+    cfg = cfg_for()
+    n = dims[0] * dims[1]
+    mesh = make_mesh(dims, ("dp", "sp"), jax.devices()[:n])
+    base = ServeEngine(mesh, cfg, ServeConfig(**kw))
+    tier = ServeEngine(mesh, cfg,
+                       ServeConfig(**kw, kv_host_pages=tier_pages))
+    return base, tier
+
+
+@pytest.fixture(scope="module")
+def base_plain():
+    """ONE untiered fp32 drain of the plain workload — the baseline
+    several gates compare against (wall discipline: compile once)."""
+    cfg = cfg_for()
+    mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+    return ServeEngine(mesh, cfg, ServeConfig(**BASE_KW)).run(reqs_plain())
+
+
+@pytest.fixture(scope="module")
+def tiered_plain():
+    """ONE forced-spill fp32 drain of the plain workload: (engine,
+    report), read-only for the tests that share it."""
+    cfg = cfg_for()
+    mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+    eng = ServeEngine(mesh, cfg, ServeConfig(**BASE_KW, kv_host_pages=16))
+    return eng, eng.run(reqs_plain())
+
+
+def reqs_plain(n=6):
+    return [Request(rid=i, prompt=(1 + i % 3, 2, 3, 4, 5),
+                    max_new=4 + i % 3) for i in range(n)]
+
+
+def reqs_shared(n=6):
+    return [Request(rid=i, prompt=(1, 2, 3, 4, 5, 6, 7, 8, 9 + i % 4),
+                    max_new=3 + i % 3) for i in range(n)]
+
+
+def reqs_periodic(n=6):
+    return [Request(rid=i, prompt=(1 + i % 2, 2, 1 + i % 2, 2,
+                                   1 + i % 2, 2), max_new=5)
+            for i in range(n)]
+
+
+class TestForcedSpillBitIdentity:
+    """THE tier gate: a device pool several times smaller than the
+    working set (6 pages vs ~18 pages of admitted footprint) forces
+    heavy spill/prefetch, and greedy output must not move a bit."""
+
+    def test_fp32_plain(self, base_plain, tiered_plain):
+        tier, rt = tiered_plain
+        assert rt.outputs == base_plain.outputs
+        assert rt.spilled_pages > 0 and rt.prefetched_pages > 0
+        assert rt.host_bytes == (
+            (rt.spilled_pages + rt.prefetched_pages) * tier.kv_page_bytes
+        )
+        # drain restores BOTH tiers' capacity
+        assert tier.free_pages() == [BASE_KW["n_pages"] + 16]
+
+    def test_prefix_share_composes(self):
+        base, tier = engines(
+            (1, 1), **dict(BASE_KW, kv_dtype="int8", prefix_share=True)
+        )
+        rb = base.run(reqs_shared())
+        rt = tier.run(reqs_shared())
+        assert rt.outputs == rb.outputs
+        assert rt.shared_tokens > 0 and rt.spilled_pages > 0
+        # conservation still holds across tiers
+        assert (rt.prefill_tokens + rt.shared_tokens
+                == sum(len(r.prompt) for r in reqs_shared()))
+
+    def test_chunked_prefill_composes(self):
+        base, tier = engines(
+            (1, 1), **dict(BASE_KW, kv_dtype="fp8", chunk_prefill=3)
+        )
+        rb = base.run(reqs_shared())
+        rt = tier.run(reqs_shared())
+        assert rt.outputs == rb.outputs and rt.spilled_pages > 0
+
+    def test_speculative_composes(self):
+        kw = dict(BASE_KW, kv_dtype="int8", spec_k=3, n_pages=8,
+                  max_seq=32)
+        base, tier = engines((1, 1), **kw)
+        rb = base.run(reqs_periodic())
+        rt = tier.run(reqs_periodic())
+        assert rt.outputs == rb.outputs
+        assert rt.accepted > 0 and rt.spilled_pages > 0
+
+    def test_disagg_composes(self):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        kw = dict(BASE_KW, kv_dtype="int8")
+        rb = DisaggEngine(mesh, cfg, ServeConfig(**kw)).run(reqs_plain())
+        eng = DisaggEngine(
+            mesh, cfg, ServeConfig(**kw, kv_host_pages=16)
+        )
+        rt = eng.run(reqs_plain())
+        assert rt.engine.outputs == rb.engine.outputs
+        assert eng.engine.host_spilled_pages > 0
+        assert rt.handoffs > 0               # migration ran, not degrade
+
+    def test_2x2_mesh_composed(self):
+        kw = dict(BASE_KW, kv_dtype="fp8", prefix_share=True,
+                  chunk_prefill=3, n_pages=8)
+        base, tier = engines((2, 2), **kw)
+        rb = base.run(reqs_shared())
+        rt = tier.run(reqs_shared())
+        assert rt.outputs == rb.outputs
+        assert tier.host_spilled_pages > 0
+
+    def test_cold_hit_fallback_counts_and_stays_correct(
+        self, base_plain, tiered_plain
+    ):
+        # thrash regime: the working set rotates every tick, so the
+        # prefetch-ahead cannot hide everything — the synchronous path
+        # must absorb the misses and count every one
+        tier, rt = tiered_plain
+        assert rt.outputs == base_plain.outputs
+        assert rt.cold_hits > 0
+        assert tier.metrics.histogram("serve/cold_hit_s").count > 0
+        # a roomy tier at steady state takes no cold hits at all
+        roomy = ServeEngine(
+            make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1]), cfg_for(),
+            ServeConfig(**dict(BASE_KW, n_pages=64), kv_host_pages=16),
+        )
+        rr = roomy.run(reqs_plain())
+        assert rr.outputs == base_plain.outputs and rr.cold_hits == 0
+
+
+class TestWarmPrefixParking:
+    def test_shared_chain_survives_its_last_holder(self):
+        kw = dict(BASE_KW, n_slots=2, n_pages=8, prefix_share=True)
+        base, tier = engines((1, 1), **kw)
+        pr = (1, 2, 3, 4, 5, 6, 7, 8)
+        first = Request(rid=0, prompt=pr, max_new=3)
+        second = Request(rid=1, prompt=pr + (9,), max_new=3)
+        rb1, rb2 = base.run([first]), base.run([second])
+        rt1 = tier.run([first])
+        assert tier._allocators[0].n_parked > 0   # the chain parked
+        rt2 = tier.run([second])
+        assert rt1.outputs == rb1.outputs
+        assert rt2.outputs == rb2.outputs
+        # sharing WITHOUT a concurrently-live holder: the untiered
+        # engine re-prefills everything, the tier serves the prefix
+        assert rb2.shared_tokens == 0
+        assert rt2.shared_tokens >= 8
+        assert tier._allocators[0].parked_hits >= 2
+        assert rt2.prefill_tokens < rb2.prefill_tokens
+
+    def test_fully_aligned_parked_prompt_rescores_privately(self):
+        # the second, IDENTICAL page-aligned prompt hits a fully parked
+        # chain: its restore is already private, so the last-position
+        # re-score needs no copy-on-write — and must not corrupt the
+        # parked original (a third hit still matches)
+        kw = dict(BASE_KW, n_slots=2, n_pages=8, prefix_share=True)
+        base, tier = engines((1, 1), **kw)
+        pr = (1, 2, 3, 4, 5, 6, 7, 8)
+        for i in range(3):
+            r = Request(rid=i, prompt=pr, max_new=3)
+            assert tier.run([r]).outputs == base.run([r]).outputs
+        assert tier._allocators[0].parked_hits >= 4
+
+
+class TestSpillChaos:
+    def scfg(self, **kw):
+        return ServeConfig(**dict(BASE_KW, **kw))
+
+    def test_total_outage_degrades_byte_identical(self, base_plain):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        rb = base_plain
+        plan = ChaosPlan(7, [Fault(site="serve/spill", p=1.0,
+                                   times=None)])
+        eng = ServeEngine(mesh, cfg, self.scfg(kv_host_pages=16),
+                          chaos=plan)
+        rt = eng.run(reqs_plain())
+        assert rt.outputs == rb.outputs
+        assert all(a.degraded for a in eng._allocators)
+        assert rt.spilled_pages == 0         # nothing ever crossed
+        assert plan.fired.get("serve/spill", 0) > 0
+
+    def test_transient_fault_retries_and_tier_survives(self, base_plain):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        rb = base_plain
+        plan = ChaosPlan(7, [Fault(site="serve/spill", p=1.0, times=1)])
+        eng = ServeEngine(mesh, cfg, self.scfg(kv_host_pages=16),
+                          chaos=plan)
+        rt = eng.run(reqs_plain())
+        assert rt.outputs == rb.outputs
+        assert not any(a.degraded for a in eng._allocators)
+        assert rt.spilled_pages > 0          # the retry carried on
+        assert plan.fired.get("serve/spill", 0) == 1
+
+
+class TestTrafficLedger:
+    def test_page_bytes_matches_analytic_form_and_store_record(self):
+        for dtype, ebytes, srow in ((jnp.float32, 4, 0), (jnp.int8, 1, 8),
+                                    (jnp.float8_e4m3fn, 1, 8)):
+            cache = init_kv_cache(GEOM, dtype=dtype)
+            g = GEOM
+            analytic = g.n_layers * (
+                2 * g.page_size * g.n_heads * g.d_head * ebytes
+                + srow * g.n_heads  # 2 fp32 scale rows x 4 B when quantized
+            )
+            assert kv_page_bytes(cache) == analytic
+            assert kv_page_bytes(cache) * g.n_pages == kv_cache_bytes(cache)
+            st = HostPageStore(2, host_leaf_shapes(g, dtype))
+            assert st.page_nbytes == analytic
+
+    def test_engine_traffic_three_way_agreement(self, tiered_plain):
+        # exact counters x analytic page bytes == report bytes ==
+        # the store's actually-moved byte counters
+        tier, rt = tiered_plain
+        traffic = kv_host_traffic_bytes(
+            tier._kv, tier.host_spilled_pages, tier.host_prefetched_pages
+        )
+        assert traffic.total_bytes == rt.host_bytes
+        store = tier._allocators[0].store
+        assert store.stats()["spill_bytes"] == traffic.spill_bytes
+        assert store.stats()["prefetch_bytes"] == traffic.prefetch_bytes
+        assert traffic.per_token(rt.tokens_generated) > 0
+
+    def test_steady_fit_moves_zero_bytes(self):
+        # everything fits the device pool: the tier must be free
+        tier = ServeEngine(
+            make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1]), cfg_for(),
+            ServeConfig(**dict(BASE_KW, n_pages=64), kv_host_pages=16),
+        )
+        rt = tier.run(reqs_plain())
+        assert rt.spilled_pages == 0 and rt.prefetched_pages == 0
+        assert rt.host_bytes == 0.0 and rt.cold_hits == 0
+
+
+class TestTieredConfig:
+    def test_negative_host_pages_rejected(self):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        with pytest.raises(ValueError):
+            ServeEngine(mesh, cfg,
+                        ServeConfig(**BASE_KW, kv_host_pages=-1))
+
+    def test_off_by_default_builds_no_tier(self):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        eng = ServeEngine(mesh, cfg, ServeConfig(**BASE_KW))
+        assert not eng._tiered
+        assert not hasattr(eng._allocators[0], "store")
+
+    def test_residency_policy_validation(self):
+        with pytest.raises(ValueError):
+            ResidencyPolicy(pin_tail=-1)

@@ -156,7 +156,10 @@ class HostPool:
         # += / -= are non-atomic read-modify-writes, so lock them
         self._stats_lock = threading.Lock()
         self._live = 0        # buffers handed out and not yet freed
+        self._live_hw = 0     # high-water of live buffers
         self._trims = 0       # trim() calls (retry pressure + manual)
+        self._spill_bytes = 0     # D2H traffic noted by paging tiers
+        self._prefetch_bytes = 0  # H2D traffic noted by paging tiers
         self._handle = lib.ts_pool_create(1 if lock_pages else 0)
         if not self._handle:
             raise MemoryError("ts_pool_create failed")
@@ -188,7 +191,29 @@ class HostPool:
             raise MemoryError(f"host pool exhausted allocating {nbytes} B")
         with self._stats_lock:
             self._live += 1
+            self._live_hw = max(self._live_hw, self._live)
         return HostBuffer(self, ptr, nbytes)
+
+    def alloc_pages(self, n_pages: int, page_nbytes: int) -> HostBuffer:
+        """One buffer covering ``n_pages`` page-shaped records of
+        ``page_nbytes`` each — the KV paging tier's spill-batch shape
+        (serve/kvcache.HostPageStore): a spill of k cold pages costs ONE
+        pool allocation, not k, so the size-class free lists see a few
+        large batch buffers instead of thousands of page-sized ones."""
+        if n_pages < 1:
+            raise ValueError(f"alloc_pages of {n_pages} pages")
+        return self.alloc(n_pages * page_nbytes)
+
+    def note_spill(self, nbytes: int) -> None:
+        """Record device→host paging traffic (lock-guarded: spill runs
+        on the engine loop, concurrent pools/threads may share this)."""
+        with self._stats_lock:
+            self._spill_bytes += int(nbytes)
+
+    def note_prefetch(self, nbytes: int) -> None:
+        """Record host→device paging traffic (see :meth:`note_spill`)."""
+        with self._stats_lock:
+            self._prefetch_bytes += int(nbytes)
 
     def _free(self, ptr: int) -> None:
         if self._handle:
@@ -210,8 +235,12 @@ class HostPool:
         out = (ctypes.c_uint64 * len(_STATS_FIELDS))()
         _lib().ts_pool_stats(self._handle, out)
         stats = dict(zip(_STATS_FIELDS, (int(v) for v in out)))
-        stats["live_buffers"] = self._live
-        stats["trim_calls"] = self._trims
+        with self._stats_lock:
+            stats["live_buffers"] = self._live
+            stats["live_buffers_hw"] = self._live_hw
+            stats["trim_calls"] = self._trims
+            stats["spill_bytes"] = self._spill_bytes
+            stats["prefetch_bytes"] = self._prefetch_bytes
         return stats
 
     def close(self) -> None:

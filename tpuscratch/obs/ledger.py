@@ -36,6 +36,7 @@ from typing import Optional, Sequence
 __all__ = [
     "CollectiveOp",
     "GradSyncBytes",
+    "KVHostTraffic",
     "Ledger",
     "RooflineReport",
     "all_gather_wire_bytes",
@@ -43,6 +44,8 @@ __all__ = [
     "analyze",
     "grad_sync_wire_bytes",
     "kv_cache_bytes",
+    "kv_host_traffic_bytes",
+    "kv_page_bytes",
     "parse_collectives",
     "reduce_scatter_wire_bytes",
     "ring_all_reduce_wire_bytes",
@@ -336,6 +339,79 @@ def kv_cache_bytes(cache) -> int:
     (tests/test_serve.py) at the record-config-12 geometry."""
     leaves = cache.values() if hasattr(cache, "values") else cache
     return int(sum(leaf.size * leaf.dtype.itemsize for leaf in leaves))
+
+
+def kv_page_bytes(cache) -> float:
+    """Exact bytes ONE logical page drags across the memory tiers: the
+    K and V page blocks of every layer plus, on the quantized rungs,
+    their per-page per-head scale rows — ``kv_cache_bytes`` divided
+    down the pages axis (every cache leaf carries pages on axis 1, so
+    the division is exact, not approximate).
+
+    Analytic form at geometry (L layers, page ``p`` tokens, H heads,
+    d_head D, element size ``e``): ``L * (2*p*H*D*e + 2*H*4[quantized])``
+    — validated against this function in tests/test_serve_tiered.py,
+    and pinned equal to ``serve.kvcache.HostPageStore.page_nbytes`` so
+    static traffic accounting and actual host-buffer footprint can
+    never drift apart."""
+    leaves = cache.values() if hasattr(cache, "values") else cache
+    total = 0.0
+    for leaf in leaves:
+        total += (leaf.size // leaf.shape[1]) * leaf.dtype.itemsize
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class KVHostTraffic:
+    """Static host↔device paging traffic of a tiered-KV engine — the
+    ledger proof form (the ``grad_sync_wire_bytes`` /
+    ``kv_cache_bytes`` pattern applied to the D2H/H2D legs): page-move
+    COUNTS are exact engine counters (every payload copy increments
+    exactly one), per-page bytes are exact pool geometry, so the byte
+    totals are proven, not sampled — only wall time is ever measured.
+
+    ``spilled_pages`` counts payload D2H copies (reserved-but-unwritten
+    pages spill as pure bookkeeping and move zero bytes — they carry no
+    payload); ``prefetched_pages`` counts payload H2D copies including
+    warm-prefix restores."""
+
+    spilled_pages: int
+    prefetched_pages: int
+    page_bytes: float
+
+    @property
+    def spill_bytes(self) -> float:
+        return self.spilled_pages * self.page_bytes
+
+    @property
+    def prefetch_bytes(self) -> float:
+        return self.prefetched_pages * self.page_bytes
+
+    @property
+    def total_bytes(self) -> float:
+        return self.spill_bytes + self.prefetch_bytes
+
+    def per_token(self, tokens: int) -> float:
+        """Host↔device bytes per emitted token — the config-12
+        ``serve_kv_tiered`` row's cost axis."""
+        if tokens < 1:
+            raise ValueError(f"tokens must be >= 1, got {tokens}")
+        return self.total_bytes / tokens
+
+
+def kv_host_traffic_bytes(cache, spilled_pages: int,
+                          prefetched_pages: int) -> KVHostTraffic:
+    """The tiered-KV traffic ledger for one pool: exact page-move
+    counts (the engine's ``host_spilled_pages`` /
+    ``host_prefetched_pages``) priced at the pool's exact per-page
+    bytes.  Validated in tests against BOTH the analytic per-page form
+    and the host store's actually-moved byte counters — three
+    independent accountings that must agree exactly."""
+    return KVHostTraffic(
+        spilled_pages=int(spilled_pages),
+        prefetched_pages=int(prefetched_pages),
+        page_bytes=kv_page_bytes(cache),
+    )
 
 
 def _cost_entry(compiled) -> dict:

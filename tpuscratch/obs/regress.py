@@ -44,9 +44,20 @@ __all__ = ["Finding", "compare", "format_findings", "index_rows",
 #: restating the peak must not masquerade as a kernel change.)
 #: ("goodput" covers the config-16 elastic-FT rows' goodput_fraction —
 #: the share of wall spent on committed steps, up)
+#: (the config-12 tiered-KV row, ISSUE 13: ``resident``/``users`` cover
+#: ``resident_users`` — concurrent residents at fixed HBM must only go
+#: up; its cost axes ride existing substrings — ``cold_hit_p99_s`` via
+#: "p99", ``host_bytes_per_token`` via "bytes" — plus "cold" below so a
+#: renamed cold-path field can never silently lose its direction)
+#: ("decode_spec" pins the serve_decode_spec row's headline ``value``
+#: — a tokens/s rate — which the "_s" substring in its METRIC NAME
+#: would otherwise mislabel lower-is-better: a latent inversion that
+#: only fires when the rate moves beyond noise, and then gates speedups
+#: as regressions.  Targeted on purpose: a bare "spec" would drag the
+#: ``spec_k`` configuration field into the comparison.)
 _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
            "throughput", "updates", "tokens_per", "accept", "speedup",
-           "achieved", "goodput")
+           "achieved", "goodput", "resident", "users", "decode_spec")
 #: name substrings ⇒ smaller is better (checked after _HIGHER)
 #: (note the ordering: ``accept_len_mean`` and ``spec_speedup`` match
 #: _HIGHER before "ratio"/"bytes" substrings could ever mislabel them —
@@ -71,7 +82,7 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
           "overhead", "bubble", "crossover", "prefill_frac", "degraded",
           "iterations", "cycles", "psum", "ppermute", "checkpoint",
-          "restart", "badput")
+          "restart", "badput", "cold")
 
 #: checked BEFORE _HIGHER: the config-15 per-SWEEP collective budget
 #: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would

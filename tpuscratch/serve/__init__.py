@@ -2,14 +2,17 @@
 
 The serving layer over the training stack: a block-paged KV cache
 sharded on the SAME (dp, sp) mesh the train step uses (kvcache, with
-per-page refcounts + a prefix trie for cross-request sharing), a
+per-page refcounts + a prefix trie for cross-request sharing, and a
+host paging tier — HostPageStore/TieredPageAllocator — spilling cold
+pages to pinned host memory behind ``ServeConfig(kv_host_pages)``), a
 cached single-token decode step numerically equivalent to the full
 forward (decode + ops.attention.decode_attention), deterministic
 per-request sampling (sampling), a continuous-batching engine with
 free-page-watermark admission and zero steady-state recompiles
-(engine; opt-in prefix sharing and chunked prefill), and a
-prefill/decode-disaggregated front end shipping finished KV pages
-between mesh slices through comm/p2p (disagg).
+(engine; opt-in prefix sharing, chunked prefill, and wave-scheduled
+spill/prefetch with cold hits measured), and a prefill/decode-
+disaggregated front end shipping finished KV pages between mesh
+slices through comm/p2p (disagg).
 """
 
 from tpuscratch.serve.decode import (  # noqa: F401
@@ -34,9 +37,14 @@ from tpuscratch.serve.engine import (  # noqa: F401
 )
 from tpuscratch.serve.kvcache import (  # noqa: F401
     CacheGeometry,
+    HostPageStore,
+    HostTierError,
     PageAllocator,
     PrefixCache,
+    ResidencyPolicy,
+    TieredPageAllocator,
     dequantize_pages,
+    host_leaf_shapes,
     init_kv_cache,
     is_quantized_kv_dtype,
     kv_cache_spec,

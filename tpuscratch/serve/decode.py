@@ -79,6 +79,41 @@ from tpuscratch.serve.kvcache import (
 from tpuscratch.obs.metrics import CompileCounter  # noqa: F401,E402
 
 
+def plan_sweep_waves(needs: Sequence[tuple[int, int, frozenset]],
+                     capacity: int) -> list[list[int]]:
+    """Partition sweeping slots into WAVES whose page footprints fit
+    the device pool together — the tiered-KV sweep scheduler (ISSUE
+    13): with a host tier holding more resident context than HBM, one
+    engine tick runs several compiled sweeps, each over the slot subset
+    whose frontier pages are device-resident, while the NEXT wave's
+    pages prefetch behind the running one (the halo driver's
+    double-buffered exchange/compute overlap applied to H2D DMA).
+
+    ``needs`` is ``(slot, group, frozenset_of_logical_pages)`` per
+    sweeping slot in slot order; ``capacity`` is one group's device
+    page count.  Waves pack first-fit in slot order — deterministic, so
+    a replayed tick partitions identically — counting each group's
+    UNIQUE pages (prefix-shared pages cost their footprint once).  A
+    single slot wider than the pool still gets its own wave: admission
+    guarantees one sequence fits the device pool (``max_seq`` check),
+    so the per-slot need can never exceed ``capacity``."""
+    waves: list[list[int]] = []
+    cur: list[int] = []
+    cur_pages: dict[int, set] = {}
+    for slot, group, pages in needs:
+        have = cur_pages.get(group, set())
+        merged = have | pages
+        if cur and len(merged) > capacity:
+            waves.append(cur)
+            cur, cur_pages = [], {}
+            merged = set(pages)
+        cur.append(slot)
+        cur_pages[group] = merged
+    if cur:
+        waves.append(cur)
+    return waves
+
+
 def check_serve_mesh(mesh: Mesh, cfg: TransformerConfig,
                      dp: str = "dp", sp: str = "sp") -> None:
     """The serve-side mesh preconditions (decode and prefill share them)."""

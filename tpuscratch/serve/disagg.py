@@ -362,13 +362,22 @@ class DisaggEngine:
     def _find_decode_slot(self, req: Request) -> Optional[tuple[int, int]]:
         """(slot, group) of a free decode slot whose group can cover the
         request's WHOLE footprint — the engine's admission watermark,
-        applied at handoff time."""
+        applied at handoff time.  Under the tier the footprint spans
+        both tiers: the migrated prompt pages must land on DEVICE (the
+        compiled migration scatters into the device pool), the budget
+        tail is a host-side reservation — a migrated page may end up in
+        either tier."""
         eng = self.engine
         need = eng.geom.pages_for(len(req.prompt) + req.max_new)
+        n_pp = eng.geom.pages_for(len(req.prompt))
         for s, slot in enumerate(eng._slots):
             if slot is None:
                 g = eng._group_of(s)
-                if eng._allocators[g].n_free >= need:
+                alloc = eng._allocators[g]
+                if eng._tiered:
+                    if alloc.can_alloc(need, resident=n_pp):
+                        return s, g
+                elif alloc.n_free >= need:
                     return s, g
         return None
 
@@ -391,9 +400,25 @@ class DisaggEngine:
             return False
         slot, group = found
         need = eng.geom.pages_for(len(req.prompt) + req.max_new)
-        dst_pages = eng._allocators[group].alloc(need)
-        assert dst_pages is not None  # _find_decode_slot checked
         n_pg = self.stage_geom.pages_for(len(req.prompt))
+        if eng._tiered:
+            # migrated prompt pages land DEVICE-resident (the compiled
+            # scatter writes the device pool); the budget tail is a
+            # host reservation that pages in when the frontier arrives
+            dst_pages = eng._tier_op(
+                group,
+                lambda: eng._allocators[group].alloc(need, resident=n_pg),
+            )
+            if dst_pages is None:
+                return False  # the gate raced a degrade; retry next tick
+            eng._allocators[group].mark_written(dst_pages[:n_pg])
+            eng._allocators[group].touch(dst_pages)
+            dst_row = [eng._allocators[group].device_page(lp)
+                       for lp in dst_pages[:n_pg]]
+        else:
+            dst_pages = eng._allocators[group].alloc(need)
+            assert dst_pages is not None  # _find_decode_slot checked
+            dst_row = dst_pages[:n_pg]
         src_rows = np.full(
             (self._dp_size, self.scfg.max_pages),
             self.stage_geom.n_pages, np.int32,
@@ -403,7 +428,7 @@ class DisaggEngine:
             (self._dp_size, self.scfg.max_pages),
             eng.geom.n_pages, np.int32,
         )
-        dst_rows[group, :n_pg] = dst_pages[:n_pg]
+        dst_rows[group, :n_pg] = dst_row
         program = self._migrate_program(group)
         attempts = {"n": 0}
 
@@ -497,6 +522,8 @@ class DisaggEngine:
         accepted0 = eng._spec_accepted
         eptok0, estok0 = eng._prefill_tokens, eng._shared_tokens
         efresh0, ecow0 = eng._fresh_tokens, eng._cow_pages
+        espill0, epref0 = eng.host_spilled_pages, eng.host_prefetched_pages
+        ecold0 = eng._cold_hits
         quarantined0 = set(eng._quarantined)
         stage0, stok0 = self._stage_count, self._stage_tokens
         hand0, deg0 = self._handoffs, self._degraded
@@ -522,7 +549,8 @@ class DisaggEngine:
                              accepted0,
                              tuple(sorted(set(eng._quarantined)
                                           - quarantined0)),
-                             eptok0, estok0, efresh0, ecow0)
+                             eptok0, estok0, efresh0, ecow0,
+                             espill0, epref0, ecold0)
         out = DisaggReport(
             engine=report,
             stage_prefills=self._stage_count - stage0,

@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from tpuscratch.ft.chaos import bind_sink
+from tpuscratch.ft.retry import RetryPolicy, retry as ft_retry
 from tpuscratch.models.transformer import TransformerConfig, init_params
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
@@ -47,12 +48,18 @@ from tpuscratch.serve.decode import (
     build_prefill,
     build_verify_step,
     check_serve_mesh,
+    plan_sweep_waves,
     propose_draft,
 )
 from tpuscratch.serve.kvcache import (
     CacheGeometry,
+    HostPageStore,
+    HostTierError,
     PageAllocator,
     PrefixCache,
+    ResidencyPolicy,
+    TieredPageAllocator,
+    host_leaf_shapes,
     init_kv_cache,
 )
 from tpuscratch.serve.sampling import (
@@ -130,6 +137,18 @@ class ServeConfig:
     # their whole length inside one tick — one long admission stops
     # blocking every resident decode stream (bounds per-token p99)
     chunk_prefill: int = 0
+    # tiered KV memory (0 = off): N host-tier page slots PER dp group
+    # (serve/kvcache.HostPageStore over native/hostpool pinned buffers).
+    # Cold pages — idle reserve tails, old chunks past the residency
+    # horizon, evicted-but-shared prefix chains — spill to the host
+    # tier and prefetch back AHEAD of the decode sweep (wave-scheduled,
+    # double-buffered), so admission capacity becomes device + host
+    # pages at fixed HBM while a warm-path decode tick never blocks on
+    # a transfer; a cold hit falls back to a synchronous prefetch whose
+    # cost is measured (serve/cold_hit_s).  Greedy output is
+    # bit-identical with spilling forced on, across the dtype ladder
+    # and composed with prefix-share / spec / chunked prefill / disagg.
+    kv_host_pages: int = 0
 
     @property
     def max_pages(self) -> int:
@@ -176,6 +195,14 @@ class GenerateReport:
     shared_tokens: int = 0
     cow_pages: int = 0          # copy-on-write page copies this drain
     fresh_kv_bytes: float = 0.0  # K/V bytes freshly written this drain
+    # tiered-KV accounting (zero with kv_host_pages=0): page-granular
+    # host↔device traffic — STATIC counts (exact page moves x the
+    # pool's exact per-page bytes, obs.ledger.kv_host_traffic_bytes),
+    # and the cold hits the prefetch-ahead failed to hide
+    spilled_pages: int = 0      # payload D2H copies this drain
+    prefetched_pages: int = 0   # payload H2D copies this drain
+    cold_hits: int = 0          # synchronously-fetched pages
+    host_bytes: float = 0.0     # spill + prefetch payload bytes
 
     @property
     def accept_len_mean(self) -> Optional[float]:
@@ -211,6 +238,14 @@ class _Slot:
 #: grow one Span per tick without bound)
 _MAX_SPANS = 1024
 
+#: the host-tier failure contract (chaos site ``serve/spill``): absorb
+#: transient extent-allocation faults fast, then DEGRADE the group to
+#: no-spill — only :class:`~tpuscratch.serve.kvcache.HostTierError` is
+#: retryable; a compiled-call failure must take the recovery path, not
+#: a retry loop
+DEFAULT_SPILL_RETRY = RetryPolicy(max_attempts=3, base_s=0.005, max_s=0.05,
+                                  retryable=(HostTierError,))
+
 
 def init_embed(seed: int, vocab: int, d_model: int) -> jax.Array:
     """Tied token embedding / unembedding table (V, d)."""
@@ -219,6 +254,22 @@ def init_embed(seed: int, vocab: int, d_model: int) -> jax.Array:
         rng.standard_normal((vocab, d_model)).astype(np.float32)
         / np.sqrt(d_model)
     )
+
+
+def _host_pool():
+    """The process-wide pinned host pool backing the tier's bulk
+    extents (``native/hostpool.py`` — the reference's L2 host_allocator
+    lineage); None degrades :class:`HostPageStore` to plain numpy
+    extents (unpinned, same semantics) where the native library is
+    absent."""
+    try:
+        from tpuscratch.native import hostpool
+
+        if hostpool.available():
+            return hostpool.default_pool()
+    except Exception:
+        pass
+    return None
 
 
 def _bucket(n: int) -> int:
@@ -284,6 +335,10 @@ class ServeEngine:
             raise ValueError(
                 f"chunk_prefill must be >= 0, got {scfg.chunk_prefill}"
             )
+        if scfg.kv_host_pages < 0:
+            raise ValueError(
+                f"kv_host_pages must be >= 0, got {scfg.kv_host_pages}"
+            )
         if (scfg.prefix_share or scfg.chunk_prefill) and scfg.retry_budget:
             raise ValueError(
                 "retry_budget composes with the monolithic admission "
@@ -325,9 +380,14 @@ class ServeEngine:
             for name, spec in kv_cache_spec(dp, sp, self._quantized).items()
         }
         self._kv = self._fresh_kv()
-        self._allocators = [
-            PageAllocator(scfg.n_pages) for _ in range(self._dp_size)
-        ]
+        # tiered KV memory (off by default): kv_host_pages > 0 swaps the
+        # per-group PageAllocator for a TieredPageAllocator over a
+        # HostPageStore — the engine-facing page currency becomes a
+        # LOGICAL id whose backing migrates, and every compiled-program
+        # table row resolves through the allocator at build time
+        self._tiered = scfg.kv_host_pages > 0
+        self._cold_hits = 0
+        self._allocators = self._fresh_allocators()
         self._slots: list[Optional[_Slot]] = [None] * scfg.n_slots
         self._slots_per_group = scfg.n_slots // self._dp_size
         self._queue: collections.deque[Request] = collections.deque()
@@ -528,11 +588,278 @@ class ServeEngine:
             ).items()
         }
 
+    # ---- the host paging tier (ISSUE 13) -------------------------------
+
+    def _fresh_allocators(self) -> list:
+        """One allocator per dp group: plain :class:`PageAllocator`
+        untiered, :class:`TieredPageAllocator` over a fresh
+        :class:`HostPageStore` when ``kv_host_pages > 0``."""
+        if not self._tiered:
+            return [PageAllocator(self.scfg.n_pages)
+                    for _ in range(self._dp_size)]
+        return [self._tier_allocator(g) for g in range(self._dp_size)]
+
+    def _tier_allocator(self, group: int) -> TieredPageAllocator:
+        store = HostPageStore(
+            self.scfg.kv_host_pages,
+            host_leaf_shapes(self.geom, self._kv_jnp_dtype),
+            pool=_host_pool(),
+            alloc_hook=self._spill_hook,
+        )
+        return TieredPageAllocator(
+            self.scfg.n_pages, store,
+            reader=self._tier_reader(group),
+            writer=self._tier_writer(group),
+            policy=ResidencyPolicy(),
+            on_parked_evict=lambda lps, g=group: self._drop_parked(g, lps),
+        )
+
+    def _spill_hook(self, nbytes: int) -> None:
+        """Fires before every host-tier extent allocation — the
+        ``serve/spill`` chaos site (an injected fault surfaces as
+        :class:`HostTierError` through the store, retried then degraded
+        by :meth:`_tier_op`)."""
+        if self._chaos is not None:
+            self._chaos.maybe_fail("serve/spill", op="serve/spill")
+
+    def _drop_parked(self, group: int, lps: list) -> None:
+        """A parked chain page was LRU-evicted from the host tier:
+        forget its trie mappings (it can no longer be restored)."""
+        if self._tries is not None:
+            self._tries[group].drop(lps)
+
+    def _tier_reader(self, group: int):
+        """The D2H spill leg: batch-read device pages off the live
+        cache pytree as host numpy (batch axis 0, exact bytes)."""
+        off = group * self.geom.n_pages
+
+        def reader(dids: list) -> dict:
+            idx = np.asarray([off + d for d in dids])
+            return {
+                name: np.moveaxis(np.asarray(buf[:, idx]), 1, 0)
+                for name, buf in self._kv.items()
+            }
+
+        return reader
+
+    def _tier_writer(self, group: int):
+        """The H2D prefetch leg: batch-land host page records back into
+        the live pool (ONE functional scatter per leaf, dispatched
+        async — the compiled sweep behind it proceeds while the copy
+        flies, which is what double-buffering means here)."""
+        off = group * self.geom.n_pages
+
+        def writer(dids: list, payloads: dict) -> None:
+            idx = jnp.asarray([off + d for d in dids])
+            for name in self._kv:
+                batch = jnp.moveaxis(jnp.asarray(payloads[name]), 0, 1)
+                self._kv[name] = self._kv[name].at[:, idx].set(batch)
+
+        return writer
+
+    def _tier_op(self, group: int, fn):
+        """Run a host-tier-touching allocator operation under the spill
+        failure contract: transient :class:`HostTierError`s (chaos site
+        ``serve/spill``, real extent-allocation failures) retry through
+        ``ft.retry``; exhaustion DEGRADES the group to no-spill —
+        device-only admission arithmetic, byte-identical output, fewer
+        residents — and re-runs the operation once device-only."""
+        alloc = self._allocators[group]
+        if not self._tiered or alloc.degraded:
+            return fn()
+        try:
+            return ft_retry(fn, DEFAULT_SPILL_RETRY, op="serve/spill")
+        except HostTierError as exc:
+            alloc.degrade()
+            self.metrics.counter("serve/spill_degraded").inc()
+            self.sink.emit(
+                "ft/degrade", site="serve/spill", group=group,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            return fn()
+
+    def _update_pins(self) -> None:
+        """Re-pin the hot window: each live slot's tail pages (its write
+        frontier, touched by every sweep it joins) are never spill
+        victims — the residency policy's pinned half."""
+        if not self._tiered:
+            return
+        pins: list[set] = [set() for _ in range(self._dp_size)]
+        tail = max(1, self._allocators[0].policy.pin_tail)
+        page = self.geom.page_size
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            n_pg = min(len(st.pages), -(-max(1, st.n_cached + 1) // page))
+            pins[self._group_of(s)].update(st.pages[max(0, n_pg - tail):n_pg])
+        for g, a in enumerate(self._allocators):
+            a.set_pins(pins[g])
+
+    def _frontier(self, st: _Slot, k_new: int) -> list:
+        """The logical pages one sweep of this slot touches: everything
+        holding positions [0, n_cached + k_new) — the attention gather
+        plus the write frontier.  Reserved pages past it stay in
+        whatever tier they are (their table entries are the sentinel:
+        masked, never read — untiered garbage-page semantics)."""
+        n_pg = min(len(st.pages),
+                   -(-(st.n_cached + k_new) // self.geom.page_size))
+        return st.pages[:n_pg]
+
+    def _sweep_row(self, group: int, st: _Slot, k_new: int) -> list:
+        """The page-table row a sweep gets: the slot's full (logical ==
+        physical) list untiered; the frontier resolved to DEVICE ids
+        when tiered (``ensure_resident`` ran first)."""
+        if not self._tiered:
+            return st.pages
+        alloc = self._allocators[group]
+        return [alloc.device_page(lp) for lp in self._frontier(st, k_new)]
+
+    def _page_dev(self, group: int, lp: int) -> int:
+        """One write-target page id for the compiled program."""
+        if not self._tiered:
+            return lp
+        return self._allocators[group].device_page(lp)
+
+    def _plan_waves(self, slots: list, k_of) -> list[list]:
+        """Wave-partition this tick's sweeping slots (see
+        ``serve.decode.plan_sweep_waves``); one wave — the whole bank —
+        untiered or when everything fits.
+
+        With prefix sharing on, a slot whose write-target pages are
+        SHARED will copy-on-write inside the sweep — one fresh device
+        page per shared target, while the original stays held by its
+        other sharers — so each such page adds a synthetic element to
+        the slot's footprint: a wave packed to exactly the pool size
+        could otherwise not seat its own CoW expansion."""
+        if not self._tiered:
+            return [list(slots)]
+        page = self.geom.page_size
+        needs = []
+        for s in slots:
+            st = self._slots[s]
+            front = set(self._frontier(st, k_of(s)))
+            if self._tries is not None:
+                alloc = self._allocators[self._group_of(s)]
+                first = st.n_cached // page
+                last = (st.n_cached + max(1, k_of(s)) - 1) // page
+                for i, lp in enumerate(st.pages[first:last + 1]):
+                    if alloc.refcount(lp) > 1:
+                        front.add(("cow", s, first + i))
+            needs.append((s, self._group_of(s), frozenset(front)))
+        return plan_sweep_waves(needs, self.scfg.n_pages)
+
+    def _stage_wave(self, slots: list, k_of, best_effort: bool = False,
+                    hold: tuple = ()) -> int:
+        """Make one wave's frontier pages device-resident.  The
+        synchronous form (``best_effort=False``) is the COLD-HIT
+        fallback — pages the prefetch-ahead failed to land block here,
+        counted and timed (``serve/cold_hit_s``); the best-effort form
+        is the prefetch-ahead itself, fetching what fits behind the
+        running sweep and leaving the rest cold.  ``hold`` shields the
+        currently-sweeping wave's pages from being chosen as victims."""
+        if not self._tiered or not slots:
+            return 0
+        by_group: dict[int, list] = {}
+        for s in slots:
+            st = self._slots[s]
+            by_group.setdefault(self._group_of(s), []).extend(
+                self._frontier(st, k_of(s))
+            )
+        hold_by_group: dict[int, list] = {}
+        for s in hold:
+            st = self._slots[s]
+            if st is not None:
+                hold_by_group.setdefault(self._group_of(s), []).extend(
+                    self._frontier(st, k_of(s))
+                )
+        cold = 0
+        t0 = time.perf_counter()
+        for g, lps in by_group.items():
+            alloc = self._allocators[g]
+            keep = hold_by_group.get(g, ())
+
+            def op(a=alloc, pages=lps, k=keep):
+                return a.ensure_resident(pages, keep=k,
+                                         best_effort=best_effort)
+
+            try:
+                cold += self._tier_op(g, op)
+            except HostTierError:
+                # even degraded the tier cannot seat this wave (live
+                # pages exceed the device pool mid-outage): recover —
+                # every in-flight request replays deterministically
+                self._recover_cache()
+                raise
+            alloc.touch(lps)
+        if cold and not best_effort:
+            self._cold_hits += cold
+            self.metrics.counter("serve/cold_hits").inc(cold)
+            self.metrics.histogram("serve/cold_hit_s").observe(
+                time.perf_counter() - t0
+            )
+        return cold
+
+    @property
+    def kv_page_bytes(self) -> float:
+        """Exact bytes ONE page moves across the tiers (payload + scale
+        rows) — ``obs.ledger.kv_page_bytes`` over the live pool."""
+        from tpuscratch.obs.ledger import kv_page_bytes
+
+        return kv_page_bytes(self._kv)
+
+    @property
+    def host_spilled_pages(self) -> int:
+        """Engine-lifetime payload D2H page copies."""
+        if not self._tiered:
+            return 0
+        return sum(a.spilled_pages for a in self._allocators)
+
+    @property
+    def host_prefetched_pages(self) -> int:
+        """Engine-lifetime payload H2D page copies (incl. parked-chain
+        restores)."""
+        if not self._tiered:
+            return 0
+        return sum(a.prefetched_pages for a in self._allocators)
+
+    @property
+    def cold_hits(self) -> int:
+        """Engine-lifetime synchronously-fetched (not prefetched-ahead)
+        pages — the cold-path counter whose p99 the bench states."""
+        return self._cold_hits
+
+    @property
+    def host_traffic_bytes(self) -> float:
+        """Engine-lifetime host↔device paging bytes — STATIC accounting
+        (exact page-move counts x exact per-page bytes), the ledger
+        proof form (``obs.ledger.kv_host_traffic_bytes``)."""
+        return (
+            (self.host_spilled_pages + self.host_prefetched_pages)
+            * self.kv_page_bytes
+        )
+
     def _free_slot_pages(self, slot: int, st: _Slot) -> None:
         """Drop this slot's holds; pages whose LAST holder left leave
-        the prefix trie too (a dead page must never be matched)."""
+        the prefix trie too (a dead page must never be matched) —
+        EXCEPT, under the tier, trie-registered pages, which PARK in
+        the host tier instead of dying: the warm-prefix pool, so a
+        shared chain no longer needs a concurrently-live holder (the
+        PR-8 retention remainder).  Parked chains stay matchable and a
+        later hit restores them (``_share_plan``)."""
         group = self._group_of(slot)
-        released = self._allocators[group].free(st.pages)
+        alloc = self._allocators[group]
+        if self._tiered:
+            park = ()
+            if self._tries is not None:
+                trie = self._tries[group]
+                park = [lp for lp in st.pages if trie.registered(lp)]
+            # no _tier_op wrap: free() absorbs host-tier failures
+            # internally (a chain that cannot park just dies — it is
+            # cache), and retrying a partially-applied free would
+            # double-free
+            released = alloc.free(st.pages, park=park)
+        else:
+            released = alloc.free(st.pages)
         if self._tries is not None and released:
             self._tries[group].drop(released)
 
@@ -547,7 +874,12 @@ class ServeEngine:
         for s, st in enumerate(self._slots):
             if st is None:
                 continue
-            self._free_slot_pages(s, st)
+            if self._tiered:
+                # no parking: the trie is about to clear, and a parked
+                # copy of a page from a dead pool must not survive it
+                self._allocators[self._group_of(s)].free(st.pages)
+            else:
+                self._free_slot_pages(s, st)
             self._slots[s] = None
             self._queue.appendleft(
                 Request(rid=st.rid, prompt=st.prompt, max_new=st.max_new)
@@ -555,6 +887,13 @@ class ServeEngine:
         if self._tries is not None:
             for trie in self._tries:
                 trie.clear()
+        if self._tiered:
+            # host copies mirror a pool that no longer exists: drop the
+            # parked pool (the allocators themselves survive — a grant
+            # made by an in-flight external admission, e.g. a disagg
+            # handoff mid-retry, stays valid and is simply rewritten)
+            for a in self._allocators:
+                a.drop_parked()
         self._kv = self._fresh_kv()
 
     # ---- request lifecycle ---------------------------------------------
@@ -612,9 +951,10 @@ class ServeEngine:
         )
 
     def _share_plan(self, req: Request,
-                    group: int) -> tuple[list[int], bool, int]:
-        """(shared pages, full_aligned, pages to NEWLY allocate) for
-        admitting ``req`` into ``group`` — the refcount-aware admission
+                    group: int) -> tuple[list[int], bool, int, int]:
+        """(shared pages, full_aligned, pages to NEWLY allocate, pages
+        that must be DEVICE-resident at admission) for admitting
+        ``req`` into ``group`` — the refcount-aware admission
         arithmetic the watermark gate and ``_admit_ctx`` share, so the
         gate can never promise pages the admission then over-draws.
 
@@ -623,20 +963,47 @@ class ServeEngine:
         logits, and that write needs a private copy of the last shared
         page — so one page of the allocation is the copy-on-write
         budget (the shared page itself stays untouched for its other
-        holders)."""
-        shared = (
-            self._tries[group].match(req.prompt)
-            if self._tries is not None else []
-        )
-        m = len(shared)
+        holders).
+
+        Under the tier a matched chain may include PARKED pages (warm
+        prefixes retained past their last holder): a live page attaches
+        (refcount + 1, free), a parked one RESTORES — a fresh private
+        device-resident page filled from the host copy — so restores
+        count in the allocation need and in the resident floor, and a
+        fully-aligned match ending on a parked page needs no
+        copy-on-write (the restored copy is already private)."""
         n_tok = len(req.prompt)
-        full_aligned = m > 0 and m * self.geom.page_size == n_tok
         total = self.geom.pages_for(n_tok + req.max_new)
-        need = total - m + (1 if full_aligned else 0)
-        return shared, full_aligned, need
+        if self._tries is None:
+            # no sharing index: the monolithic prefill writes the whole
+            # prompt in ONE program, so its pages must be device-
+            # resident at admission; a chunked (ctx-mode) admission
+            # writes lazily — each chunk's sweep stages its own pages
+            resident = 0
+            if self._tiered and not self._ctx_mode:
+                resident = self.geom.pages_for(n_tok)
+            return [], False, total, min(total, resident)
+        alloc = self._allocators[group]
+        prefer = (
+            (lambda p: alloc.refcount(p) > 0) if self._tiered else None
+        )
+        shared = self._tries[group].match(req.prompt, prefer=prefer)
+        m = len(shared)
+        full_aligned = m > 0 and m * self.geom.page_size == n_tok
+        if not self._tiered:
+            need = total - m + (1 if full_aligned else 0)
+            return shared, full_aligned, need, 0
+        n_live = sum(1 for p in shared if alloc.refcount(p) > 0)
+        n_restore = m - n_live
+        cow = 1 if (full_aligned and alloc.refcount(shared[-1]) > 0) else 0
+        need = total - n_live + cow
+        # restores + the CoW target are written before any chunk runs;
+        # the rest of the context-prefill footprint pages in lazily
+        resident = min(need, n_restore + cow)
+        return shared, full_aligned, need, resident
 
     def _find_slot(self, req: Request) -> Optional[int]:
-        needs: dict[int, int] = {}  # the plan depends only on the group
+        needs: dict[int, tuple] = {}  # the plan depends only on the group
         for s, slot in enumerate(self._slots):
             if slot is None:
                 group = self._group_of(s)
@@ -645,8 +1012,17 @@ class ServeEngine:
                 # those — not the request's whole footprint (shared
                 # pages are already live and consume no free capacity)
                 if group not in needs:
-                    needs[group] = self._share_plan(req, group)[2]
-                if self._allocators[group].n_free >= needs[group]:
+                    plan = self._share_plan(req, group)
+                    needs[group] = (plan[2], plan[3])
+                need, resident = needs[group]
+                alloc = self._allocators[group]
+                if self._tiered:
+                    # cross-tier gate: device room for the written-now
+                    # part, device + host capacity for the whole grant
+                    # (the same arithmetic alloc() runs — shared code)
+                    if alloc.can_alloc(need, resident=resident):
+                        return s
+                elif alloc.n_free >= need:
                     return s
         return None
 
@@ -676,11 +1052,33 @@ class ServeEngine:
             return self._admit_ctx(req, slot, finished)
         geom, scfg = self.geom, self.scfg
         group = self._group_of(slot)
-        pages = self._allocators[group].alloc(
-            geom.pages_for(len(req.prompt) + req.max_new)
-        )
-        assert pages is not None  # _find_slot checked the watermark
         n_tok = len(req.prompt)
+        total = geom.pages_for(n_tok + req.max_new)
+        if self._tiered:
+            # prompt pages device-resident (the prefill program writes
+            # them NOW); the generation-budget tail is a host-side
+            # reservation — no payload exists yet, so its "pages" cost
+            # zero device room and zero bytes until the write frontier
+            # arrives and the sweep staging pulls them up
+            n_pp = geom.pages_for(n_tok)
+            pages = self._tier_op(
+                group,
+                lambda: self._allocators[group].alloc(
+                    total, resident=n_pp
+                ),
+            )
+            if pages is None:
+                # the gate raced a degrade/park shift: retry next tick
+                self._queue.appendleft(req)
+                return False
+            self._allocators[group].mark_written(pages[:n_pp])
+            self._allocators[group].touch(pages)
+            row = [self._allocators[group].device_page(lp)
+                   for lp in pages[:n_pp]]
+        else:
+            pages = self._allocators[group].alloc(total)
+            assert pages is not None  # _find_slot checked the watermark
+            row = pages
         bucket = _bucket(n_tok)
         if bucket not in self._prefills:
             self._prefills[bucket] = build_prefill(
@@ -692,7 +1090,7 @@ class ServeEngine:
         page_rows = np.full(
             (self._dp_size, scfg.max_pages), geom.n_pages, np.int32
         )
-        page_rows[group, : len(pages)] = pages
+        page_rows[group, : len(row)] = row
 
         def attempt() -> int:
             if self._chaos is not None:
@@ -794,7 +1192,9 @@ class ServeEngine:
                 self._queue.appendleft(req)
                 raise
         n_tok = len(req.prompt)
-        shared, full_aligned, need = self._share_plan(req, group)
+        shared, full_aligned, need, _resident = self._share_plan(req, group)
+        if self._tiered:
+            return self._admit_ctx_tiered(req, slot, shared, finished)
         priv = alloc.alloc(need)
         assert priv is not None  # _find_slot ran the same arithmetic
         if shared:
@@ -825,6 +1225,113 @@ class ServeEngine:
                 self._ctx_step([slot], finished)
         return True
 
+    def _admit_ctx_tiered(self, req: Request, slot: int,
+                          shared: list, finished: Optional[list]) -> bool:
+        """The context admission across tiers: walk the matched chain
+        attaching LIVE pages (refcount + 1) and RESTORING parked ones
+        (warm-prefix hits — a fresh private device page filled from the
+        host copy, the parked original retained for later sharers),
+        then allocate the unshared footprint as lazy host reservations.
+        A chain whose restore comes up short truncates there (the
+        tail recomputes through the context program — correctness never
+        depends on the cache); an allocation that comes up short
+        unwinds and requeues for the next tick (the gate re-runs)."""
+        geom, scfg = self.geom, self.scfg
+        group = self._group_of(slot)
+        alloc = self._allocators[group]
+        n_tok = len(req.prompt)
+        total = geom.pages_for(n_tok + req.max_new)
+
+        def unwind(restored, live_taken):
+            if restored:
+                alloc.free(restored)
+            if live_taken:
+                alloc.free(live_taken)
+            self._queue.appendleft(req)
+            return False
+
+        # 1. the chain: per matched block, a live page or a restore
+        chain: list[int] = []      # page per block, in sequence order
+        restored: list[int] = []
+        for lp in shared:
+            if alloc.refcount(lp) > 0:
+                chain.append(lp)
+                continue
+            if not alloc.is_parked(lp):
+                break  # evicted under us: the chain ends here
+            fresh = self._tier_op(
+                group,
+                lambda p=lp: alloc.restore_parked(p, keep=restored),
+            )
+            if fresh is None:
+                break  # no room to restore: prefill the rest instead
+            chain.append(fresh)
+            restored.append(fresh)
+        m = len(chain)
+        full_aligned = m > 0 and m * geom.page_size == n_tok
+        last_live = full_aligned and chain[-1] not in restored
+
+        # 2. the unshared footprint (+ the CoW page when the aligned
+        # chain ends on a LIVE page — a restored tail is already
+        # private); reserve pages are host-born, staged lazily
+        priv_n = total - m + (1 if last_live else 0)
+        priv = self._tier_op(
+            group,
+            lambda: alloc.alloc(priv_n, resident=1 if last_live else 0,
+                                keep=chain),
+        ) if priv_n else []
+        if priv is None:
+            return unwind(restored, [])
+        live_pages = [lp for lp in chain if lp not in restored]
+        if live_pages:
+            alloc.share(live_pages)
+        if restored:
+            self.metrics.counter("serve/parked_restores").inc(
+                len(restored)
+            )
+
+        # 3. seat the slot (the untiered cases, tier-resolved)
+        if last_live:
+            src = chain[-1]
+            try:
+                self._tier_op(
+                    group,
+                    lambda: alloc.ensure_resident([src], keep=priv[:1]),
+                )
+            except HostTierError:
+                # even the degraded re-run found no device room for the
+                # CoW source: give back everything this admission took
+                # (the share() holds included) and retry from the queue
+                # under device-only arithmetic
+                return unwind(restored + priv, live_pages)
+            self._copy_page(group, alloc.device_page(src),
+                            alloc.device_page(priv[0]))
+            alloc.mark_written(priv[:1])
+            alloc.free([src])  # drop the hold share() just took
+            pages = chain[:-1] + priv
+            n_cached = n_tok - 1
+            self._cow_pages += 1
+        elif full_aligned:
+            # last block restored: already private — re-score in place
+            pages = chain + priv
+            n_cached = n_tok - 1
+        else:
+            pages = chain + priv
+            n_cached = m * geom.page_size
+        alloc.touch(pages)
+        self._shared_tokens += n_cached
+        self._slots[slot] = _Slot(
+            rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_cached,
+            max_new=req.max_new, last_token=0, generated=[],
+            pending=req.prompt[n_cached:],
+        )
+        self._prefill_count += 1
+        if scfg.chunk_prefill == 0:
+            while (self._slots[slot] is not None
+                   and self._slots[slot].pending):
+                self._ctx_step([slot], finished)
+        return True
+
     def _ensure_private(self, slot: int, page_index: int) -> None:
         """Copy-on-write guard on the write paths: a slot about to
         write into table entry ``page_index`` must hold that page
@@ -840,13 +1347,25 @@ class ServeEngine:
         page = st.pages[page_index]
         if alloc.refcount(page) <= 1:
             return
-        fresh = alloc.alloc(1)
+        if self._tiered:
+            self._tier_op(
+                group, lambda: alloc.ensure_resident([page])
+            )
+            fresh = self._tier_op(
+                group, lambda: alloc.alloc(1, resident=1, keep=[page])
+            )
+        else:
+            fresh = alloc.alloc(1)
         if fresh is None:
             raise RuntimeError(
                 f"copy-on-write of shared page {page} (slot {slot}) "
                 "found an empty pool — admission reserved too little"
             )
-        self._copy_page(group, page, fresh[0])
+        self._copy_page(group, self._page_dev(group, page),
+                        self._page_dev(group, fresh[0]))
+        if self._tiered:
+            alloc.mark_written(fresh)
+            alloc.touch(fresh)
         st.pages[page_index] = fresh[0]
         if self._tries is not None:
             self._tries[group].drop(alloc.free([page]))
@@ -855,20 +1374,37 @@ class ServeEngine:
         self._cow_pages += 1
 
     def _copy_page(self, group: int, src: int, dst: int) -> None:
-        """Copy one page's payload (and, for int8 pools, its scale
-        rows) between group-local ids — the copy-on-write data move.
-        Host-level functional update between compiled steps; rare by
-        construction (once per fully-shared aligned admission)."""
+        """Copy one page's payload (and, for quantized pools, its scale
+        rows) between group-local DEVICE ids — the copy-on-write data
+        move (tiered callers resolve logical ids first).  Host-level
+        functional update between compiled steps; rare by construction
+        (once per fully-shared aligned admission)."""
         off = group * self.geom.n_pages
         for name, buf in self._kv.items():
             self._kv[name] = buf.at[:, off + dst].set(buf[:, off + src])
 
+    def _ctx_k_of(self, s: int) -> int:
+        """Tokens the next context sweep advances for slot ``s`` — the
+        wave planner's and stager's frontier width."""
+        return max(1, min(self._chunk, len(self._slots[s].pending)))
+
     def _ctx_step(self, slots: list[int], finished: Optional[list]) -> None:
-        """One context-prefill chunk for every PREFILLING slot: each
-        advances up to ``self._chunk`` pending prompt tokens through
-        the ONE compiled context program (K/V written to its pages,
-        ragged-causal attention over its cached prefix).  A slot whose
-        pending tail drains samples its first token (the same
+        """One context-prefill chunk for every PREFILLING slot, wave-
+        partitioned under the tier (one wave — the whole set — when the
+        device pool seats everything): each wave sweeps while the next
+        wave's pages prefetch behind it."""
+        waves = self._plan_waves(slots, self._ctx_k_of)
+        for i, wave in enumerate(waves):
+            nxt = waves[i + 1] if i + 1 < len(waves) else None
+            self._ctx_sweep(wave, finished, prefetch=nxt)
+
+    def _ctx_sweep(self, slots: list[int], finished: Optional[list],
+                   prefetch: Optional[list] = None) -> None:
+        """One context-prefill chunk for one wave of PREFILLING slots:
+        each advances up to ``self._chunk`` pending prompt tokens
+        through the ONE compiled context program (K/V written to its
+        pages, ragged-causal attention over its cached prefix).  A slot
+        whose pending tail drains samples its first token (the same
         ``request_key(seed, rid, 0)`` draw the monolithic prefill
         makes), registers its full prompt pages in the prefix trie, and
         joins the decode bank — or is evicted right here when its
@@ -890,13 +1426,29 @@ class ServeEngine:
             for pi in range(st.n_cached // geom.page_size,
                             (st.n_cached + take - 1) // geom.page_size + 1):
                 self._ensure_private(s, pi)
+        # cold-hit fallback: pages the prefetch-ahead missed block here,
+        # synchronously, before the table snapshot resolves device ids
+        self._stage_wave(slots, self._ctx_k_of)
+        for s in slots:
+            st = self._slots[s]
+            take = takes[s]
+            group = self._group_of(s)
             x[s, :take] = self._embed_np[list(st.pending[:take])]
-            tables[s, : len(st.pages)] = st.pages
+            row = self._sweep_row(group, st, take)
+            tables[s, : len(row)] = row
             for j in range(take):
                 pos = st.n_cached + j
-                write_pages[s, j] = st.pages[pos // geom.page_size]
+                write_pages[s, j] = self._page_dev(
+                    group, st.pages[pos // geom.page_size]
+                )
                 write_offs[s, j] = pos % geom.page_size
             seq_lens[s] = st.n_cached + 1
+            if self._tiered:
+                first = st.n_cached // geom.page_size
+                last = (st.n_cached + take - 1) // geom.page_size
+                self._allocators[group].mark_written(
+                    st.pages[first:last + 1]
+                )
         done = [s for s in slots
                 if takes[s] == len(self._slots[s].pending)]
         try:
@@ -906,6 +1458,12 @@ class ServeEngine:
                     jnp.asarray(tables), jnp.asarray(write_pages),
                     jnp.asarray(write_offs), jnp.asarray(seq_lens),
                 )
+                if prefetch:
+                    # double-buffered: the NEXT wave's pages land while
+                    # this wave's compiled sweep runs (issued before the
+                    # host sync below pulls its sampled tokens)
+                    self._stage_wave(prefetch, self._ctx_k_of,
+                                     best_effort=True, hold=tuple(slots))
                 if done:
                     # STATIC shapes over the whole slot bank (the
                     # decode tick's rule): a variable done-set length
@@ -1002,6 +1560,17 @@ class ServeEngine:
             m.histogram("serve/prefill_tokens_tick").observe(prefill_tokens)
         if self.scfg.spec_k > 0:
             m.counter("serve/accepted").inc(accepted)
+        if self._tiered:
+            # tier residency telemetry (the PR-11 footprint idiom:
+            # observable, not silent); cold_hits/cold_hit_s land where
+            # they happen (_stage_wave) — these are the running totals
+            m.gauge("serve/host_spilled_pages").set(self.host_spilled_pages)
+            m.gauge("serve/host_prefetched_pages").set(
+                self.host_prefetched_pages
+            )
+            m.gauge("serve/host_parked_pages").set(
+                sum(a.n_parked for a in self._allocators)
+            )
         m.gauge("serve/decode_compiles").set(self.decode_counter.count)
         m.gauge("serve/prefill_compiles").set(self.prefill_counter.count)
         if self.sink.enabled:  # skip the event build on the no-obs path
@@ -1018,12 +1587,23 @@ class ServeEngine:
 
     def _tick_inner(self) -> list[tuple[int, tuple[int, ...]]]:
         finished = []
+        if self._tiered:
+            # advance the LRU clock and re-pin the hot window (each
+            # live slot's write-frontier tail) before anything can spill
+            for a in self._allocators:
+                a.tick()
+            self._update_pins()
         while self._queue:
             slot = self._find_slot(self._queue[0])
             if slot is None:
                 break
             req = self._queue.popleft()
             if not self._admit(req, slot, finished):
+                if self._queue and self._queue[0] is req:
+                    # tiered admission fell short mid-plan (degrade or
+                    # parked-eviction race) and requeued itself: stop
+                    # admitting this tick — the gate re-runs next tick
+                    break
                 continue  # quarantined: the slot stays free
             st = self._slots[slot]
             # budget spent at prefill (an admission that already drained
@@ -1032,6 +1612,8 @@ class ServeEngine:
             if (st is not None and not st.pending and st.generated
                     and req.max_new == 1):
                 finished.append(self._evict(slot))
+        if self._tiered:
+            self._update_pins()  # fresh admissions joined the window
 
         # chunked prefill interleaves with decode INSIDE the tick: every
         # prefilling slot advances one chunk, every decoding slot one
@@ -1044,17 +1626,62 @@ class ServeEngine:
             self._ctx_step(prefilling, finished)
         active = [s for s, st in enumerate(self._slots)
                   if st is not None and not st.pending and st.generated]
-        if not active:
-            return finished
-        if self.scfg.spec_k > 0:
-            self._spec_tick(active, finished)
-        else:
-            self._decode_tick(active, finished)
+        if active:
+            if self.scfg.spec_k > 0:
+                self._spec_tick(active, finished)
+            else:
+                self._decode_tick(active, finished)
+        if self._tiered:
+            self._prefetch_next_tick()
         return finished
+
+    def _prefetch_next_tick(self) -> None:
+        """Schedule prefetch ONE TICK AHEAD from the page tables of the
+        slots about to sweep: the first wave of the next tick's sweep
+        set stages best-effort now, so in steady state the next tick's
+        synchronous stage finds everything resident and a warm-path
+        decode tick never blocks on a transfer (cold hits measure
+        exactly the cases this missed)."""
+        prefilling = [s for s, st in enumerate(self._slots)
+                      if st is not None and st.pending]
+        active = [s for s, st in enumerate(self._slots)
+                  if st is not None and not st.pending and st.generated]
+        k_of = (self._spec_k_of if self.scfg.spec_k > 0 else self._one)
+        nxt = prefilling + active
+        if not nxt:
+            return
+
+        def k_mixed(s):
+            return (self._ctx_k_of(s) if self._slots[s].pending
+                    else k_of(s))
+
+        waves = self._plan_waves(nxt, k_mixed)
+        self._stage_wave(waves[0], k_mixed, best_effort=True)
+
+    @staticmethod
+    def _one(_s: int) -> int:
+        """k_new for a plain decode sweep: one token per slot."""
+        return 1
 
     def _decode_tick(self, active: list[int],
                      finished: list[tuple[int, tuple[int, ...]]]) -> None:
-        """One plain decode sweep: one token per active slot."""
+        """One plain decode tick, wave-partitioned under the tier (one
+        wave — the whole bank — untiered or when everything fits):
+        each wave's compiled sweep runs while the next wave's cold
+        pages prefetch behind it (double-buffered; see
+        ``serve.decode.plan_sweep_waves``)."""
+        waves = self._plan_waves(active, self._one)
+        for i, wave in enumerate(waves):
+            nxt = waves[i + 1] if i + 1 < len(waves) else None
+            self._decode_sweep(wave, finished, prefetch=nxt)
+
+    def _decode_sweep(self, active: list[int],
+                      finished: list[tuple[int, tuple[int, ...]]],
+                      prefetch: Optional[list] = None) -> None:
+        """One plain decode sweep: one token per slot in this wave
+        (slots outside it are masked idle — their streams depend only
+        on their own pages and PRNG draws, so wave order cannot change
+        any slot's output)."""
         scfg, geom = self.scfg, self.geom
         n = scfg.n_slots
         x = np.zeros((n, self.cfg.d_model), np.float32)
@@ -1071,13 +1698,21 @@ class ServeEngine:
             st = self._slots[s]
             if self._tries is not None:  # CoW guard on the write target
                 self._ensure_private(s, st.n_cached // geom.page_size)
+        self._stage_wave(active, self._one)  # sync cold-hit fallback
+        for s in active:
+            st = self._slots[s]
+            group = self._group_of(s)
             x[s] = self._embed_np[st.last_token]
-            tables[s, : len(st.pages)] = st.pages
-            write_page[s] = st.pages[st.n_cached // geom.page_size]
+            row = self._sweep_row(group, st, 1)
+            tables[s, : len(row)] = row
+            wp = st.pages[st.n_cached // geom.page_size]
+            write_page[s] = self._page_dev(group, wp)
             write_off[s] = st.n_cached % geom.page_size
             seq_lens[s] = st.n_cached + 1
             rids[s] = st.rid
             positions[s] = len(st.generated)
+            if self._tiered:
+                self._allocators[group].mark_written([wp])
         try:
             with self.timeline.span("serve/decode"):
                 out, self._kv = self._decode(
@@ -1085,6 +1720,12 @@ class ServeEngine:
                     jnp.asarray(write_page), jnp.asarray(write_off),
                     jnp.asarray(seq_lens),
                 )
+                if prefetch:
+                    # double-buffered: the NEXT wave's pages land while
+                    # this wave's compiled sweep runs (issued before the
+                    # host sync below pulls the sampled tokens)
+                    self._stage_wave(prefetch, self._one,
+                                     best_effort=True, hold=tuple(active))
                 keys = request_keys(self._seed_key, jnp.asarray(rids),
                                     jnp.asarray(positions))
                 logits = self._unembed(out, self.embed)
@@ -1105,9 +1746,25 @@ class ServeEngine:
             if len(st.generated) >= st.max_new:
                 finished.append(self._evict(s))
 
+    def _spec_k_of(self, _s: int) -> int:
+        """k_new bound for a speculative sweep: the full draft budget
+        (the actual draft may be shorter — over-staging by at most one
+        page, never under)."""
+        return self.scfg.spec_k + 1
+
     def _spec_tick(self, active: list[int],
                    finished: list[tuple[int, tuple[int, ...]]]) -> None:
-        """One speculative sweep: every active slot proposes up to
+        """One speculative tick, wave-partitioned under the tier (see
+        ``_decode_tick``)."""
+        waves = self._plan_waves(active, self._spec_k_of)
+        for i, wave in enumerate(waves):
+            nxt = waves[i + 1] if i + 1 < len(waves) else None
+            self._spec_sweep(wave, finished, prefetch=nxt)
+
+    def _spec_sweep(self, active: list[int],
+                    finished: list[tuple[int, tuple[int, ...]]],
+                    prefetch: Optional[list] = None) -> None:
+        """One speculative sweep: every slot in this wave proposes up to
         ``spec_k`` self-drafted tokens (``propose_draft`` over its own
         prompt + generated history), the ONE verify forward scores the
         whole bank — each slot's cache pages gathered once for all its
@@ -1138,19 +1795,32 @@ class ServeEngine:
                 st.prompt + tuple(st.generated), k, scfg.spec_ngram
             )[: remaining - 1]
             drafts[s] = draft
-            toks = (st.last_token,) + draft
             if self._tries is not None:  # CoW guard on the write targets
                 for pi in range(st.n_cached // geom.page_size,
-                                (st.n_cached + len(toks) - 1)
+                                (st.n_cached + len(draft))
                                 // geom.page_size + 1):
                     self._ensure_private(s, pi)
+        self._stage_wave(active, self._spec_k_of)  # sync cold-hit path
+        for s in active:
+            st = self._slots[s]
+            group = self._group_of(s)
+            toks = (st.last_token,) + drafts[s]
             x[s, : len(toks)] = self._embed_np[list(toks)]
-            tables[s, : len(st.pages)] = st.pages
+            row = self._sweep_row(group, st, len(toks))
+            tables[s, : len(row)] = row
             for j in range(len(toks)):
                 pos = st.n_cached + j
-                write_pages[s, j] = st.pages[pos // geom.page_size]
+                write_pages[s, j] = self._page_dev(
+                    group, st.pages[pos // geom.page_size]
+                )
                 write_offs[s, j] = pos % geom.page_size
             seq_lens[s] = st.n_cached + 1
+            if self._tiered:
+                first = st.n_cached // geom.page_size
+                last = (st.n_cached + len(toks) - 1) // geom.page_size
+                self._allocators[group].mark_written(
+                    st.pages[first:last + 1]
+                )
         try:
             with self.timeline.span("serve/decode"):
                 out, self._kv = self._decode(
@@ -1158,6 +1828,9 @@ class ServeEngine:
                     jnp.asarray(write_pages), jnp.asarray(write_offs),
                     jnp.asarray(seq_lens),
                 )
+                if prefetch:
+                    self._stage_wave(prefetch, self._spec_k_of,
+                                     best_effort=True, hold=tuple(active))
                 logits = np.asarray(self._unembed(out, self.embed))
         except Exception:
             self._recover_cache()  # donated kv may be consumed; replay
@@ -1198,6 +1871,8 @@ class ServeEngine:
         accepted0 = self._spec_accepted
         ptok0, stok0 = self._prefill_tokens, self._shared_tokens
         fresh0, cow0 = self._fresh_tokens, self._cow_pages
+        spill0, pref0 = self.host_spilled_pages, self.host_prefetched_pages
+        cold0 = self._cold_hits
         quarantined0 = set(self._quarantined)
         for r in requests:
             self.submit(r)
@@ -1217,7 +1892,8 @@ class ServeEngine:
                               accepted0,
                               tuple(sorted(set(self._quarantined)
                                            - quarantined0)),
-                              ptok0, stok0, fresh0, cow0)
+                              ptok0, stok0, fresh0, cow0,
+                              spill0, pref0, cold0)
         self.sink.emit(
             "serve/report",
             completed=report.completed,
@@ -1234,6 +1910,11 @@ class ServeEngine:
             shared_tokens=report.shared_tokens,
             cow_pages=report.cow_pages,
             fresh_kv_bytes=round(report.fresh_kv_bytes, 3),
+            **({"spilled_pages": report.spilled_pages,
+                "prefetched_pages": report.prefetched_pages,
+                "cold_hits": report.cold_hits,
+                "host_bytes": round(report.host_bytes, 3)}
+               if self._tiered else {}),
         )
         emit_phase_totals(self.sink, self.recorder)
         self.sink.emit_metrics(self.metrics.snapshot(),
@@ -1244,8 +1925,16 @@ class ServeEngine:
     def _report(self, outputs, tokens0, decode0, prefill0, prefill_s0,
                 decode_s0, slot0=0, drafted0=0, accepted0=0,
                 quarantined=(), ptok0=0, stok0=0, fresh0=0,
-                cow0=0) -> GenerateReport:
+                cow0=0, spill0=0, pref0=0, cold0=0) -> GenerateReport:
+        spilled = self.host_spilled_pages - spill0
+        prefetched = self.host_prefetched_pages - pref0
         return GenerateReport(
+            spilled_pages=spilled,
+            prefetched_pages=prefetched,
+            cold_hits=self._cold_hits - cold0,
+            host_bytes=(spilled + prefetched) * (
+                self.kv_page_bytes if self._tiered else 0.0
+            ),
             completed=len(outputs),
             tokens_generated=self._tokens_generated - tokens0,
             decode_steps=self._decode_steps - decode0,

@@ -75,9 +75,10 @@ the dense path, in VMEM inside the fused Pallas kernel
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable, Optional
+from typing import Callable, Iterable, Optional
 
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 #: symmetric int8 range: q in [-127, 127], value = q * scale
@@ -335,17 +336,27 @@ class PrefixCache:
     def n_blocks(self) -> int:
         return len(self._map)
 
-    def match(self, prompt: Iterable[int]) -> list[int]:
+    def match(self, prompt: Iterable[int],
+              prefer: Optional[Callable[[int], bool]] = None) -> list[int]:
         """Page ids of the LONGEST cached full-page-aligned prefix of
         ``prompt`` (possibly empty).  The chain walks block by block, so
-        a match is always a contiguous prefix."""
+        a match is always a contiguous prefix.
+
+        ``prefer`` biases alternate selection: when given, the first
+        alternate satisfying it wins, falling back to the oldest copy —
+        the tiered engine passes "is live" so a chain with both a live
+        holder and a host-parked copy attaches to the live one (sharing
+        a live page is free; restoring a parked one pays an H2D copy)."""
         prompt = tuple(prompt)
         pages = []
         for i in range(self.page_size, len(prompt) + 1, self.page_size):
             alts = self._map.get(prompt[:i])
             if not alts:
                 break
-            pages.append(alts[0])
+            if prefer is None:
+                pages.append(alts[0])
+            else:
+                pages.append(next((p for p in alts if prefer(p)), alts[0]))
         return pages
 
     def insert(self, prompt: Iterable[int], pages: Iterable[int]) -> None:
@@ -360,6 +371,12 @@ class PrefixCache:
             if page not in alts:
                 alts.append(page)
                 self._rev.setdefault(page, set()).add(key)
+
+    def registered(self, page: int) -> bool:
+        """True when ``page`` indexes at least one prefix block — the
+        tiered engine's park predicate: only trie-registered pages are
+        worth retaining in the host tier after their last holder."""
+        return page in self._rev
 
     def drop(self, pages: Iterable[int]) -> None:
         """Forget every mapping onto ``pages`` — called with the
@@ -380,3 +397,835 @@ class PrefixCache:
         pool holds no valid K/V, so no prefix may be matched)."""
         self._map.clear()
         self._rev.clear()
+
+
+# ---- the host paging tier (ISSUE 13) -------------------------------------
+#
+# Residency per chip is capped by HBM: the device page pool bounds
+# concurrent users and aggregate context length, and the dtype ladder
+# already took in-HBM bytes/token as low as it goes.  The tier below
+# extends the SOSP '23 paged design one level down the memory hierarchy:
+# cold pages spill into page-shaped pinned-host buffers
+# (native/hostpool.py — the reference's L2 host_allocator lineage) and
+# prefetch back ahead of the decode sweep, so the device pool holds only
+# the pages the next sweeps touch while the host tier holds everything
+# resident.  The engine drives WHEN (serve/engine.py: wave scheduling,
+# prefetch one tick ahead, synchronous cold-hit fallback); this module
+# owns WHAT: the host store, the cross-tier refcount laws, and the
+# residency policy.
+
+
+class HostTierError(RuntimeError):
+    """The host tier could not back an operation (buffer allocation
+    failed, or capacity ran out) — the engine's spill path retries this
+    through ``ft.retry`` and then DEGRADES to no-spill (device-only
+    admission), so a host-tier outage shrinks capacity instead of
+    corrupting state."""
+
+
+class HostPageStore:
+    """Page-granular host tier: ``n_pages`` page-record slots over bulk
+    host buffers, with the :class:`PageAllocator` refcount laws.
+
+    A page RECORD is one logical KV page's payload across every cache
+    leaf and layer — for the fp32 rung ``k``/``v`` blocks of shape
+    ``(n_layers, page_size, n_heads, d_head)``, plus the per-page scale
+    rows ``(n_layers, n_heads)`` on the quantized rungs — packed
+    contiguously so one spill moves one contiguous region.
+
+    Backing is allocated LAZILY in spill-batch extents: the first write
+    into k unbacked slots costs ONE ``HostPool.alloc_pages`` bulk
+    buffer (not k allocations), regions are permanently bound to slots,
+    and a freed slot keeps its region for reuse — so steady-state
+    paging never re-allocates.  Without the native library the extents
+    degrade to plain numpy (unpinned, same semantics).  ``alloc_hook``
+    fires before every extent allocation — the ``serve/spill`` chaos
+    injection point.
+
+    Refcount laws (the allocator's, extended across tiers): ``put``
+    grants refcount 1, ``share`` adds a holder to a live slot, ``free``
+    drops one and reclaims at zero — so a spilled page shared k ways
+    still counts one holder per sharer, and no holder's view can be
+    reclaimed under it.
+
+    EMPTY slots (``put_empty``) reserve capacity with no backing at
+    all: a reserved-but-never-written budget-tail page has no payload
+    worth moving, so its "spill" is pure bookkeeping — zero bytes, no
+    allocation, outage-immune."""
+
+    def __init__(self, n_pages: int,
+                 leaf_shapes: dict[str, tuple[tuple, object]],
+                 pool=None,
+                 alloc_hook: Optional[Callable[[int], None]] = None):
+        if n_pages < 1:
+            raise ValueError(f"n_pages must be >= 1, got {n_pages}")
+        self.n_pages = n_pages
+        self.pool = pool                      # native HostPool or None
+        self.alloc_hook = alloc_hook
+        self._leaves: dict[str, tuple[tuple, np.dtype, int]] = {}
+        off = 0
+        for name, (shape, dtype) in leaf_shapes.items():
+            dt = np.dtype(dtype)
+            self._leaves[name] = (tuple(shape), dt, off)
+            off += int(np.prod(shape)) * dt.itemsize
+        self.page_nbytes = off
+        self._free_bare = list(range(n_pages - 1, -1, -1))
+        self._free_backed: list[int] = []
+        self._refs: dict[int, int] = {}
+        self._region: dict[int, np.ndarray] = {}  # slot -> uint8 record
+        self._empty: set[int] = set()             # live slots w/o payload
+        self._extents: list = []                  # keep buffers alive
+        self._spare_regions: list[np.ndarray] = []  # cut, not yet bound
+        self._backed_bytes = 0
+        self._backed_hw = 0
+        self.spill_bytes = 0     # payload bytes written into the store
+        self.prefetch_bytes = 0  # payload bytes read back out
+
+    # ---- capacity & refcount laws (PageAllocator's, host-side) ---------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free_bare) + len(self._free_backed)
+
+    @property
+    def n_live(self) -> int:
+        return len(self._refs)
+
+    def refcount(self, slot: int) -> int:
+        return self._refs.get(slot, 0)
+
+    def is_empty(self, slot: int) -> bool:
+        """True for a live slot reserved with no payload."""
+        return slot in self._empty
+
+    def share(self, slots: Iterable[int]) -> None:
+        slots = list(slots)
+        for s in slots:
+            if s not in self._refs:
+                raise ValueError(
+                    f"host page {s} is not live (cannot share a freed "
+                    f"page; {len(self._refs)} live of {self.n_pages})"
+                )
+        for s in slots:
+            self._refs[s] += 1
+
+    def free(self, slots: Iterable[int]) -> list[int]:
+        released = []
+        for s in slots:
+            if s not in self._refs:
+                raise ValueError(
+                    f"host page {s} is not live (double free or foreign "
+                    f"id; {len(self._refs)} live of {self.n_pages})"
+                )
+            self._refs[s] -= 1
+            if self._refs[s] == 0:
+                del self._refs[s]
+                self._empty.discard(s)
+                if s in self._region:
+                    self._free_backed.append(s)
+                else:
+                    self._free_bare.append(s)
+                released.append(s)
+        return released
+
+    # ---- backing -------------------------------------------------------
+
+    def _alloc_extent(self, n: int) -> None:
+        """ONE bulk buffer for ``n`` fresh page regions (the spill-batch
+        shape).  Failures surface as :class:`HostTierError`."""
+        nbytes = n * self.page_nbytes
+        try:
+            if self.alloc_hook is not None:
+                self.alloc_hook(nbytes)
+            if self.pool is not None:
+                buf = self.pool.alloc_pages(n, self.page_nbytes)
+                raw = buf.view(np.uint8)
+            else:
+                buf = None
+                raw = np.empty(nbytes, np.uint8)
+        except HostTierError:
+            raise
+        except Exception as exc:
+            raise HostTierError(
+                f"host tier extent of {nbytes} B failed: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+        self._extents.append((buf, raw))
+        self._backed_bytes += nbytes
+        self._backed_hw = max(self._backed_hw, self._backed_bytes)
+        for i in range(n - 1, -1, -1):
+            # regions bind to slots at first use (in _take_backed)
+            self._spare_regions.append(
+                raw[i * self.page_nbytes:(i + 1) * self.page_nbytes]
+            )
+
+    def _take_backed(self, n: int) -> Optional[list[int]]:
+        """``n`` live-able slots WITH regions bound, all-or-nothing:
+        region-bearing free slots first (steady-state reuse — no
+        allocation), then bare slots bound to fresh regions cut from
+        ONE bulk extent.  None when fewer than ``n`` slots are free;
+        the extent allocation happens BEFORE any slot leaves a free
+        list, so a failed batch grants nothing."""
+        if n > self.n_free:
+            return None
+        n_backed = min(n, len(self._free_backed))
+        short = n - n_backed
+        if short > len(self._spare_regions):
+            self._alloc_extent(short - len(self._spare_regions))
+        slots = [self._free_backed.pop() for _ in range(n_backed)]
+        for _ in range(short):
+            s = self._free_bare.pop()
+            self._region[s] = self._spare_regions.pop()
+            slots.append(s)
+        return slots
+
+    # ---- payload movement ----------------------------------------------
+
+    def _views(self, slot: int) -> dict[str, np.ndarray]:
+        region = self._region[slot]
+        out = {}
+        for name, (shape, dt, off) in self._leaves.items():
+            n = int(np.prod(shape)) * dt.itemsize
+            out[name] = np.frombuffer(
+                region[off:off + n], dtype=dt
+            ).reshape(shape)
+        return out
+
+    def put(self, payloads: dict[str, np.ndarray]) -> Optional[list[int]]:
+        """Store a spill batch: every array carries the batch on axis 0
+        (``(B, *per_page_shape)``).  Returns the granted slots at
+        refcount 1, or None (granting nothing) when fewer than B slots
+        are free; raises :class:`HostTierError` when slot capacity is
+        there but backing cannot be allocated."""
+        n = len(next(iter(payloads.values())))
+        if n == 0:
+            return []
+        slots = self._take_backed(n)
+        if slots is None:
+            return None
+        for i, s in enumerate(slots):
+            views = self._views(s)
+            for name, arr in payloads.items():
+                views[name][...] = arr[i]
+            self._refs[s] = 1
+        moved = n * self.page_nbytes
+        self.spill_bytes += moved
+        if self.pool is not None:
+            self.pool.note_spill(moved)
+        return slots
+
+    def put_empty(self, n: int) -> Optional[list[int]]:
+        """Reserve ``n`` slots with NO payload (refcount 1) — the
+        unwritten-page spill: capacity bookkeeping, zero bytes."""
+        if n == 0:
+            return []
+        if n > self.n_free:
+            return None
+        slots = []
+        for _ in range(n):
+            s = (self._free_bare.pop() if self._free_bare
+                 else self._free_backed.pop())
+            self._refs[s] = 1
+            self._empty.add(s)
+            slots.append(s)
+        return slots
+
+    def read_batch(self, slots: Iterable[int]) -> dict[str, np.ndarray]:
+        """Copy slot payloads back out, batch axis 0 — the prefetch
+        read.  Empty slots are illegal here (nothing to read)."""
+        slots = list(slots)
+        for s in slots:
+            if s not in self._refs:
+                raise ValueError(f"host page {s} is not live")
+            if s in self._empty:
+                raise ValueError(f"host page {s} is empty (never written)")
+        out = {
+            name: np.stack([self._views(s)[name] for s in slots])
+            for name in self._leaves
+        }
+        moved = len(slots) * self.page_nbytes
+        self.prefetch_bytes += moved
+        if self.pool is not None:
+            self.pool.note_prefetch(moved)
+        return out
+
+    def stats(self) -> dict:
+        """Footprint observable, not silent (the PR-11 metrics idiom)."""
+        return {
+            "n_pages": self.n_pages,
+            "n_live": self.n_live,
+            "n_free": self.n_free,
+            "page_nbytes": self.page_nbytes,
+            "backed_bytes": self._backed_bytes,
+            "backed_bytes_hw": self._backed_hw,
+            "spill_bytes": self.spill_bytes,
+            "prefetch_bytes": self.prefetch_bytes,
+        }
+
+    def close(self) -> None:
+        """Drop every region view, then return the bulk buffers to the
+        host pool.  Only legal with no live slots: a closed store
+        restarts cold — its freed slots lose their regions (back to the
+        bare list), and the next spill batch cuts fresh extents."""
+        if self._refs:
+            raise ValueError(
+                f"cannot close: {len(self._refs)} host page(s) still "
+                f"live"
+            )
+        self._region.clear()
+        self._spare_regions.clear()
+        self._free_bare += self._free_backed
+        self._free_backed.clear()
+        extents, self._extents = self._extents, []
+        import gc
+
+        gc.collect()  # numpy views over ctypes blocks clear via cycles
+        for buf, _raw in extents:
+            if buf is not None:
+                try:
+                    buf.free()
+                except ValueError:
+                    pass  # a stray external view keeps it until GC
+        self._backed_bytes = 0
+
+
+def host_leaf_shapes(geom: CacheGeometry, dtype) -> dict:
+    """Per-page host-record layout for one cache pool: what ONE logical
+    page drags across the tiers — the K and V blocks of every layer
+    plus, on the quantized rungs, their per-page per-head scale rows.
+    The record byte count is exactly ``obs.ledger.kv_page_bytes`` of the
+    pool (test-pinned), so static traffic accounting and actual store
+    footprint can never drift apart."""
+    dt = np.dtype(jnp.dtype(dtype))
+    page = (geom.n_layers, geom.page_size, geom.n_heads, geom.d_head)
+    out = {"k": (page, dt), "v": (page, dt)}
+    if is_quantized_kv_dtype(dtype):
+        srow = (geom.n_layers, geom.n_heads)
+        out["k_scale"] = (srow, np.dtype(np.float32))
+        out["v_scale"] = (srow, np.dtype(np.float32))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ResidencyPolicy:
+    """WHICH pages stay device-resident: LRU by last-attended sweep,
+    with a pinned hot window.
+
+    - ``pin_tail``: the last N pages of every live sequence (its write
+      frontier — touched by EVERY sweep it joins) are never chosen as
+      spill victims, so steady decode cannot thrash its own hot window;
+    - victims among the cold are ordered by ``(last_attended, page
+      id)`` — least-recently-attended first, and among equals the
+      OLDEST chunk of a context spills first (ids grow with position),
+      which is exactly the long-context residency horizon: chunks past
+      the horizon page out, the recent window stays hot."""
+
+    pin_tail: int = 1
+
+    def __post_init__(self):
+        if self.pin_tail < 0:
+            raise ValueError(f"pin_tail must be >= 0, got {self.pin_tail}")
+
+
+class TieredPageAllocator:
+    """Two-tier page allocator: LOGICAL pages whose backing moves
+    between a device :class:`PageAllocator` and a :class:`HostPageStore`
+    under a :class:`ResidencyPolicy` — the engine-facing currency
+    (slot page lists, the prefix trie, copy-on-write) stays a logical
+    id for the page's whole lifetime while its bytes migrate.
+
+    The refcount laws are the :class:`PageAllocator`'s, extended across
+    tiers: holders count on the LOGICAL page, so a spilled page shared
+    k ways still counts one holder per sharer and neither tier can
+    reclaim it; ``free`` drops one holder and the page's backing (in
+    whichever tier) is reclaimed only at zero.
+
+    Data movement is delegated: ``reader(device_ids) -> {leaf: (B,
+    ...)}`` pulls page payloads off the device pool (the D2H spill leg)
+    and ``writer(device_ids, payloads)`` lands them back (the H2D
+    prefetch leg) — the engine binds these over its live cache pytree,
+    so this class owns placement and laws, never jax buffers.
+
+    A page is RESIDENT when device-backed; ``ensure_resident`` is the
+    prefetch (and synchronous cold-hit) path, spilling LRU victims for
+    room.  Reserved-but-unwritten pages (budget tails) spill and
+    return as pure bookkeeping — no payload exists, so no bytes move
+    and untiered garbage-page semantics are preserved exactly.
+
+    PARKED pages extend the prefix trie's retention beyond page
+    liveness: a freed trie-registered page can ``park`` (refcount 0,
+    host-backed, evictable LRU cache) instead of dying, and a later
+    trie hit ``restore_parked``s it into a fresh private logical page.
+
+    ``degrade()`` is the host-tier outage contract: no further spills
+    or parks, admission arithmetic collapses to device-only — the
+    engine calls it after ``ft.retry`` exhausts on
+    :class:`HostTierError`, making a total host outage behave exactly
+    like an untiered engine."""
+
+    def __init__(self, n_pages: int, store: Optional[HostPageStore],
+                 reader: Callable, writer: Callable,
+                 policy: Optional[ResidencyPolicy] = None,
+                 on_parked_evict: Optional[Callable] = None):
+        self._dev = PageAllocator(n_pages)
+        self.n_pages = n_pages
+        self.store = store
+        self._reader, self._writer = reader, writer
+        self.policy = policy or ResidencyPolicy()
+        self._on_parked_evict = on_parked_evict
+        self._next = 0
+        self._loc: dict[int, tuple[str, int]] = {}  # lp -> (tier, id)
+        self._refs: dict[int, int] = {}
+        self._written: set[int] = set()
+        self._last: dict[int, int] = {}             # lp -> sweep stamp
+        self._pins: frozenset = frozenset()
+        self._parked: dict[int, int] = {}           # lp -> park stamp
+        self._clock = 0
+        self.degraded = False
+        self.spilled_pages = 0      # payload D2H copies
+        self.prefetched_pages = 0   # payload H2D copies (incl. restores)
+        self.spilled_empty = 0      # bookkeeping-only spills
+        self.parked_hits = 0        # trie hits served from parked chains
+
+    # ---- PageAllocator-compatible surface ------------------------------
+
+    @property
+    def n_free(self) -> int:
+        """Unique reclaimable capacity ACROSS tiers (parked pages are
+        reclaimable cache, so they count): after every holder frees and
+        the parked pool drains, returns device + host capacity."""
+        host = 0
+        if self.store is not None and not self.degraded:
+            host = self.store.n_free + len(self._parked)
+        return self._dev.n_free + host
+
+    @property
+    def n_live(self) -> int:
+        return len(self._refs)
+
+    @property
+    def n_parked(self) -> int:
+        return len(self._parked)
+
+    def refcount(self, lp: int) -> int:
+        return self._refs.get(lp, 0)
+
+    def is_resident(self, lp: int) -> bool:
+        return self._loc[lp][0] == "dev"
+
+    def is_parked(self, lp: int) -> bool:
+        return lp in self._parked
+
+    def device_page(self, lp: int) -> int:
+        """The device id backing a RESIDENT logical page (table rows and
+        copy-on-write read this after ``ensure_resident``)."""
+        tier, i = self._loc[lp]
+        if tier != "dev":
+            raise ValueError(f"logical page {lp} is not device-resident")
+        return i
+
+    # ---- policy inputs (the engine narrates residency) -----------------
+
+    def tick(self) -> None:
+        """Advance the LRU clock (one engine tick)."""
+        self._clock += 1
+
+    def touch(self, lps: Iterable[int]) -> None:
+        """Stamp pages as attended THIS sweep (the LRU recency input)."""
+        for lp in lps:
+            self._last[lp] = self._clock
+
+    def mark_written(self, lps: Iterable[int]) -> None:
+        """Pages now carry real K/V: their spills move payload (an
+        unwritten page's spill is free, and its prefetch restores
+        untiered garbage-page semantics — no copy either way)."""
+        for lp in lps:
+            self._written.add(lp)
+
+    def set_pins(self, lps: Iterable[int]) -> None:
+        """The pinned hot window (each live slot's tail pages) — never
+        chosen as spill victims except as a correctness fallback when a
+        sweep cannot otherwise seat its pages."""
+        self._pins = frozenset(lps)
+
+    # ---- allocation across tiers ---------------------------------------
+
+    def _spill_candidates(self, keep: set, allow_pinned: bool) -> list[int]:
+        """Victims in eviction order: resident LIVE pages outside
+        ``keep``, LRU-by-last-attended (ties: lowest id = oldest chunk),
+        pinned pages excluded unless ``allow_pinned``.  Empty under
+        degrade: no host, nowhere to spill."""
+        if self.store is None or self.degraded:
+            return []
+        cands = [
+            lp for lp, (tier, _) in self._loc.items()
+            if tier == "dev" and lp in self._refs and lp not in keep
+            and (allow_pinned or lp not in self._pins)
+        ]
+        cands.sort(key=lambda lp: (self._last.get(lp, -1), lp))
+        return cands
+
+    def _host_room(self, n: int) -> bool:
+        """Make ``n`` host slots available, evicting parked pages LRU
+        (oldest park first) — parked chains are cache, reclaimable."""
+        if self.store is None or self.degraded:
+            return n == 0
+        while self.store.n_free < n and self._parked:
+            victim = min(self._parked, key=lambda lp: (self._parked[lp], lp))
+            self._evict_parked(victim)
+        return self.store.n_free >= n
+
+    def _evict_parked(self, lp: int) -> None:
+        del self._parked[lp]
+        self.store.free([self._loc.pop(lp)[1]])
+        self._written.discard(lp)
+        self._last.pop(lp, None)
+        if self._on_parked_evict is not None:
+            self._on_parked_evict([lp])
+
+    def _spill(self, victims: list[int]) -> None:
+        """Move victims' backing device -> host as ONE batch: one bulk
+        store write for the written ones (one extent allocation at
+        most), pure bookkeeping for the unwritten ones, device ids
+        freed.  All-or-nothing: a host-tier failure raises before any
+        location changes."""
+        if not victims:
+            return
+        if not self._host_room(len(victims)):
+            raise HostTierError(
+                f"host tier full: cannot spill {len(victims)} page(s) "
+                f"({self.store.n_free if self.store else 0} free)"
+            )
+        written = [lp for lp in victims if lp in self._written]
+        empty = [lp for lp in victims if lp not in self._written]
+        slots_w: list[int] = []
+        if written:
+            payload = self._reader([self._loc[lp][1] for lp in written])
+            got = self.store.put(payload)
+            if got is None:
+                raise HostTierError("host tier full mid-spill")
+            slots_w = got
+        slots_e = self.store.put_empty(len(empty)) if empty else []
+        if slots_e is None:
+            self.store.free(slots_w)
+            raise HostTierError("host tier full mid-spill")
+        for lp, s in zip(written, slots_w):
+            self._dev.free([self._loc[lp][1]])
+            self._loc[lp] = ("host", s)
+        for lp, s in zip(empty, slots_e):
+            self._dev.free([self._loc[lp][1]])
+            self._loc[lp] = ("host", s)
+        self.spilled_pages += len(written)
+        self.spilled_empty += len(empty)
+
+    def _make_room(self, n: int, keep: set, soft: bool = False) -> int:
+        """Spill until ``n`` device pages are free (LRU victims outside
+        ``keep``; pinned pages only as a last-resort correctness
+        fallback).  Returns the free count achieved; raises
+        :class:`HostTierError` when short unless ``soft``."""
+        short = n - self._dev.n_free
+        if short > 0:
+            cands = self._spill_candidates(keep, allow_pinned=False)
+            if len(cands) < short:
+                cands = self._spill_candidates(keep, allow_pinned=True)
+            take = cands[:short]
+            if len(take) < short and not soft:
+                raise HostTierError(
+                    f"cannot make device room for {n} page(s): "
+                    f"{self._dev.n_free} free, {len(cands)} spillable"
+                )
+            if soft and self.store is not None and not self.degraded:
+                # best effort: spill what host capacity actually takes
+                room = self.store.n_free + len(self._parked)
+                take = take[:room]
+            self._spill(take)
+        return self._dev.n_free
+
+    def _feasible(self, n: int, resident: int, keep: set) -> bool:
+        """The alloc/watermark arithmetic, shared so the admission gate
+        can never promise pages ``alloc`` then over-draws (the
+        ``_share_plan`` discipline applied across tiers)."""
+        if n <= 0:
+            return True
+        host_cap = 0
+        if self.store is not None and not self.degraded:
+            host_cap = self.store.n_free + len(self._parked)
+        if self._dev.n_free + host_cap < n:
+            return False
+        dev_short = max(0, resident - self._dev.n_free)
+        if dev_short > 0:
+            cands = self._spill_candidates(keep, allow_pinned=True)
+            if len(cands) < dev_short:
+                return False
+        # host slots: one per spilled victim + one per host-born page
+        return dev_short + (n - resident) <= host_cap
+
+    def _norm_resident(self, n: int, resident: Optional[int]) -> int:
+        """Degrade (or a missing store) collapses to the untiered
+        contract: everything allocates device-resident — host
+        reservations need host capacity that no longer exists."""
+        if self.store is None or self.degraded:
+            return n
+        return n if resident is None else min(resident, n)
+
+    def can_alloc(self, n: int, resident: Optional[int] = None,
+                  keep: Iterable[int] = ()) -> bool:
+        """Pure twin of :meth:`alloc` — the engine's watermark gate."""
+        return self._feasible(n, self._norm_resident(n, resident),
+                              set(keep))
+
+    def alloc(self, n: int = 1, resident: Optional[int] = None,
+              keep: Iterable[int] = ()) -> Optional[list[int]]:
+        """Grant ``n`` logical pages at refcount 1, the first
+        ``resident`` of them device-backed (spilling LRU victims for
+        room) and the rest host-backed EMPTY reservations — or None,
+        granting nothing, when the tiers cannot cover it.  ``resident``
+        defaults to all (the write-now contract: prefill and
+        copy-on-write targets must be on device); budget tails pass a
+        smaller count and cost no device pages until their frontier
+        arrives.  ``keep`` shields in-flight pages from the spill."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} pages")
+        if n == 0:
+            return []
+        resident = self._norm_resident(n, resident)
+        keep = set(keep)
+        if not self._feasible(n, resident, keep):
+            return None
+        self._make_room(resident, keep)
+        dids = self._dev.alloc(resident) if resident else []
+        assert dids is not None
+        host_n = n - resident
+        slots: list[int] = []
+        if host_n:
+            if not self._host_room(host_n):
+                self._dev.free(dids)
+                raise HostTierError(
+                    f"host tier full allocating {host_n} reserve page(s)"
+                )
+            got = self.store.put_empty(host_n)
+            if got is None:
+                self._dev.free(dids)
+                raise HostTierError(
+                    f"host tier full allocating {host_n} reserve page(s)"
+                )
+            slots = got
+        lps = []
+        for i in range(n):
+            lp = self._next
+            self._next += 1
+            if i < resident:
+                self._loc[lp] = ("dev", dids[i])
+            else:
+                self._loc[lp] = ("host", slots[i - resident])
+            self._refs[lp] = 1
+            self._last[lp] = self._clock
+            lps.append(lp)
+        return lps
+
+    def share(self, lps: Iterable[int]) -> None:
+        """Add one holder per LIVE logical page — tier-independent (the
+        spilled-shared-page law: holders count on the logical page)."""
+        lps = list(lps)
+        for lp in lps:
+            if lp not in self._refs:
+                raise ValueError(
+                    f"logical page {lp} is not live (cannot share a "
+                    f"freed page; {len(self._refs)} live)"
+                )
+        for lp in lps:
+            self._refs[lp] += 1
+
+    def free(self, lps: Iterable[int],
+             park: Iterable[int] = ()) -> list[int]:
+        """Drop one holder per page; a page whose LAST holder left
+        either PARKS (still trie-matchable from the host tier — pages
+        named in ``park``, written, host tier healthy) or dies, and
+        only the DEAD are returned (the engine drops exactly those from
+        its prefix trie; parked entries stay matchable)."""
+        park = set(park)
+        dead = []
+        for lp in lps:
+            if lp not in self._refs:
+                raise ValueError(
+                    f"logical page {lp} is not live (double free or "
+                    f"foreign id; {len(self._refs)} live)"
+                )
+            self._refs[lp] -= 1
+            if self._refs[lp] > 0:
+                continue
+            del self._refs[lp]
+            if (lp in park and lp in self._written
+                    and self.store is not None and not self.degraded):
+                try:
+                    self._park(lp)
+                    continue
+                except HostTierError:
+                    pass  # no host room: the chain dies like before
+            self._release(lp)
+            dead.append(lp)
+        return dead
+
+    def _release(self, lp: int) -> None:
+        tier, i = self._loc.pop(lp)
+        if tier == "dev":
+            self._dev.free([i])
+        else:
+            self.store.free([i])
+        self._written.discard(lp)
+        self._last.pop(lp, None)
+
+    # ---- parking (warm-prefix retention, PR-8 remainder) ---------------
+
+    def _park(self, lp: int) -> None:
+        """Refcount hit zero but the chain stays warm: host-resident,
+        refcount 0, LRU-evictable.  Resident pages spill first (their
+        payload is the thing being retained)."""
+        if self._loc[lp][0] == "dev":
+            if not self._host_room(1):
+                raise HostTierError("host tier full: cannot park")
+            payload = self._reader([self._loc[lp][1]])
+            slots = self.store.put(payload)
+            if slots is None:
+                raise HostTierError("host tier full: cannot park")
+            self._dev.free([self._loc[lp][1]])
+            self._loc[lp] = ("host", slots[0])
+            self.spilled_pages += 1
+        self._parked[lp] = self._clock
+
+    def restore_parked(self, lp: int,
+                       keep: Iterable[int] = ()) -> Optional[int]:
+        """A trie hit on a parked chain: copy the parked page's payload
+        into a FRESH device-resident logical page (refcount 1, private
+        to the requester — no copy-on-write ever needed on it) and
+        return it; the parked original stays parked for later sharers
+        (its LRU stamp refreshed).  None when no room."""
+        if lp not in self._parked:
+            raise ValueError(f"logical page {lp} is not parked")
+        # read FIRST (read_batch stacks into an owned copy): the alloc
+        # below may spill for room, and its parked-LRU eviction could
+        # pick lp itself — the copy keeps the restore valid either way
+        payload = self.store.read_batch([self._loc[lp][1]])
+
+        def uncount_read():
+            # un-count the speculative read: no page actually moved up,
+            # and the three-way traffic agreement (engine counters x
+            # page bytes == store bytes) must stay exact — including
+            # when a transient extent fault makes ft.retry re-enter
+            self.store.prefetch_bytes -= self.store.page_nbytes
+            if self.store.pool is not None:
+                self.store.pool.note_prefetch(-self.store.page_nbytes)
+
+        try:
+            fresh = self.alloc(1, resident=1, keep=keep)
+        except Exception:
+            uncount_read()
+            raise
+        if fresh is None:
+            uncount_read()
+            return None
+        self._writer([self._loc[fresh[0]][1]], payload)
+        self._written.add(fresh[0])
+        if lp in self._parked:  # survived the alloc: refresh its LRU
+            self._parked[lp] = self._clock
+        self.prefetched_pages += 1
+        self.parked_hits += 1
+        return fresh[0]
+
+    def drop_parked(self) -> list[int]:
+        """Evict every parked page (the cache-recovery path: a rebuilt
+        pool holds no valid K/V anywhere)."""
+        lps = sorted(self._parked)
+        for lp in lps:
+            del self._parked[lp]
+            self.store.free([self._loc.pop(lp)[1]])
+            self._written.discard(lp)
+            self._last.pop(lp, None)
+        return lps
+
+    # ---- residency (the spill/prefetch hot path) -----------------------
+
+    def ensure_resident(self, lps: Iterable[int], keep: Iterable[int] = (),
+                        best_effort: bool = False) -> int:
+        """Prefetch every host-backed page in ``lps`` onto the device
+        (ONE batched H2D write for the written ones; empty reservations
+        just take a device id — garbage contents, exactly the untiered
+        fresh-page semantics).  Returns how many pages actually moved
+        payload — the synchronous caller's COLD-HIT count, zero when
+        the prefetch-ahead already landed them.  ``best_effort`` (the
+        prefetch-ahead leg) fetches what fits and leaves the rest cold
+        instead of raising."""
+        lps = list(lps)
+        missing = [lp for lp in lps if self._loc[lp][0] == "host"
+                   and lp not in self._parked]
+        if not missing:
+            return 0
+        keep = set(keep) | set(lps)
+        copied = 0
+        # SWAP in rounds: a spill consumes a host slot that only frees
+        # when a fetched page vacates its own — so when both tiers run
+        # tight (aggregate residency near device + host), each round
+        # spills at most the host headroom, fetches that many, and the
+        # vacated slots fund the next round.  Each round still batches
+        # (one store write, one scatter), so the bulk-extent contract
+        # holds per round.
+        while missing:
+            take = min(len(missing), self._dev.n_free)
+            if take == 0:
+                headroom = 0
+                if self.store is not None and not self.degraded:
+                    headroom = self.store.n_free + len(self._parked)
+                want = min(len(missing), max(1, headroom))
+                try:
+                    self._make_room(want, keep, soft=best_effort)
+                except HostTierError:
+                    if best_effort:
+                        break
+                    raise
+                take = min(len(missing), self._dev.n_free)
+                if take == 0:
+                    if best_effort:
+                        break
+                    raise HostTierError(
+                        f"no device room for {len(missing)} page(s)"
+                    )
+            batch, missing = missing[:take], missing[take:]
+            dids = self._dev.alloc(take)
+            assert dids is not None
+            written = [(lp, d) for lp, d in zip(batch, dids)
+                       if lp in self._written]
+            if written:
+                payload = self.store.read_batch(
+                    [self._loc[lp][1] for lp, _ in written]
+                )
+                self._writer([d for _, d in written], payload)
+            for lp, d in zip(batch, dids):
+                self.store.free([self._loc[lp][1]])
+                self._loc[lp] = ("dev", d)
+            self.prefetched_pages += len(written)
+            copied += len(written)
+        return copied
+
+    # ---- outage contract -----------------------------------------------
+
+    def degrade(self) -> None:
+        """Host-tier outage: stop spilling and parking; admission
+        arithmetic collapses to the device pool (already host-backed
+        LIVE pages stay prefetchable — reads need no allocation), so
+        the engine behaves like an untiered one from here on."""
+        self.degraded = True
+
+    def stats(self) -> dict:
+        out = {
+            "device_free": self._dev.n_free,
+            "n_live": self.n_live,
+            "n_parked": self.n_parked,
+            "spilled_pages": self.spilled_pages,
+            "prefetched_pages": self.prefetched_pages,
+            "spilled_empty": self.spilled_empty,
+            "parked_hits": self.parked_hits,
+            "degraded": self.degraded,
+        }
+        if self.store is not None:
+            out["host"] = self.store.stats()
+        return out
