@@ -343,12 +343,32 @@ class TestTieredAllocator:
     def test_wave_planner_packs_unique_pages_first_fit(self):
         needs = [(0, 0, frozenset({1, 2})), (1, 0, frozenset({2, 3})),
                  (2, 0, frozenset({4, 5, 6})), (3, 1, frozenset({1, 2}))]
-        # capacity 4: slots 0+1 share page 2 (union 3), slot 2 would
-        # push group 0 to 6 -> new wave; slot 3 is group 1 (own pool)
-        waves = plan_sweep_waves(needs, 4)
-        assert waves == [[0, 1], [2, 3]]
+        # capacity 4, legacy slot order: slots 0+1 share page 2 (union
+        # 3), slot 2 would push group 0 to 6 -> new wave; slot 3 is
+        # group 1 (own pool)
+        assert plan_sweep_waves(needs, 4, reorder=False) == [[0, 1], [2, 3]]
+        # wave-aware reorder (ISSUE 14) pulls slot 3 (group 1, its own
+        # pool) forward into the first wave instead of splitting on
+        # slot order; waves come back slot-sorted
+        assert plan_sweep_waves(needs, 4) == [[0, 1, 3], [2]]
         assert plan_sweep_waves(needs, 16) == [[0, 1, 2, 3]]
         assert plan_sweep_waves([], 4) == []
+
+    def test_wave_reorder_packs_coresident_slots_together(self):
+        # slot order interleaves two share-groups: legacy first-fit
+        # splits every boundary (4 waves), the affinity reorder seats
+        # each share-group in one wave (2) — the saved waves are saved
+        # H2D/D2H round trips under the tier
+        needs = [(0, 0, frozenset({1, 2})), (1, 0, frozenset({3, 4})),
+                 (2, 0, frozenset({1, 2})), (3, 0, frozenset({3, 4}))]
+        assert plan_sweep_waves(needs, 2) == [[0, 2], [1, 3]]
+        assert plan_sweep_waves(needs, 2, reorder=False) == \
+            [[0], [1], [2], [3]]
+        # determinism: a replayed tick partitions identically
+        assert plan_sweep_waves(needs, 2) == plan_sweep_waves(needs, 2)
+        # every slot appears exactly once regardless of packing
+        flat = sorted(s for w in plan_sweep_waves(needs, 2) for s in w)
+        assert flat == [0, 1, 2, 3]
 
 
 D = 32

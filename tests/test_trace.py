@@ -657,8 +657,10 @@ class TestRegress:
     def test_tokens_drop_regresses_and_latency_rise_regresses(self):
         base = regress.index_rows(self.BASE)
         new = regress.index_rows([
-            dict(self.BASE[0], value=70000.0),          # -30% tokens/s
-            dict(self.BASE[1], p99_s=0.008),            # 2x p99
+            # -55% tokens/s: past the CPU-proxy rate floor (0.40) — a
+            # -30% injection would now be absorbed as measured noise
+            dict(self.BASE[0], value=45000.0),
+            dict(self.BASE[1], p99_s=0.012),            # 3x p99
             dict(self.BASE[2], grad_sync_bytes_zero=25728.0),  # 2x wire
         ])
         findings = regress.compare(base, new, noise=0.1)
@@ -693,6 +695,71 @@ class TestRegress:
                      "fused_speedup"):
             assert regress.direction(name) == "higher", name
         assert "peak_hbm_gbps" in regress._SKIP
+
+    def test_noise_floors_absorb_tail_swings_but_not_2x(self):
+        """The measured-noise floors (ISSUE 14): wall-clock fields
+        swing on SAME-CODE control runs (+11.6–27.5% in PR 13's
+        ``--check`` pairs; a PR-14 three-run config-12 control on the
+        1-core proxy measured tails to ~52% and rates to ~34% even
+        median-of-3), so a +45% p99 / +30% rate drift must stay in
+        band on CPU rows, while a true >2x regression still gates —
+        fields WITHOUT a floor (exact-counter fractions like
+        prefill_frac) keep the tight default band, and TPU rows skip
+        the floors entirely (chip noise has no CPU-proxy excuse)."""
+        c17 = {"config": 17, "metric": "serve_router_tokens_per_s",
+               "value": 1000.0, "prefill_frac": 0.4,
+               "ttft_p99_s_latency": 0.030, "platform": "cpu"}
+        base = regress.index_rows(self.BASE + [c17])
+        drifted = regress.index_rows([
+            self.BASE[0],
+            dict(self.BASE[1], p99_s=0.004 * 1.45),     # +45%: in floor
+            self.BASE[2],
+            dict(c17, value=1000.0 * 0.70,              # -30%: in floor
+                 ttft_p99_s_latency=0.030 * 1.5),       # +50%: in floor
+        ])
+        assert not regress.has_regression(
+            regress.compare(base, drifted, noise=0.1)
+        )
+        worse = regress.index_rows([
+            self.BASE[0],
+            dict(self.BASE[1], p99_s=0.012),            # 3x: regressed
+            self.BASE[2],
+            dict(c17, value=450.0,                      # -55%: past floor
+                 prefill_frac=0.48,                     # +20%: no floor
+                 ttft_p99_s_latency=0.075),             # 2.5x: past floor
+        ])
+        bad = {(f.metric, f.field) for f in
+               regress.compare(base, worse, noise=0.1)
+               if f.status == "regressed"}
+        assert ("serve_decode_tokens_per_s", "p99_s") in bad
+        assert ("serve_router_tokens_per_s", "value") in bad
+        assert ("serve_router_tokens_per_s", "prefill_frac") in bad
+        assert ("serve_router_tokens_per_s", "ttft_p99_s_latency") in bad
+        # the same tail drift on a CHIP row is NOT noise: floors are
+        # CPU-proxy-scoped, tpu rows keep the tight band
+        chip = dict(self.BASE[1], platform="tpu")
+        chip_drift = dict(chip, p99_s=0.004 * 1.45)
+        assert regress.has_regression(regress.compare(
+            regress.index_rows([chip]),
+            regress.index_rows([chip_drift]), noise=0.1,
+        ))
+
+    def test_router_field_directions(self):
+        """Config 17's fleet-router fields: TTFT tails and the
+        prefill fraction regress UPWARD, rates/sharing counters
+        DOWNWARD — and the affinity-off CONTROL fields must not be
+        dragged into _HIGHER by an over-broad "affinity" substring
+        (the decode_spec latent-inversion lesson)."""
+        lower = ("ttft_p99_s_latency", "ttft_p50_s_batch",
+                 "prefill_frac", "prefill_frac_affinity_off")
+        higher = ("serve_router_tokens_per_s", "affinity_speedup",
+                  "tokens_per_s_affinity_off", "shared_tokens",
+                  "subpage_tokens", "affinity_hits", "affinity_tokens")
+        for name in lower:
+            assert regress.direction(name) == "lower", name
+        for name in higher:
+            assert regress.direction(name) == "higher", name
+        assert "replicas" in regress._SKIP
 
     def test_improvement_and_missing_are_not_failures(self):
         base = regress.index_rows(self.BASE)
@@ -751,13 +818,14 @@ class TestRegress:
 
     def test_cli_smoke(self, tmp_path):
         """The acceptance gate as a subprocess: clean pair exits 0, an
-        injected 30%% tokens/s regression exits nonzero."""
+        injected 55%% tokens/s regression (past the CPU-proxy rate
+        floor) exits nonzero."""
         base = self._write(tmp_path, "base.json", self.BASE)
         good = self._write(tmp_path, "good.json",
                            [dict(self.BASE[0], value=97000.0),
                             self.BASE[1], self.BASE[2]])
         bad = self._write(tmp_path, "bad.json",
-                          [dict(self.BASE[0], value=70000.0),
+                          [dict(self.BASE[0], value=45000.0),
                            self.BASE[1], self.BASE[2]])
         env = dict(os.environ, JAX_PLATFORMS="cpu")
         r = subprocess.run(
@@ -797,8 +865,9 @@ class TestRegress:
         from tpuscratch.bench import record
 
         def fake_config(out):
+            # -55%: past the CPU-proxy tokens_per_s noise floor
             record._emit(out, config=99, metric="fake_tokens_per_s",
-                         value=70000.0)
+                         value=45000.0)
 
         monkeypatch.setitem(record.CONFIGS, 99, fake_config)
         base = self._write(

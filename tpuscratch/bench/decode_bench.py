@@ -261,6 +261,143 @@ def bench_serve_stream(mesh, cfg, scfg, prompts, max_new: int = 8,
     return out
 
 
+def arrival_mix_requests(mix, n_requests: int, length: int, vocab: int,
+                         seed: int = 0, max_new: int = 8,
+                         pools_per_class: int = 1) -> list:
+    """A multi-tenant arrival stream: ``mix`` is ``[(class, rate),
+    ...]`` and the returned ``(class, Request)`` pairs interleave the
+    classes proportionally to their rates (seeded draws — the workload
+    is a pure function of its arguments, the config-12 rule).  Each
+    class owns ``pools_per_class`` shared-prefix pools (its "system
+    prompts"): every request draws one pool's prefix plus a private
+    tail, so same-class traffic shares pages and CROSS-class traffic
+    never does — the workload prefix-affine routing exists for.  The
+    prefix is ~3/4 of ``length``, forced odd so it is never
+    page-aligned — the sub-page boundary rung is always exercised."""
+    import numpy as np
+
+    from tpuscratch.serve import Request
+
+    if not mix:
+        raise ValueError("arrival mix needs at least one class:rate pair")
+    rng = np.random.default_rng(seed)
+    names = [name for name, _ in mix]
+    rates = np.array([float(r) for _, r in mix])
+    if (rates <= 0).any():
+        raise ValueError(f"rates must be positive: {mix}")
+    probs = rates / rates.sum()
+    # ~3/4 of length, forced ODD so the shared prefix can never be
+    # page-aligned (page sizes are even): every pool exercises the
+    # sub-page boundary rung and subpage_tokens stays observably > 0
+    prefix_len = max(1, (3 * length) // 4) | 1
+    pools = {
+        name: [
+            tuple(int(t) for t in rng.integers(0, vocab, prefix_len))
+            for _ in range(pools_per_class)
+        ]
+        for name in names
+    }
+    out = []
+    for i in range(n_requests):
+        name = names[int(rng.choice(len(names), p=probs))]
+        prefix = pools[name][int(rng.integers(0, pools_per_class))]
+        tail = tuple(
+            int(t) for t in rng.integers(0, vocab, length - prefix_len)
+        )
+        out.append((name, Request(rid=i, prompt=prefix + tail,
+                                  max_new=max_new)))
+    return out
+
+
+def bench_router(mesh, cfg, scfg, n_replicas: int, tagged, rcfg=None,
+                 warmup: bool = True) -> dict:
+    """Drain one multi-tenant ``(class, Request)`` stream through a
+    :class:`~tpuscratch.serve.router.FleetRouter` over ``n_replicas``
+    fresh engines — the fleet-level measurement (config 17): aggregate
+    tokens/s, per-class p50/p99 TTFT and token rates, cross-replica
+    ``prefill_frac``, and the affinity/dispatch accounting.  The static
+    sharing law (``prefill + shared == submitted``) is asserted on
+    every drain — a bench that cannot reconcile its own counters must
+    not report them.
+
+    ``warmup`` drains one slot-bank of throwaway requests through EACH
+    replica before routing, so every compiled program (prefill buckets,
+    decode) exists fleet-wide — compile time must not masquerade as
+    TTFT."""
+    from tpuscratch.serve import FleetRouter, Request, ServeEngine
+
+    engines = [ServeEngine(mesh, cfg, scfg) for _ in range(n_replicas)]
+    if warmup and tagged:
+        p0 = tagged[0][1].prompt
+        for eng in engines:
+            eng.run([
+                Request(rid=900_000 + i, prompt=p0, max_new=2)
+                for i in range(scfg.n_slots)
+            ])
+    router = FleetRouter(engines, rcfg=rcfg)
+    rep = router.run(tagged)
+    if rep.prefill_tokens + rep.shared_tokens != \
+            rep.submitted_prompt_tokens:
+        raise RuntimeError(
+            f"fleet counter law violated: {rep.prefill_tokens} prefilled"
+            f" + {rep.shared_tokens} shared != "
+            f"{rep.submitted_prompt_tokens} submitted"
+        )
+    return {
+        "replicas": n_replicas,
+        "requests": rep.completed,
+        "tokens": rep.tokens_generated,
+        "wall_s": rep.wall_s,
+        "tokens_per_s": rep.tokens_per_s,
+        "prefill_tokens": rep.prefill_tokens,
+        "shared_tokens": rep.shared_tokens,
+        "subpage_tokens": rep.subpage_tokens,
+        "prefill_frac": rep.prefill_frac,
+        "affinity_hits": rep.affinity_hits,
+        "affinity_tokens": rep.affinity_tokens,
+        "backpressure_holds": rep.backpressure_holds,
+        "reroles": rep.reroles,
+        "dispatched": list(rep.dispatched),
+        "classes": {
+            c.name: {
+                "completed": c.completed,
+                "tokens": c.tokens,
+                "ttft_p50_s": c.ttft_p50_s,
+                "ttft_p99_s": c.ttft_p99_s,
+                "tokens_per_s": c.tokens_per_s,
+            }
+            for c in rep.classes
+        },
+        "outputs": rep.outputs,
+    }
+
+
+def router_mix_setup(on_tpu: bool):
+    """The config-17 fleet workload: (serve cfg overrides, replica
+    count, arrival mix, request count, prompt length, SLO classes) —
+    ONE definition shared by the CLI ``--arrival-mix`` path and
+    ``bench.record`` config 17 (the ``default_decode_setup`` rule)."""
+    mix = (("latency", 3.0), ("batch", 1.0))
+    classes = (
+        # chunked-prefill admission for the TTFT class would need a
+        # heterogeneous fleet; on the homogeneous record fleet the
+        # preference is vacuous and the classes differ by REPORTING
+        ("latency", "ttft"),
+        ("batch", "throughput"),
+    )
+    if on_tpu:
+        return dict(n_replicas=3, n_requests=48, length=64, max_new=8,
+                    mix=mix, classes=classes)
+    # sized so the affinity win clears CPU noise: 16 requests over
+    # 3x4 fleet slots shares heavily without the over-concentration
+    # queueing that larger backlogs pay for affinity (measured: 24+
+    # requests trade the prefill saving back as queue wait); length 21
+    # puts the 15-token shared prefix 3 tokens past a page boundary,
+    # so the sub-page rung saves 3 tokens per boundary copy, not 1
+    return dict(n_replicas=3, n_requests=16, length=21, max_new=4,
+                mix=mix, classes=classes)
+
+
 def bench_chunk_longmix(mesh, cfg, scfg, chunk: int, long_len: int = 32,
                         n_resident: int = None, max_new: int = 24) -> dict:
     """The chunked-prefill p99 claim, measured: resident short-prompt
@@ -663,6 +800,21 @@ def main(argv=None) -> int:
                          "and prefetch back ahead of the decode sweep "
                          "— rides the steady-state sweep, or sizes the "
                          "tier for --long-context")
+    ap.add_argument("--arrival-mix", default=None,
+                    metavar="CLS:RATE[:TARGET][,...]",
+                    help="run the FLEET-router workload instead of the "
+                         "steady-state sweep: a multi-tenant arrival "
+                         "mix (rates weight the interleave; TARGET is "
+                         "ttft|throughput, default throughput) drains "
+                         "through a FleetRouter twice — prefix "
+                         "affinity on then off, identical greedy "
+                         "outputs asserted — reporting aggregate "
+                         "tokens/s, per-class p99 TTFT, and cross-"
+                         "replica prefill_frac; 'default' uses the "
+                         "config-17 canonical mix")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="fleet size for --arrival-mix (default: the "
+                         "config-17 setup's)")
     ap.add_argument("--long-context", action="store_true",
                     help="run the long-context resident-users sweep "
                          "instead of the steady-state sweep: a many-"
@@ -704,6 +856,61 @@ def main(argv=None) -> int:
               f"{row['host_bytes_per_token']:.0f} B/token",
               file=sys.stderr)
         payload = {"platform": jax.default_backend(), "tiered": row}
+        print(json.dumps(payload))
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(json.dumps(payload) + "\n")
+        return 0
+
+    if args.arrival_mix is not None:
+        from tpuscratch.serve.router import RouterConfig, SLOClass
+
+        setup = router_mix_setup(on_tpu)
+        if args.arrival_mix == "default":
+            mix = list(setup["mix"])
+            targets = dict(setup["classes"])
+        else:
+            mix, targets = [], {}
+            for part in args.arrival_mix.split(","):
+                bits = part.split(":")
+                if len(bits) not in (2, 3):
+                    ap.error(f"bad --arrival-mix entry {part!r} "
+                             "(want CLS:RATE[:TARGET])")
+                mix.append((bits[0], float(bits[1])))
+                targets[bits[0]] = bits[2] if len(bits) == 3 \
+                    else "throughput"
+        n_rep = args.replicas or setup["n_replicas"]
+        length, max_new = setup["length"], setup["max_new"]
+        scfg = dataclasses.replace(
+            scfg, prefix_share=True,
+            max_seq=max(scfg.max_seq, length + max_new),
+        )
+        tagged = arrival_mix_requests(mix, setup["n_requests"], length,
+                                      scfg.vocab, max_new=max_new)
+        classes = tuple(
+            SLOClass(n, target=targets.get(n, "throughput"))
+            for n, _ in mix
+        )
+        rows = {}
+        for aff in (True, False):
+            row = bench_router(
+                mesh, cfg, scfg, n_rep, tagged,
+                rcfg=RouterConfig(affinity=aff, classes=classes),
+            )
+            tag = "affinity_on" if aff else "affinity_off"
+            rows[tag] = row
+            cls99 = ", ".join(
+                f"{n} p99 TTFT {c['ttft_p99_s'] * 1e3:.1f} ms"
+                for n, c in sorted(row["classes"].items())
+            )
+            print(f"# router {tag}: {row['tokens_per_s']:.3e} tok/s "
+                  f"aggregate, prefill_frac {row['prefill_frac']:.3f} "
+                  f"({cls99})", file=sys.stderr)
+        if rows["affinity_on"].pop("outputs") != \
+                rows["affinity_off"].pop("outputs"):
+            raise RuntimeError("affinity on/off outputs diverged — "
+                               "the routing comparison is void")
+        payload = {"platform": jax.default_backend(), "router": rows}
         print(json.dumps(payload))
         if args.json:
             with open(args.json, "a") as f:

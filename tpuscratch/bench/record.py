@@ -36,6 +36,13 @@ the ones this repo establishes. Configs follow BASELINE.md:
     3D multigrid cells/s over growing meshes with analytic comm_ratio,
     s-step smoothing vs per-sweep (ledger ppermutes/cycle), classic vs
     pipelined CG (ledger psums/iter)             (CPU proxy off-chip)
+16. elastic-FT goodput under one injected preemption: blocking vs
+    async checkpointing for the trainer / halo driver / solver runner,
+    badput bucket shares summing to wall exactly (CPU proxy off-chip)
+17. fleet router: a multi-tenant arrival mix drained through N engine
+    replicas, prefix affinity on vs off (identical greedy outputs
+    asserted) — aggregate tokens/s, per-class p99 TTFT, cross-replica
+    prefill_frac, sub-page sharing counters      (CPU proxy off-chip)
 
 Each config prints one JSON line with the platform recorded, so CPU-proxy
 numbers can never masquerade as chip numbers.
@@ -701,6 +708,28 @@ def config11_train(out: list, iters: int = 3) -> None:
         print(f"# config 11 pp failed: {e}", file=sys.stderr)
 
 
+def _median_of(runs, key):
+    """The run whose ``key`` is the median — the ONE selection policy
+    behind every noise-robust re-measure (``_median_run`` and the
+    config-17 interleaved arms), so a future tuning changes them all
+    together."""
+    runs = sorted(runs, key=key)
+    return runs[len(runs) // 2]
+
+
+def _median_run(fn, key, k: int = 3):
+    """Run ``fn`` ``k`` times and return the run whose ``key`` is the
+    median — the noise-robust re-measure (ISSUE 14): on the 1-core CPU
+    proxy, single-shot wall-clock rates swing up to ~40% on SAME-CODE
+    control runs (a background process stealing the core mid-window),
+    and the median run discards the stolen-window outliers while
+    keeping one COHERENT run's fields (a field-wise median would mix
+    runs and break cross-field consistency, e.g. ``value`` vs its own
+    ``p50_s``).  Static counter fields are identical across runs by
+    construction, so which run is picked never changes them."""
+    return _median_of([fn() for _ in range(k)], key)
+
+
 def config12_decode(out: list, obs_path=None) -> None:
     """Serving decode throughput/latency (tpuscratch.serve): steady-state
     engine ticks — continuous batching, paged KV cache, one compiled
@@ -711,7 +740,15 @@ def config12_decode(out: list, obs_path=None) -> None:
     samples within one continuous steady-state window
     (``default_decode_setup``'s ``measure_steps``), not from repeated
     invocations — repetitions would restart the engine and re-pay
-    prefill, measuring admission rather than decode.
+    prefill, measuring admission rather than decode.  The wall-clock
+    rows ARE re-measured median-of-3 (``_median_run``, ISSUE 14): each
+    repeat is a complete window and the median-by-headline run is the
+    row, so a background process stealing the core mid-window cannot
+    masquerade as a code change — averaging across windows would
+    instead blend the stolen window in.  With ``--obs`` the JSONL
+    artifact carries ALL repeats' per-tick telemetry (each window is
+    its own ``bench/decode`` event; match the emitted row by its
+    tokens/s to find the median window's ticks).
 
     ``obs_path`` attaches an obs JSONL sink to the benched engines, so
     the recorded artifact carries per-tick queue depth, free-page
@@ -753,7 +790,14 @@ def config12_decode(out: list, obs_path=None) -> None:
         run={"bench": "record/config12", "platform": jax.default_backend()},
         host=jax.process_index(),
     ) as sink:
-        results = sweep(mesh, cfg, scfg, batches, sink=sink, **kwargs)
+        # median-of-3 re-measure on every wall-clock row below (the
+        # ISSUE-14 noise-robust records satellite): each repeat is a
+        # complete steady-state window, the median-by-headline run is
+        # the row
+        results = _median_run(
+            lambda: sweep(mesh, cfg, scfg, batches, sink=sink, **kwargs),
+            key=lambda rs: max(r.tokens_per_s for r in rs),
+        )
         best = max(results, key=lambda r: r.tokens_per_s)
         _emit(
             out,
@@ -858,14 +902,20 @@ def config12_decode(out: list, obs_path=None) -> None:
             kwargs.get("prompt_len", 8), scfg.vocab
         )
         kw = {k: v for k, v in kwargs.items() if k != "prompt_len"}
-        r_base = bench_decode(
-            mesh, cfg, _dc.replace(scfg, n_slots=batch),
-            prompt=prompt, sink=sink, **kw,
+        r_base = _median_run(
+            lambda: bench_decode(
+                mesh, cfg, _dc.replace(scfg, n_slots=batch),
+                prompt=prompt, sink=sink, **kw,
+            ),
+            key=lambda r: r.tokens_per_s,
         )
-        r_spec = bench_decode(
-            mesh, cfg, _dc.replace(scfg, n_slots=batch,
-                                   spec_k=4 if on_tpu else 3),
-            prompt=prompt, sink=sink, **kw,
+        r_spec = _median_run(
+            lambda: bench_decode(
+                mesh, cfg, _dc.replace(scfg, n_slots=batch,
+                                       spec_k=4 if on_tpu else 3),
+                prompt=prompt, sink=sink, **kw,
+            ),
+            key=lambda r: r.tokens_per_s,
         )
         print(f"# {r_spec.summary()} (vs {r_base.tokens_per_s:.3e} tok/s "
               "non-spec)", file=sys.stderr)
@@ -909,8 +959,12 @@ def config12_decode(out: list, obs_path=None) -> None:
             prompts = shared_prefix_prompts(
                 scfg.n_slots * 2, length, ratio, scfg.vocab
             )
-            share_rows[ratio] = bench_serve_stream(
-                mesh, cfg, share_scfg, prompts, max_new=max_new, sink=sink
+            share_rows[ratio] = _median_run(
+                lambda: bench_serve_stream(
+                    mesh, cfg, share_scfg, prompts, max_new=max_new,
+                    sink=sink,
+                ),
+                key=lambda row: row["p99_tick_s"],
             )
             print(
                 f"# share {ratio}: prefill_frac "
@@ -921,12 +975,16 @@ def config12_decode(out: list, obs_path=None) -> None:
                 file=sys.stderr,
             )
         long_len = 256 if on_tpu else 32
-        longmix = bench_chunk_longmix(
-            mesh, cfg,
-            _dc.replace(scfg, max_seq=max(scfg.max_seq, long_len + 32),
-                        n_pages=max(scfg.n_pages, 64)),
-            chunk=scfg.page_size,
-            long_len=long_len,
+        longmix = _median_run(
+            lambda: bench_chunk_longmix(
+                mesh, cfg,
+                _dc.replace(scfg,
+                            max_seq=max(scfg.max_seq, long_len + 32),
+                            n_pages=max(scfg.n_pages, 64)),
+                chunk=scfg.page_size,
+                long_len=long_len,
+            ),
+            key=lambda row: row["p99_ratio"],
         )
         print(
             f"# long-mix p99: mono {longmix['p99_s_mono'] * 1e3:.2f} ms "
@@ -962,12 +1020,19 @@ def config12_decode(out: list, obs_path=None) -> None:
         prompts0 = shared_prefix_prompts(
             scfg.n_slots * 2, length, 0.0, scfg.vocab
         )
-        mono_stream = bench_serve_stream(
-            mesh, cfg, stream_scfg, prompts0, max_new=max_new, sink=sink
+        mono_stream = _median_run(
+            lambda: bench_serve_stream(
+                mesh, cfg, stream_scfg, prompts0, max_new=max_new,
+                sink=sink,
+            ),
+            key=lambda row: row["tokens_per_s"],
         )
-        disagg_stream = bench_serve_stream(
-            mesh, cfg, stream_scfg, prompts0, max_new=max_new,
-            disagg=True, sink=sink,
+        disagg_stream = _median_run(
+            lambda: bench_serve_stream(
+                mesh, cfg, stream_scfg, prompts0, max_new=max_new,
+                disagg=True, sink=sink,
+            ),
+            key=lambda row: row["tokens_per_s"],
         )
         if disagg_stream["outputs"] != mono_stream["outputs"]:
             raise RuntimeError(
@@ -1773,6 +1838,117 @@ def config16_elastic_goodput(out: list) -> None:
         raise RuntimeError("all config-16 workloads failed")
 
 
+def config17_serve_router(out: list) -> None:
+    """Fleet router (ISSUE 14): the canonical multi-tenant arrival mix
+    (``decode_bench.router_mix_setup`` — the one-definition rule)
+    drained through a FleetRouter over N fresh engine replicas, prefix
+    affinity ON then OFF, identical greedy outputs asserted by
+    ``bench_router``'s caller.  The headline is the affinity-on
+    aggregate tokens/s; the gated fields are the cross-replica
+    ``prefill_frac`` (static counters — affinity concentrating tenants
+    must keep it below the affinity-off control), per-class p99 TTFT
+    (direction ``ttft`` lower, judged against the widened
+    ``_NOISE_FLOORS`` band), and the sharing counters
+    (``shared``/``subpage``/``affinity`` higher).  The fleet counter
+    law ``prefill + shared == submitted`` is asserted inside
+    ``bench_router`` on every drain."""
+    import dataclasses as _dc
+
+    import jax
+
+    from tpuscratch.bench.decode_bench import (
+        arrival_mix_requests,
+        bench_router,
+        default_decode_setup,
+        router_mix_setup,
+    )
+    from tpuscratch.runtime.mesh import make_mesh
+    from tpuscratch.serve.router import RouterConfig, SLOClass
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, _batches, _kw = default_decode_setup(on_tpu)
+    setup = router_mix_setup(on_tpu)
+    scfg = _dc.replace(
+        scfg, prefix_share=True,
+        max_seq=max(scfg.max_seq, setup["length"] + setup["max_new"]),
+    )
+    tagged = arrival_mix_requests(
+        setup["mix"], setup["n_requests"], setup["length"], scfg.vocab,
+        max_new=setup["max_new"],
+    )
+    classes = tuple(SLOClass(n, target=t) for n, t in setup["classes"])
+    # median-of-k re-measure (the noise-robust-records satellite): the
+    # rate and TTFT fields are measured k times per arm, interleaved so
+    # machine drift hits both arms alike, and the row is each arm's
+    # median-tokens/s drain — the static counter fields are identical
+    # across repeats (deterministic workload), so picking one WHOLE
+    # drain keeps the row's counters self-consistent
+    runs = {True: [], False: []}
+    for _rep in range(3):
+        for aff in (True, False):
+            runs[aff].append(bench_router(
+                mesh, cfg, scfg, setup["n_replicas"], tagged,
+                rcfg=RouterConfig(affinity=aff, classes=classes),
+            ))
+    outs = {r.pop("outputs") for rs in runs.values() for r in rs}
+    if len(outs) != 1:
+        raise RuntimeError(
+            "config 17: outputs diverged across routing arms/repeats "
+            "— routing changed what was emitted"
+        )
+
+    def by_rate(r):
+        return r["tokens_per_s"]
+
+    on, off = _median_of(runs[True], by_rate), _median_of(runs[False], by_rate)
+    if on["prefill_frac"] > off["prefill_frac"]:
+        # static counters on a deterministic workload: affinity must
+        # concentrate sharing, this is arithmetic, not measurement
+        raise RuntimeError(
+            f"config 17: affinity-on prefill_frac {on['prefill_frac']} "
+            f"above affinity-off {off['prefill_frac']}"
+        )
+    per_class = {}
+    for name, c in sorted(on["classes"].items()):
+        per_class[f"ttft_p99_s_{name}"] = c["ttft_p99_s"]
+        per_class[f"ttft_p50_s_{name}"] = c["ttft_p50_s"]
+        per_class[f"tokens_per_s_{name}"] = c["tokens_per_s"]
+    print(
+        f"# config 17: affinity {on['tokens_per_s']:.3e} tok/s vs "
+        f"{off['tokens_per_s']:.3e} off "
+        f"({on['tokens_per_s'] / off['tokens_per_s']:.3f}x), "
+        f"prefill_frac {on['prefill_frac']:.3f} vs "
+        f"{off['prefill_frac']:.3f}, subpage {on['subpage_tokens']} tok",
+        file=sys.stderr,
+    )
+    _emit(
+        out,
+        config=17,
+        metric="serve_router_tokens_per_s",
+        value=on["tokens_per_s"],
+        tokens_per_s_affinity_off=off["tokens_per_s"],
+        affinity_speedup=on["tokens_per_s"] / off["tokens_per_s"],
+        prefill_frac=on["prefill_frac"],
+        prefill_frac_affinity_off=off["prefill_frac"],
+        shared_tokens=on["shared_tokens"],
+        subpage_tokens=on["subpage_tokens"],
+        affinity_hits=on["affinity_hits"],
+        affinity_tokens=on["affinity_tokens"],
+        replicas=on["replicas"],
+        requests=on["requests"],
+        **per_class,
+        detail=(
+            f"{on['replicas']} replicas, {on['requests']} requests, "
+            f"affinity on/off prefill_frac {on['prefill_frac']:.3f}/"
+            f"{off['prefill_frac']:.3f}, aggregate "
+            f"{on['tokens_per_s']:.3e}/{off['tokens_per_s']:.3e} tok/s, "
+            f"{on['subpage_tokens']} sub-page tokens (not "
+            f"page-quantized), outputs identical"
+        ),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -1790,13 +1966,14 @@ CONFIGS = {
     14: config14_plan_overlap,
     15: config15_solver,
     16: config16_elastic_goodput,
+    17: config17_serve_router,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
-                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16")
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

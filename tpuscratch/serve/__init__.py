@@ -9,10 +9,14 @@ cached single-token decode step numerically equivalent to the full
 forward (decode + ops.attention.decode_attention), deterministic
 per-request sampling (sampling), a continuous-batching engine with
 free-page-watermark admission and zero steady-state recompiles
-(engine; opt-in prefix sharing, chunked prefill, and wave-scheduled
-spill/prefetch with cold hits measured), and a prefill/decode-
+(engine; opt-in prefix sharing — full-page trie plus sub-page
+boundary continuations — chunked prefill, and wave-scheduled
+spill/prefetch with cold hits measured), a prefill/decode-
 disaggregated front end shipping finished KV pages between mesh
-slices through comm/p2p (disagg).
+slices through comm/p2p (disagg), and a fleet router dispatching
+across N engine replicas with prefix-affine load balancing,
+per-tenant SLO classes, and an autoscaled prefill:decode pool
+(router) — greedy output bit-identical under any routing.
 """
 
 from tpuscratch.serve.decode import (  # noqa: F401
@@ -49,6 +53,13 @@ from tpuscratch.serve.kvcache import (  # noqa: F401
     is_quantized_kv_dtype,
     kv_cache_spec,
     quantize_pages,
+)
+from tpuscratch.serve.router import (  # noqa: F401
+    ClassReport,
+    FleetRouter,
+    RouterConfig,
+    RouterReport,
+    SLOClass,
 )
 from tpuscratch.serve.sampling import (  # noqa: F401
     accept_speculative,

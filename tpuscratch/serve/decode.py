@@ -80,7 +80,8 @@ from tpuscratch.obs.metrics import CompileCounter  # noqa: F401,E402
 
 
 def plan_sweep_waves(needs: Sequence[tuple[int, int, frozenset]],
-                     capacity: int) -> list[list[int]]:
+                     capacity: int,
+                     reorder: bool = True) -> list[list[int]]:
     """Partition sweeping slots into WAVES whose page footprints fit
     the device pool together — the tiered-KV sweep scheduler (ISSUE
     13): with a host tier holding more resident context than HBM, one
@@ -91,26 +92,62 @@ def plan_sweep_waves(needs: Sequence[tuple[int, int, frozenset]],
 
     ``needs`` is ``(slot, group, frozenset_of_logical_pages)`` per
     sweeping slot in slot order; ``capacity`` is one group's device
-    page count.  Waves pack first-fit in slot order — deterministic, so
-    a replayed tick partitions identically — counting each group's
-    UNIQUE pages (prefix-shared pages cost their footprint once).  A
-    single slot wider than the pool still gets its own wave: admission
-    guarantees one sequence fits the device pool (``max_seq`` check),
-    so the per-slot need can never exceed ``capacity``."""
-    waves: list[list[int]] = []
-    cur: list[int] = []
-    cur_pages: dict[int, set] = {}
-    for slot, group, pages in needs:
-        have = cur_pages.get(group, set())
-        merged = have | pages
-        if cur and len(merged) > capacity:
+    page count.  Packing counts each group's UNIQUE pages
+    (prefix-shared pages cost their footprint once).  A single slot
+    wider than the pool still gets its own wave: admission guarantees
+    one sequence fits the device pool (``max_seq`` check), so the
+    per-slot need can never exceed ``capacity``.
+
+    ``reorder`` (default, the ISSUE-14 wave-aware batch reordering):
+    each wave is seeded with the first unplaced slot and then GREEDILY
+    grown by the slot sharing the most pages with it (ties: fewest
+    fresh pages added, then lowest slot id) — co-resident slots
+    (prefix-shared chains, parked-and-restored siblings) pack into the
+    same wave instead of being split by slot order, so a tick runs
+    fewer waves and moves fewer H2D/D2H round trips.  Deterministic (a
+    replayed tick partitions identically), and wave composition cannot
+    change any slot's output — each slot's sweep depends only on its
+    own pages and PRNG draws.  ``reorder=False`` is the legacy
+    slot-order first-fit; the engine plans both and ledger-counts the
+    waves the reorder saved.  Waves are returned slot-sorted."""
+    if not reorder:
+        waves: list[list[int]] = []
+        cur: list[int] = []
+        cur_pages: dict[int, set] = {}
+        for slot, group, pages in needs:
+            have = cur_pages.get(group, set())
+            merged = have | pages
+            if cur and len(merged) > capacity:
+                waves.append(cur)
+                cur, cur_pages = [], {}
+                merged = set(pages)
+            cur.append(slot)
+            cur_pages[group] = merged
+        if cur:
             waves.append(cur)
-            cur, cur_pages = [], {}
-            merged = set(pages)
-        cur.append(slot)
-        cur_pages[group] = merged
-    if cur:
-        waves.append(cur)
+        return waves
+    remaining = list(needs)
+    waves = []
+    while remaining:
+        slot, group, pages = remaining.pop(0)
+        cur = [slot]
+        cur_pages = {group: set(pages)}
+        while True:
+            best = None  # (overlap, -added, -idx) maximized
+            for idx, (s, g, pg) in enumerate(remaining):
+                have = cur_pages.get(g, set())
+                merged = have | pg
+                if len(merged) > capacity:
+                    continue
+                key = (len(have & pg), -(len(merged) - len(have)), -idx)
+                if best is None or key > best[0]:
+                    best = (key, idx)
+            if best is None:
+                break
+            s, g, pg = remaining.pop(best[1])
+            cur.append(s)
+            cur_pages[g] = cur_pages.get(g, set()) | pg
+        waves.append(sorted(cur))
     return waves
 
 

@@ -57,6 +57,7 @@ from tpuscratch.serve.engine import (
     ServeConfig,
     ServeEngine,
     _bucket,
+    validate_request,
 )
 from tpuscratch.models.transformer import TransformerConfig
 from tpuscratch.serve.kvcache import (
@@ -217,6 +218,9 @@ class DisaggEngine:
         self._queue: collections.deque[Request] = collections.deque()
         self._handoff: collections.deque[_Staged] = collections.deque()
         self._seen: set[int] = set()
+        # finishes collected by an in-progress tick (the engine's
+        # _finish_buf contract, front-end half — see step())
+        self._finish_buf: list[tuple[int, tuple[int, ...]]] = []
         self._chaos = chaos
         self._retry = handoff_retry
         self._stage_count = 0
@@ -233,6 +237,72 @@ class DisaggEngine:
     def n_staged(self) -> int:
         """Requests prefilled and waiting in the handoff queue."""
         return len(self._handoff)
+
+    @property
+    def stage_prefill_tokens(self) -> int:
+        """Engine-lifetime prompt tokens prefilled on the staging slice
+        — the disagg half of the fleet prefill-counter law (the router
+        sums this with the decode engine's ``prefill_tokens``)."""
+        return self._stage_tokens
+
+    def prefix_match_tokens(self, prompt) -> int:
+        """Router affinity probe (``ServeEngine`` contract): delegates
+        to the decode-side engine's prefix index, which is empty —
+        disagg runs without ``prefix_share`` (staged prefills are
+        monolithic) — so this returns 0 and the router falls back to
+        least-loaded for disagg fleets."""
+        return self.engine.prefix_match_tokens(prompt)
+
+    def take_ttft(self, rid: int):
+        """Pop one finished request's TTFT (stamped when its staged
+        prefill sampled the first token)."""
+        return self.engine.take_ttft(rid)
+
+    def validate(self, req: Request) -> None:
+        """The decode engine's rules plus the staging-pool bound —
+        the front-door contract (``ServeEngine.validate``)."""
+        validate_request(req, self.scfg)
+        self.validate_local(req)
+
+    def validate_local(self, req: Request) -> None:
+        """The replica-specific half: the staging-pool bound (stricter
+        than ``max_seq`` when ``stage_pages`` undercuts the prompt)."""
+        if (self.stage_geom.pages_for(len(req.prompt))
+                > self.stage_geom.n_pages):
+            # would never fit the staging pool: refusing now beats the
+            # silent forever-requeue a too-small pool would otherwise be
+            raise ValueError(
+                f"request {req.rid}: prompt needs "
+                f"{self.stage_geom.pages_for(len(req.prompt))} staging "
+                f"pages, pool holds {self.stage_geom.n_pages}"
+            )
+
+    # the fleet router's quarantine surface, delegated to the decode
+    # engine (where the TTFT stamps and quarantine map live) — except
+    # the queue walk, which must cover the front queue too
+    @property
+    def quarantined(self) -> dict:
+        return self.engine.quarantined
+
+    def quarantine(self, rid: int, reason: str, attempts: int = 1) -> None:
+        self.engine.quarantine(rid, reason, attempts=attempts)
+
+    def take_poison_rid(self):
+        return self.engine.take_poison_rid()
+
+    def is_quarantined(self, rid: int) -> bool:
+        return self.engine.is_quarantined(rid)
+
+    @property
+    def has_buffered_finishes(self) -> bool:
+        return bool(self._finish_buf) or self.engine.has_buffered_finishes
+
+    def drop_queued(self, rid: int) -> bool:
+        for req in list(self._queue):
+            if req.rid == rid:
+                self._queue.remove(req)
+                return True
+        return self.engine.drop_queued(rid)
 
     @property
     def n_queued(self) -> int:
@@ -263,34 +333,18 @@ class DisaggEngine:
 
     # ---- request lifecycle ----------------------------------------------
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request, t0: Optional[float] = None) -> None:
         """Validate and queue for the prefill slice (the decode engine's
-        validation rules, applied before staging)."""
-        if req.max_new < 1:
-            raise ValueError(f"max_new must be >= 1, got {req.max_new}")
-        if req.rid < 0:
-            raise ValueError(f"rid must be >= 0, got {req.rid}")
-        if not req.prompt:
-            raise ValueError("empty prompt")
-        if len(req.prompt) + req.max_new > self.scfg.max_seq:
-            raise ValueError(
-                f"request {req.rid}: prompt {len(req.prompt)} + max_new "
-                f"{req.max_new} exceeds max_seq {self.scfg.max_seq}"
-            )
-        if any(t < 0 or t >= self.scfg.vocab for t in req.prompt):
-            raise ValueError(f"request {req.rid}: token id out of vocab")
-        if (self.stage_geom.pages_for(len(req.prompt))
-                > self.stage_geom.n_pages):
-            # would never fit the staging pool: refusing now beats the
-            # silent forever-requeue a too-small pool would otherwise be
-            raise ValueError(
-                f"request {req.rid}: prompt needs "
-                f"{self.stage_geom.pages_for(len(req.prompt))} staging "
-                f"pages, pool holds {self.stage_geom.n_pages}"
-            )
+        validation rules, applied before staging).  ``t0`` back-dates
+        the TTFT clock (the ``ServeEngine.submit`` contract)."""
+        self.validate(req)
         if req.rid in self._seen:
             raise ValueError(f"request id {req.rid} already used")
         self._seen.add(req.rid)
+        # TTFT clock starts at the FRONT-END submit, not at the later
+        # decode-side admission (the engine's stamp_submit setdefault
+        # keeps this when the request re-enters a degraded handoff)
+        self.engine.stamp_submit(req.rid, t0)
         self._queue.append(req)
 
     def _stage_prefill(self, req: Request) -> Optional[_Staged]:
@@ -336,6 +390,7 @@ class DisaggEngine:
         self._stage_count += 1
         self._stage_tokens += n_tok
         self._stage_s += eng._last_span_s()
+        eng._mark_first_token(req.rid)  # TTFT: first token exists HERE
         return _Staged(req=req, pages=pages, first_token=tok)
 
     def _fresh_stage_kv(self) -> dict:
@@ -484,8 +539,12 @@ class DisaggEngine:
 
     def step(self) -> list[tuple[int, tuple[int, ...]]]:
         """One disaggregated tick: stage what the prefill pool can hold,
-        hand off what the decode pool can seat, decode one sweep."""
-        finished: list[tuple[int, tuple[int, ...]]] = []
+        hand off what the decode pool can seat, decode one sweep.
+        Finishes collect on the ENGINE-side buffer contract
+        (``_tick_inner``'s): a stage-retired ``max_new == 1`` request
+        must survive a raise-through later in the same tick — its
+        token exists nowhere else at that point."""
+        finished = self._finish_buf
         while self._queue:
             staged = self._stage_prefill(self._queue[0])
             if staged is None:
@@ -505,6 +564,7 @@ class DisaggEngine:
                 break
             self._handoff.popleft()
         finished.extend(self.engine.step())
+        self._finish_buf = []
         return finished
 
     def run(self, requests: Sequence[Request] = (),

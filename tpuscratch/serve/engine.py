@@ -195,6 +195,14 @@ class GenerateReport:
     shared_tokens: int = 0
     cow_pages: int = 0          # copy-on-write page copies this drain
     fresh_kv_bytes: float = 0.0  # K/V bytes freshly written this drain
+    # sub-page sharing (ISSUE 14): tokens served from a COPIED boundary
+    # page past the last full-page match — included in shared_tokens,
+    # broken out so the no-longer-page-quantized claim is checkable
+    subpage_tokens: int = 0
+    # per-request time-to-first-token, seconds from submit to the first
+    # sampled token, for requests COMPLETED this drain — the router's
+    # per-class p50/p99 TTFT input (fleet SLO reporting, ISSUE 14)
+    ttft_s: tuple[tuple[int, float], ...] = ()
     # tiered-KV accounting (zero with kv_host_pages=0): page-granular
     # host↔device traffic — STATIC counts (exact page moves x the
     # pool's exact per-page bytes, obs.ledger.kv_host_traffic_bytes),
@@ -245,6 +253,26 @@ _MAX_SPANS = 1024
 #: a retry loop
 DEFAULT_SPILL_RETRY = RetryPolicy(max_attempts=3, base_s=0.005, max_s=0.05,
                                   retryable=(HostTierError,))
+
+
+def validate_request(req: Request, scfg: ServeConfig) -> None:
+    """The admission-independent request rules — ONE definition,
+    enforced at every front door (``ServeEngine.submit``,
+    ``DisaggEngine.submit``, ``FleetRouter.submit``), so a malformed
+    request fails at submission, never mid-dispatch."""
+    if req.max_new < 1:
+        raise ValueError(f"max_new must be >= 1, got {req.max_new}")
+    if req.rid < 0:
+        raise ValueError(f"rid must be >= 0, got {req.rid}")
+    if not req.prompt:
+        raise ValueError("empty prompt")
+    if len(req.prompt) + req.max_new > scfg.max_seq:
+        raise ValueError(
+            f"request {req.rid}: prompt {len(req.prompt)} + max_new "
+            f"{req.max_new} exceeds max_seq {scfg.max_seq}"
+        )
+    if any(t < 0 or t >= scfg.vocab for t in req.prompt):
+        raise ValueError(f"request {req.rid}: token id out of vocab")
 
 
 def init_embed(seed: int, vocab: int, d_model: int) -> jax.Array:
@@ -394,6 +422,14 @@ class ServeEngine:
         self._seen_rids: set[int] = set()
         self._chaos = chaos  # ft.ChaosPlan or None: "serve/prefill" site
         self._quarantined: dict[int, str] = {}  # rid -> last error
+        # rid whose ADMISSION raised through the last tick (the
+        # retry_budget == 0 raise-through contract) — _recover_cache
+        # requeues every in-flight request ahead of it, so the queue
+        # head does NOT name the poison; this does.  Cleared each tick;
+        # the fleet router reads it to quarantine the right request.
+        self._poison_rid: Optional[int] = None
+        # finishes collected by an in-progress tick (see _tick_inner)
+        self._finish_buf: list[tuple[int, tuple[int, ...]]] = []
         self._seed_key = jax.random.key(scfg.seed)
         self.recorder = (
             recorder if recorder is not None else FlightRecorder()
@@ -465,6 +501,11 @@ class ServeEngine:
         self._shared_tokens = 0
         self._fresh_tokens = 0   # tokens whose K/V this engine wrote
         self._cow_pages = 0
+        self._subpage_tokens = 0
+        # TTFT bookkeeping (ISSUE 14): submit stamps arrival, the first
+        # sampled token stamps delivery; the router drains via take_ttft
+        self._submit_t: dict[int, float] = {}
+        self._ttft: dict[int, float] = {}
 
     # ---- introspection (tests + report) --------------------------------
 
@@ -545,6 +586,53 @@ class ServeEngine:
         return self._cow_pages
 
     @property
+    def subpage_tokens(self) -> int:
+        """Engine-lifetime tokens served from COPIED boundary pages
+        past the last full-page match (sub-page sharing, ISSUE 14) —
+        a subset of ``shared_tokens``."""
+        return self._subpage_tokens
+
+    def _mark_first_token(self, rid: int) -> None:
+        """Stamp TTFT at the FIRST sampled token (idempotent: a replay
+        after recovery, or the decode-side re-admission of a staged
+        request, keeps the original stamp)."""
+        t0 = self._submit_t.pop(rid, None)
+        if rid not in self._ttft:
+            self._ttft[rid] = (
+                time.perf_counter() - t0 if t0 is not None else 0.0
+            )
+            if len(self._ttft) > 4096:
+                # bounded for step()-driven serving loops that never
+                # read TTFT (run() pops at report, the router pops per
+                # finish): oldest stamps age out, never accumulate
+                self._ttft.pop(next(iter(self._ttft)))
+
+    def take_ttft(self, rid: int) -> Optional[float]:
+        """Pop one finished request's time-to-first-token (seconds from
+        submit to first sampled token); None when never stamped.  The
+        fleet router reads per-request TTFT here as requests finish —
+        rids it consumed no longer appear in ``GenerateReport.ttft_s``."""
+        return self._ttft.pop(rid, None)
+
+    def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
+        """Longest prefix (in TOKENS) of ``prompt`` this engine's
+        prefix index can serve from registered pages: the full-page
+        trie chain plus the sub-page boundary continuation.  Zero
+        without ``prefix_share`` — the router's fleet-level affinity
+        index reads this per replica (ISSUE 14)."""
+        if self._tries is None:
+            return 0
+        best = 0
+        for g, trie in enumerate(self._tries):
+            alloc = self._allocators[g]
+            m = len(trie.match(prompt))
+            _, n_sub = trie.match_tail(
+                prompt, m, prefer=lambda p: alloc.refcount(p) > 0
+            )
+            best = max(best, m * self.scfg.page_size + n_sub)
+        return best
+
+    @property
     def fresh_kv_bytes(self) -> float:
         """Engine-lifetime K/V bytes freshly written into the pool
         (prefilled prompt tokens + generated tokens, at this pool's
@@ -562,6 +650,69 @@ class ServeEngine:
     @property
     def n_queued(self) -> int:
         return len(self._queue)
+
+    def validate(self, req: Request) -> None:
+        """Would :meth:`submit` accept ``req``?  Raises the engine's
+        rejection otherwise — the stateless half of admission (rid
+        reuse stays submit's job), so a front end (the fleet router)
+        can enforce EVERY replica's rules at its own door instead of
+        raising out of a later dispatch."""
+        validate_request(req, self.scfg)
+        self.validate_local(req)
+
+    def validate_local(self, req: Request) -> None:
+        """The replica-SPECIFIC half of :meth:`validate`: rules that
+        can differ between output-compatible replicas (none here; the
+        disagg front end adds its staging-pool bound).  A fleet front
+        end runs the common ``validate_request`` once and this per
+        replica — N prompts scans would otherwise be N-for-1 work."""
+
+    def stamp_submit(self, rid: int, t0: Optional[float] = None) -> None:
+        """Start ``rid``'s TTFT clock without queueing — the disagg
+        front end stamps arrival here before staging; ``t0`` back-dates
+        to an earlier arrival (idempotent: the first stamp wins)."""
+        self._submit_t.setdefault(
+            rid, time.perf_counter() if t0 is None else t0
+        )
+
+    def take_poison_rid(self) -> Optional[int]:
+        """Pop the rid whose admission raised through the last tick
+        (None when the raise was not attributable to one request) —
+        the fleet router's quarantine handle."""
+        rid, self._poison_rid = self._poison_rid, None
+        return rid
+
+    def drop_queued(self, rid: int) -> bool:
+        """Remove ``rid`` from the request queue (True when found) —
+        how a front end retracts a request the engine requeued under
+        the raise-through contract."""
+        for req in list(self._queue):
+            if req.rid == rid:
+                self._queue.remove(req)
+                return True
+        return False
+
+    @property
+    def has_buffered_finishes(self) -> bool:
+        """True when a raise-through tick parked finishes that the
+        next tick will emit (see ``_tick_inner``)."""
+        return bool(self._finish_buf)
+
+    def is_quarantined(self, rid: int) -> bool:
+        """Membership check without the ``quarantined`` property's
+        dict copy — the router probes every in-flight rid per tick."""
+        return rid in self._quarantined
+
+    def quarantine(self, rid: int, reason: str, attempts: int = 1) -> None:
+        """Mark ``rid`` quarantined — reported, never requeued — the
+        ONE owner of the bookkeeping (quarantine map, TTFT stamp drop,
+        counter, sink event), shared by the in-engine retry path and
+        the fleet router's raise-through handling."""
+        self._quarantined[rid] = reason
+        self._submit_t.pop(rid, None)
+        self.metrics.counter("serve/quarantined").inc()
+        self.sink.emit("ft/quarantine", rid=rid, attempts=attempts,
+                       error=reason)
 
     @property
     def quarantined(self) -> dict[int, str]:
@@ -746,7 +897,19 @@ class ServeEngine:
                     if alloc.refcount(lp) > 1:
                         front.add(("cow", s, first + i))
             needs.append((s, self._group_of(s), frozenset(front)))
-        return plan_sweep_waves(needs, self.scfg.n_pages)
+        waves = plan_sweep_waves(needs, self.scfg.n_pages)
+        self.metrics.counter("serve/sweep_waves").inc(len(waves))
+        if len(waves) > 1:
+            # ledger the waves the affinity reorder saved over legacy
+            # slot-order first-fit (ISSUE 14); a single wave can never
+            # be beaten, so the baseline plan is skipped there
+            base = plan_sweep_waves(needs, self.scfg.n_pages,
+                                    reorder=False)
+            if len(base) > len(waves):
+                self.metrics.counter("serve/waves_saved").inc(
+                    len(base) - len(waves)
+                )
+        return waves
 
     def _stage_wave(self, slots: list, k_of, best_effort: bool = False,
                     hold: tuple = ()) -> int:
@@ -898,26 +1061,18 @@ class ServeEngine:
 
     # ---- request lifecycle ---------------------------------------------
 
-    def submit(self, req: Request) -> None:
-        if req.max_new < 1:
-            raise ValueError(f"max_new must be >= 1, got {req.max_new}")
-        if req.rid < 0:
-            raise ValueError(f"rid must be >= 0, got {req.rid}")
-        if not req.prompt:
-            raise ValueError("empty prompt")
-        if len(req.prompt) + req.max_new > self.scfg.max_seq:
-            raise ValueError(
-                f"request {req.rid}: prompt {len(req.prompt)} + max_new "
-                f"{req.max_new} exceeds max_seq {self.scfg.max_seq}"
-            )
-        if any(t < 0 or t >= self.scfg.vocab for t in req.prompt):
-            raise ValueError(f"request {req.rid}: token id out of vocab")
+    def submit(self, req: Request, t0: Optional[float] = None) -> None:
+        """Queue ``req``.  ``t0`` back-dates the TTFT clock to an
+        earlier arrival stamp (the fleet router passes its own submit
+        time so queue-held wall never looks free)."""
+        self.validate(req)
         if req.rid in self._seen_rids:
             # rids key the PRNG streams AND the report's outputs map — a
             # reuse would silently drop one output and sample identical
             # token streams for both
             raise ValueError(f"request id {req.rid} already used")
         self._seen_rids.add(req.rid)
+        self.stamp_submit(req.rid, t0)
         self._queue.append(req)
 
     def admit_prefilled(self, req: Request, slot: int, pages: list[int],
@@ -944,6 +1099,7 @@ class ServeEngine:
             )
         self._seen_rids.add(req.rid)
         self._tokens_generated += 1
+        self._mark_first_token(req.rid)
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=list(pages),
             n_cached=len(req.prompt), max_new=req.max_new,
@@ -1120,6 +1276,7 @@ class ServeEngine:
                 self._allocators[group].free(pages)
                 self._queue.appendleft(req)
                 self._recover_cache()
+                self._poison_rid = req.rid
                 raise
         else:
             tok = None
@@ -1137,11 +1294,10 @@ class ServeEngine:
                     self._recover_cache()
                     if a + 1 >= attempts:
                         self._allocators[group].free(pages)
-                        reason = f"{type(exc).__name__}: {exc}"
-                        self._quarantined[req.rid] = reason
-                        self.metrics.counter("serve/quarantined").inc()
-                        self.sink.emit("ft/quarantine", rid=req.rid,
-                                       attempts=attempts, error=reason)
+                        self.quarantine(
+                            req.rid, f"{type(exc).__name__}: {exc}",
+                            attempts=attempts,
+                        )
                         return False
                     if self.sink.enabled:
                         self.sink.emit("ft/prefill_retry", rid=req.rid,
@@ -1150,6 +1306,7 @@ class ServeEngine:
         self._prefill_s += self._last_span_s()
         self._prefill_count += 1
         self._tokens_generated += 1
+        self._mark_first_token(req.rid)
         self._prefill_tokens += n_tok
         self._fresh_tokens += n_tok
         self._slots[slot] = _Slot(
@@ -1190,6 +1347,7 @@ class ServeEngine:
                                        op="serve/prefill")
             except Exception:
                 self._queue.appendleft(req)
+                self._poison_rid = req.rid
                 raise
         n_tok = len(req.prompt)
         shared, full_aligned, need, _resident = self._share_plan(req, group)
@@ -1211,6 +1369,8 @@ class ServeEngine:
         else:
             pages = shared + priv
             n_cached = len(shared) * geom.page_size
+            n_cached += self._subpage_attach(req, group, len(shared),
+                                             priv[0])
         self._shared_tokens += n_cached
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_cached,
@@ -1318,6 +1478,7 @@ class ServeEngine:
         else:
             pages = chain + priv
             n_cached = m * geom.page_size
+            n_cached += self._subpage_attach(req, group, m, priv[0])
         alloc.touch(pages)
         self._shared_tokens += n_cached
         self._slots[slot] = _Slot(
@@ -1331,6 +1492,59 @@ class ServeEngine:
                    and self._slots[slot].pending):
                 self._ctx_step([slot], finished)
         return True
+
+    def _subpage_attach(self, req: Request, group: int, m: int,
+                        target: int) -> int:
+        """Sub-page (token-granular) sharing at the admission boundary
+        (ISSUE 14, the PR-8 remainder): a prompt whose match ends
+        MID-page copies the donor's boundary page into the admission's
+        own first private page ``target`` at the token frontier — the
+        full pages stay refcount-shared, the boundary tokens arrive by
+        copy — so sharing is no longer quantized to ``page_size``.
+        Returns the tokens attached (0 when no registered donor
+        continues the ``m``-page match); the caller extends
+        ``n_cached`` by it and the context program prefills only the
+        remainder.
+
+        The donor page is COPIED, never refcounted: the donor keeps
+        writing its own page (its write frontier lives there) and the
+        admission owns the copy outright, so no copy-on-write guard is
+        ever needed on either side.  K/V at position ``j`` depends
+        only on tokens ``[0, j]``, which donor and sharer agree on up
+        to the frontier; entries past it are stale donor state that
+        the length masks hide and this request's own writes — which
+        start exactly at the frontier — overwrite (on the quantized
+        rungs the first write also zeroes-past-offset and requantizes:
+        the chunked-prefill write contract).  Capped at ``n_tok - 1``
+        total shared tokens so the tail always re-scores at least one
+        position for its logits."""
+        if self._tries is None:
+            return 0
+        alloc = self._allocators[group]
+        donor, n_sub = self._tries[group].match_tail(
+            req.prompt, m, prefer=lambda p: alloc.refcount(p) > 0
+        )
+        n_sub = min(n_sub,
+                    len(req.prompt) - 1 - m * self.geom.page_size)
+        if donor is None or n_sub <= 0 or alloc.refcount(donor) < 1:
+            return 0
+        if self._tiered:
+            try:
+                self._tier_op(
+                    group,
+                    lambda: alloc.ensure_resident([donor, target]),
+                )
+            except HostTierError:
+                return 0  # no device room: prefill the boundary instead
+        self._copy_page(group, self._page_dev(group, donor),
+                        self._page_dev(group, target))
+        if self._tiered:
+            alloc.mark_written([target])
+            alloc.touch([donor, target])
+        self._cow_pages += 1
+        self._subpage_tokens += n_sub
+        self.metrics.counter("serve/subpage_tokens").inc(n_sub)
+        return n_sub
 
     def _ensure_private(self, slot: int, page_index: int) -> None:
         """Copy-on-write guard on the write paths: a slot about to
@@ -1500,6 +1714,7 @@ class ServeEngine:
             st.last_token = tok
             st.generated = [tok]
             self._tokens_generated += 1
+            self._mark_first_token(st.rid)
             if self._tries is not None:
                 self._tries[self._group_of(s)].insert(st.prompt, st.pages)
             if len(st.generated) >= st.max_new:
@@ -1523,6 +1738,7 @@ class ServeEngine:
         (tick latency, queue depth, free-page watermark, insert/evict
         counts, compile counts) and emits one sink event."""
         t0 = time.perf_counter()
+        self._poison_rid = None
         prefills0 = self._prefill_count
         tokens0 = self._tokens_generated
         accepted0 = self._spec_accepted
@@ -1586,7 +1802,13 @@ class ServeEngine:
             )
 
     def _tick_inner(self) -> list[tuple[int, tuple[int, ...]]]:
-        finished = []
+        # collected finishes live on the ENGINE until the tick returns:
+        # an admission that raises through mid-tick (retry_budget == 0)
+        # must not lose requests evicted earlier in the same tick —
+        # they were already freed from their slots, so the buffer is
+        # the only place their tokens exist, and they re-emerge from
+        # the next successful tick instead of vanishing
+        finished = self._finish_buf
         if self._tiered:
             # advance the LRU clock and re-pin the hot window (each
             # live slot's write-frontier tail) before anything can spill
@@ -1633,6 +1855,7 @@ class ServeEngine:
                 self._decode_tick(active, finished)
         if self._tiered:
             self._prefetch_next_tick()
+        self._finish_buf = []
         return finished
 
     def _prefetch_next_tick(self) -> None:
@@ -1873,6 +2096,7 @@ class ServeEngine:
         fresh0, cow0 = self._fresh_tokens, self._cow_pages
         spill0, pref0 = self.host_spilled_pages, self.host_prefetched_pages
         cold0 = self._cold_hits
+        sub0 = self._subpage_tokens
         quarantined0 = set(self._quarantined)
         for r in requests:
             self.submit(r)
@@ -1893,7 +2117,7 @@ class ServeEngine:
                               tuple(sorted(set(self._quarantined)
                                            - quarantined0)),
                               ptok0, stok0, fresh0, cow0,
-                              spill0, pref0, cold0)
+                              spill0, pref0, cold0, sub0=sub0)
         self.sink.emit(
             "serve/report",
             completed=report.completed,
@@ -1925,10 +2149,19 @@ class ServeEngine:
     def _report(self, outputs, tokens0, decode0, prefill0, prefill_s0,
                 decode_s0, slot0=0, drafted0=0, accepted0=0,
                 quarantined=(), ptok0=0, stok0=0, fresh0=0,
-                cow0=0, spill0=0, pref0=0, cold0=0) -> GenerateReport:
+                cow0=0, spill0=0, pref0=0, cold0=0,
+                sub0=0) -> GenerateReport:
         spilled = self.host_spilled_pages - spill0
         prefetched = self.host_prefetched_pages - pref0
+        # per-request TTFT for requests completed this drain (rids the
+        # router already consumed via take_ttft no longer appear)
+        ttft = tuple(
+            (rid, self._ttft.pop(rid))
+            for rid in sorted(outputs) if rid in self._ttft
+        )
         return GenerateReport(
+            subpage_tokens=self._subpage_tokens - sub0,
+            ttft_s=ttft,
             spilled_pages=spilled,
             prefetched_pages=prefetched,
             cold_hits=self._cold_hits - cold0,

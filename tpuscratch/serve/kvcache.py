@@ -323,6 +323,20 @@ class PrefixCache:
     land on the oldest live copy, and when that copy's owner dies the
     next alternate takes over instead of the whole chain vanishing
     while an equivalent live copy exists.
+
+    **Sub-page (token-granular) continuations** (ISSUE 14, the PR-8
+    remainder): beside the full-block map, every registered block —
+    full or partial-tail — is ALSO indexed as a continuation of the
+    aligned prefix BEFORE it: key ``prompt[:b * page_size]`` -> list of
+    ``(block_tokens, page)``.  :meth:`match_tail` then extends an
+    aligned match past its last page boundary: the longest common
+    prefix between a registered block's tokens and the request's
+    remaining prompt names how many tokens of that donor page are
+    valid K/V for the request (K/V at position ``j`` depends only on
+    tokens ``[0, j]``, which agree by construction).  The engine
+    copy-on-writes the donor page into the admission's own boundary
+    page at the token frontier, so affinity/sharing wins are no longer
+    quantized to ``page_size``.
     """
 
     def __init__(self, page_size: int):
@@ -331,6 +345,9 @@ class PrefixCache:
         self.page_size = page_size
         self._map: dict[tuple, list[int]] = {}  # prefix -> live copies
         self._rev: dict[int, set[tuple]] = {}   # page id -> its keys
+        # sub-page continuation index: aligned key -> [(block, page)]
+        self._tails: dict[tuple, list[tuple[tuple, int]]] = {}
+        self._rev_tails: dict[int, set[tuple]] = {}  # page -> tail keys
 
     @property
     def n_blocks(self) -> int:
@@ -361,16 +378,59 @@ class PrefixCache:
 
     def insert(self, prompt: Iterable[int], pages: Iterable[int]) -> None:
         """Register ``prompt``'s full-page blocks against the pages that
-        hold them (``pages`` in sequence order, one per full block;
-        extra tail entries ignored).  A key that already indexes other
-        copies gains an alternate; matches keep landing on the oldest."""
+        hold them (``pages`` in sequence order, one per full block, plus
+        the partial-tail page when the prompt ends mid-page; further
+        entries ignored).  A key that already indexes other copies gains
+        an alternate; matches keep landing on the oldest.  Every block —
+        the partial tail included — is also registered as a sub-page
+        CONTINUATION of the aligned prefix before it (see
+        :meth:`match_tail`)."""
         prompt, pages = tuple(prompt), list(pages)
-        for blk, page in zip(range(len(prompt) // self.page_size), pages):
-            key = prompt[: (blk + 1) * self.page_size]
+        ps = self.page_size
+        for blk, page in zip(range(len(prompt) // ps), pages):
+            key = prompt[: (blk + 1) * ps]
             alts = self._map.setdefault(key, [])
             if page not in alts:
                 alts.append(page)
                 self._rev.setdefault(page, set()).add(key)
+            self._insert_tail(prompt[: blk * ps], key[blk * ps:], page)
+        nb, rem = divmod(len(prompt), ps)
+        if rem and nb < len(pages):
+            # the partial last block: matchable only token-granularly
+            self._insert_tail(prompt[: nb * ps], prompt[nb * ps:],
+                              pages[nb])
+
+    def _insert_tail(self, key: tuple, block: tuple, page: int) -> None:
+        alts = self._tails.setdefault(key, [])
+        if (block, page) not in alts:
+            alts.append((block, page))
+            self._rev_tails.setdefault(page, set()).add(key)
+
+    def match_tail(self, prompt: Iterable[int], matched_pages: int,
+                   prefer: Optional[Callable[[int], bool]] = None,
+                   ) -> tuple[Optional[int], int]:
+        """``(page, n_tokens)`` of the best sub-page continuation past
+        an aligned match of ``matched_pages`` full pages: the donor
+        page whose registered block shares the longest (>= 1) token
+        prefix with the prompt's remainder.  ``prefer`` filters donors
+        (the engine passes "is live" — a sub-page donor is COPIED, not
+        refcounted, so it must be readable right now); ``(None, 0)``
+        when nothing continues the match."""
+        prompt = tuple(prompt)
+        key = prompt[: matched_pages * self.page_size]
+        rest = prompt[matched_pages * self.page_size:]
+        best_page, best_n = None, 0
+        for block, page in self._tails.get(key, ()):
+            if prefer is not None and not prefer(page):
+                continue
+            n = 0
+            for a, b in zip(block, rest):
+                if a != b:
+                    break
+                n += 1
+            if n > best_n:
+                best_page, best_n = page, n
+        return best_page, best_n
 
     def registered(self, page: int) -> bool:
         """True when ``page`` indexes at least one prefix block — the
@@ -381,7 +441,8 @@ class PrefixCache:
     def drop(self, pages: Iterable[int]) -> None:
         """Forget every mapping onto ``pages`` — called with the
         allocator's released list, so dead pages cannot be matched;
-        keys with surviving alternate copies stay matchable."""
+        keys with surviving alternate copies stay matchable.  Sub-page
+        continuation entries die with their page the same way."""
         for p in pages:
             for key in self._rev.pop(p, ()):
                 alts = self._map.get(key)
@@ -391,12 +452,21 @@ class PrefixCache:
                     alts.remove(p)
                 if not alts:
                     del self._map[key]
+            for key in self._rev_tails.pop(p, ()):
+                alts = self._tails.get(key)
+                if alts is None:
+                    continue
+                alts[:] = [bp for bp in alts if bp[1] != p]
+                if not alts:
+                    del self._tails[key]
 
     def clear(self) -> None:
         """Forget everything — the engine's cache-recovery path (a reset
         pool holds no valid K/V, so no prefix may be matched)."""
         self._map.clear()
         self._rev.clear()
+        self._tails.clear()
+        self._rev_tails.clear()
 
 
 # ---- the host paging tier (ISSUE 13) -------------------------------------

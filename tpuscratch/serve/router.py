@@ -1,0 +1,693 @@
+"""Fleet router: prefix-affine load balancing, per-tenant SLO classes,
+and an autoscaled prefill:decode pool over N engine replicas.
+
+Everything below one engine is built (paged int8/fp8 KV, fused paged
+attention, speculative decode, prefix sharing, chunked prefill,
+prefill/decode disaggregation, tiered host KV); "millions of users"
+means **many** engines, and without a front end every replica is an
+island — a request that lands on the wrong replica re-prefills a
+prefix another replica already holds, and nothing trades TTFT against
+tokens/s per tenant.  :class:`FleetRouter` owns the fleet-level queue
+and dispatches across N :class:`~tpuscratch.serve.engine.ServeEngine`
+/ :class:`~tpuscratch.serve.disagg.DisaggEngine` replicas, four layers
+deep:
+
+1. **prefix-affine routing** — the SOSP '23 paged-KV sharing argument
+   applied ACROSS replicas: the router keeps a fleet-level prefix
+   index (page-aligned prompt-prefix blocks -> the replicas routed
+   requests with that prefix, PLUS each replica's live
+   ``PrefixCache``/parked-chain state read through
+   ``prefix_match_tokens``) and sends each request to the replica
+   holding its longest matched prefix, falling back to least-loaded.
+   Static counters prove the savings: over a fault-free drain, fleet
+   ``prefill_tokens + shared_tokens == submitted prompt tokens``, and
+   ``prefill_frac`` drops monotonically as affinity concentrates
+   tenants (``RouterReport``).
+2. **per-tenant SLO classes** — requests carry a tenant/class tag
+   (:class:`SLOClass`): TTFT-target classes prefer chunked-prefill
+   replicas (admission never monopolizes a tick), throughput classes
+   prefer resident (unchunked) scheduling, and ``max_queue`` bounds a
+   class's in-flight depth per replica — the backpressure knob.  The
+   engines stamp per-request TTFT (``GenerateReport.ttft_s`` /
+   ``take_ttft``), so the router reports per-class p50/p99 TTFT and
+   tokens/s — the MegaScale goodput-accounting discipline applied to
+   fleet scheduling.
+3. **autoscaled prefill:decode ratio** (disagg fleets) — replicas
+   re-role between the PREFILL pool (accepts new dispatches) and the
+   DECODE pool (drains only) from the staged-handoff backlog: a deep
+   backlog means decode is the bottleneck, so the router shrinks the
+   prefill pool; a dry one grows it back.  Hysteresis (two
+   thresholds + a cooldown) keeps re-roling from thrashing, and the
+   prefill pool never empties.
+4. **sub-page sharing** rides below (serve/engine ``_subpage_attach``):
+   a matched prefix ending mid-page shares its full pages and copies
+   the boundary page at the token frontier, so affinity wins are no
+   longer quantized to ``page_size``.
+
+House invariant: greedy output is BIT-identical under any routing —
+1 replica or N, affinity on or off, any re-roling schedule — because a
+request's stream depends only on ``(seed, rid, prompt)``: sampling
+keys are ``request_key(seed, rid, position)`` draws and every engine
+path (share/spec/chunk/disagg/tiered, fp32/int8/fp8) is test-gated
+batch-composition-independent.  Routing moves WHERE work runs and
+WHAT is recomputed, never what is emitted (tests/test_serve_router.py,
+marker ``router``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Optional, Sequence, Union
+
+from tpuscratch.obs.metrics import percentile
+from tpuscratch.serve.disagg import DisaggEngine
+from tpuscratch.serve.engine import Request, ServeEngine
+
+
+def _percentile(xs: Sequence[float], q: float) -> float:
+    """``obs.metrics.percentile`` (the ONE nearest-rank
+    implementation), tolerating an empty drain (0 completions in a
+    class) as 0.0 instead of raising."""
+    return percentile(xs, q) if xs else 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """One tenant/traffic class and its service objective.
+
+    ``target="ttft"`` maps the class to chunked-prefill admission
+    (replicas with ``chunk_prefill > 0`` preferred — a long admission
+    never monopolizes a tick, bounding time-to-first-token);
+    ``target="throughput"`` maps it to resident scheduling (unchunked
+    replicas preferred — no per-chunk overhead on the prefill path).
+    On a homogeneous fleet the preference is vacuous and every replica
+    is a candidate.  ``max_queue`` bounds the class's dispatched-but-
+    unfinished depth PER replica (0 = unbounded): when every candidate
+    is at the bound the request holds in the router queue — per-class
+    backpressure instead of unbounded replica queues."""
+
+    name: str
+    target: str = "throughput"   # "ttft" | "throughput"
+    max_queue: int = 0           # per-replica in-flight bound, 0 = off
+
+    def __post_init__(self):
+        if self.target not in ("ttft", "throughput"):
+            raise ValueError(
+                f"SLO target {self.target!r} not in ('ttft', 'throughput')"
+            )
+        if self.max_queue < 0:
+            raise ValueError(
+                f"max_queue must be >= 0, got {self.max_queue}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterConfig:
+    """Fleet knobs (the engines themselves come from ``ServeConfig``)."""
+
+    affinity: bool = True        # prefix-affine routing (else least-loaded)
+    classes: tuple[SLOClass, ...] = (SLOClass("default"),)
+    # disagg-fleet autoscaling: re-role replicas between the prefill
+    # and decode pools from the staged-handoff backlog (requests
+    # prefilled but waiting for decode slots).  Backlog per prefill
+    # replica > scale_down_backlog: decode is the bottleneck — move a
+    # prefill replica to the decode pool; < scale_up_backlog: prefill
+    # is starving decode — move one back.  The gap between the two
+    # thresholds plus cooldown_ticks is the hysteresis band that keeps
+    # re-roling from thrashing; the prefill pool never drops below one
+    # replica.
+    autoscale: bool = False
+    scale_down_backlog: float = 4.0   # staged per prefill replica, upper
+    scale_up_backlog: float = 1.0     # staged per prefill replica, lower
+    cooldown_ticks: int = 4
+    # fleet prefix-index size bound (page-aligned block keys); oldest
+    # entries evict first — staleness only costs a routing choice,
+    # never correctness
+    index_cap: int = 4096
+
+    def __post_init__(self):
+        if not self.classes:
+            raise ValueError("RouterConfig needs at least one SLOClass")
+        names = [c.name for c in self.classes]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO class names: {names}")
+        if self.scale_up_backlog >= self.scale_down_backlog:
+            raise ValueError(
+                "hysteresis band inverted: scale_up_backlog "
+                f"{self.scale_up_backlog} must be < scale_down_backlog "
+                f"{self.scale_down_backlog}"
+            )
+        if self.cooldown_ticks < 0:
+            raise ValueError(
+                f"cooldown_ticks must be >= 0, got {self.cooldown_ticks}"
+            )
+        if self.index_cap < 1:
+            raise ValueError(f"index_cap must be >= 1, got {self.index_cap}")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassReport:
+    """One SLO class's drain: completion, TTFT tail, token rate."""
+
+    name: str
+    completed: int
+    tokens: int
+    ttft_p50_s: float
+    ttft_p99_s: float
+    tokens_per_s: float
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterReport:
+    """What a fleet drain produced — ``GenerateReport``'s router twin.
+
+    The static sharing proof holds fleet-wide on a fault-free drain:
+    ``prefill_tokens + shared_tokens == submitted_prompt_tokens`` —
+    every submitted prompt token was either COMPUTED through some
+    replica's prefill program or SERVED from a shared page — so
+    ``prefill_frac`` dropping under affinity is arithmetic, not a
+    measurement.  (A disagg handoff that degrades to a local re-prefill
+    double-counts its prompt; chaos-free drains reconcile exactly.)"""
+
+    completed: int
+    tokens_generated: int
+    wall_s: float
+    tokens_per_s: float                  # aggregate, fleet-wide
+    outputs: tuple[tuple[int, tuple[int, ...]], ...]
+    classes: tuple[ClassReport, ...]
+    # the fleet prefill-counter law's three legs
+    prefill_tokens: int
+    shared_tokens: int
+    submitted_prompt_tokens: int
+    subpage_tokens: int = 0
+    # routing accounting
+    affinity_hits: int = 0       # dispatches that followed a prefix match
+    affinity_tokens: int = 0     # matched prefix tokens at dispatch time
+    backpressure_holds: int = 0  # dispatch attempts held by max_queue
+    reroles: int = 0             # prefill<->decode pool moves
+    dispatched: tuple[int, ...] = ()  # requests per replica
+
+    @property
+    def prefill_frac(self) -> float:
+        """Fraction of submitted prompt tokens actually prefilled —
+        the quantity cross-replica affinity exists to shrink."""
+        total = self.prefill_tokens + self.shared_tokens
+        return self.prefill_tokens / total if total else 1.0
+
+    @property
+    def shared_frac(self) -> float:
+        total = self.prefill_tokens + self.shared_tokens
+        return self.shared_tokens / total if total else 0.0
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One routed-but-not-yet-dispatched request.  ``t0`` is the
+    ROUTER-submit wall stamp: the TTFT clock starts here, so time held
+    in the router queue (backpressure, candidate filtering) counts
+    toward the per-class TTFT the router reports — ``max_queue`` must
+    never look free in the SLO report."""
+
+    cls: str
+    req: Request
+    t0: float = 0.0
+
+
+class FleetRouter:
+    """Front end over N engine replicas: ``submit`` tags and queues,
+    ``step`` dispatches + ticks every replica, ``run`` drains.
+
+    Replicas must be output-compatible — same sampling seed, vocab,
+    ``max_seq``, temperature/top-k, page size, and KV dtype — so a
+    request emits the SAME tokens wherever it lands (checked at
+    construction; scheduling knobs like ``n_slots``/``chunk_prefill``
+    may differ per replica, and a heterogeneous chunked/unchunked mix
+    is exactly how the SLO classes get their two admission paths).
+    Every replica steps every tick (a decode-pool replica keeps
+    draining); only DISPATCH is role-gated."""
+
+    def __init__(self, replicas: Sequence[Union[ServeEngine, DisaggEngine]],
+                 rcfg: Optional[RouterConfig] = None):
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = list(replicas)
+        self.rcfg = rcfg or RouterConfig()
+        ref = self._scfg(self.replicas[0])
+        for r in self.replicas[1:]:
+            sc = self._scfg(r)
+            for f in ("seed", "vocab", "max_seq", "temperature", "top_k",
+                      "page_size", "kv_dtype"):
+                if getattr(sc, f) != getattr(ref, f):
+                    raise ValueError(
+                        f"replica ServeConfig.{f} mismatch "
+                        f"({getattr(sc, f)!r} != {getattr(ref, f)!r}): "
+                        "outputs would depend on routing"
+                    )
+        self._page = ref.page_size
+        self._classes = {c.name: c for c in self.rcfg.classes}
+        self._disagg = all(isinstance(r, DisaggEngine)
+                           for r in self.replicas)
+        if self.rcfg.autoscale and not self._disagg:
+            raise ValueError(
+                "autoscale re-roles prefill:decode pools — every "
+                "replica must be a DisaggEngine"
+            )
+        self._queue: collections.deque[_Pending] = collections.deque()
+        self._class_of: dict[int, str] = {}      # rid -> class name
+        self._replica_of: dict[int, int] = {}    # rid -> replica index
+        self._inflight: set[int] = set()         # dispatched, unfinished
+        self._seen: set[int] = set()
+        # per-(replica, class) dispatched-but-unfinished depth — the
+        # backpressure quantity max_queue bounds
+        self._depth: dict[tuple[int, str], int] = {}
+        # fleet prefix index: (aligned_len, rolling_hash) block key ->
+        # replica ids in registration order (insertion-ordered dict
+        # doubles as the LRU-ish eviction order under index_cap)
+        self._index: dict[tuple[int, int], list[int]] = {}
+        # pool roles (autoscale): True = accepts new dispatches
+        self._prefill_role = [True] * len(self.replicas)
+        self._cooldown = 0
+        # run()-scoped accounting (lifetime counters, deltas at run)
+        self._submitted_ptok = 0
+        self._affinity_hits = 0
+        self._affinity_tokens = 0
+        self._backpressure_holds = 0
+        self._reroles = 0
+        self._dispatched = [0] * len(self.replicas)
+        self._ttft: dict[str, list[float]] = {
+            c.name: [] for c in self.rcfg.classes
+        }
+        self._class_tokens: dict[str, int] = {
+            c.name: 0 for c in self.rcfg.classes
+        }
+        self._class_done: dict[str, int] = {
+            c.name: 0 for c in self.rcfg.classes
+        }
+
+    @staticmethod
+    def _scfg(replica):
+        return replica.scfg
+
+    # ---- introspection --------------------------------------------------
+
+    @property
+    def n_queued(self) -> int:
+        """Router-held requests plus every replica's own queue."""
+        return len(self._queue) + sum(r.n_queued for r in self.replicas)
+
+    @property
+    def n_active(self) -> int:
+        return sum(r.n_active for r in self.replicas)
+
+    @property
+    def n_prefill_pool(self) -> int:
+        return sum(self._prefill_role)
+
+    @property
+    def reroles(self) -> int:
+        return self._reroles
+
+    # ---- request lifecycle ----------------------------------------------
+
+    def submit(self, req: Request, tenant: str = "default") -> None:
+        """Queue ``req`` under ``tenant``'s SLO class.  rids are unique
+        FLEET-wide (they key the PRNG streams and the merged outputs
+        map — the engine's rule, one level up)."""
+        if tenant not in self._classes:
+            raise ValueError(
+                f"unknown tenant class {tenant!r} "
+                f"(have {sorted(self._classes)})"
+            )
+        # EVERY replica's admission rules, enforced at THIS front door
+        # (routing may send the request anywhere): a request a replica
+        # would reject must fail here, not raise out of a later
+        # dispatch — where it would stay router-queued and re-raise
+        # every tick.  The common rules run ONCE (the constructor pins
+        # every validate_request-relevant field fleet-equal); only each
+        # replica's local half (e.g. the disagg staging bound) loops.
+        self.replicas[0].validate(req)
+        for r in self.replicas[1:]:
+            r.validate_local(req)
+        if req.rid in self._seen:
+            raise ValueError(f"request id {req.rid} already used")
+        self._seen.add(req.rid)
+        self._class_of[req.rid] = tenant
+        self._submitted_ptok += len(req.prompt)
+        self._queue.append(_Pending(cls=tenant, req=req,
+                                    t0=time.perf_counter()))
+
+    # ---- the fleet prefix index -----------------------------------------
+
+    def _block_keys(self, prompt: tuple) -> list[tuple[int, int]]:
+        """``(aligned_length, rolling_hash)`` per page-aligned prefix of
+        ``prompt`` — ONE O(len) pass, instead of materializing and
+        hashing O(len²/page_size) prefix-tuple tokens per probe (the
+        long-context dispatch cost).  The polynomial hash is
+        deterministic across processes (no PYTHONHASHSEED), and a
+        collision merely merges two prefix families in the PLANNED
+        index — it costs a routing choice, never correctness (the
+        index's standing discipline)."""
+        keys = []
+        h = 0
+        for j, t in enumerate(prompt):
+            h = (h * 1_000_003 + t + 1) & 0xFFFFFFFFFFFFFFFF
+            if (j + 1) % self._page == 0:
+                keys.append((j + 1, h))
+        return keys
+
+    def _register(self, keys: list[tuple[int, int]], replica: int) -> None:
+        """Record the dispatch in the fleet index: every page-aligned
+        prefix block (``_block_keys`` form) -> this replica.  PLANNED
+        state, not live state — it makes same-prefix followers route
+        together even before the first prefill lands (the live tries
+        are empty exactly when the burst arrives); the live half of the
+        score comes from ``prefix_match_tokens`` at dispatch time.
+        Stale entries (the replica's pages died) cost a routing choice,
+        never correctness, and the cap evicts oldest-first."""
+        for key in keys:
+            reps = self._index.get(key)
+            if reps is None:
+                if len(self._index) >= self.rcfg.index_cap:
+                    self._index.pop(next(iter(self._index)))
+                self._index[key] = [replica]
+            elif replica not in reps:
+                reps.append(replica)
+
+    def _planned_match(self, keys: list[tuple[int, int]],
+                       replica: int) -> int:
+        """Longest indexed page-aligned prefix (tokens) this replica
+        was already routed, from the prompt's precomputed
+        ``_block_keys``.  Every aligned length is probed — no break on
+        a missing shorter key: the cap evicts oldest-first, and a
+        prompt family's SHORTEST key is always its oldest, so stopping
+        there would orphan the family's surviving longer keys (dead
+        entries filling the cap while affinity decays to
+        least-loaded)."""
+        best = 0
+        for ln, h in keys:
+            reps = self._index.get((ln, h))
+            if reps is not None and replica in reps:
+                best = ln
+        return best
+
+    # ---- dispatch -------------------------------------------------------
+
+    def _load(self, i: int) -> int:
+        r = self.replicas[i]
+        return r.n_queued + r.n_active + getattr(r, "n_staged", 0)
+
+    def _candidates(self, cls: SLOClass) -> list[int]:
+        """Replicas this class may dispatch to, most-preferred subset
+        first: prefill-pool members, narrowed by the class target when
+        the fleet has both admission paths, minus replicas at the
+        class's max_queue depth."""
+        pool = [i for i, on in enumerate(self._prefill_role) if on]
+        if cls.target == "ttft":
+            pref = [i for i in pool
+                    if self._scfg(self.replicas[i]).chunk_prefill > 0]
+        else:
+            pref = [i for i in pool
+                    if self._scfg(self.replicas[i]).chunk_prefill == 0]
+        cands = pref or pool
+        if cls.max_queue > 0:
+            cands = [i for i in cands
+                     if self._depth.get((i, cls.name), 0) < cls.max_queue]
+        return cands
+
+    def _route(self, pend: _Pending) -> Optional[int]:
+        """Pick a replica for one request (None: held by backpressure).
+        Affinity: the best of the live per-replica prefix match (full
+        pages + sub-page boundary) and the planned fleet-index match;
+        a positive best score wins, ties broken least-loaded then
+        lowest index.  No match (or affinity off): least-loaded.
+        Replicas WITHOUT ``prefix_share`` never score: landing on a
+        "matched" replica saves nothing there (every prompt re-prefills
+        in full), so counting the planned index would concentrate load
+        for fictitious wins — a disagg fleet (which rejects
+        ``prefix_share``) routes purely least-loaded."""
+        cls = self._classes[pend.cls]
+        cands = self._candidates(cls)
+        if not cands:
+            self._backpressure_holds += 1
+            return None
+        best, best_score = None, 0
+        if self.rcfg.affinity:
+            keys = self._block_keys(pend.req.prompt)  # once, all cands
+            for i in cands:
+                if not self._scfg(self.replicas[i]).prefix_share:
+                    continue
+                score = max(
+                    self.replicas[i].prefix_match_tokens(pend.req.prompt),
+                    self._planned_match(keys, i),
+                )
+                if score > best_score or (
+                    score == best_score and score > 0 and best is not None
+                    and (self._load(i), i) < (self._load(best), best)
+                ):
+                    best, best_score = i, score
+        if best is not None and best_score > 0:
+            self._affinity_hits += 1
+            self._affinity_tokens += best_score
+            return best
+        return min(cands, key=lambda i: (self._load(i), i))
+
+    def _dispatch(self) -> None:
+        """Drain the router queue into replicas, TTFT classes first
+        (FIFO within a class); requests held by backpressure stay
+        queued for the next tick.  Each pending leaves the queue only
+        AFTER its replica submit succeeds: a raise mid-loop must not
+        leave an already-dispatched request queued in two places (the
+        forever-wedge a rebuild-after-the-loop would create)."""
+        order = sorted(
+            self._queue,
+            key=lambda p: 0 if self._classes[p.cls].target == "ttft" else 1,
+        )
+        for pend in order:
+            i = self._route(pend)
+            if i is None:
+                continue  # held by backpressure: stays queued
+            # t0 back-dates the engine's TTFT clock to the ROUTER
+            # submit: queue-held wall is part of what the tenant waited
+            self.replicas[i].submit(pend.req, t0=pend.t0)
+            self._queue.remove(pend)
+            self._replica_of[pend.req.rid] = i
+            self._inflight.add(pend.req.rid)
+            self._depth[(i, pend.cls)] = (
+                self._depth.get((i, pend.cls), 0) + 1
+            )
+            self._dispatched[i] += 1
+            if self._scfg(self.replicas[i]).prefix_share:
+                self._register(self._block_keys(pend.req.prompt), i)
+
+    def _quarantine_poison(self, rep, exc: Exception) -> None:
+        """A replica tick raised under the ``retry_budget == 0``
+        raise-through contract.  When the raise came from an ADMISSION
+        (the engine stamped ``_poison_rid`` before re-raising), the
+        engine recovered its cache and requeued the failing request —
+        BEHIND every in-flight request ``_recover_cache`` requeued for
+        replay, so the queue head does NOT name it — leaving the caller
+        to decide.  Fleet-side the router IS the caller, and one poison
+        request must not stall the whole drain: pull the stamped rid
+        out of the replica queue, quarantine it on the replica
+        (reported, never requeued; the depth-release sweep in
+        :meth:`step` then frees its ``max_queue`` slot), and keep
+        ticking — the requeued in-flight requests replay bit-identically
+        on later ticks.  A raise with NO admission stamp (a decode-step
+        or staging failure — not attributable to one request) is not a
+        poison admission: re-raise, the pre-router contract."""
+        rid = rep.take_poison_rid()
+        if rid is None or rid not in self._inflight:
+            raise exc
+        rep.drop_queued(rid)
+        rep.quarantine(rid, f"{type(exc).__name__}: {exc}")
+
+    # ---- autoscaling (disagg fleets) ------------------------------------
+
+    def _autoscale(self) -> None:
+        """Re-role one replica per decision from the staged-handoff
+        backlog, hysteresis-bounded (see :class:`RouterConfig`)."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        n_pre = self.n_prefill_pool
+        backlog = sum(r.n_staged for r in self.replicas)
+        per = backlog / n_pre
+        if per > self.rcfg.scale_down_backlog and n_pre > 1:
+            # decode-bound: the deepest-staged prefill replica stops
+            # taking new work and drains
+            victim = max(
+                (i for i, on in enumerate(self._prefill_role) if on),
+                key=lambda i: (self.replicas[i].n_staged, -i),
+            )
+            self._prefill_role[victim] = False
+            self._reroles += 1
+            self._cooldown = self.rcfg.cooldown_ticks
+        elif per < self.rcfg.scale_up_backlog and n_pre < len(self.replicas):
+            # prefill-bound (or drained): the emptiest decode-pool
+            # replica rejoins the prefill pool
+            back = min(
+                (i for i, on in enumerate(self._prefill_role) if not on),
+                key=lambda i: (self._load(i), i),
+            )
+            self._prefill_role[back] = True
+            self._reroles += 1
+            self._cooldown = self.rcfg.cooldown_ticks
+
+    # ---- the tick -------------------------------------------------------
+
+    def step(self) -> list[tuple[int, tuple[int, ...]]]:
+        """One fleet tick: autoscale roles, dispatch what routes, tick
+        EVERY replica, collect finishes (with per-class TTFT)."""
+        if self.rcfg.autoscale:
+            self._autoscale()
+        self._dispatch()
+        finished: list[tuple[int, tuple[int, ...]]] = []
+        for i, rep in enumerate(self.replicas):
+            try:
+                done = rep.step()
+            except Exception as exc:
+                self._quarantine_poison(rep, exc)
+                continue
+            for rid, toks in done:
+                self._inflight.discard(rid)
+                cls = self._class_of.get(rid)
+                if cls is not None:
+                    self._depth[(i, cls)] = max(
+                        0, self._depth.get((i, cls), 0) - 1
+                    )
+                    self._class_tokens[cls] += len(toks)
+                    self._class_done[cls] += 1
+                    ttft = rep.take_ttft(rid)
+                    if ttft is not None:
+                        self._ttft[cls].append(ttft)
+                finished.append((rid, toks))
+        # a QUARANTINED request never reaches the finish list — release
+        # its backpressure depth here, or one poison request would pin
+        # its class's max_queue slot forever (the engine-side livelock
+        # lesson, router-level)
+        for rid in [r for r in self._inflight
+                    if self.replicas[self._replica_of[r]]
+                    .is_quarantined(r)]:
+            self._inflight.discard(rid)
+            i, cls = self._replica_of[rid], self._class_of.get(rid)
+            if cls is not None:
+                self._depth[(i, cls)] = max(
+                    0, self._depth.get((i, cls), 0) - 1
+                )
+        return finished
+
+    def run(self, requests: Sequence = (),
+            max_steps: int = 100_000) -> RouterReport:
+        """Submit ``requests`` — ``Request``s (default class) or
+        ``(tenant, Request)`` pairs — and drain the whole fleet.
+        Counters in the report are THIS drain's deltas, so a reused
+        router's reports stay internally consistent."""
+        for r in requests:
+            if isinstance(r, Request):
+                self.submit(r)
+            else:
+                tenant, req = r
+                self.submit(req, tenant=tenant)
+        ptok0 = [self._prefill_of(r) for r in self.replicas]
+        stok0 = [self._shared_of(r) for r in self.replicas]
+        sub0 = [self._subpage_of(r) for r in self.replicas]
+        # the drain's "submitted" leg: prompts still PENDING admission
+        # anywhere — the router queue plus every replica's own queue (a
+        # prior step() may have dispatched without draining; those
+        # prompts prefill during THIS drain, so the counter law needs
+        # them).  Disagg handed-off requests sit in the INNER engine's
+        # queue already prefilled, so rep._queue (the front queue) is
+        # exactly the not-yet-prefilled set.
+        subm0 = self._submitted_ptok - sum(
+            len(p.req.prompt) for p in self._queue
+        ) - sum(len(q.prompt) for r in self.replicas for q in r._queue)
+        hits0, atok0 = self._affinity_hits, self._affinity_tokens
+        holds0, rer0 = self._backpressure_holds, self._reroles
+        disp0 = list(self._dispatched)
+        ttft0 = {c: len(v) for c, v in self._ttft.items()}
+        ctok0 = dict(self._class_tokens)
+        cdone0 = dict(self._class_done)
+        outputs: dict[int, tuple[int, ...]] = {}
+        steps = 0
+        t0 = time.perf_counter()
+        while self._queue or any(
+            r.n_queued or r.n_active or getattr(r, "n_staged", 0)
+            or r.has_buffered_finishes      # parked by a raise-through
+            for r in self.replicas
+        ):
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"fleet did not drain in {max_steps} steps "
+                    f"({self.n_queued} queued, {self.n_active} active)"
+                )
+            for rid, toks in self.step():
+                outputs[rid] = toks
+            steps += 1
+        wall = time.perf_counter() - t0
+        tokens = sum(len(t) for t in outputs.values())
+        prefill = sum(
+            self._prefill_of(r) - p0
+            for r, p0 in zip(self.replicas, ptok0)
+        )
+        shared = sum(
+            self._shared_of(r) - s0
+            for r, s0 in zip(self.replicas, stok0)
+        )
+        classes = []
+        for c in self.rcfg.classes:
+            samples = self._ttft[c.name][ttft0[c.name]:]
+            ctoks = self._class_tokens[c.name] - ctok0[c.name]
+            classes.append(ClassReport(
+                name=c.name,
+                completed=self._class_done[c.name] - cdone0[c.name],
+                tokens=ctoks,
+                ttft_p50_s=_percentile(samples, 50),
+                ttft_p99_s=_percentile(samples, 99),
+                tokens_per_s=ctoks / wall if wall else 0.0,
+            ))
+        return RouterReport(
+            completed=len(outputs),
+            tokens_generated=tokens,
+            wall_s=wall,
+            tokens_per_s=tokens / wall if wall else 0.0,
+            outputs=tuple(sorted(outputs.items())),
+            classes=tuple(classes),
+            prefill_tokens=prefill,
+            shared_tokens=shared,
+            submitted_prompt_tokens=self._submitted_ptok - subm0,
+            subpage_tokens=sum(
+                self._subpage_of(r) - s0
+                for r, s0 in zip(self.replicas, sub0)
+            ),
+            affinity_hits=self._affinity_hits - hits0,
+            affinity_tokens=self._affinity_tokens - atok0,
+            backpressure_holds=self._backpressure_holds - holds0,
+            reroles=self._reroles - rer0,
+            dispatched=tuple(
+                d - d0 for d, d0 in zip(self._dispatched, disp0)
+            ),
+        )
+
+    # ---- fleet counter taps ---------------------------------------------
+
+    @staticmethod
+    def _prefill_of(r) -> int:
+        """Prompt tokens COMPUTED on this replica: the engine's prefill
+        programs plus, for disagg, the staging slice's."""
+        if isinstance(r, DisaggEngine):
+            return r.engine.prefill_tokens + r.stage_prefill_tokens
+        return r.prefill_tokens
+
+    @staticmethod
+    def _shared_of(r) -> int:
+        if isinstance(r, DisaggEngine):
+            return r.engine.shared_tokens
+        return r.shared_tokens
+
+    @staticmethod
+    def _subpage_of(r) -> int:
+        if isinstance(r, DisaggEngine):
+            return r.engine.subpage_tokens
+        return r.subpage_tokens
