@@ -17,6 +17,12 @@ turns on self-drafting speculative decoding (K draft tokens verified
 per cache sweep; the report's accepted/drafted counters show how many
 sweeps the drafts saved, and the accounting identity
 ``tokens == prefills + slot_steps + accepted`` is asserted live).
+A closing section demonstrates device-resident MACRO-STEP decode
+(``ServeConfig(macro_steps=4)``): the whole engine tick fused into one
+compiled ``lax.scan`` — one dispatch and one host-sync per 4 tokens,
+identical output, with the dispatch identity
+``dispatches == ceil(slot_steps / macro_steps)`` asserted live on a
+single decoding stream.
 
 argv tier:  ex24_serving.py [--decode-slots=N] [--kv-pages=N]
             [--page-size=N] [--spec[=K]] [--int8]
@@ -109,6 +115,34 @@ def main(argv=None) -> None:
     assert report.tokens_generated == (
         report.prefills + report.slot_steps + report.accepted
     ), "accepted-token counters do not reconcile with emitted tokens"
+
+    # device-resident macro-step decode (ISSUE 15): the same engine
+    # contract at macro_steps=4 — ONE compiled lax.scan dispatch and
+    # ONE host sync per 4 tokens.  A single decoding stream makes the
+    # dispatch identity exact: dispatches == ceil(slot_steps / T).
+    banner("macro-step decode (macro_steps=4)")
+    import math
+    import dataclasses as _dc
+
+    macro_req = Request(rid=1000, prompt=(1, 2, 3), max_new=10)
+    m1 = ServeEngine(
+        mesh, cfg, _dc.replace(scfg, spec_k=0)
+    ).run([macro_req])
+    m4 = ServeEngine(
+        mesh, cfg, _dc.replace(scfg, spec_k=0, macro_steps=4)
+    ).run([macro_req])
+    assert m4.outputs == m1.outputs, "macro output diverged from per-token"
+    assert m4.dispatches == math.ceil(m4.slot_steps / 4), (
+        f"dispatch identity broke: {m4.dispatches} != "
+        f"ceil({m4.slot_steps}/4)"
+    )
+    assert m1.dispatches == m1.slot_steps  # per-token: one each
+    assert m4.host_syncs == m4.dispatches
+    print(f"per-token: {m1.slot_steps} decode steps = {m1.dispatches} "
+          f"dispatches / {m1.host_syncs} host syncs")
+    print(f"macro T=4: same {m4.slot_steps} token steps in "
+          f"{m4.dispatches} dispatches / {m4.host_syncs} host syncs "
+          f"(= ceil({m4.slot_steps}/4)), outputs identical")
     print(f"[{jax.default_backend()}] serving demo PASSED")
 
 

@@ -409,6 +409,82 @@ class TestPagedFusedOracle:
         assert paged_attention_supported(2, 8, 4, jnp.float32) is None
 
 
+class TestPagedHeadGrid:
+    """The large-H head-grid variant (ISSUE 15, the PR-12 remainder):
+    when ``H*K`` online-softmax state rows overflow the VMEM scratch
+    budget, the grid gains a head-block axis — each (sequence, head
+    block) pair sweeps the pages with its own scratch.  Heads are
+    independent in attention, so the split must be invisible: oracle
+    equivalence at the same FUSED_PAGED_ATOL, every dtype rung."""
+
+    def _force_budget(self, monkeypatch, rows):
+        monkeypatch.setenv("TPUSCRATCH_PAGED_STATE_ROWS", str(rows))
+
+    def test_head_block_selection(self, monkeypatch):
+        from tpuscratch.ops.attention import _head_block
+
+        self._force_budget(monkeypatch, 4)
+        assert _head_block(2, 1) == 2      # under budget: no split
+        assert _head_block(4, 2) == 2      # 4*2 > 4 -> blocks of 2
+        assert _head_block(8, 4) == 1      # only H=1 fits 1*4 <= 4
+        self._force_budget(monkeypatch, 512)
+        assert _head_block(8, 16) == 8     # default geometries: whole H
+
+    @pytest.mark.parametrize("dtype", PAGED_DTYPES)
+    def test_decode_head_grid_matches_oracle(self, dtype, monkeypatch):
+        # H*K = 2 > 1: the grid splits to per-head sweeps
+        self._force_budget(monkeypatch, 1)
+        rng = np.random.default_rng(9)
+        k_p, v_p, table, sk, sv = _paged_case(rng, dtype=dtype)
+        lens = jnp.asarray([9, 16, 0], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((3, 2, 8)).astype(np.float32))
+        dense = decode_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=False)
+        fused = decode_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)
+        assert float(jnp.abs(fused[2]).max()) == 0.0  # idle -> zeros
+
+    @pytest.mark.parametrize("dtype", PAGED_DTYPES)
+    def test_verify_head_grid_matches_oracle(self, dtype, monkeypatch):
+        # K=3 rides the head-split sweep: ragged-causal masking and the
+        # idle-slot guard must hold per head block exactly as unsplit
+        self._force_budget(monkeypatch, 3)
+        rng = np.random.default_rng(10)
+        k_p, v_p, table, sk, sv = _paged_case(rng, dtype=dtype)
+        K = 3
+        lens = jnp.asarray([3, 4, 0], jnp.int32)
+        q = jnp.asarray(
+            rng.standard_normal((3, K, 2, 8)).astype(np.float32)
+        )
+        dense = verify_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=False)
+        fused = verify_attention(q, k_p, v_p, table, lens, sk, sv,
+                                 fused=True)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=FUSED_PAGED_ATOL)
+        assert float(jnp.abs(fused[2]).max()) == 0.0
+
+    def test_head_grid_identical_to_unsplit_kernel(self, monkeypatch):
+        """The split changes the schedule, not the algebra: the same
+        inputs through the unsplit kernel and the head-grid kernel
+        agree bit-for-bit in interpret mode (identical per-head op
+        order — only the grid iteration is reshaped)."""
+        rng = np.random.default_rng(11)
+        k_p, v_p, table, sk, sv = _paged_case(rng, dtype=jnp.int8)
+        lens = jnp.asarray([9, 7, 13], jnp.int32)
+        q = jnp.asarray(
+            rng.standard_normal((3, 2, 2, 8)).astype(np.float32)
+        )
+        monkeypatch.setenv("TPUSCRATCH_PAGED_STATE_ROWS", "512")
+        whole = paged_attention(q, k_p, v_p, table, lens, sk, sv)
+        monkeypatch.setenv("TPUSCRATCH_PAGED_STATE_ROWS", "2")
+        split = paged_attention(q, k_p, v_p, table, lens, sk, sv)
+        np.testing.assert_array_equal(np.asarray(whole),
+                                      np.asarray(split))
+
+
 @pytest.mark.pallas_tpu
 @pytest.mark.skipif(jax.default_backend() != "tpu",
                     reason="compiled Mosaic paged kernel needs a TPU")

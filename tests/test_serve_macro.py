@@ -1,0 +1,526 @@
+"""Device-resident macro-step decode (ISSUE 15, marker ``macro``).
+
+The correctness anchors:
+
+- **greedy bit-identity across T**: ``ServeConfig(macro_steps=T)``
+  fuses T whole engine ticks into one compiled ``lax.scan`` — same
+  outputs at T in {1, 4, 16}, composed with the dtype ladder
+  (fp32/int8/fp8), prefix sharing, chunked prefill, disaggregation,
+  and the fleet router, on the 1x1 and 2x2 meshes (``macro_steps=1``
+  builds the EXACT legacy per-token program — no loop program exists);
+- **boundary laws**: a request whose budget ends mid-scan emits
+  exactly ``max_new`` tokens (the done-mask suppresses its writes and
+  flips it to the legacy idle contract for the scan tail), TTFT is
+  stamped correctly when tokens land inside a macro tick, and chaos
+  recovery (``serve/prefill`` fault at T=16) replays bit-identically;
+- **dispatch accounting**: ``GenerateReport.dispatches``/``host_syncs``
+  drop ~T× at fixed token count, with the single-stream identity
+  ``dispatches == ceil(slot_steps / macro_steps)`` exact;
+- **clamping**: speculative decode and tiered KV need per-token host
+  decisions and clamp the effective T to 1 — documented and
+  ledger-visible (``macro_steps_effective``/``macro_clamped_by``),
+  never a silent degrade;
+- **one compiled sweep, reused**: the scan program's optimized HLO
+  carries ONE copy of the sweep's collective pattern regardless of T
+  (``obs.ledger`` instruction counts equal at T=4 and T=16), and
+  steady-state serving at any T still compiles the decode side exactly
+  once (CompileCounter);
+- **roofline accounting** (the decode_bench fix): the static
+  swept-byte accounting scales by the per-tick round delta, so a
+  macro window books the same sweep traffic as the per-token window
+  for the same tokens instead of ~T× less.
+"""
+
+import dataclasses
+import math
+
+import pytest
+import jax
+
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import (
+    DisaggEngine,
+    FleetRouter,
+    Request,
+    RouterConfig,
+    ServeConfig,
+    ServeEngine,
+)
+
+pytestmark = pytest.mark.macro
+
+
+def cfg_for(**kw):
+    # capacity_factor == n_experts: the no-drop MoE regime every other
+    # serve equivalence test runs under (test_serve's rule)
+    kw.setdefault("n_layers", 1)
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=32, n_heads=4, n_experts=4, d_ff=48, **kw
+    )
+
+
+SCFG = ServeConfig(n_slots=4, n_pages=16, page_size=4, max_seq=24,
+                   vocab=16)
+
+#: staggered budgets + mixed lengths: evictions land mid-scan at every
+#: T and queued requests back-fill at macro boundaries
+REQS = [
+    Request(rid=i, prompt=tuple((3 * i + j) % 16 for j in range(2 + i % 5)),
+            max_new=2 + (i * 3) % 6)
+    for i in range(6)
+]
+
+
+def run_engine(dims=(1, 1), reqs=REQS, cfg=None, **scfg_kw):
+    cfg = cfg or cfg_for()
+    n = dims[0] * dims[1]
+    mesh = make_mesh(dims, ("dp", "sp"), jax.devices()[:n])
+    scfg = dataclasses.replace(SCFG, **scfg_kw)
+    eng = ServeEngine(mesh, cfg, scfg)
+    return eng, eng.run(reqs)
+
+
+class TestMacroBitIdentity:
+    def test_identical_across_T_and_legacy_program_at_1(self):
+        cfg = cfg_for(n_layers=2)
+        eng1, r1 = run_engine(cfg=cfg)
+        # macro_steps=1 IS the legacy engine: no scan program is built
+        assert eng1._decode_loop is None and eng1._decode is not None
+        for T in (4, 16):
+            engT, rT = run_engine(cfg=cfg, macro_steps=T)
+            assert engT._decode is None and engT._decode_loop is not None
+            assert rT.outputs == r1.outputs
+            assert rT.tokens_generated == r1.tokens_generated
+            assert rT.slot_steps == r1.slot_steps
+            assert rT.decode_compiles == 1   # one scan program, ever
+            assert engT.free_pages() == eng1.free_pages()  # no leaks
+
+    @pytest.mark.parametrize(
+        "kv_dtype",
+        ["int8",
+         # the fp8 rung rides the identical dtype-generic write/scale
+         # path (one mechanism, test_serve's ladder contract) — kept
+         # out of the tier-1 wall like PR-14's fp8+spec composition
+         pytest.param("fp8", marks=pytest.mark.slow)],
+    )
+    def test_identical_on_quantized_rungs(self, kv_dtype):
+        _, r1 = run_engine(kv_dtype=kv_dtype)
+        _, r4 = run_engine(kv_dtype=kv_dtype, macro_steps=4)
+        assert r4.outputs == r1.outputs
+
+    def test_identical_with_share_and_chunk(self):
+        kw = dict(prefix_share=True, chunk_prefill=2, kv_dtype="int8")
+        _, r1 = run_engine(**kw)
+        _, r4 = run_engine(macro_steps=4, **kw)
+        assert r4.outputs == r1.outputs
+        # the sharing counters are scheduling-independent too
+        assert (r4.prefill_tokens, r4.shared_tokens) == (
+            r1.prefill_tokens, r1.shared_tokens
+        )
+
+    def test_identical_on_2x2_mesh_composed(self):
+        kw = dict(prefix_share=True, kv_dtype="int8")
+        _, r1 = run_engine(dims=(2, 2), **kw)
+        _, r16 = run_engine(dims=(2, 2), macro_steps=16, **kw)
+        assert r16.outputs == r1.outputs
+
+    def test_identical_at_temperature(self):
+        # the in-scan fold_in chain must reproduce the host-side
+        # request_keys stream draw-for-draw, not just under argmax
+        kw = dict(temperature=0.8, top_k=5, seed=7)
+        _, r1 = run_engine(**kw)
+        _, r4 = run_engine(macro_steps=4, **kw)
+        assert r4.outputs == r1.outputs
+
+    def test_identical_under_disagg(self):
+        cfg = cfg_for()
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4)
+                for i in range(4)]
+
+        def run(T):
+            eng = DisaggEngine(mesh, cfg,
+                               dataclasses.replace(SCFG, macro_steps=T))
+            return eng, eng.run(reqs)
+
+        eng1, r1 = run(1)
+        eng4, r4 = run(4)
+        assert r4.outputs == r1.outputs
+        assert eng4.dispatches < eng1.dispatches
+
+    def test_identical_under_router(self):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        reqs = [Request(rid=i, prompt=(1 + i, 2, 3), max_new=5)
+                for i in range(4)]
+
+        def run(T):
+            reps = [ServeEngine(mesh, cfg,
+                                dataclasses.replace(SCFG, macro_steps=T))
+                    for _ in range(2)]
+            return FleetRouter(reps, RouterConfig(affinity=False)).run(reqs)
+
+        r1, r4 = run(1), run(4)
+        assert r4.outputs == r1.outputs
+        assert 0 < r4.dispatches < r1.dispatches
+        assert r4.host_syncs == r4.dispatches
+
+
+class TestMacroBoundaryLaws:
+    def test_budget_ends_mid_scan_emits_exactly_max_new(self):
+        # max_new - 1 decode steps not divisible by T: the done-mask
+        # must suppress the scan tail, never emit past the budget
+        for T, max_new in ((4, 4), (16, 6), (16, 2)):
+            req = Request(rid=0, prompt=(1, 2, 3), max_new=max_new)
+            eng, rep = run_engine(reqs=[req], macro_steps=T)
+            assert rep.completed == 1
+            assert len(dict(rep.outputs)[0]) == max_new
+            assert rep.tokens_generated == max_new
+            assert eng.free_pages() == [16]  # evicted, pages returned
+
+    def test_mixed_budgets_one_bank(self):
+        # slots finish at different scan iterations of the SAME
+        # dispatch; each stream must stop at its own budget and the
+        # finished slots ride the tail write-suppressed
+        reqs = [Request(rid=i, prompt=(1 + i,), max_new=1 + i)
+                for i in range(4)]
+        _, r1 = run_engine(reqs=reqs)
+        _, r16 = run_engine(reqs=reqs, macro_steps=16)
+        assert r16.outputs == r1.outputs
+        for rid, toks in r16.outputs:
+            assert len(toks) == 1 + rid
+
+    def test_ttft_stamped_inside_macro_tick(self):
+        # first tokens land at prefill/admission — stamping must
+        # survive the macro scheduling (completions inside macro ticks)
+        eng, rep = run_engine(macro_steps=16)
+        stamped = dict(rep.ttft_s)
+        assert set(stamped) == {r.rid for r in REQS}
+        assert all(t >= 0.0 for t in stamped.values())
+        # chunked-prefill admissions sample their first token at
+        # tail-drain INSIDE the tick stream — stamp must still exist
+        _, rep_c = run_engine(macro_steps=4, chunk_prefill=2)
+        assert set(dict(rep_c.ttft_s)) == {r.rid for r in REQS}
+
+    def test_recover_replay_bit_identical_under_chaos_t16(self):
+        from tpuscratch.ft.chaos import ChaosPlan, Fault
+
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4)
+                for i in range(3)]
+        _, clean = run_engine(reqs=reqs, macro_steps=16)
+
+        # a mid-drain prefill fault raises through (retry_budget=0):
+        # _recover_cache resets the donated pool and requeues every
+        # in-flight request; the replay through macro ticks must
+        # reproduce the fault-free run bit-for-bit
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        plan = ChaosPlan(0, [Fault("serve/prefill", key=1, at=(0,),
+                                   times=1)])
+        eng = ServeEngine(mesh, cfg,
+                          dataclasses.replace(SCFG, macro_steps=16),
+                          chaos=plan)
+        for r in reqs:
+            eng.submit(r)
+        outputs = {}
+        raised = 0
+        for _ in range(100):
+            if not (eng.n_queued or eng.n_active):
+                break
+            try:
+                for rid, toks in eng.step():
+                    outputs[rid] = toks
+            except Exception:
+                raised += 1
+        assert raised == 1
+        assert tuple(sorted(outputs.items())) == clean.outputs
+        assert eng.free_pages() == [16]
+
+    def test_failed_macro_dispatch_recovers_and_replays(self):
+        # the scan program's donated cache may be consumed by a raise:
+        # the legacy recovery contract, through the macro path.
+        # max_new > T + 1 so the bank is still mid-stream after the
+        # first macro tick — the raise lands with slots active.
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=10)
+                for i in range(3)]
+        _, clean = run_engine(reqs=reqs, macro_steps=4)
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        eng = ServeEngine(mesh, cfg,
+                          dataclasses.replace(SCFG, macro_steps=4))
+        for r in reqs:
+            eng.submit(r)
+        eng.step()
+
+        class Boom(RuntimeError):
+            pass
+
+        real = eng._decode_loop
+
+        def exploding(*a, **k):
+            raise Boom("mid-flight device error")
+
+        eng._decode_loop = exploding
+        with pytest.raises(Boom):
+            eng.step()
+        assert eng.n_active == 0 and eng.n_queued == 3
+        assert eng.free_pages() == [16]
+        eng._decode_loop = real
+        rep = eng.run([])
+        assert rep.outputs == clean.outputs
+
+
+class TestDispatchAccounting:
+    def test_single_stream_identity(self):
+        # ONE decoding stream: dispatches == ceil(slot_steps / T),
+        # host_syncs == dispatches — the ex24/ex32 live identity
+        req = Request(rid=0, prompt=(1, 2, 3), max_new=10)
+        for T in (1, 4, 16):
+            _, rep = run_engine(reqs=[req], macro_steps=T)
+            assert rep.slot_steps == 9       # max_new - 1 (prefill emits 1)
+            assert rep.dispatches == math.ceil(9 / T)
+            assert rep.host_syncs == rep.dispatches
+
+    def test_dispatches_drop_T_fold_at_fixed_tokens(self):
+        _, r1 = run_engine()
+        _, r16 = run_engine(macro_steps=16)
+        assert r16.tokens_generated == r1.tokens_generated
+        assert r1.dispatches == r1.decode_steps  # per-token: 1 per sweep
+        assert r16.dispatches < r1.dispatches
+        assert r16.host_syncs == r16.dispatches
+
+    def test_decode_rounds_scale_with_span(self):
+        # rounds = token rounds the bank ran: per macro dispatch, the
+        # longest active span (the roofline multiplier)
+        req = Request(rid=0, prompt=(1, 2, 3), max_new=10)
+        eng1, _ = run_engine(reqs=[req])
+        eng4, _ = run_engine(reqs=[req], macro_steps=4)
+        assert eng1.decode_rounds == 9
+        assert eng4.decode_rounds == 9       # same rounds, fewer dispatches
+        assert eng4.dispatches == 3
+
+    def test_clamped_under_spec_and_tier(self):
+        # per-token host decisions (drafting, wave staging) clamp T to
+        # 1 — visible, not silent — and outputs match the unclamped
+        # spelling of the same config
+        eng_s, rep_s = run_engine(macro_steps=8, spec_k=2)
+        assert eng_s.macro_steps_effective == 1
+        assert eng_s.macro_clamped_by == "spec_k"
+        _, base_s = run_engine(spec_k=2)
+        assert rep_s.outputs == base_s.outputs
+
+        eng_t, rep_t = run_engine(macro_steps=8, kv_host_pages=4)
+        assert eng_t.macro_steps_effective == 1
+        assert eng_t.macro_clamped_by == "kv_host_pages"
+        _, base_t = run_engine(kv_host_pages=4)
+        assert rep_t.outputs == base_t.outputs
+        # the clamp is ledger-visible: the gauge carries the effective T
+        assert eng_t.metrics.gauge("serve/macro_steps").value == 1
+
+    def test_macro_steps_validation(self):
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        with pytest.raises(ValueError):
+            ServeEngine(mesh, cfg,
+                        dataclasses.replace(SCFG, macro_steps=0))
+
+
+class TestMacroPrograms:
+    def test_zero_steady_state_recompiles_across_waves_of_requests(self):
+        # two full admission waves through one engine: the scan
+        # program must compile exactly once, ever
+        eng, _ = run_engine(macro_steps=4)
+        more = [Request(rid=100 + i, prompt=(2 + i, 1), max_new=5)
+                for i in range(6)]
+        rep2 = eng.run(more)
+        assert rep2.completed == 6
+        assert eng.decode_compiles == 1
+
+    def test_scan_reuses_one_sweep_pattern(self):
+        # the ledger proof: a lax.scan body appears ONCE in the
+        # optimized HLO (a while loop), so the sweep's collective
+        # pattern is reused T times — instruction counts must be equal
+        # at T=4 and T=16 and must NOT scale with T.  2x2 mesh so the
+        # sp psum / dp MoE collectives actually exist.
+        import numpy as np
+        import jax.numpy as jnp
+
+        from tpuscratch.models.transformer import init_params
+        from tpuscratch.obs.ledger import analyze
+        from tpuscratch.serve.decode import (
+            build_decode_loop,
+            build_decode_step,
+        )
+        from tpuscratch.serve.kvcache import CacheGeometry, init_kv_cache
+
+        cfg = cfg_for()
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        geom = CacheGeometry(cfg.n_layers, SCFG.n_pages, SCFG.page_size,
+                             cfg.n_heads, cfg.d_head)
+        params = init_params(0, cfg)
+        kv = init_kv_cache(geom, 2)
+        n = SCFG.n_slots
+        embed = jnp.zeros((SCFG.vocab, cfg.d_model), jnp.float32)
+        kd = jax.random.key_data(jax.random.key(0))
+        i32 = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
+        args = (params, kv, embed, kd,
+                i32(n, SCFG.max_pages), i32(n), i32(n), i32(n),
+                i32(n), i32(n))
+
+        counts = {}
+        for T in (4, 16):
+            prog = build_decode_loop(mesh, cfg, geom, T)
+            counts[T] = analyze(prog, *args).counts()
+        assert counts[4] == counts[16], (
+            "scan collectives scale with T — the loop unrolled"
+        )
+        # and against the single-step program: the scan adds only the
+        # early-exit mask's one scalar reduce, never a second sweep
+        step_counts = analyze(
+            build_decode_step(mesh, cfg, geom),
+            params, kv, jnp.zeros((n, cfg.d_model), np.float32),
+            i32(n, SCFG.max_pages), i32(n), i32(n), i32(n),
+        ).counts()
+        for kind, c in counts[16].items():
+            assert c <= step_counts.get(kind, 0) + 2, (
+                f"{kind}: {c} in the scan vs {step_counts.get(kind, 0)} "
+                "in one step — the sweep pattern is not being reused"
+            )
+
+
+class TestMacroRoofline:
+    def test_swept_bytes_scale_by_round_delta_at_t4(self):
+        # the decode_bench fix (ISSUE 15 satellite): static swept-byte
+        # accounting must multiply the sampled page footprint by the
+        # tick's ROUND delta — at T=4 a tick sweeps its pages 4 times,
+        # and the unscaled per-tick sample would understate the sweep
+        # traffic (hence mis-state achieved_frac) ~T×.  The bench
+        # methodology: warm past admission, account a steady-state
+        # window with every slot live (no insert/evict inside it).
+        # Warmups are ROUND-aligned, so both engines account the
+        # identical rounds-5..12 footprint trajectory and the ledger-
+        # exact per-round sum must agree across T.
+        def accounted(T, warm_steps, steps):
+            cfg = cfg_for()
+            mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+            eng = ServeEngine(mesh, cfg,
+                              dataclasses.replace(SCFG, macro_steps=T))
+            for i in range(4):
+                eng.submit(Request(rid=i, prompt=(1 + i, 2), max_new=14))
+            for _ in range(warm_steps):
+                eng.step()
+            assert eng.n_active == 4
+            page_bytes = eng.scfg.page_size * eng.kv_bytes_per_token
+            swept, rprev = 0.0, eng.decode_rounds
+            for _ in range(steps):
+                before = eng.cached_pages * page_bytes
+                eng.step()
+                after = eng.cached_pages * page_bytes
+                swept += 0.5 * (before + after) * (
+                    eng.decode_rounds - rprev
+                )
+                rprev = eng.decode_rounds
+            assert eng.n_active == 4         # window stayed steady-state
+            return swept, eng.decode_rounds
+
+        s1, rounds1 = accounted(1, warm_steps=4, steps=8)
+        s4, rounds4 = accounted(4, warm_steps=1, steps=2)
+        assert rounds1 == rounds4            # same token rounds ran
+        assert s4 == pytest.approx(s1, rel=0.10)
+        # and nowhere near the unscaled ~4x understatement
+        assert s4 > 0.5 * s1
+
+    def test_bench_decode_macro_fields(self):
+        from tpuscratch.bench.decode_bench import bench_decode
+
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        scfg = dataclasses.replace(SCFG, n_slots=1, n_pages=64,
+                                   max_seq=64, macro_steps=4)
+        r = bench_decode(mesh, cfg, scfg, prompt_len=4, measure_steps=4,
+                         warmup_steps=2)
+        assert r.macro_steps == 4
+        assert r.dispatches_per_token == pytest.approx(0.25)
+        assert r.host_syncs_per_token == pytest.approx(0.25)
+        assert r.swept_bytes > 0
+
+
+class TestMacroRegressGate:
+    def test_macro_row_direction_gated(self):
+        # the config-12 serve_decode_macro row through the regression
+        # gate: a clean same-code pair passes; dispatches/token creeping
+        # back up (the scan losing coverage) or tokens/s collapsing
+        # past the CPU noise floor regresses.  Static dispatch fields
+        # keep the TIGHT band (no noise floor matches them).
+        from tpuscratch.obs import regress
+
+        row = {
+            "config": 12, "metric": "serve_decode_macro",
+            "platform": "cpu", "value": 1.5e4,
+            "tokens_per_s_t1": 1.2e3, "tokens_per_s_t16": 1.5e4,
+            "macro_speedup": 12.5,
+            "dispatches_per_token_t1": 1.0,
+            "dispatches_per_token_t16": 0.0625,
+            "host_syncs_per_token_t16": 0.0625,
+        }
+        base = regress.index_rows([dict(row)])
+        clean = regress.compare(base, regress.index_rows([dict(row)]),
+                                noise=0.05)
+        assert not regress.has_regression(clean)
+
+        # injected: dispatches/token back to ~1 (static field, tight
+        # band — a 2% drift would already flag)
+        bad = dict(row, dispatches_per_token_t16=1.0)
+        findings = regress.compare(base, regress.index_rows([bad]),
+                                   noise=0.05)
+        assert regress.has_regression(findings)
+        names = {f.field for f in findings if f.status == "regressed"}
+        assert "dispatches_per_token_t16" in names
+
+        # injected: T=16 rate collapsing past the 40% CPU floor
+        slow = dict(row, tokens_per_s_t16=1.5e4 * 0.4,
+                    macro_speedup=12.5 * 0.4)
+        findings = regress.compare(base, regress.index_rows([slow]),
+                                   noise=0.05)
+        assert regress.has_regression(findings)
+
+        # directions as registered: dispatches/host_syncs LOWER,
+        # speedup/tokens HIGHER
+        assert regress.direction("dispatches_per_token_t16") == "lower"
+        assert regress.direction("host_syncs_per_token_t16") == "lower"
+        assert regress.direction("macro_speedup") == "higher"
+        assert regress.direction("tokens_per_s_t16") == "higher"
+        # the wall-clock fields carry CPU noise floors; the static
+        # dispatch counters must NOT (the PR-14 floor discipline)
+        assert regress.noise_floor("tokens_per_s_t16", "cpu") > 0
+        assert regress.noise_floor("dispatches_per_token_t16", "cpu") == 0
+        assert regress.noise_floor("tokens_per_s_t16", "tpu") == 0
+
+    def test_macro_row_through_check_cli(self, tmp_path):
+        # the full record.py --check path (it runs regress.main
+        # in-process on two artifacts): a clean same-code pair exits
+        # 0, an injected dispatches-per-token regression exits 1
+        import json
+
+        from tpuscratch.obs import regress
+
+        row = {
+            "config": 12, "metric": "serve_decode_macro",
+            "platform": "cpu", "value": 1.5e4,
+            "tokens_per_s_t1": 1.2e3, "tokens_per_s_t16": 1.5e4,
+            "macro_speedup": 12.5,
+            "dispatches_per_token_t16": 0.0625,
+            "host_syncs_per_token_t16": 0.0625,
+        }
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps(row) + "\n")
+        clean = tmp_path / "clean.json"
+        clean.write_text(json.dumps(row) + "\n")
+        assert regress.main([str(base), str(clean)]) == 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(
+            json.dumps(dict(row, dispatches_per_token_t16=1.0)) + "\n"
+        )
+        assert regress.main([str(base), str(bad)]) == 1

@@ -1,13 +1,15 @@
 """Serving-side decode throughput and per-token latency (BASELINE row 12).
 
 ``python -m tpuscratch.bench.decode_bench [--json PATH]
-[--kv-dtype int8|fp8] [--spec K] [--fused auto|on|off]``
+[--kv-dtype int8|fp8] [--spec K] [--fused auto|on|off] [--macro T]``
 
 ``--kv-dtype int8``/``fp8`` runs the sweep on quantized KV pages (~1/4
 the cache bytes per token); ``--spec K`` speculates K draft tokens per
 verify sweep over an accept-friendly periodic prompt; ``--fused``
 selects the decode-sweep kernel (the fused Pallas paged-attention
-kernel vs the dense XLA oracle) — the serving hot-path levers, locally
+kernel vs the dense XLA oracle); ``--macro T`` fuses T engine ticks
+into one compiled scan (one dispatch + one host sync per T tokens,
+``ServeConfig(macro_steps)``) — the serving hot-path levers, locally
 sweepable before a record run.
 
 Every row additionally carries the decode-sweep ROOFLINE: the HBM
@@ -101,15 +103,25 @@ class DecodeBenchResult:
     times_per_token_s: tuple[float, ...] = ()
     # the decode-sweep roofline (ISSUE 12): HBM bytes the measured
     # window's sweeps moved — per tick, each live slot's page footprint
-    # (engine.cached_pages sampled before the tick) times the pool's
-    # exact per-token bytes (pages + amortized scale planes, the
-    # obs.ledger.kv_cache_bytes accounting) — over the measured wall,
-    # against the stated platform peak.  swept_bytes is STATIC
+    # (engine.cached_pages, trapezoid of the tick-boundary samples)
+    # times the pool's exact per-token bytes (pages + amortized scale
+    # planes, the obs.ledger.kv_cache_bytes accounting) times the
+    # tick's ROUND delta (a macro tick sweeps its pages up to
+    # macro_steps times per dispatch — ISSUE 15) — over the measured
+    # wall, against the stated platform peak.  swept_bytes is STATIC
     # accounting (page counts x ledger bytes), only the wall is sampled.
     swept_bytes: float = 0.0
     achieved_bytes_per_s: float = 0.0
     achieved_frac: float = 0.0
     fused: str = "auto"
+    # macro-step decode accounting (ISSUE 15): tokens per decode
+    # dispatch the window ran at, and the measured-window dispatch /
+    # host-sync cost PER TOKEN — the two static counters macro decode
+    # drives down ~T× (exact engine counters over exact token counts,
+    # nothing sampled)
+    macro_steps: int = 1
+    dispatches_per_token: float = 0.0
+    host_syncs_per_token: float = 0.0
 
     @property
     def tokens_per_s(self) -> float:
@@ -135,6 +147,12 @@ class DecodeBenchResult:
             out += (
                 f", sweep {self.achieved_bytes_per_s / 1e9:.2f} GB/s "
                 f"({100 * self.achieved_frac:.1f}% of peak)"
+            )
+        if self.macro_steps > 1:
+            out += (
+                f", macro T={self.macro_steps}: "
+                f"{self.dispatches_per_token:.4f} dispatches/token, "
+                f"{self.host_syncs_per_token:.4f} syncs/token"
             )
         return out
 
@@ -574,6 +592,38 @@ def bench_tiered_residency(mesh, cfg, scfg, host_pages: int,
     }
 
 
+def bench_budget(scfg, tokens_per_tick: int | None = None,
+                 measure_steps: int = 32, warmup_steps: int = 4) -> int:
+    """Per-slot generation budget of one :func:`bench_decode` window:
+    (warmup + measure + 2) ticks × the tokens a tick can emit per slot
+    — ONE definition (the +2 teardown margin and the tick ceiling),
+    shared by the bench itself and every caller that must pre-check
+    the page reservation.  ``tokens_per_tick`` defaults to the
+    config's own ceiling: max(spec_k + 1, CLAMP-AWARE macro_steps) —
+    the engine's ``serve.engine.macro_clamp`` rule, so a spec/tiered
+    config is never budgeted for a macro width it won't run."""
+    if tokens_per_tick is None:
+        from tpuscratch.serve.engine import macro_clamp
+
+        tokens_per_tick = max(scfg.spec_k + 1, macro_clamp(scfg)[0])
+    return (warmup_steps + measure_steps + 2) * tokens_per_tick
+
+
+def fitting_batches(scfg, batches, tokens_per_tick: int | None = None,
+                    prompt_len: int = 8, measure_steps: int = 32,
+                    warmup_steps: int = 4) -> tuple[int, tuple[int, ...]]:
+    """(pages one slot reserves, the ``batches`` whose full bank fits
+    one group's pool) for a :func:`bench_decode` window — the
+    admission-watermark arithmetic, shared by the ``--spec`` and
+    ``--macro`` CLI guards and record config 12's macro row so the
+    three can never desync from :func:`bench_budget`."""
+    budget = bench_budget(scfg, tokens_per_tick,
+                          measure_steps=measure_steps,
+                          warmup_steps=warmup_steps)
+    need = -(-(prompt_len + budget) // scfg.page_size)
+    return need, tuple(b for b in batches if b * need <= scfg.n_pages)
+
+
 def bench_decode(
     mesh,
     cfg,
@@ -610,9 +660,12 @@ def bench_decode(
     # through the last measured tick — finishing exactly on it would put
     # the all-slot eviction/free teardown inside the timed window, and
     # with 64 samples p99 interpolates at the max.  A speculative tick
-    # can emit up to spec_k + 1 tokens per slot, so the budget (and the
-    # pool reservation) scales by that ceiling.
-    budget = (warmup_steps + measure_steps + 2) * (scfg.spec_k + 1)
+    # can emit up to spec_k + 1 tokens per slot, and a MACRO tick up to
+    # the CLAMP-AWARE macro_steps, so the budget (and the pool
+    # reservation) scales by that ceiling (bench_budget — one shared
+    # definition with the CLI/record fitting guards)
+    budget = bench_budget(scfg, measure_steps=measure_steps,
+                          warmup_steps=warmup_steps)
     scfg = dataclasses.replace(
         scfg, max_seq=max(scfg.max_seq, prompt_len + budget),
     )
@@ -631,18 +684,34 @@ def bench_decode(
     compiles_before = engine.decode_compiles
     tokens0, slots0 = engine.tokens_generated, engine.slot_steps
     accepted0 = engine.spec_accepted
+    disp0, sync0 = engine.dispatches, engine.host_syncs
     page_bytes = engine.scfg.page_size * engine.kv_bytes_per_token
     times, tick_tokens = [], []
     swept_bytes = 0.0
     tprev = engine.tokens_generated
+    rprev = engine.decode_rounds
     for _ in range(measure_steps):
-        # pages THIS tick's sweep gathers, sampled before it runs —
-        # static accounting (page counts x exact ledger bytes/token);
-        # one sweep reads them once whether it scores 1 or K queries
-        swept_bytes += engine.cached_pages * page_bytes
+        # pages the tick's sweeps gather — static accounting (page
+        # counts x exact ledger bytes/token); one ROUND reads each live
+        # slot's footprint once whether it scores 1 or K queries, and a
+        # macro tick runs up to macro_steps rounds per dispatch, so the
+        # footprint scales by the tick's round delta (without it a
+        # macro tick's sweep traffic would be under-counted ~T× and
+        # achieved_frac silently mis-stated).  The footprint GROWS
+        # inside the tick as frontiers advance, so the per-round
+        # estimate is the trapezoid of the boundary samples — exact
+        # for the (linear) steady-state growth either side of a page
+        # boundary, and unbiased across them.
+        pages_before = engine.cached_pages * page_bytes
         t0 = time.perf_counter()
         engine.step()  # pulls sampled tokens to host: fenced
         times.append(time.perf_counter() - t0)
+        pages_after = engine.cached_pages * page_bytes
+        swept_bytes += (
+            0.5 * (pages_before + pages_after)
+            * (engine.decode_rounds - rprev)
+        )
+        rprev = engine.decode_rounds
         tnow = engine.tokens_generated
         tick_tokens.append(tnow - tprev)
         tprev = tnow
@@ -660,7 +729,9 @@ def bench_decode(
     res = BenchResult(
         name=f"decode b={scfg.n_slots} prompt={prompt_len} "
              f"page={scfg.page_size} kv={scfg.kv_dtype}"
-             + (f" spec={scfg.spec_k}" if scfg.spec_k else ""),
+             + (f" spec={scfg.spec_k}" if scfg.spec_k else "")
+             + (f" macro={engine.macro_steps_effective}"
+                if engine.macro_steps_effective > 1 else ""),
         times_s=tuple(times),
         items=tokens / measure_steps,  # measured tokens per tick
     )
@@ -679,6 +750,9 @@ def bench_decode(
         achieved_bytes_per_s=achieved,
         achieved_frac=achieved / peak_hbm_bytes_per_s(),
         fused=scfg.fused_attention,
+        macro_steps=engine.macro_steps_effective,
+        dispatches_per_token=(engine.dispatches - disp0) / max(1, tokens),
+        host_syncs_per_token=(engine.host_syncs - sync0) / max(1, tokens),
     )
     if sink is not None and sink.enabled:
         sink.emit(
@@ -692,6 +766,9 @@ def bench_decode(
             achieved_hbm_gbps=out.achieved_bytes_per_s / 1e9,
             achieved_frac=out.achieved_frac,
             fused=scfg.fused_attention,
+            macro_steps=out.macro_steps,
+            dispatches_per_token=out.dispatches_per_token,
+            host_syncs_per_token=out.host_syncs_per_token,
             **({"accept_len_mean": accept_mean}
                if accept_mean is not None else {}),
         )
@@ -782,6 +859,15 @@ def main(argv=None) -> int:
                          "(0 = off); sweeps use an accept-friendly "
                          "periodic prompt so the amortization regime "
                          "is what gets measured")
+    ap.add_argument("--macro", type=int, default=1, metavar="T",
+                    help="device-resident macro-step decode: tokens "
+                         "per engine dispatch (1 = the per-token "
+                         "legacy program; T > 1 fuses T ticks into "
+                         "one compiled lax.scan — one dispatch + one "
+                         "host sync per T tokens, bit-identical "
+                         "greedy output; clamped to 1 under --spec / "
+                         "--kv-host-pages, which need per-token host "
+                         "decisions)")
     ap.add_argument("--share-ratio", default=None, metavar="R[,R...]",
                     help="run the PREFIX-SHARING stream workload at "
                          "these prompt share ratios (comma-separated, "
@@ -837,6 +923,7 @@ def main(argv=None) -> int:
     scfg = dataclasses.replace(scfg, kv_dtype=args.kv_dtype,
                                spec_k=args.spec,
                                fused_attention=args.fused,
+                               macro_steps=max(1, args.macro),
                                kv_host_pages=max(0, args.kv_host_pages)
                                if not args.long_context else 0)
 
@@ -976,10 +1063,12 @@ def main(argv=None) -> int:
         # a speculative slot's budget (hence page reservation) scales by
         # spec + 1; drop sweep points whose full bank cannot fit the
         # pool — the admission watermark would (correctly) refuse them
-        budget = (kwargs.get("warmup_steps", 4)
-                  + kwargs.get("measure_steps", 32) + 2) * (args.spec + 1)
-        need = -(-(len(kwargs["prompt"]) + budget) // scfg.page_size)
-        fitting = tuple(b for b in batches if b * need <= scfg.n_pages)
+        need, fitting = fitting_batches(
+            scfg, batches, args.spec + 1,
+            prompt_len=len(kwargs["prompt"]),
+            measure_steps=kwargs.get("measure_steps", 32),
+            warmup_steps=kwargs.get("warmup_steps", 4),
+        )
         for b in set(batches) - set(fitting):
             print(f"# batch {b} skipped: speculative reservation "
                   f"{b * need} pages > pool {scfg.n_pages}",
@@ -989,6 +1078,28 @@ def main(argv=None) -> int:
                 f"--spec {args.spec}: even batch 1 reserves {need} pages "
                 f"> pool {scfg.n_pages}; lower --spec or the measured "
                 "window"
+            )
+        batches = fitting
+    if args.macro > 1 and not args.spec and args.kv_host_pages <= 0:
+        # a macro slot's budget (hence page reservation) scales by T —
+        # the speculative fitting rule, clamp-aware through
+        # fitting_batches (under --spec / --kv-host-pages the engine
+        # runs T=1 and the spec block above already sized the bank)
+        need, fitting = fitting_batches(
+            scfg, batches,
+            prompt_len=kwargs.get("prompt_len", 8),
+            measure_steps=kwargs.get("measure_steps", 32),
+            warmup_steps=kwargs.get("warmup_steps", 4),
+        )
+        for b in set(batches) - set(fitting):
+            print(f"# batch {b} skipped: macro T={args.macro} "
+                  f"reservation {b * need} pages > pool {scfg.n_pages}",
+                  file=sys.stderr)
+        if not fitting:
+            ap.error(
+                f"--macro {args.macro}: even batch 1 reserves {need} "
+                f"pages > pool {scfg.n_pages}; lower --macro or the "
+                "measured window"
             )
         batches = fitting
     rows = []
@@ -1012,6 +1123,9 @@ def main(argv=None) -> int:
                 "achieved_hbm_gbps": r.achieved_bytes_per_s / 1e9,
                 "achieved_frac": r.achieved_frac,
                 "fused": r.fused,
+                "macro_steps": r.macro_steps,
+                "dispatches_per_token": r.dispatches_per_token,
+                "host_syncs_per_token": r.host_syncs_per_token,
             }
             if r.accept_len_mean is not None:
                 row["accept_len_mean"] = r.accept_len_mean

@@ -1114,6 +1114,67 @@ def config12_decode(out: list, obs_path=None) -> None:
             ),
         )
 
+        # device-resident macro-step decode (ISSUE 15): the SAME
+        # steady-state workload at macro_steps T in {1, 4, 16} — one
+        # compiled lax.scan dispatch and one sampling host-sync per T
+        # tokens instead of per token.  dispatches/token and host
+        # syncs/token are EXACT engine counters over exact token counts
+        # (static, tight regression band — they must drop ~T×);
+        # tokens/s is the measured wall-clock payoff (median-of-3,
+        # CPU-proxy noise floors apply off-TPU only — the PR-14 floor
+        # discipline).  Greedy bit-identity across T is test-gated
+        # (tests/test_serve_macro.py), not re-proven here.
+        # a macro slot's budget (hence page reservation) scales by T:
+        # pick the largest sweep batch whose T=16 bank fits the pool
+        # (decode_bench.fitting_batches — the one shared sizing rule),
+        # same batch at every T so the comparison is apples-to-apples
+        from tpuscratch.bench.decode_bench import fitting_batches
+
+        _, _fit = fitting_batches(
+            scfg, batches, 16,
+            prompt_len=kwargs.get("prompt_len", 8),
+            measure_steps=kwargs.get("measure_steps", 32),
+            warmup_steps=kwargs.get("warmup_steps", 4),
+        )
+        macro_batch = max(_fit or (1,))
+        macro_rows = {}
+        for T in (1, 4, 16):
+            macro_rows[T] = _median_run(
+                lambda T=T: bench_decode(
+                    mesh, cfg, _dc.replace(scfg, n_slots=macro_batch,
+                                           macro_steps=T),
+                    sink=sink, **kwargs,
+                ),
+                key=lambda r: r.tokens_per_s,
+            )
+            print(f"# macro T={T}: {macro_rows[T].summary()}",
+                  file=sys.stderr)
+        r1, r16 = macro_rows[1], macro_rows[16]
+        _emit(
+            out,
+            config=12,
+            metric="serve_decode_macro",
+            value=r16.tokens_per_s,
+            tokens_per_s_t1=r1.tokens_per_s,
+            tokens_per_s_t4=macro_rows[4].tokens_per_s,
+            tokens_per_s_t16=r16.tokens_per_s,
+            macro_speedup=r16.tokens_per_s / r1.tokens_per_s,
+            dispatches_per_token_t1=r1.dispatches_per_token,
+            dispatches_per_token_t4=macro_rows[4].dispatches_per_token,
+            dispatches_per_token_t16=r16.dispatches_per_token,
+            host_syncs_per_token_t1=r1.host_syncs_per_token,
+            host_syncs_per_token_t16=r16.host_syncs_per_token,
+            detail=(
+                f"T=16 {r16.tokens_per_s:.3e} tok/s "
+                f"({r16.tokens_per_s / r1.tokens_per_s:.2f}x vs T=1); "
+                f"dispatches/token "
+                f"{r1.dispatches_per_token:.4f} -> "
+                f"{r16.dispatches_per_token:.4f}, host syncs/token "
+                f"{r1.host_syncs_per_token:.4f} -> "
+                f"{r16.host_syncs_per_token:.4f}"
+            ),
+        )
+
 
 def config13_zero_train(out: list, iters: int = 3) -> None:
     """Replicated vs ZeRO-sharded training (ISSUE 4): tokens/s of the

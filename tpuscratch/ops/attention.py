@@ -1237,6 +1237,48 @@ def paged_attention_supported(H: int, D: int, page_size: int,
     return None
 
 
+#: VMEM scratch budget of the fused paged kernel, in STATE ROWS (the
+#: sublane extent of the (H*K, ·) online-softmax scratch: m/l lanes +
+#: the (H*K, D) fp32 accumulator).  The record-config-12 geometries sit
+#: far under it (H=8, K<=16 -> 128 rows); a large-H model (e.g. H=128
+#: at K=8 -> 1024+) overflows, and the grid then gains a head-block
+#: axis (:func:`_head_block`).  Override for tests / other chips via
+#: the env var.
+_PAGED_STATE_ROWS_ENV = "TPUSCRATCH_PAGED_STATE_ROWS"
+_PAGED_STATE_ROWS_DEFAULT = 512
+
+
+def _paged_state_rows() -> int:
+    env = os.environ.get(_PAGED_STATE_ROWS_ENV, "").strip()
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return _PAGED_STATE_ROWS_DEFAULT
+
+
+def _head_block(H: int, K: int) -> int:
+    """Heads per grid step of the fused paged kernel: all of them while
+    ``H*K`` state rows fit the scratch budget, else the largest divisor
+    of ``H`` that does (compiled Mosaic additionally keeps the
+    8-sublane quantum; interpret mode accepts any divisor).  Falls back
+    to the full H when no divisor qualifies — the un-split kernel is
+    still correct, just scratch-hungry."""
+    budget = _paged_state_rows()
+    if H * K <= budget:
+        return H
+    for h in range(H - 1, 0, -1):
+        if H % h:
+            continue
+        if h * K > budget:
+            continue
+        if not use_interpret() and h % 8:
+            continue
+        return h
+    return H
+
+
 def _use_paged_kernel(fused: bool | None, hd: tuple[int, int],
                       k_pages) -> bool:
     """Resolve the ``fused`` argument of the cached entry points."""
@@ -1255,9 +1297,10 @@ def _use_paged_kernel(fused: bool | None, hd: tuple[int, int],
 def _paged_kernel(
     tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest,
     scale: float, page: int, K: int, H: int, D: int, nj: int,
-    quantized: bool,
+    quantized: bool, head_grid: bool = False,
 ):
-    """One (sequence b, page j) grid step of the fused sweep.
+    """One (sequence b[, head block h], page j) grid step of the fused
+    sweep.
 
     Scalar-prefetch refs: tbl (B, max_pages) clipped page ids, lens (B,)
     true cached lengths.  Blocks: q (1, K, H, D) — constant across j;
@@ -1270,7 +1313,15 @@ def _paged_kernel(
 
     Rows are ordered head-major (row h*K + kq is head h, query kq) so
     the per-page score block computes as ONE head-batched MXU pass and
-    the online-softmax state updates stay 2D elementwise."""
+    the online-softmax state updates stay 2D elementwise.
+
+    ``head_grid``: the LARGE-H variant — the grid gains a head-block
+    axis (B, H/Hb, max_pages) when the full ``H*K`` state rows would
+    overflow the VMEM scratch budget (``_paged_state_rows``), and
+    ``H`` here is the per-block head count Hb.  Each (b, h) pair runs
+    its own page sweep against its own scratch; the head axis rides
+    the BLOCK index maps, so this kernel body is unchanged beyond
+    which program_id is the page step."""
     if quantized:
         ks_ref, vs_ref, o_ref = rest[0], rest[1], rest[2]
         m_scr, l_scr, acc_scr = rest[3:]
@@ -1279,7 +1330,7 @@ def _paged_kernel(
         o_ref = rest[0]
         m_scr, l_scr, acc_scr = rest[1:]
     b = pl.program_id(0)
-    j = pl.program_id(1)
+    j = pl.program_id(2 if head_grid else 1)
     seq_len = len_ref[b]
     # pages this sequence's sweep must read: query position kq attends
     # cache entries < seq_len + kq, so the frontier is seq_len + K - 1
@@ -1386,40 +1437,70 @@ def paged_attention(
     quantized = k_scale is not None
     table = jnp.clip(page_table, 0, n_pages - 1).astype(jnp.int32)
     lens = seq_lens.astype(jnp.int32)
+    # head-grid variant (ISSUE 15, the PR-12 large-H remainder): when
+    # the full H*K state rows overflow the VMEM scratch budget, the
+    # grid gains a head-block axis and each (sequence, head block)
+    # pair runs its own page sweep — heads are independent in
+    # attention, so splitting them changes nothing but the scratch
+    # footprint (oracle-equivalence pinned at FUSED_PAGED_ATOL)
+    Hb = _head_block(H, K)
+    head_grid = Hb < H
 
-    def kv_imap(b, j, tbl, ln):
-        last = jnp.maximum((ln[b] + K - 1 + page_size - 1) // page_size - 1, 0)
-        return tbl[b, jnp.minimum(j, last)], 0, 0, 0
+    if head_grid:
+        def kv_imap(b, h, j, tbl, ln):
+            last = jnp.maximum(
+                (ln[b] + K - 1 + page_size - 1) // page_size - 1, 0
+            )
+            return tbl[b, jnp.minimum(j, last)], 0, h, 0
 
-    def scale_imap(b, j, tbl, ln):
-        p_, _, _, _ = kv_imap(b, j, tbl, ln)
-        return p_, 0
+        def scale_imap(b, h, j, tbl, ln):
+            p_, _, _, _ = kv_imap(b, h, j, tbl, ln)
+            return p_, h
 
-    qspec = pl.BlockSpec((1, K, H, D), lambda b, j, tbl, ln: (b, 0, 0, 0))
-    kvspec = pl.BlockSpec((1, page_size, H, D), kv_imap)
+        qspec = pl.BlockSpec(
+            (1, K, Hb, D), lambda b, h, j, tbl, ln: (b, 0, h, 0)
+        )
+        grid = (B, H // Hb, max_pages)
+        semantics = ("parallel", "parallel", "arbitrary")
+    else:
+        def kv_imap(b, j, tbl, ln):
+            last = jnp.maximum(
+                (ln[b] + K - 1 + page_size - 1) // page_size - 1, 0
+            )
+            return tbl[b, jnp.minimum(j, last)], 0, 0, 0
+
+        def scale_imap(b, j, tbl, ln):
+            p_, _, _, _ = kv_imap(b, j, tbl, ln)
+            return p_, 0
+
+        qspec = pl.BlockSpec((1, K, Hb, D), lambda b, j, tbl, ln: (b, 0, 0, 0))
+        grid = (B, max_pages)
+        semantics = ("parallel", "arbitrary")
+    kvspec = pl.BlockSpec((1, page_size, Hb, D), kv_imap)
     in_specs = [qspec, kvspec, kvspec]
     inputs = [q, k_pages, v_pages]
     if quantized:
-        sspec = pl.BlockSpec((1, H), scale_imap)
+        sspec = pl.BlockSpec((1, Hb), scale_imap)
         in_specs += [sspec, sspec]
         inputs += [k_scale, v_scale]
     kern = functools.partial(
         _paged_kernel,
         scale=1.0 / float(D) ** 0.5, page=page_size,
-        K=K, H=H, D=D, nj=max_pages, quantized=quantized,
+        K=K, H=Hb, D=D, nj=max_pages, quantized=quantized,
+        head_grid=head_grid,
     )
-    params = mosaic_params(dimension_semantics=("parallel", "arbitrary"))
+    params = mosaic_params(dimension_semantics=semantics)
     return pl.pallas_call(
         kern,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B, max_pages),
+            grid=grid,
             in_specs=in_specs,
             out_specs=qspec,
             scratch_shapes=[
-                pltpu.VMEM((H * K, _STATE_LANES), jnp.float32),
-                pltpu.VMEM((H * K, _STATE_LANES), jnp.float32),
-                pltpu.VMEM((H * K, D), jnp.float32),
+                pltpu.VMEM((Hb * K, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((Hb * K, _STATE_LANES), jnp.float32),
+                pltpu.VMEM((Hb * K, D), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, K, H, D), q.dtype),

@@ -6,11 +6,14 @@ per-page refcounts + a prefix trie for cross-request sharing, and a
 host paging tier — HostPageStore/TieredPageAllocator — spilling cold
 pages to pinned host memory behind ``ServeConfig(kv_host_pages)``), a
 cached single-token decode step numerically equivalent to the full
-forward (decode + ops.attention.decode_attention), deterministic
-per-request sampling (sampling), a continuous-batching engine with
-free-page-watermark admission and zero steady-state recompiles
-(engine; opt-in prefix sharing — full-page trie plus sub-page
-boundary continuations — chunked prefill, and wave-scheduled
+forward (decode + ops.attention.decode_attention) with an optional
+device-resident macro-step loop fusing T whole engine ticks into one
+compiled ``lax.scan`` (``ServeConfig(macro_steps)``: one dispatch and
+one host sync per T tokens, greedy output bit-identical at any T),
+deterministic per-request sampling (sampling), a continuous-batching
+engine with free-page-watermark admission and zero steady-state
+recompiles (engine; opt-in prefix sharing — full-page trie plus
+sub-page boundary continuations — chunked prefill, and wave-scheduled
 spill/prefetch with cold hits measured), a prefill/decode-
 disaggregated front end shipping finished KV pages between mesh
 slices through comm/p2p (disagg), and a fleet router dispatching
@@ -22,6 +25,7 @@ per-tenant SLO classes, and an autoscaled prefill:decode pool
 from tpuscratch.serve.decode import (  # noqa: F401
     CompileCounter,
     build_context_prefill,
+    build_decode_loop,
     build_decode_step,
     build_prefill,
     build_verify_step,

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
@@ -71,6 +72,7 @@ from tpuscratch.serve.kvcache import (
     kv_cache_spec,
     quantize_pages,
 )
+from tpuscratch.serve.sampling import request_keys, sample_batch
 
 
 # promoted to the observability subsystem (recompile detection is not a
@@ -340,6 +342,165 @@ def build_decode_step(mesh: Mesh, cfg: TransformerConfig,
         body,
         (pspec, kspec, P(dp), P(dp), P(dp), P(dp), P(dp)),
         (P(dp), kspec),
+        donate_argnums=(1,),
+    )
+
+
+# ---- device-resident macro-step decode (ISSUE 15) ------------------------
+
+
+def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
+                   macro_steps: int, temperature: float = 0.0,
+                   top_k: int = 0, sp: str = "sp", dp: str = "dp",
+                   quantized: bool = False, fused: bool | None = None):
+    """The macro-step shard_map body: ``macro_steps`` whole engine
+    token-ticks — decode sweep, unembed, sample, quantized KV write,
+    frontier/length advance — fused into ONE ``lax.scan``, so the host
+    dispatches and syncs once per T tokens instead of per token (the
+    ``mpicuda4.cu`` one-kernel-does-everything reduction applied to the
+    serving tick; per-token host orchestration is pure badput once the
+    sweep itself is cheap).
+
+    (params, kv, embed, key_data, tables, n_cached, rids, positions,
+    budgets, last_tok) -> ((T, B_loc) tokens, (T, B_loc) active mask,
+    kv').
+
+    Local shapes: tables (B_loc, max_pages) — each slot's FULL page
+    list (prompt + reserved budget tail; the write frontier advances
+    into the tail inside the scan), sentinel rows for empty slots;
+    n_cached (B_loc,) tokens already cached (0 idles the slot);
+    rids/positions (B_loc,) — the per-request PRNG fold-in chain,
+    positions advanced in-carry so draw ``i`` of a request is keyed
+    identically to the per-token engine's; budgets (B_loc,) tokens this
+    slot may still emit; last_tok (B_loc,) each slot's current token.
+    embed (V, d) and key_data (the engine seed key's
+    ``jax.random.key_data``) are replicated.
+
+    Scan-step semantics are EXACTLY one legacy engine tick, so greedy
+    output is bit-identical across macro_steps:
+
+    - a slot is ACTIVE while ``n_cached > 0`` and it has budget left;
+      a slot whose budget ends mid-scan flips to the legacy IDLE
+      contract for the remaining iterations — zero input vector,
+      ``seq_len == 0`` (attention returns zeros, the MoE idle-last
+      permutation sorts it out of capacity competition), sentinel
+      write target (the drop-mode scatter / quantized-write drop
+      suppresses its K/V write) — byte-for-byte what the per-token
+      engine feeds an evicted slot's seat;
+    - the write target is computed in-carry from the slot's own table
+      row and frontier, so page-boundary crossings need no host;
+    - sampling draws ``fold_in(fold_in(seed, rid), position)`` exactly
+      as ``serve.sampling.request_keys`` does host-side.
+
+    The in-program EARLY-EXIT mask: each iteration reduces "any slot
+    active?" across the whole mesh (one scalar psum — replicated, so
+    every rank takes the same branch) and an all-done bank skips the
+    sweep/sample body via ``lax.cond`` instead of burning the tail of
+    the scan on idle sweeps.
+
+    The scan compiles to ONE while loop: the sweep's gather/collective
+    pattern appears once in the optimized HLO and is REUSED T times
+    (ledger-asserted in tests), which is why steady-state recompiles
+    stay zero at any T."""
+    if macro_steps < 1:
+        raise ValueError(f"macro_steps must be >= 1, got {macro_steps}")
+    step = decode_step_fn(cfg, sp=sp, dp=dp, quantized=quantized,
+                          fused=fused)
+    page_size, n_pages = geom.page_size, geom.n_pages
+
+    def loop(params, kv, embed, key_data, tables, n_cached, rids,
+             positions, budgets, last_tok):
+        key = jax.random.wrap_key_data(key_data)
+        B = tables.shape[0]
+
+        def body(carry, _):
+            kv, n_cached, positions, last_tok, emitted = carry
+            active = (n_cached > 0) & (emitted < budgets)
+            # replicated early-exit predicate: every rank must agree
+            # (the MoE FFN reduces over dp, attention output over sp)
+            any_active = lax.psum(
+                jnp.any(active).astype(jnp.int32), (dp, sp)
+            ) > 0
+
+            def tick(ops):
+                kv, n_cached, positions, last_tok, emitted = ops
+                act_i = active.astype(n_cached.dtype)
+                x = jnp.where(active[:, None], embed[last_tok], 0.0)
+                seq = jnp.where(active, n_cached + 1, 0)
+                pidx = jnp.clip(
+                    n_cached // page_size, 0, tables.shape[1] - 1
+                )
+                wp = jnp.where(
+                    active,
+                    jnp.take_along_axis(tables, pidx[:, None], 1)[:, 0],
+                    n_pages,
+                )
+                woff = jnp.where(active, n_cached % page_size, 0)
+                out, kv = step(params, kv, x, tables, wp, woff, seq)
+                logits = out @ embed.T
+                # the ONE key-derivation chain (serve.sampling): the
+                # per-token engine and this scan must draw the same
+                # streams or macro bit-identity silently breaks
+                keys = request_keys(key, rids, positions)
+                toks = sample_batch(keys, logits, temperature=temperature,
+                                    top_k=top_k)
+                toks = jnp.where(active, toks, 0)
+                return (
+                    (kv, n_cached + act_i, positions + act_i,
+                     jnp.where(active, toks, last_tok), emitted + act_i),
+                    toks,
+                )
+
+            def skip(ops):
+                return ops, jnp.zeros((B,), jnp.int32)
+
+            carry, toks = lax.cond(
+                any_active, tick, skip,
+                (kv, n_cached, positions, last_tok, emitted),
+            )
+            return carry, (toks, active)
+
+        init = (kv, n_cached, positions, last_tok,
+                jnp.zeros_like(budgets))
+        (kv, *_), (toks, mask) = lax.scan(
+            body, init, None, length=macro_steps
+        )
+        return toks, mask, kv
+
+    return loop
+
+
+def build_decode_loop(mesh: Mesh, cfg: TransformerConfig,
+                      geom: CacheGeometry, macro_steps: int,
+                      temperature: float = 0.0, top_k: int = 0,
+                      dp: str = "dp", sp: str = "sp",
+                      counter: CompileCounter | None = None,
+                      quantized: bool = False, fused: bool | None = None):
+    """Compiled device-resident macro-step decode over ``mesh``: jit'd
+    fn(params, kv, embed, key_data, tables (B, max_pages), n_cached,
+    rids, positions, budgets, last_tok — all (B,) int32) ->
+    (tokens (T, B), active_mask (T, B), kv'), slots sharded P(dp),
+    embed/key replicated, cache donated.  ONE dispatch and ONE
+    host-sync per ``macro_steps`` generated tokens; the engine holds B
+    fixed at its slot count and T fixed at construction, so
+    steady-state macro decode never recompiles (``counter`` proves
+    it).  See :func:`decode_loop_fn` for the per-iteration contract
+    and the bit-identity argument."""
+    check_serve_mesh(mesh, cfg, dp, sp)
+    _check_geometry(cfg, geom)
+    body = decode_loop_fn(
+        cfg, geom, macro_steps, temperature=temperature, top_k=top_k,
+        sp=sp, dp=dp, quantized=quantized, fused=fused,
+    )
+    if counter is not None:
+        body = counter.wrap(body)
+    pspec = param_spec(cfg, dp)
+    kspec = kv_cache_spec(dp, sp, quantized)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, kspec, P(), P(), P(dp), P(dp), P(dp), P(dp), P(dp), P(dp)),
+        (P(None, dp), P(None, dp), kspec),
         donate_argnums=(1,),
     )
 

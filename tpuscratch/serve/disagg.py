@@ -258,6 +258,26 @@ class DisaggEngine:
         prefill sampled the first token)."""
         return self.engine.take_ttft(rid)
 
+    # decode-side dispatch accounting (ISSUE 15), delegated to the
+    # decode engine where the macro loop runs; the staging slice's
+    # prefill dispatches are deliberately not counted (the contract is
+    # decode-side, like ServeEngine's)
+    @property
+    def dispatches(self) -> int:
+        return self.engine.dispatches
+
+    @property
+    def host_syncs(self) -> int:
+        return self.engine.host_syncs
+
+    @property
+    def decode_rounds(self) -> int:
+        return self.engine.decode_rounds
+
+    @property
+    def macro_steps_effective(self) -> int:
+        return self.engine.macro_steps_effective
+
     def validate(self, req: Request) -> None:
         """The decode engine's rules plus the staging-pool bound —
         the front-door contract (``ServeEngine.validate``)."""

@@ -44,6 +44,7 @@ from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
 from tpuscratch.runtime.profiling import Timeline
 from tpuscratch.serve.decode import (
     build_context_prefill,
+    build_decode_loop,
     build_decode_step,
     build_prefill,
     build_verify_step,
@@ -137,6 +138,26 @@ class ServeConfig:
     # their whole length inside one tick — one long admission stops
     # blocking every resident decode stream (bounds per-token p99)
     chunk_prefill: int = 0
+    # device-resident macro-step decode (ISSUE 15): tokens generated
+    # per engine dispatch.  1 (default) runs the EXACT legacy per-token
+    # program; N > 1 fuses N whole engine ticks — decode sweep,
+    # unembed, sample, KV write, frontier/length advance — into ONE
+    # compiled lax.scan carrying all slot state on device, so the
+    # engine pays ONE XLA dispatch and ONE sampling host-sync per N
+    # tokens instead of per token (the dominant un-attacked term on
+    # the decode hot path once the sweep itself is cheap).  Greedy
+    # output is bit-identical at any N; insert/evict/admission,
+    # chunked-prefill advancement and router re-roling happen at
+    # macro-tick boundaries; a done-mask suppresses writes for slots
+    # whose budget ends mid-scan and an in-program early-exit mask
+    # skips the tail of an all-done bank.  Paths that need PER-TOKEN
+    # host decisions CLAMP the effective N to 1 rather than silently
+    # degrading: speculative decode (spec_k > 0 — the draft proposer
+    # is a host-side scan) and tiered KV (kv_host_pages > 0 — wave
+    # staging/prefetch are host-side); the clamp is ledger-visible
+    # (serve/macro_steps gauge, macro_steps_effective in the
+    # serve/engine event, engine.macro_steps_effective).
+    macro_steps: int = 1
     # tiered KV memory (0 = off): N host-tier page slots PER dp group
     # (serve/kvcache.HostPageStore over native/hostpool pinned buffers).
     # Cold pages — idle reserve tails, old chunks past the residency
@@ -186,6 +207,14 @@ class GenerateReport:
     slot_steps: int = 0   # active-slot decode/verify invocations
     drafted: int = 0      # speculative draft tokens scored
     accepted: int = 0     # draft tokens accepted into outputs
+    # decode-side dispatch accounting (ISSUE 15): compiled decode
+    # invocations and the host syncs pulling their sampled tokens —
+    # the two per-token costs macro-step decode amortizes to one per
+    # ``macro_steps`` tokens.  For a single decoding stream,
+    # dispatches == ceil(slot_steps / macro_steps) (asserted live in
+    # ex24/ex32); both are registered lower-is-better in obs.regress.
+    dispatches: int = 0
+    host_syncs: int = 0
     # prefix-sharing accounting (the static half of the sharing claim):
     # every prompt token is either COMPUTED through a prefill program
     # (prefill_tokens) or SERVED from a shared page (shared_tokens), so
@@ -253,6 +282,22 @@ _MAX_SPANS = 1024
 #: a retry loop
 DEFAULT_SPILL_RETRY = RetryPolicy(max_attempts=3, base_s=0.005, max_s=0.05,
                                   retryable=(HostTierError,))
+
+
+def macro_clamp(scfg: ServeConfig) -> tuple[int, Optional[str]]:
+    """(effective macro_steps, clamping field or None) — THE clamp
+    rule, one definition: paths that need per-token host decisions run
+    T=1 (speculative drafting is a host-side scan, tiered wave
+    staging/prefetch are host-side).  The engine applies it at
+    construction and reports it (``macro_steps_effective`` /
+    ``macro_clamped_by``); the bench sizes slot budgets and page
+    reservations by the same rule so it can never reserve a ~T×
+    bank for an engine that serves one token per tick."""
+    if scfg.macro_steps > 1 and scfg.spec_k > 0:
+        return 1, "spec_k"
+    if scfg.macro_steps > 1 and scfg.kv_host_pages > 0:
+        return 1, "kv_host_pages"
+    return scfg.macro_steps, None
 
 
 def validate_request(req: Request, scfg: ServeConfig) -> None:
@@ -367,6 +412,10 @@ class ServeEngine:
             raise ValueError(
                 f"kv_host_pages must be >= 0, got {scfg.kv_host_pages}"
             )
+        if scfg.macro_steps < 1:
+            raise ValueError(
+                f"macro_steps must be >= 1, got {scfg.macro_steps}"
+            )
         if (scfg.prefix_share or scfg.chunk_prefill) and scfg.retry_budget:
             raise ValueError(
                 "retry_budget composes with the monolithic admission "
@@ -443,6 +492,12 @@ class ServeEngine:
         self.sink = sink if sink is not None else NullSink()
         bind_sink(chaos, self.sink)  # injected ft/fault events join the stream
         self._tick = 0
+        # effective macro-step width (macro_clamp — the one shared
+        # rule): paths that need PER-TOKEN host decisions clamp to 1
+        # rather than silently degrading; the clamp is ledger-visible
+        # below (gauge + engine event + macro_steps_effective)
+        self._macro_T, self._macro_clamp = macro_clamp(scfg)
+        self.metrics.gauge("serve/macro_steps").set(self._macro_T)
         self.sink.emit(
             "serve/engine",
             n_slots=scfg.n_slots, n_pages=scfg.n_pages,
@@ -450,17 +505,31 @@ class ServeEngine:
             dp_size=self._dp_size, n_layers=cfg.n_layers,
             n_heads=cfg.n_heads, d_model=cfg.d_model,
             kv_dtype=scfg.kv_dtype, spec_k=scfg.spec_k,
+            macro_steps=scfg.macro_steps,
+            macro_steps_effective=self._macro_T,
+            **({"macro_clamped_by": self._macro_clamp}
+               if self._macro_clamp else {}),
         )
         self.decode_counter = CompileCounter()
         self.prefill_counter = CompileCounter()
         # speculation swaps the one-token decode program for ONE fixed
         # (spec_k + 1)-token verify program — still a single compile,
-        # still counted by decode_counter
+        # still counted by decode_counter; macro_steps > 1 swaps it for
+        # ONE fixed T-token scan program, same discipline
+        self._decode_loop = None
         if scfg.spec_k > 0:
             self._decode = build_verify_step(
                 mesh, cfg, self.geom, scfg.spec_k, dp=dp, sp=sp,
                 counter=self.decode_counter, quantized=self._quantized,
                 fused=self._fused,
+            )
+        elif self._macro_T > 1:
+            self._decode = None
+            self._decode_loop = build_decode_loop(
+                mesh, cfg, self.geom, self._macro_T,
+                temperature=scfg.temperature, top_k=scfg.top_k,
+                dp=dp, sp=sp, counter=self.decode_counter,
+                quantized=self._quantized, fused=self._fused,
             )
         else:
             self._decode = build_decode_step(
@@ -489,10 +558,22 @@ class ServeEngine:
             if scfg.prefix_share else None
         )
         self._unembed = jax.jit(lambda o, e: o @ e.T)
+        # the macro loop takes the seed key as raw key DATA (typed PRNG
+        # keys don't ride shard_map argument specs); wrap_key_data
+        # inside the program reproduces the fold_in chain bit-for-bit
+        self._seed_key_data = jax.random.key_data(self._seed_key)
         self._decode_steps = 0
         self._prefill_count = 0
         self._tokens_generated = 0
         self._slot_steps = 0
+        # decode-side dispatch accounting (ISSUE 15): compiled decode
+        # program invocations, host syncs pulling their sampled tokens,
+        # and token ROUNDS the bank has run (a macro tick advances
+        # several rounds per dispatch; the bench's swept-byte roofline
+        # scales by the round delta, not the dispatch count)
+        self._dispatches = 0
+        self._host_syncs = 0
+        self._decode_rounds = 0
         self._spec_drafted = 0
         self._spec_accepted = 0
         self._prefill_s = 0.0
@@ -554,6 +635,45 @@ class ServeEngine:
     def tokens_generated(self) -> int:
         """Engine-lifetime emitted tokens (benches read deltas)."""
         return self._tokens_generated
+
+    @property
+    def macro_steps_effective(self) -> int:
+        """Tokens per decode dispatch after clamping (see
+        ``ServeConfig.macro_steps``); 1 means the per-token program."""
+        return self._macro_T
+
+    @property
+    def macro_clamped_by(self) -> Optional[str]:
+        """The config field that clamped ``macro_steps`` to 1 (None
+        when the requested width runs) — the ledger-visible half of
+        the documented clamp contract."""
+        return self._macro_clamp
+
+    @property
+    def dispatches(self) -> int:
+        """Engine-lifetime compiled DECODE-side dispatches (plain
+        sweeps, speculative sweeps, macro scans — not prefill).  Under
+        macro decode one dispatch covers up to ``macro_steps`` token
+        rounds: ``dispatches == ceil(slot_steps / macro_steps)`` for a
+        single decoding stream (asserted live in ex24/ex32)."""
+        return self._dispatches
+
+    @property
+    def host_syncs(self) -> int:
+        """Engine-lifetime decode-side host synchronizations (sampled
+        tokens pulled to the host — the per-token blocking transfer
+        macro decode amortizes to one per T tokens)."""
+        return self._host_syncs
+
+    @property
+    def decode_rounds(self) -> int:
+        """Engine-lifetime decode token ROUNDS: iterations in which
+        every active slot swept its cached pages once.  One per
+        decode/spec tick; up to ``macro_steps`` per macro dispatch.
+        The bench's static swept-byte accounting multiplies sampled
+        page counts by the per-tick round delta — without it a macro
+        tick's sweep bytes would be under-counted ~T×."""
+        return self._decode_rounds
 
     @property
     def slot_steps(self) -> int:
@@ -1851,6 +1971,8 @@ class ServeEngine:
         if active:
             if self.scfg.spec_k > 0:
                 self._spec_tick(active, finished)
+            elif self._macro_T > 1:
+                self._macro_tick(active, finished)
             else:
                 self._decode_tick(active, finished)
         if self._tiered:
@@ -1897,6 +2019,7 @@ class ServeEngine:
         for i, wave in enumerate(waves):
             nxt = waves[i + 1] if i + 1 < len(waves) else None
             self._decode_sweep(wave, finished, prefetch=nxt)
+        self._decode_rounds += 1
 
     def _decode_sweep(self, active: list[int],
                       finished: list[tuple[int, tuple[int, ...]]],
@@ -1958,6 +2081,8 @@ class ServeEngine:
             raise
         self._decode_s += self._last_span_s()
         self._decode_steps += 1
+        self._dispatches += 1
+        self._host_syncs += 1
         self._slot_steps += len(active)
         self._fresh_tokens += len(active)
         for s in active:
@@ -1966,6 +2091,84 @@ class ServeEngine:
             st.last_token = int(toks[s])
             st.generated.append(st.last_token)
             self._tokens_generated += 1
+            if len(st.generated) >= st.max_new:
+                finished.append(self._evict(s))
+
+    def _macro_tick(self, active: list[int],
+                    finished: list[tuple[int, tuple[int, ...]]]) -> None:
+        """One device-resident MACRO tick (ISSUE 15): up to
+        ``macro_steps`` whole token rounds for every active slot in
+        ONE compiled ``lax.scan`` dispatch and ONE host sync — the
+        scan carries page tables, write frontiers, lengths, PRNG
+        fold-in positions and budget done-masks on device
+        (``serve.decode.build_decode_loop``), so per-token host
+        orchestration disappears from the hot path.  Each scan
+        iteration reproduces one legacy engine tick bit-for-bit (a
+        slot whose budget ends mid-scan flips to the legacy idle
+        contract, write-suppressed); admission/eviction stay host-side
+        at THIS boundary.  Unreachable under the tier or speculation —
+        both clamp ``macro_steps`` to 1 at construction."""
+        scfg, geom = self.scfg, self.geom
+        n, T = scfg.n_slots, self._macro_T
+        tables = np.full((n, scfg.max_pages), geom.n_pages, np.int32)
+        n_cached = np.zeros((n,), np.int32)
+        rids = np.zeros((n,), np.int32)
+        positions = np.zeros((n,), np.int32)
+        budgets = np.zeros((n,), np.int32)
+        last_tok = np.zeros((n,), np.int32)
+        spans: dict[int, int] = {}
+        for s in active:
+            st = self._slots[s]
+            span = min(T, st.max_new - len(st.generated))
+            spans[s] = span
+            if self._tries is not None:
+                # CoW guard over the WHOLE write span up front (the
+                # speculative sweep's rule): the scan's frontier may
+                # cross into shared pages mid-dispatch, and the copy
+                # must precede the tables snapshot
+                for pi in range(st.n_cached // geom.page_size,
+                                (st.n_cached + span - 1)
+                                // geom.page_size + 1):
+                    self._ensure_private(s, pi)
+        for s in active:
+            st = self._slots[s]
+            tables[s, : len(st.pages)] = st.pages
+            n_cached[s] = st.n_cached
+            rids[s] = st.rid
+            positions[s] = len(st.generated)
+            budgets[s] = st.max_new - len(st.generated)
+            last_tok[s] = st.last_token
+        try:
+            with self.timeline.span("serve/decode"):
+                toks, _mask, self._kv = self._decode_loop(
+                    self.params, self._kv, self.embed,
+                    self._seed_key_data,
+                    jnp.asarray(tables), jnp.asarray(n_cached),
+                    jnp.asarray(rids), jnp.asarray(positions),
+                    jnp.asarray(budgets), jnp.asarray(last_tok),
+                )
+                toks = np.asarray(toks)  # ONE host sync per T tokens
+        except Exception:
+            self._recover_cache()  # donated kv may be consumed; replay
+            raise
+        self._decode_s += self._last_span_s()
+        self._decode_steps += 1
+        self._dispatches += 1
+        self._host_syncs += 1
+        # rounds actually run before the early-exit mask idled the
+        # bank: the longest span (other slots rode it, write-suppressed
+        # once done — the done-mask law the boundary tests pin)
+        self._decode_rounds += max(spans.values())
+        for s in active:
+            st = self._slots[s]
+            steps = spans[s]
+            out = [int(t) for t in toks[:steps, s]]
+            st.n_cached += steps
+            st.generated.extend(out)
+            st.last_token = out[-1]
+            self._slot_steps += steps
+            self._fresh_tokens += steps
+            self._tokens_generated += steps
             if len(st.generated) >= st.max_new:
                 finished.append(self._evict(s))
 
@@ -1983,6 +2186,7 @@ class ServeEngine:
         for i, wave in enumerate(waves):
             nxt = waves[i + 1] if i + 1 < len(waves) else None
             self._spec_sweep(wave, finished, prefetch=nxt)
+        self._decode_rounds += 1
 
     def _spec_sweep(self, active: list[int],
                     finished: list[tuple[int, tuple[int, ...]]],
@@ -2060,6 +2264,8 @@ class ServeEngine:
             raise
         self._decode_s += self._last_span_s()
         self._decode_steps += 1
+        self._dispatches += 1
+        self._host_syncs += 1
         self._slot_steps += len(active)
         accept_hist = self.metrics.histogram("serve/accept_len")
         for s in active:
@@ -2097,6 +2303,7 @@ class ServeEngine:
         spill0, pref0 = self.host_spilled_pages, self.host_prefetched_pages
         cold0 = self._cold_hits
         sub0 = self._subpage_tokens
+        disp0, hs0 = self._dispatches, self._host_syncs
         quarantined0 = set(self._quarantined)
         for r in requests:
             self.submit(r)
@@ -2117,7 +2324,8 @@ class ServeEngine:
                               tuple(sorted(set(self._quarantined)
                                            - quarantined0)),
                               ptok0, stok0, fresh0, cow0,
-                              spill0, pref0, cold0, sub0=sub0)
+                              spill0, pref0, cold0, sub0=sub0,
+                              disp0=disp0, hs0=hs0)
         self.sink.emit(
             "serve/report",
             completed=report.completed,
@@ -2129,6 +2337,7 @@ class ServeEngine:
             decode_s=round(report.decode_s, 6),
             quarantined=len(report.quarantined),
             slot_steps=report.slot_steps,
+            dispatches=report.dispatches, host_syncs=report.host_syncs,
             drafted=report.drafted, accepted=report.accepted,
             prefill_tokens=report.prefill_tokens,
             shared_tokens=report.shared_tokens,
@@ -2150,7 +2359,7 @@ class ServeEngine:
                 decode_s0, slot0=0, drafted0=0, accepted0=0,
                 quarantined=(), ptok0=0, stok0=0, fresh0=0,
                 cow0=0, spill0=0, pref0=0, cold0=0,
-                sub0=0) -> GenerateReport:
+                sub0=0, disp0=0, hs0=0) -> GenerateReport:
         spilled = self.host_spilled_pages - spill0
         prefetched = self.host_prefetched_pages - pref0
         # per-request TTFT for requests completed this drain (rids the
@@ -2179,6 +2388,8 @@ class ServeEngine:
             outputs=tuple(sorted(outputs.items())),
             quarantined=tuple(quarantined),
             slot_steps=self._slot_steps - slot0,
+            dispatches=self._dispatches - disp0,
+            host_syncs=self._host_syncs - hs0,
             drafted=self._spec_drafted - drafted0,
             accepted=self._spec_accepted - accepted0,
             prefill_tokens=self._prefill_tokens - ptok0,

@@ -188,6 +188,14 @@ class RouterReport:
     backpressure_holds: int = 0  # dispatch attempts held by max_queue
     reroles: int = 0             # prefill<->decode pool moves
     dispatched: tuple[int, ...] = ()  # requests per replica
+    # fleet decode-side dispatch accounting (ISSUE 15): compiled decode
+    # invocations + token host-syncs summed across replicas — under
+    # macro-step replicas (``ServeConfig(macro_steps=T)``) both drop
+    # ~T× at fixed token count; per single-stream replica the identity
+    # dispatches == ceil(slot_steps / macro_steps) holds exactly
+    # (asserted live in ex32).  Lower-is-better in obs.regress.
+    dispatches: int = 0
+    host_syncs: int = 0
 
     @property
     def prefill_frac(self) -> float:
@@ -593,6 +601,8 @@ class FleetRouter:
         ptok0 = [self._prefill_of(r) for r in self.replicas]
         stok0 = [self._shared_of(r) for r in self.replicas]
         sub0 = [self._subpage_of(r) for r in self.replicas]
+        disp0_decode = [r.dispatches for r in self.replicas]
+        hs0 = [r.host_syncs for r in self.replicas]
         # the drain's "submitted" leg: prompts still PENDING admission
         # anywhere — the router queue plus every replica's own queue (a
         # prior step() may have dispatched without draining; those
@@ -667,6 +677,13 @@ class FleetRouter:
             reroles=self._reroles - rer0,
             dispatched=tuple(
                 d - d0 for d, d0 in zip(self._dispatched, disp0)
+            ),
+            dispatches=sum(
+                r.dispatches - d0
+                for r, d0 in zip(self.replicas, disp0_decode)
+            ),
+            host_syncs=sum(
+                r.host_syncs - h0 for r, h0 in zip(self.replicas, hs0)
             ),
         )
 
