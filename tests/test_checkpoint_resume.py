@@ -19,6 +19,13 @@ import pytest
 WORKER = pathlib.Path(__file__).parent / "_ckpt_worker.py"
 REPO = pathlib.Path(__file__).parent.parent
 
+# Every worker below compiles the SAME tiny stencil program from
+# scratch; a shared XLA compile cache collapses that to one compile per
+# suite run.  Scoped to the worker subprocesses only (never the pytest
+# process): executable deserialization is exercised by exactly this
+# program, and a bad cache entry can fail only a worker, not the run.
+XLA_CACHE = "/tmp/tpuscratch-ckpt-worker-xla-cache"
+
 
 def _run_worker(ckpt_dir, steps, save_every, die_after=0, chaos_kill="",
                 async_ckpt=False, timeout=180):
@@ -26,6 +33,9 @@ def _run_worker(ckpt_dir, steps, save_every, die_after=0, chaos_kill="",
     env["PYTHONPATH"] = os.pathsep.join(
         [str(REPO)] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
     )
+    env["JAX_COMPILATION_CACHE_DIR"] = XLA_CACHE
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
     if die_after:
         env["TPUSCRATCH_DIE_AFTER_SAVES"] = str(die_after)
     else:

@@ -1,0 +1,509 @@
+"""runtime.chunked + runtime.scheduler: the one chunk loop and the
+mesh co-scheduler (ISSUE 16).
+
+The correctness anchors:
+
+- drift guard: trainer, halo driver and solver runner ALL advance
+  through ``ChunkedProgram.tick`` — the three legacy loop copies are
+  gone and cannot silently come back;
+- arbitration: RoundRobin honors its quantum, Priority preempts
+  background work at the next chunk boundary (a mid-run burst arrival),
+  GoodputShare picks the workload furthest below its target share;
+- co-scheduling is invisible to the workloads: a train job and an MG3D
+  solve time-slicing one mesh produce results BIT-identical to solo
+  runs — including when one workload is chaos-preempted mid-run and
+  restarted in place by the scheduler's per-entry budget;
+- accounting: ``obs.goodput.by_workload`` splits the shared stream into
+  per-workload reports whose buckets sum to per-workload walls and
+  whose walls sum to the scheduler wall exactly;
+- ``supervise_program`` restarts a chunked program through its
+  ``remake`` factory under the supervisor's budget/event discipline.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from tpuscratch.ft import (
+    ChaosPlan,
+    Fault,
+    Preempted,
+    RestartBudget,
+    RestartsExhausted,
+)
+from tpuscratch.ft.supervisor import supervise_program
+from tpuscratch.models.trainer import train_program
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.obs.goodput import by_workload
+from tpuscratch.obs.report import load_events
+from tpuscratch.obs.sink import Sink
+from tpuscratch.runtime.chunked import (
+    ChunkResult,
+    ChunkedProgram,
+    WorkloadSink,
+)
+from tpuscratch.runtime.errors import CommError
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.runtime.scheduler import (
+    GoodputShare,
+    MeshScheduler,
+    Priority,
+    RoundRobin,
+)
+from tpuscratch.runtime.scheduler import _Entry
+from tpuscratch.solvers.runner import mg3d_solve_program
+
+
+def _tiny_cfg():
+    # compile-light model for the real-workload classes below: what's
+    # under test is scheduler semantics, shapes only set the compile bill
+    return TransformerConfig(d_model=16, n_heads=2, n_experts=2,
+                             d_ff=32, n_layers=1, capacity_factor=2.0)
+
+pytestmark = pytest.mark.sched
+
+
+class _Events:
+    """A list-collecting obs sink (the ``Sink`` duck type)."""
+
+    enabled = True
+
+    def __init__(self):
+        self.events = []
+
+    def emit(self, event, **fields):
+        self.events.append({"event": event, **fields})
+
+    def emit_metrics(self, snapshot, event="metrics", scope=None):
+        pass
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+    def of(self, kind):
+        return [e for e in self.events if e["event"] == kind]
+
+
+def _prog(name, total, trace, *, fail=None, sink=None, state=None,
+          tick_s=0.0):
+    """A synthetic ChunkedProgram: each tick appends ``(name, pos)`` to
+    ``trace``; ``fail`` maps pos -> exception, raised ONCE (consumed —
+    the replayed chunk succeeds, like a transient comm fault).  The
+    shared ``state`` dict stands in for a checkpoint: ``remake`` resumes
+    from the last committed position."""
+    state = state if state is not None else {"pos": 0}
+    fail = fail if fail is not None else {}
+
+    def build():
+        def run_chunk(cp, pos):
+            if pos in fail:
+                raise fail.pop(pos)
+            if tick_s:
+                import time
+
+                time.sleep(tick_s)
+            trace.append((name, pos))
+            return pos
+
+        def make_event(cp, pos, payload, sp):
+            state["pos"] = pos + 1
+            return ChunkResult(pos=pos + 1, event={"step": pos + 1})
+
+        return ChunkedProgram(
+            workload=name, total=total, pos=state["pos"],
+            run_chunk=run_chunk, make_event=make_event,
+            epilogue=lambda cp: cp.pos, sink=sink, remake=build,
+        )
+
+    return build()
+
+
+def _params_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+class TestChunkedProgram:
+    def test_run_ticks_to_completion(self):
+        trace = []
+        p = _prog("a", 3, trace)
+        assert not p.started and not p.done
+        assert p.run() == 3
+        assert trace == [("a", 0), ("a", 1), ("a", 2)]
+        assert p.finished and p.done
+        assert p.finish() == 3  # idempotent: returns the cached result
+
+    def test_tick_past_end_raises(self):
+        p = _prog("a", 1, [])
+        p.run()
+        with pytest.raises(RuntimeError, match="past the end"):
+            p.tick()
+
+    def test_workload_tagging(self):
+        sink = _Events()
+        _prog("tagged", 2, [], sink=sink).run()
+        chunk = sink.of("tagged/chunk")
+        assert len(chunk) == 2
+        assert all(e["workload"] == "tagged" for e in chunk)
+
+    def test_workload_sink_laws(self):
+        inner = _Events()
+        ws = WorkloadSink(WorkloadSink(inner, "a"), "b")
+        assert ws.inner is inner  # tags never stack
+        ws.emit("x")
+        ws.emit("y", workload="explicit")  # an explicit tag wins
+        assert inner.events == [
+            {"event": "x", "workload": "b"},
+            {"event": "y", "workload": "explicit"},
+        ]
+
+
+class TestPolicies:
+    def test_round_robin_quantum(self):
+        trace = []
+        sched = MeshScheduler(policy=RoundRobin(quantum=2))
+        sched.add(_prog("a", 3, trace))
+        sched.add(_prog("b", 3, trace))
+        res = sched.run()
+        assert trace == [("a", 0), ("a", 1), ("b", 0), ("b", 1),
+                         ("a", 2), ("b", 2)]
+        assert res == {"a": 3, "b": 3}
+
+    def test_round_robin_rejects_bad_quantum(self):
+        with pytest.raises(ValueError):
+            RoundRobin(quantum=0)
+
+    def test_priority_burst_preempts_at_the_boundary(self):
+        """A higher-priority job added MID-RUN (the serving-burst case)
+        runs to completion at the very next chunk boundary, then the
+        background workload resumes."""
+        trace = []
+        burst_state = {"added": False}
+
+        def arrival(s):
+            if s.ticks == 2 and not burst_state["added"]:
+                burst_state["added"] = True
+                s.add(_prog("burst", 2, trace), priority=10)
+
+        sched = MeshScheduler(policy=Priority(), on_tick=arrival)
+        sched.add(_prog("bg", 5, trace), priority=0)
+        sched.run()
+        assert trace == [("bg", 0), ("bg", 1), ("burst", 0), ("burst", 1),
+                         ("bg", 2), ("bg", 3), ("bg", 4)]
+
+    def test_goodput_share_picks_the_furthest_below_target(self):
+        a = _Entry("a", None, None, 0, None, None, 0)
+        b = _Entry("b", None, None, 0, None, None, 1)
+        a.busy_s, b.busy_s = 1.0, 9.0
+        pol = GoodputShare({"a": 0.5, "b": 0.5})
+        assert pol.pick([a, b], "b", 1) == "a"
+        # weights renormalize over the READY set: alone, b is on target
+        assert pol.pick([b], "b", 1) == "b"
+        # per-entry share is the fallback weight when targets omit it
+        c = _Entry("c", None, None, 0, 3.0, None, 2)
+        assert GoodputShare()._weight(c) == 3.0
+
+
+class TestScheduler:
+    def test_switch_stream_and_run_summary(self):
+        sink = _Events()
+        sched = MeshScheduler(policy=RoundRobin(), sink=sink)
+        sched.add(_prog("a", 2, [], sink=sink))
+        sched.add(_prog("b", 2, [], sink=sink))
+        sched.run()
+        switches = sink.of("sched/switch")
+        assert switches[0]["prev"] is None  # first pick: not a switch
+        run = sink.of("sched/run")[-1]
+        assert run["switches"] == len(switches) - 1
+        assert run["ticks"] == 4 and run["workloads"] == 2
+        assert run["overhead_s"] >= 0.0
+        assert run["policy"] == "RoundRobin"
+        finishes = {e["workload"] for e in sink.of("sched/finish")}
+        assert finishes == {"a", "b"}
+
+    def test_duplicate_workload_rejected(self):
+        sched = MeshScheduler()
+        sched.add(_prog("a", 1, []))
+        with pytest.raises(ValueError, match="duplicate"):
+            sched.add(_prog("a", 1, []))
+
+    def test_per_entry_restart_resumes_while_others_tick(self):
+        """A transient CommError in one workload restarts THAT workload
+        from its last committed position; the other keeps ticking."""
+        sink = _Events()
+        trace = []
+        sched = MeshScheduler(policy=RoundRobin(), sink=sink)
+        sched.add(_prog("flaky", 3, trace, sink=sink,
+                        fail={1: CommError("halo", "injected")}),
+                  restarts=RestartBudget(max_restarts=2, backoff_s=0.0))
+        sched.add(_prog("steady", 3, trace, sink=sink))
+        res = sched.run()
+        assert res == {"flaky": 3, "steady": 3}
+        assert sched.entries["flaky"].restarts == 1
+        # the replay re-ran pos 1 (the consumed fault healed)
+        assert trace.count(("flaky", 1)) == 1
+        assert trace.count(("steady", 2)) == 1
+        restarts = sink.of("ft/restart")
+        assert len(restarts) == 1
+        assert restarts[0]["workload"] == "flaky"
+
+    def test_restarts_exhausted_aborts_the_rest(self):
+        sink = _Events()
+
+        def always_fail(cp, pos):
+            raise CommError("halo", "hard down")
+
+        doomed = ChunkedProgram(
+            workload="doomed", total=2, run_chunk=always_fail,
+            make_event=lambda cp, pos, payload, sp: ChunkResult(pos=pos + 1),
+            sink=sink, remake=lambda: doomed_fresh(),
+        )
+
+        def doomed_fresh():
+            return ChunkedProgram(
+                workload="doomed", total=2, run_chunk=always_fail,
+                make_event=lambda cp, pos, payload, sp: ChunkResult(
+                    pos=pos + 1),
+                sink=sink, remake=lambda: doomed_fresh(),
+            )
+
+        sched = MeshScheduler(policy=RoundRobin(), sink=sink)
+        sched.add(doomed, restarts=RestartBudget(max_restarts=1,
+                                                 backoff_s=0.0))
+        other = _prog("other", 50, [], sink=sink)
+        sched.add(other)
+        with pytest.raises(RestartsExhausted):
+            sched.run()
+        assert len(sink.of("ft/give_up")) == 1
+        # the survivor was aborted (its contexts unwound), not left open
+        assert not sched.entries["other"].program.started
+        run = sink.of("sched/run")[-1]
+        assert run.get("error") is True
+
+    def test_no_budget_propagates(self):
+        sched = MeshScheduler()
+        sched.add(_prog("a", 3, [], fail={0: CommError("halo", "boom")}))
+        with pytest.raises(CommError):
+            sched.run()
+
+
+class TestByWorkload:
+    def test_partition_of_a_synthetic_stream(self):
+        events = [
+            {"event": "sched/switch", "t": 0.0, "workload": "a",
+             "prev": None, "tick": 0},
+            {"event": "train/chunk", "t": 8.0, "workload": "a",
+             "step": 2, "chunk": 2, "chunk_s": 6.0, "tokens": 64},
+            {"event": "sched/switch", "t": 10.0, "workload": "b",
+             "prev": "a", "tick": 1},
+            {"event": "solver/chunk", "t": 18.0, "workload": "b",
+             "cycle": 2, "chunk": 2, "wall_s": 6.0},
+            {"event": "sched/run", "t": 20.0, "wall_s": 20.0,
+             "ticks": 2, "switches": 1, "workloads": 2,
+             "overhead_s": 0.1, "policy": "RoundRobin"},
+        ]
+        wg = by_workload(events)
+        wg.check()
+        assert wg.wall_s == pytest.approx(20.0)
+        assert wg.switches == 1
+        assert wg.reports["a"].wall_s == pytest.approx(10.0)
+        assert wg.reports["b"].wall_s == pytest.approx(10.0)
+        assert wg.reports["a"].buckets["step"] == pytest.approx(6.0)
+        assert wg.shares["a"] == pytest.approx(0.5)
+        assert "workload" in wg.table()[0] or wg.table()  # table renders
+        assert "a" in wg.summary() and "b" in wg.summary()
+
+    def test_no_switch_fallback_sums_own_windows(self):
+        """A stream with no sched/* events (two solo runs back to back)
+        still splits by tag: per-workload own-window accounting, the
+        combined wall is their sum."""
+        events = [
+            {"event": "train/chunk", "t": 5.0, "workload": "a",
+             "step": 1, "chunk": 1, "chunk_s": 4.0},
+            {"event": "solver/chunk", "t": 11.0, "workload": "b",
+             "cycle": 1, "chunk": 1, "wall_s": 5.0},
+        ]
+        wg = by_workload(events)
+        wg.check()
+        assert wg.switches == 0
+        assert wg.wall_s == pytest.approx(
+            wg.reports["a"].wall_s + wg.reports["b"].wall_s)
+
+
+class TestSuperviseProgram:
+    def test_program_form_restarts_via_remake(self):
+        sink = _Events()
+        trace = []
+        p = _prog("w", 3, trace, sink=sink,
+                  fail={1: Preempted("w/preempt", 1)})
+        out = supervise_program(
+            p, budget=RestartBudget(max_restarts=2, backoff_s=0.0),
+            sleep=lambda s: None)
+        assert out == 3
+        assert trace == [("w", 0), ("w", 1), ("w", 2)]  # resumed at 1
+        restarts = sink.of("ft/restart")
+        assert len(restarts) == 1
+        assert restarts[0]["workload"] == "w"  # the program's own sink
+
+    def test_factory_form(self):
+        trace = []
+        state = {"pos": 0}
+        out = supervise_program(
+            lambda: _prog("w", 2, trace, state=state),
+            budget=RestartBudget(max_restarts=1, backoff_s=0.0),
+            sleep=lambda s: None)
+        assert out == 2
+
+    def test_program_without_remake_rejected(self):
+        p = ChunkedProgram(
+            workload="w", total=1,
+            run_chunk=lambda cp, pos: None,
+            make_event=lambda cp, pos, payload, sp: ChunkResult(pos=pos + 1),
+        )
+        with pytest.raises(ValueError, match="remake"):
+            supervise_program(p)
+
+
+class TestCoschedBitIdentity:
+    """The acceptance anchor: co-scheduled == solo, bit for bit."""
+
+    STEPS, SAVE_EVERY, BATCH, SEQ = 4, 2, 4, 8
+    CYCLES, CHUNK = 6, 2
+
+    @pytest.fixture(scope="class")
+    def tmesh(self):
+        return make_mesh((2, 1), ("dp", "sp"), jax.devices()[:2])
+
+    @pytest.fixture(scope="class")
+    def smesh(self):
+        return make_mesh((1, 1, 1), ("z", "row", "col"),
+                         jax.devices()[:1])
+
+    @pytest.fixture(scope="class")
+    def b_world(self):
+        rng = np.random.default_rng(5)
+        b = rng.standard_normal((16, 16, 16)).astype(np.float32)
+        return b - b.mean()
+
+    def _train(self, tmesh, ckpt, **kw):
+        return train_program(tmesh, _tiny_cfg(), self.STEPS,
+                             str(ckpt), save_every=self.SAVE_EVERY,
+                             batch=self.BATCH, seq=self.SEQ,
+                             optimizer="adam", **kw)
+
+    def _solve(self, smesh, b_world, ckpt, **kw):
+        return mg3d_solve_program(b_world, str(ckpt), mesh=smesh,
+                                  tol=1e-10, max_cycles=self.CYCLES,
+                                  chunk_cycles=self.CHUNK, **kw)
+
+    @pytest.fixture(scope="class")
+    def solo(self, tmp_path_factory, tmesh, smesh, b_world):
+        d = tmp_path_factory.mktemp("sched_solo")
+        params, rep = self._train(tmesh, d / "t").run()
+        x, srep = self._solve(smesh, b_world, d / "s").run()
+        return params, rep, x, srep
+
+    def test_cosched_bit_identical_and_partitioned(self, tmp_path, tmesh,
+                                                   smesh, b_world, solo):
+        p_solo, rep_solo, x_solo, srep_solo = solo
+        path = str(tmp_path / "obs.jsonl")
+        with Sink(path) as sink:
+            sched = MeshScheduler(policy=RoundRobin(), sink=sink)
+            sched.add(self._train(tmesh, tmp_path / "t", obs=sink))
+            sched.add(self._solve(smesh, b_world, tmp_path / "s",
+                                  sink=sink))
+            res = sched.run()
+        p_co, rep_co = res["train"]
+        x_co, srep_co = res["solver"]
+        assert _params_equal(p_solo, p_co)
+        assert rep_solo.losses == rep_co.losses
+        assert np.array_equal(x_solo, x_co)
+        assert srep_solo.cycles == srep_co.cycles
+
+        events = load_events([path])
+        wg = by_workload(events)
+        wg.check()  # buckets sum per workload; walls sum to the wall
+        assert set(wg.reports) == {"train", "solver"}
+        assert wg.switches >= 1
+        assert abs(sum(r.wall_s for r in wg.reports.values())
+                   - wg.wall_s) <= 1e-6 * max(1.0, wg.wall_s)
+        # every workload-tagged event belongs to a registered workload
+        tags = {e["workload"] for e in events if "workload" in e}
+        assert tags == {"train", "solver"}
+
+    def test_chaos_preempted_workload_restarts_bit_identical(
+            self, tmp_path, tmesh, smesh, b_world, solo):
+        """Chaos preempts the TRAIN workload mid-co-schedule (after the
+        step-2 save); the scheduler restarts it in place from the
+        checkpoint, the solver never notices, and the final results
+        still match the fault-free solo runs bit for bit."""
+        p_solo, _, x_solo, _ = solo
+        path = str(tmp_path / "obs.jsonl")
+        plan = ChaosPlan(0, [Fault("train/preempt", at=(2,),
+                                   kind="preempt")])
+        with Sink(path) as sink:
+            sched = MeshScheduler(policy=RoundRobin(), sink=sink)
+            sched.add(self._train(tmesh, tmp_path / "t", obs=sink,
+                                  chaos=plan),
+                      restarts=RestartBudget(max_restarts=2,
+                                             backoff_s=0.0))
+            sched.add(self._solve(smesh, b_world, tmp_path / "s",
+                                  sink=sink))
+            res = sched.run()
+        p_co, _ = res["train"]
+        x_co, _ = res["solver"]
+        assert sched.entries["train"].restarts == 1
+        assert _params_equal(p_solo, p_co)
+        assert np.array_equal(x_solo, x_co)
+        events = load_events([path])
+        by_workload(events).check()
+        restarts = [e for e in events if e.get("event") == "ft/restart"]
+        assert len(restarts) == 1 and restarts[0]["workload"] == "train"
+
+
+class TestDriftGuard:
+    def test_all_drivers_route_through_the_one_loop(self, tmp_path,
+                                                    monkeypatch):
+        """The ISSUE 16 guard: trainer, halo driver and solver runner
+        advance ONLY via ChunkedProgram.tick — a re-grown private loop
+        in any of them stops showing up here."""
+        ticked = {}
+        real_tick = ChunkedProgram.tick
+
+        def counting_tick(self):
+            ticked[self.workload] = ticked.get(self.workload, 0) + 1
+            return real_tick(self)
+
+        monkeypatch.setattr(ChunkedProgram, "tick", counting_tick)
+
+        from tpuscratch.halo import driver
+        from tpuscratch.models.trainer import train
+
+        mesh = make_mesh((2, 1), ("dp", "sp"), jax.devices()[:2])
+        train(mesh, _tiny_cfg(), 2, str(tmp_path / "t"),
+              save_every=2, batch=4, seq=8)
+
+        rng = np.random.default_rng(123)
+        world = rng.standard_normal((16, 16)).astype(np.float32)
+        from tpuscratch.runtime.mesh import make_mesh_2d
+
+        driver.checkpointed_stencil(world, steps=4,
+                                    ckpt_dir=str(tmp_path / "h"),
+                                    save_every=2,
+                                    mesh=make_mesh_2d((2, 2)))
+
+        b = rng.standard_normal((16, 16, 16)).astype(np.float32)
+        b -= b.mean()
+        smesh = make_mesh((1, 1, 1), ("z", "row", "col"),
+                          jax.devices()[:1])
+        mg3d_solve_program(b, str(tmp_path / "s"), mesh=smesh,
+                           tol=1e-7, max_cycles=2, chunk_cycles=2).run()
+
+        assert ticked.get("train", 0) >= 1
+        assert ticked.get("halo", 0) >= 1
+        assert ticked.get("solver", 0) >= 1

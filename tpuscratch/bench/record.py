@@ -2010,6 +2010,155 @@ def config17_serve_router(out: list) -> None:
     )
 
 
+def config18_cosched(out: list) -> None:
+    """Mesh co-scheduling (ISSUE 16): a training run and an MG3D solve
+    time-slicing ONE mesh under ``runtime.scheduler.MeshScheduler``'s
+    goodput-share policy, vs the same two jobs run back-to-back solo.
+    Both arms' results are asserted BIT-identical (the chunk-boundary
+    preemption contract), both streams are accounted by
+    ``obs.goodput.by_workload`` with the partition invariants checked
+    live (per-workload buckets sum to per-workload walls; the walls sum
+    to the scheduler wall exactly).  Gated fields: aggregate goodput
+    fraction (higher), achieved-vs-target ``share_err`` (lower), and
+    per-context-switch overhead ``switch_s`` (lower), all with CPU
+    noise floors in ``obs.regress``."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from tpuscratch.models.trainer import train_program
+    from tpuscratch.models.transformer import TransformerConfig
+    from tpuscratch.obs.goodput import by_workload
+    from tpuscratch.obs.report import load_events
+    from tpuscratch.obs.sink import Sink
+    from tpuscratch.runtime.mesh import make_mesh
+    from tpuscratch.runtime.scheduler import GoodputShare, MeshScheduler
+    from tpuscratch.solvers.runner import mg3d_solve_program
+
+    avail = len(jax.devices())
+    n = min(4, avail)
+    rng = np.random.default_rng(0)
+    mesh = make_mesh((n, 1), ("dp", "sp"), jax.devices()[:n])
+    cfg = TransformerConfig(d_model=128, n_heads=2, n_experts=n,
+                            d_ff=256, n_layers=2)
+    steps, save_every = 16, 2
+    sdims = (2, 2, 1) if avail >= 4 else (1, 1, 1)
+    ns = sdims[0] * sdims[1] * sdims[2]
+    b = rng.standard_normal(
+        tuple(d * 32 for d in sdims)).astype(np.float32)
+    b -= b.mean()
+    smesh = make_mesh(sdims, ("z", "row", "col"), jax.devices()[:ns])
+    solve_kw = dict(mesh=smesh, tol=1e-7, max_cycles=24, chunk_cycles=4)
+    targets = {"train": 0.7, "solver": 0.3}
+
+    def tprog(ck, sink):
+        return train_program(mesh, cfg, steps, ck,
+                             save_every=save_every, batch=2 * n, seq=32,
+                             optimizer="adam", obs=sink)
+
+    def sprog(ck, sink):
+        return mg3d_solve_program(b, ck, sink=sink, **solve_kw)
+
+    # warm both compiled programs OUTSIDE any accounting window (the
+    # config-16 discipline: the lru-cached solver chunk program and the
+    # jit cache are shared across arms, so neither arm's first chunk
+    # should eat the compile into its goodput window)
+    wwd = tempfile.mkdtemp(prefix="tpuscratch_c18_warm_")
+    try:
+        tprog(f"{wwd}/t", None).run()
+        sprog(f"{wwd}/s", None).run()
+    finally:
+        shutil.rmtree(wwd, ignore_errors=True)
+
+    arms = {}
+    for mode in ("solo", "cosched"):
+        wd = tempfile.mkdtemp(prefix=f"tpuscratch_c18_{mode}_")
+        try:
+            path = f"{wd}/obs.jsonl"
+            sink = Sink(path, run={
+                "bench": f"record/config18/{mode}",
+                "platform": jax.default_backend(),
+            })
+            sched_ev = None
+            if mode == "solo":
+                r_train = tprog(f"{wd}/ckt", sink).run()
+                r_solve = sprog(f"{wd}/cks", sink).run()
+            else:
+                sched = MeshScheduler(policy=GoodputShare(targets),
+                                      sink=sink)
+                sched.add(tprog(f"{wd}/ckt", sink))
+                sched.add(sprog(f"{wd}/cks", sink))
+                res = sched.run()
+                r_train, r_solve = res["train"], res["solver"]
+            sink.close()
+            events = load_events([path])
+            wg = by_workload(events, targets=targets)
+            wg.check()  # both partition invariants, live, or raise
+            if mode == "cosched":
+                sched_ev = next(e for e in events
+                                if e.get("event") == "sched/run")
+            arms[mode] = (r_train, r_solve, wg, sched_ev)
+        finally:
+            shutil.rmtree(wd, ignore_errors=True)
+
+    (p_solo, rep_solo), (x_solo, _), wg_solo, _ = arms["solo"]
+    (p_co, rep_co), (x_co, srep_co), wg_co, sched_ev = arms["cosched"]
+    same_params = all(
+        bool(np.array_equal(np.asarray(a), np.asarray(c)))
+        for a, c in zip(jax.tree.leaves(p_solo), jax.tree.leaves(p_co))
+    )
+    if not (same_params and rep_solo.losses == rep_co.losses
+            and np.array_equal(x_solo, x_co)):
+        raise RuntimeError(
+            "co-scheduled results differ from solo — the chunk-boundary "
+            "preemption contract is broken"
+        )
+
+    def agg_goodput(wg):
+        step = sum(r.buckets.get("step", 0.0) for r in wg.reports.values())
+        return step / wg.wall_s if wg.wall_s else 0.0
+
+    shares = wg_co.shares
+    share_err = max(abs(shares[k] - targets[k]) for k in targets)
+    switches = int(sched_ev.get("switches") or 0)
+    switch_s = (float(sched_ev.get("overhead_s") or 0.0)
+                / max(switches, 1))
+    row = {
+        "goodput_fraction_cosched": agg_goodput(wg_co),
+        "goodput_fraction_solo": agg_goodput(wg_solo),
+        "share_train": shares.get("train", 0.0),
+        "share_solver": shares.get("solver", 0.0),
+        "target_train": targets["train"],
+        "target_solver": targets["solver"],
+        "share_err": share_err,
+        "switches": switches,
+        "switch_s": switch_s,
+        "wall_s_cosched": wg_co.wall_s,
+        "wall_s_solo": wg_solo.wall_s,
+        "solver_cycles": srep_co.cycles,
+    }
+    _emit(
+        out,
+        config=18,
+        metric="cosched_goodput_train_solver",
+        # headline: the co-scheduled aggregate goodput fraction (the
+        # metric name's "goodput" substring infers higher-is-better);
+        # share_err / switch_s ride as direction-registered fields
+        value=row["goodput_fraction_cosched"],
+        **row,
+        detail=(
+            f"train+solver on one mesh, GoodputShare targets "
+            f"{targets['train']:.0%}/{targets['solver']:.0%}, achieved "
+            f"{row['share_train']:.1%}/{row['share_solver']:.1%} "
+            f"(err {share_err:.1%}), {switches} switches at "
+            f"{1e3 * switch_s:.2f} ms/switch, results bit-identical to "
+            f"solo, both partition checks live"
+        ),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -2028,13 +2177,14 @@ CONFIGS = {
     15: config15_solver,
     16: config16_elastic_goodput,
     17: config17_serve_router,
+    18: config18_cosched,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
-                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17")
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

@@ -35,6 +35,11 @@ T = TypeVar("T")
 #: absent: a poisoned data stream does not heal by restarting.
 RESTARTABLE = (Preempted, CommError, OSError)
 
+#: "not passed" sentinel for supervise_program's obs kwargs — ``None``
+#: is a meaningful value there (supervise's own defaults), while an
+#: omitted kwarg derives from the program itself
+_UNSET = object()
+
 
 class RestartsExhausted(RuntimeError):
     """The restart budget is spent — chained to the last failure."""
@@ -124,6 +129,66 @@ def supervise(
         )
         sink.flush()
         return out
+
+
+def supervise_program(
+    program_or_factory,
+    *,
+    budget: Optional[RestartBudget] = None,
+    restartable: Optional[tuple] = None,
+    sink=_UNSET,
+    metrics=_UNSET,
+    recorder=_UNSET,
+    log: Callable[[str], None] = lambda s: None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """:func:`supervise` around a ``runtime.chunked.ChunkedProgram`` —
+    the restart-loop glue the three chunked drivers used to re-plumb
+    individually (each wiring its own attempt closure + sink/metrics/
+    recorder kwargs through ``supervise``).
+
+    ``program_or_factory`` is either a built program (its ``remake``
+    factory provides the restart re-invocation — resumed from
+    ``ckpt_dir``, chaos plan persisting across restarts) or a zero-arg
+    factory returning a fresh program per attempt.  The obs kwargs
+    default to the PROGRAM'S OWN sink/metrics/recorder when omitted, so
+    ``supervise_program(train_program(...))`` emits its ``ft/restart``
+    events into the same (workload-tagged) stream the program writes —
+    pass them explicitly (``None`` included) to override.  Returns the
+    completing attempt's ``run()`` result."""
+    from tpuscratch.runtime.chunked import ChunkedProgram  # lazy: cycle
+
+    if isinstance(program_or_factory, ChunkedProgram):
+        first = program_or_factory
+        remake = first.remake
+        if remake is None:
+            raise ValueError(
+                f"supervise_program: program {first.workload!r} has no "
+                "remake factory — restarts cannot re-invoke it"
+            )
+    else:
+        remake = program_or_factory
+        first = remake()
+    if sink is _UNSET:
+        sink = first.sink
+    if metrics is _UNSET:
+        metrics = first.metrics
+    if recorder is _UNSET:
+        recorder = first.rec
+    box = {"program": first}
+
+    def attempt():
+        program = box["program"]
+        if program is None:
+            program = remake()
+        box["program"] = None  # consumed: a failed attempt remakes
+        return program.run()
+
+    return supervise(attempt, budget=budget or RestartBudget(),
+                     restartable=(restartable if restartable is not None
+                                  else RESTARTABLE),
+                     sink=sink, metrics=metrics, recorder=recorder,
+                     log=log, sleep=sleep)
 
 
 def supervise_elastic(
@@ -246,14 +311,15 @@ def supervise_train(mesh, cfg, steps: int, ckpt_dir: str, *,
     (every restart's chunks land on ONE flight-recorder timeline, with
     the restart instants between them).  Returns
     ``(params, TrainReport)`` of the completing invocation."""
-    from tpuscratch.models.trainer import train  # lazy: avoids the cycle
+    from tpuscratch.models.trainer import train_program  # lazy: cycle
 
     if recorder is not None:
         train_kw.setdefault("recorder", recorder)
 
-    def attempt():
-        return train(mesh, cfg, steps, ckpt_dir, **train_kw)
+    def factory():
+        return train_program(mesh, cfg, steps, ckpt_dir, **train_kw)
 
-    return supervise(attempt, budget=budget, restartable=restartable,
-                     sink=sink, metrics=metrics, recorder=recorder,
-                     log=log, sleep=sleep)
+    return supervise_program(factory, budget=budget,
+                             restartable=restartable, sink=sink,
+                             metrics=metrics, recorder=recorder,
+                             log=log, sleep=sleep)

@@ -201,17 +201,51 @@ def checkpointed_stencil(
     boundaries, exact f32 round trip through the .npy format —
     tests/test_checkpoint_resume.py kills a run mid-flight to prove it).
     """
+    return checkpointed_stencil_program(
+        world, steps, ckpt_dir, save_every=save_every, mesh=mesh, halo=halo,
+        coeffs=coeffs, impl=impl, periodic=periodic, keep=keep, sink=sink,
+        chaos=chaos, recorder=recorder, reshard=reshard,
+        async_ckpt=async_ckpt,
+    ).run()
+
+
+def checkpointed_stencil_program(
+    world: np.ndarray,
+    steps: int,
+    ckpt_dir: str,
+    save_every: int = 100,
+    mesh: Optional[Mesh] = None,
+    halo: tuple[int, int] = (1, 1),
+    coeffs=(0.25, 0.25, 0.25, 0.25, 0.0),
+    impl: str = "xla",
+    periodic: bool = True,
+    keep: int = 3,
+    sink=None,
+    chaos=None,
+    recorder=None,
+    reshard: bool = False,
+    async_ckpt: bool = False,
+    workload: str = "halo",
+):
+    """:func:`checkpointed_stencil` as a steppable
+    ``runtime.chunked.ChunkedProgram`` — same arguments, same event
+    stream, same bit-identical resume contract, but the chunk loop is
+    the shared runtime's, so a ``MeshScheduler`` can time-slice the
+    stencil against other workloads at save boundaries.  ``run()``
+    returns the assembled world; ``workload`` tags every emitted
+    event."""
     from tpuscratch.runtime import checkpoint
     from tpuscratch.obs.sink import NullSink
-    from tpuscratch.obs.trace import (
-        FlightRecorder,
-        emit_phase_totals,
-        file_flight_data,
+    from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
+    from tpuscratch.runtime.chunked import (
+        ChunkedProgram,
+        ChunkResult,
+        WorkloadSink,
     )
 
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
-    sink = sink if sink is not None else NullSink()
+    sink = WorkloadSink(sink if sink is not None else NullSink(), workload)
     rec = recorder if recorder is not None else FlightRecorder()
     mesh, topo, layout, spec = _setup(world.shape, mesh, halo, periodic)
 
@@ -238,8 +272,6 @@ def checkpointed_stencil(
             old_topo = CartTopology((r0, c0), (periodic, periodic))
             tiles = decompose(assemble(tiles, old_topo, old_layout),
                               topo, layout)
-    state = jnp.asarray(tiles)
-
     sink.emit(
         "halo/config",
         world_h=world.shape[0], world_w=world.shape[1], steps=steps,
@@ -247,85 +279,61 @@ def checkpointed_stencil(
         resumed_at=start,
     )
     cells = world.shape[0] * world.shape[1]
-    save_hook = None
+    save_policy = None
     if chaos is not None:
-        from tpuscratch.ft.chaos import bind_sink
-        from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY, retry
+        from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY
 
-        bind_sink(chaos, sink)
-        save_hook = chaos.save_hook()
-    ckp = None
-    if async_ckpt:
-        from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
+        save_policy = DEFAULT_SAVE_RETRY
+    hal = {"state": jnp.asarray(tiles),
+           "programs": {}}  # chunk size -> compiled program
 
-        ckp = AsyncCheckpointer(chaos=chaos, sink=sink)
-    programs: dict[int, object] = {}  # chunk size -> compiled program
-    # a preempted/failed invocation still files its flight data (the
-    # trainer's hardening): in-flight spans closed at their partial
-    # wall, cumulative trace/phase totals scoped by this recorder's
-    # id, plus the buffered event tail; the async checkpointer's
-    # context is the exit barrier (drain on success, abandon-with-log
-    # while unwinding)
-    import contextlib
+    def remake():
+        return checkpointed_stencil_program(
+            world, steps, ckpt_dir, save_every=save_every, mesh=mesh,
+            halo=halo, coeffs=coeffs, impl=impl, periodic=periodic,
+            keep=keep, sink=sink, chaos=chaos, recorder=recorder,
+            reshard=reshard, async_ckpt=async_ckpt, workload=workload,
+        )
 
-    with file_flight_data(sink, rec), \
-            (ckp if ckp is not None else contextlib.nullcontext()):
-        while start < steps:
-            chunk = min(save_every, steps - start)
-            fresh = chunk not in programs
-            if fresh:
-                programs[chunk] = make_stencil_program(mesh, spec, chunk, coeffs, impl)
-            if chaos is not None:
-                # the collective wrapper: a transient CommError here is the
-                # supervisor's restartable class; resume replays this chunk
-                chaos.maybe_fail("comm/halo_chunk", index=start, op="halo_chunk")
-            chunk_sp = rec.open_span("halo/chunk", step_begin=start)
-            state = jax.block_until_ready(programs[chunk](state))
-            rec.close_span(chunk_sp)
-            chunk_s = chunk_sp.seconds
-            start += chunk
+    def run_chunk(cp, pos):
+        chunk = min(save_every, steps - pos)
+        fresh = chunk not in hal["programs"]
+        if fresh:
             # a freshly-built program jit-compiles inside this chunk's
             # first call, so the bracket is compile-dominated wall — the
             # trainer's CompileCounter convention at chunk granularity;
             # obs.goodput carves compile_s out of the step bucket
-            sink.emit(
-                "halo/chunk",
-                step=start, chunk=chunk, wall_s=round(chunk_s, 6),
-                cell_updates_per_s=round(cells * chunk / chunk_s, 3),
-                compile_s=round(chunk_s, 6) if fresh else 0.0,
+            hal["programs"][chunk] = make_stencil_program(
+                mesh, spec, chunk, coeffs, impl
             )
+        hal["state"] = jax.block_until_ready(hal["programs"][chunk](hal["state"]))
+        return chunk, fresh
 
-            meta = {"steps_total": steps, "impl": impl}
-            if ckp is not None:
-                snap_sp = rec.open_span("ckpt/snapshot", step=start)
-                ckp.snapshot(ckpt_dir, start, np.asarray(state),
-                             metadata=meta, keep=keep)
-                rec.close_span(snap_sp)
-                sink.emit("ckpt/snapshot", step=start,
-                          wall_s=round(snap_sp.seconds, 6))
-            else:
-                def do_save(snap=np.asarray(state), at=start):
-                    return checkpoint.save(ckpt_dir, at, snap,
-                                           metadata=meta, hook=save_hook)
+    def make_event(cp, pos, payload, chunk_sp):
+        chunk, fresh = payload
+        chunk_s = chunk_sp.seconds
+        return ChunkResult(pos=pos + chunk, event={
+            "step": pos + chunk, "chunk": chunk, "wall_s": round(chunk_s, 6),
+            "cell_updates_per_s": round(cells * chunk / chunk_s, 3),
+            "compile_s": round(chunk_s, 6) if fresh else 0.0,
+        })
 
-                save_sp = rec.open_span("ckpt/save", step=start)
-                if chaos is not None:
-                    retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
-                else:
-                    do_save()
-                checkpoint.prune(ckpt_dir, keep)
-                rec.close_span(save_sp)
-                sink.emit("ckpt/save", step=start,
-                          wall_s=round(save_sp.seconds, 6))
-            if chaos is not None:
-                # AFTER the save: the restarted run resumes exactly
-                # here (a fired preemption unwinds through the async
-                # checkpointer's context, which completes the in-flight
-                # write before the supervisor re-invokes)
-                chaos.maybe_preempt("halo/preempt", index=start)
-    emit_phase_totals(sink, rec)
-    sink.flush()
-    return assemble(np.asarray(state), topo, layout)
+    def snapshot(cp, pos):
+        return np.asarray(hal["state"]), {"steps_total": steps, "impl": impl}
+
+    def epilogue(cp):
+        emit_phase_totals(cp.sink, cp.rec)
+        cp.sink.flush()
+        return assemble(np.asarray(hal["state"]), topo, layout)
+
+    return ChunkedProgram(
+        workload=workload, prefix="halo", total=steps, pos=start,
+        run_chunk=run_chunk, make_event=make_event, snapshot=snapshot,
+        epilogue=epilogue, fail_site="comm/halo_chunk", fail_op="halo_chunk",
+        preempt_site="halo/preempt", ckpt_dir=ckpt_dir, keep=keep,
+        save_retry=save_policy, async_ckpt=async_ckpt, sink=sink,
+        recorder=rec, chaos=chaos, remake=remake,
+    )
 
 
 def distributed_stencil(

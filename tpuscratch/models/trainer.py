@@ -39,7 +39,7 @@ from tpuscratch.ft.guards import (
     GuardPolicy,
     GuardState,
 )
-from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY, RetryPolicy, retry
+from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY, RetryPolicy
 from tpuscratch.models.transformer import (
     TransformerConfig,
     init_adam_state,
@@ -60,12 +60,13 @@ from tpuscratch.parallel.plan import ShardingPlan
 from tpuscratch.runtime.errors import CommError
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
 from tpuscratch.obs.sink import NullSink
-from tpuscratch.obs.trace import (
-    FlightRecorder,
-    emit_phase_totals,
-    file_flight_data,
-)
+from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
 from tpuscratch.runtime import checkpoint
+from tpuscratch.runtime.chunked import (
+    ChunkedProgram,
+    ChunkResult,
+    WorkloadSink,
+)
 
 
 @functools.lru_cache(maxsize=8)
@@ -269,6 +270,65 @@ def train(
     blocking path's, at most one write is in flight, and the barrier is
     drained before each next snapshot, at preemption points, and at
     exit."""
+    return train_program(
+        mesh, cfg, steps, ckpt_dir, lr=lr, optimizer=optimizer,
+        save_every=save_every, batch=batch, seq=seq, seed=seed, keep=keep,
+        log=log, obs=obs, recorder=recorder, chaos=chaos, guard=guard,
+        save_retry=save_retry, zero=zero, accum_steps=accum_steps,
+        plan=plan, reshard=reshard, async_ckpt=async_ckpt,
+    ).run()
+
+
+def train_program(
+    mesh: Mesh,
+    cfg: TransformerConfig,
+    steps: int,
+    ckpt_dir: str,
+    *,
+    lr: float = 0.05,
+    optimizer: str = "sgd",
+    save_every: int = 10,
+    batch: Optional[int] = None,
+    seq: Optional[int] = None,
+    seed: int = 0,
+    keep: int = 3,
+    log: Callable[[str], None] = lambda s: None,
+    obs=None,
+    recorder: Optional[FlightRecorder] = None,
+    chaos=None,
+    guard: Optional[GuardPolicy | GuardState] = None,
+    save_retry: Optional[RetryPolicy] = None,
+    zero: bool = False,
+    accum_steps: int = 1,
+    plan: Optional[ShardingPlan] = None,
+    reshard: bool = False,
+    async_ckpt: bool = False,
+    workload: str = "train",
+) -> ChunkedProgram:
+    """:func:`train` as an UN-RUN ``runtime.chunked.ChunkedProgram`` —
+    the steppable form a co-scheduler
+    (``runtime.scheduler.MeshScheduler``) or
+    ``ft.supervisor.supervise_program`` consumes.  All validation,
+    checkpoint resume and step-function construction happens here,
+    eagerly, so a mismatched resume fails at build time; each ``tick()``
+    then runs one save chunk with the EXACT legacy event stream
+    (``train/chunk``, the guard ladder's ``ft/*``,
+    ``ckpt/save``/``ckpt/snapshot``) — every event additionally tagged
+    ``workload=`` for per-job goodput accounting.  ``program.remake()``
+    rebuilds it resumed from ``ckpt_dir`` — the restart factory the
+    supervisor and the scheduler re-invoke after a preemption."""
+    orig_guard = guard  # remake re-passes the caller's policy/state
+
+    def remake():
+        return train_program(
+            mesh, cfg, steps, ckpt_dir, lr=lr, optimizer=optimizer,
+            save_every=save_every, batch=batch, seq=seq, seed=seed,
+            keep=keep, log=log, obs=obs, recorder=recorder, chaos=chaos,
+            guard=orig_guard, save_retry=save_retry, zero=zero,
+            accum_steps=accum_steps, plan=plan, reshard=reshard,
+            async_ckpt=async_ckpt, workload=workload,
+        )
+
     if save_every < 1:
         raise ValueError(f"save_every must be >= 1, got {save_every}")
     if optimizer not in ("sgd", "adam"):
@@ -457,7 +517,7 @@ def train(
         opt = commit_opt(opt)
         log(f"resumed at step {start} (meta {meta})")
 
-    sink = obs if obs is not None else NullSink()
+    sink = WorkloadSink(obs if obs is not None else NullSink(), workload)
     want_gnorm = sink.enabled
     metrics = MetricsRegistry()
     counter = CompileCounter()
@@ -509,197 +569,165 @@ def train(
     }
     if zero:
         metadata["mesh_shape"] = mesh_shape
-    save_hook = chaos.save_hook() if chaos is not None else None
     save_policy = save_retry if save_retry is not None else (
         DEFAULT_SAVE_RETRY if chaos is not None else None
     )
-    ckp = None
-    if async_ckpt:
-        from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
-
-        ckp = AsyncCheckpointer(retry=save_policy, chaos=chaos, sink=sink,
-                                metrics=metrics, log=log)
-    losses = []
-    ran = 0
-    ref_loss = float("nan")  # spike baseline: previous chunk's loss
+    losses: list[float] = []
+    st = {"params": params, "opt": opt, "ran": 0,
+          "ref_loss": float("nan")}  # spike baseline: previous chunk's loss
     run_t0 = time.perf_counter()
-    # a preempted/failed invocation still files its flight data: in-flight
-    # spans closed at their partial wall, the cumulative trace/phase
-    # totals (scoped by this recorder's id, so a restart's fresh recorder
-    # ADDS instead of replacing), and the buffered event tail.  The
-    # async checkpointer's context is the exit barrier: drained on a
-    # clean exit (a write failure surfaces here), abandoned-with-log
-    # when already unwinding (a secondary writer error must not mask
-    # the primary failure)
-    with file_flight_data(sink, rec), \
-            (ckp if ckp is not None else contextlib.nullcontext()):
-        while start < steps:
-            chunk = min(save_every, steps - start)
-            loss = gnorm = None
-            statuses = []
-            compile_s = 0.0
-            chunk_sp = rec.open_span("train/chunk", step_begin=start)
-            for i in range(chunk):
-                if accum_steps > 1:
-                    # each update consumes accum_steps consecutive entries
-                    # of the deterministic stream (at k=1 this is exactly
-                    # the legacy indexing, so trajectories line up)
-                    micro = [
-                        synthetic_batch(seed, (start + i) * accum_steps + j,
-                                        batch, seq, cfg.d_model)
-                        for j in range(accum_steps)
-                    ]
-                    x = jnp.stack([m[0] for m in micro])
-                    y = jnp.stack([m[1] for m in micro])
-                else:
-                    x, y = synthetic_batch(seed, start + i, batch, seq,
-                                           cfg.d_model)
-                if chaos is not None:
-                    x = chaos.corrupt_batch(x, start + i)
-                # compile detection: jit tracing + compilation run
-                # synchronously inside the traced call, so the bracket around
-                # a step whose CompileCounter ticked is compile-dominated
-                # wall — the goodput report's "compile" badput bucket
-                traced = counter.count
-                step_t0 = time.perf_counter()
-                if guard is not None:
-                    rl = jnp.asarray(ref_loss, jnp.float32)
-                    if optimizer == "adam":
-                        params, opt, loss, gnorm, st = step_fn(params, opt, x,
-                                                               y, rl)
-                    else:
-                        params, loss, gnorm, st = step_fn(params, x, y, rl)
-                    statuses.append(st)
-                elif optimizer == "adam":
-                    params, opt, loss, *rest = step_fn(params, opt, x, y)
-                    gnorm = rest[0] if rest else None
-                else:
-                    params, loss, *rest = step_fn(params, x, y)
-                    gnorm = rest[0] if rest else None
-                if counter.count > traced:
-                    compile_s += time.perf_counter() - step_t0
-            loss_f = float(jax.block_until_ready(loss))
-            rec.close_span(chunk_sp)  # fenced by the loss readback
-            chunk_sp.args["compile_s"] = round(compile_s, 6)
-            chunk_s = chunk_sp.seconds
-            if guard is not None:
-                st_host = [int(s) for s in statuses]
-                skips = st_host.count(STATUS_SKIPPED)
-                clips = st_host.count(STATUS_CLIPPED)
-                if skips or clips:
-                    metrics.counter("ft/skipped_steps").inc(skips)
-                    metrics.counter("ft/clipped_steps").inc(clips)
-                    sink.emit("ft/guard", step=start + chunk, skipped=skips,
-                              clipped=clips)
-                if guard_state.observe(st_host):
-                    # the stream is poisoned, not glitched: discard this
-                    # chunk, restore the last committed state, replay
-                    guard_state.rolled_back()  # GuardFailure past the budget
-                    metrics.counter("ft/rollbacks").inc()
-                    rb_sp = rec.open_span("train/rollback", from_step=start + chunk)
-                    if ckp is not None:
-                        # the in-flight write must publish before we ask
-                        # "what is the last committed step"
-                        ckp.drain()
-                    rb_to = checkpoint.latest_step(ckpt_dir)
-                    if rb_to is None:
-                        params, opt = fresh_state()
-                        rb_to = 0
-                    else:
-                        params, opt, rb_to, _ = _restore_state(
-                            ckpt_dir, params, opt, rb_to,
-                            mesh_shape=mesh_shape, reshard=reshard,
-                            live_plan=plan_id,
-                        )
-                        opt = commit_opt(opt)
-                    rec.close_span(rb_sp)
-                    # lost wall: the discarded chunk's compute + the restore
-                    # — the goodput "rollback" badput bucket
-                    sink.emit("ft/rollback", from_step=start + chunk,
-                              to_step=rb_to,
-                              lost_s=round(chunk_s + rb_sp.seconds, 6))
-                    log(f"guard rollback: step {start + chunk} -> {rb_to}")
-                    start = rb_to
-                    ref_loss = float("nan")
-                    continue
-            start += chunk
-            ran += chunk
-            losses.append(loss_f)
-            if math.isfinite(loss_f):
-                ref_loss = loss_f
-            metrics.counter("train/steps").inc(chunk)
-            metrics.gauge("train/loss").set(loss_f)
-            metrics.histogram("train/step_s").observe(chunk_s / chunk)
-            metrics.gauge("train/compiles").set(counter.count)
-            chunk_ev = {
-                "step": start, "loss": loss_f,
-                "steps": chunk,
-                "tokens": chunk * accum_steps * batch * seq,
-                "chunk_s": round(chunk_s, 6),
-                "compile_s": round(compile_s, 6),
-                "step_s": round(chunk_s / chunk, 6),
-                "steps_per_s": round(chunk / chunk_s, 3),
-                "tokens_per_s": round(
-                    chunk * accum_steps * batch * seq / chunk_s, 3
-                ),
-                "compiles": counter.count,
-            }
-            if gnorm is not None:
-                gnorm_f = float(gnorm)
-                chunk_ev["grad_norm"] = gnorm_f
-                metrics.gauge("train/grad_norm").set(gnorm_f)
-            sink.emit("train/chunk", **chunk_ev)
-            state = (
-                {"params": params, "opt": opt} if opt is not None else params
-            )
 
-            if ckp is not None:
-                # async: pay only the device→pinned-host copy here; the
-                # serialize+publish runs on the background writer (its
-                # ckpt/write event is stamped when it truly finishes)
-                snap_sp = rec.open_span("ckpt/snapshot", step=start)
-                ckp.snapshot(ckpt_dir, start, state, metadata=metadata,
-                             keep=keep)
-                rec.close_span(snap_sp)
-                sink.emit("ckpt/snapshot", step=start,
-                          wall_s=round(snap_sp.seconds, 6))
+    def run_chunk(cp, pos):
+        chunk = min(save_every, steps - pos)
+        loss = gnorm = None
+        statuses = []
+        compile_s = 0.0
+        params, opt = st["params"], st["opt"]
+        for i in range(chunk):
+            if accum_steps > 1:
+                # each update consumes accum_steps consecutive entries
+                # of the deterministic stream (at k=1 this is exactly
+                # the legacy indexing, so trajectories line up)
+                micro = [
+                    synthetic_batch(seed, (pos + i) * accum_steps + j,
+                                    batch, seq, cfg.d_model)
+                    for j in range(accum_steps)
+                ]
+                x = jnp.stack([m[0] for m in micro])
+                y = jnp.stack([m[1] for m in micro])
             else:
-                def do_save(snap=jax.tree.map(np.asarray, state), at=start):
-                    return checkpoint.save(ckpt_dir, at, snap,
-                                           metadata=metadata,
-                                           hook=save_hook)
-
-                save_sp = rec.open_span("ckpt/save", step=start)
-                if save_policy is not None:
-                    retry(do_save, save_policy, op="ckpt/save", log=log)
-                else:
-                    do_save()
-                checkpoint.prune(ckpt_dir, keep)
-                rec.close_span(save_sp)
-                sink.emit("ckpt/save", step=start,
-                          wall_s=round(save_sp.seconds, 6))
-            log(f"step {start}/{steps}: loss {loss_f:.5f}")
+                x, y = synthetic_batch(seed, pos + i, batch, seq,
+                                       cfg.d_model)
             if chaos is not None:
-                # AFTER the save: the restarted run resumes exactly
-                # here.  No async drain here — an unconditional barrier
-                # would serialize every write behind the loop; when the
-                # preemption DOES fire, the checkpointer's context exit
-                # completes the in-flight write before the supervisor
-                # re-invokes
-                chaos.maybe_preempt("train/preempt", index=start)
-    sink.emit(
-        "train/run",
-        steps_run=ran, final_step=start,
-        wall_s=round(time.perf_counter() - run_t0, 6),
-        compiles=counter.count,
-    )
-    emit_phase_totals(sink, rec)
-    sink.emit_metrics(metrics.snapshot(), scope=metrics.id)
-    sink.flush()
-    gs = guard_state
-    return params, TrainReport(
-        ran, start, tuple(losses),
-        skipped=gs.skips if gs else 0,
-        clipped=gs.clips if gs else 0,
-        rollbacks=gs.rollbacks if gs else 0,
+                x = chaos.corrupt_batch(x, pos + i)
+            # compile detection: jit tracing + compilation run
+            # synchronously inside the traced call, so the bracket around
+            # a step whose CompileCounter ticked is compile-dominated
+            # wall — the goodput report's "compile" badput bucket
+            traced = counter.count
+            step_t0 = time.perf_counter()
+            if guard is not None:
+                rl = jnp.asarray(st["ref_loss"], jnp.float32)
+                if optimizer == "adam":
+                    params, opt, loss, gnorm, gst = step_fn(params, opt, x,
+                                                            y, rl)
+                else:
+                    params, loss, gnorm, gst = step_fn(params, x, y, rl)
+                statuses.append(gst)
+            elif optimizer == "adam":
+                params, opt, loss, *rest = step_fn(params, opt, x, y)
+                gnorm = rest[0] if rest else None
+            else:
+                params, loss, *rest = step_fn(params, x, y)
+                gnorm = rest[0] if rest else None
+            if counter.count > traced:
+                compile_s += time.perf_counter() - step_t0
+        loss_f = float(jax.block_until_ready(loss))  # fences the span
+        st["params"], st["opt"] = params, opt
+        return chunk, loss_f, gnorm, statuses, compile_s
+
+    def make_event(cp, pos, payload, chunk_sp):
+        chunk, loss_f, gnorm, statuses, compile_s = payload
+        chunk_sp.args["compile_s"] = round(compile_s, 6)
+        chunk_s = chunk_sp.seconds
+        if guard is not None:
+            st_host = [int(s) for s in statuses]
+            skips = st_host.count(STATUS_SKIPPED)
+            clips = st_host.count(STATUS_CLIPPED)
+            if skips or clips:
+                metrics.counter("ft/skipped_steps").inc(skips)
+                metrics.counter("ft/clipped_steps").inc(clips)
+                cp.sink.emit("ft/guard", step=pos + chunk, skipped=skips,
+                             clipped=clips)
+            if guard_state.observe(st_host):
+                # the stream is poisoned, not glitched: discard this
+                # chunk, restore the last committed state, replay
+                guard_state.rolled_back()  # GuardFailure past the budget
+                metrics.counter("ft/rollbacks").inc()
+                rb_sp = cp.rec.open_span("train/rollback",
+                                         from_step=pos + chunk)
+                # the in-flight async write must publish before we ask
+                # "what is the last committed step"
+                cp.drain()
+                rb_to = checkpoint.latest_step(ckpt_dir)
+                if rb_to is None:
+                    st["params"], st["opt"] = fresh_state()
+                    rb_to = 0
+                else:
+                    rb_p, rb_o, rb_to, _ = _restore_state(
+                        ckpt_dir, st["params"], st["opt"], rb_to,
+                        mesh_shape=mesh_shape, reshard=reshard,
+                        live_plan=plan_id,
+                    )
+                    st["params"], st["opt"] = rb_p, commit_opt(rb_o)
+                cp.rec.close_span(rb_sp)
+                # lost wall: the discarded chunk's compute + the restore
+                # — the goodput "rollback" badput bucket
+                cp.sink.emit("ft/rollback", from_step=pos + chunk,
+                             to_step=rb_to,
+                             lost_s=round(chunk_s + rb_sp.seconds, 6))
+                log(f"guard rollback: step {pos + chunk} -> {rb_to}")
+                st["ref_loss"] = float("nan")
+                return ChunkResult(pos=rb_to, rollback=True)
+        new = pos + chunk
+        st["ran"] += chunk
+        losses.append(loss_f)
+        if math.isfinite(loss_f):
+            st["ref_loss"] = loss_f
+        metrics.counter("train/steps").inc(chunk)
+        metrics.gauge("train/loss").set(loss_f)
+        metrics.histogram("train/step_s").observe(chunk_s / chunk)
+        metrics.gauge("train/compiles").set(counter.count)
+        chunk_ev = {
+            "step": new, "loss": loss_f,
+            "steps": chunk,
+            "tokens": chunk * accum_steps * batch * seq,
+            "chunk_s": round(chunk_s, 6),
+            "compile_s": round(compile_s, 6),
+            "step_s": round(chunk_s / chunk, 6),
+            "steps_per_s": round(chunk / chunk_s, 3),
+            "tokens_per_s": round(
+                chunk * accum_steps * batch * seq / chunk_s, 3
+            ),
+            "compiles": counter.count,
+        }
+        if gnorm is not None:
+            gnorm_f = float(gnorm)
+            chunk_ev["grad_norm"] = gnorm_f
+            metrics.gauge("train/grad_norm").set(gnorm_f)
+        return ChunkResult(pos=new, event=chunk_ev)
+
+    def snapshot(cp, pos):
+        state = ({"params": st["params"], "opt": st["opt"]}
+                 if st["opt"] is not None else st["params"])
+        return state, metadata
+
+    def on_saved(cp, pos):
+        log(f"step {pos}/{steps}: loss {losses[-1]:.5f}")
+
+    def epilogue(cp):
+        cp.sink.emit(
+            "train/run",
+            steps_run=st["ran"], final_step=cp.pos,
+            wall_s=round(time.perf_counter() - run_t0, 6),
+            compiles=counter.count,
+        )
+        emit_phase_totals(cp.sink, cp.rec)
+        cp.sink.emit_metrics(metrics.snapshot(), scope=metrics.id)
+        cp.sink.flush()
+        gs = guard_state
+        return st["params"], TrainReport(
+            st["ran"], cp.pos, tuple(losses),
+            skipped=gs.skips if gs else 0,
+            clipped=gs.clips if gs else 0,
+            rollbacks=gs.rollbacks if gs else 0,
+        )
+
+    return ChunkedProgram(
+        workload=workload, prefix="train", total=steps, pos=start,
+        run_chunk=run_chunk, make_event=make_event, snapshot=snapshot,
+        epilogue=epilogue, on_saved=on_saved, preempt_site="train/preempt",
+        ckpt_dir=ckpt_dir, keep=keep, save_retry=save_policy,
+        write_retry=save_policy, async_ckpt=async_ckpt, sink=sink,
+        recorder=rec, metrics=metrics, chaos=chaos, log=log, remake=remake,
     )

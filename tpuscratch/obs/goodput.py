@@ -63,7 +63,8 @@ import dataclasses
 import math
 from typing import Optional, Sequence
 
-__all__ = ["BUCKETS", "GoodputReport", "goodput_report"]
+__all__ = ["BUCKETS", "GoodputReport", "WorkloadGoodput", "by_workload",
+           "goodput_report"]
 
 #: the wall-time partition, in report order.  ``step`` is the goodput
 #: bucket; everything else is badput (``other`` = unattributed host
@@ -311,3 +312,233 @@ def goodput_report(
            if rate is not None and peak_flops_per_s else None)
     return GoodputReport(wall_s=wall, buckets=buckets, steps=steps,
                          tokens=tokens, mfu=mfu, model_flops_per_s=rate)
+
+
+# ---- per-workload partitioning (the co-scheduled stream) ----------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkloadGoodput:
+    """One co-scheduled stream split into per-workload reports.
+
+    ``wall_s`` is the scheduler's arbitration window (first
+    ``sched/switch`` to ``sched/run``); the per-workload ``reports``
+    each account only that workload's OWN time slices, so their walls
+    partition ``wall_s`` exactly — :meth:`check` asserts it, plus each
+    report's own bucket invariant.  Switch overhead books to the
+    INCOMING workload's slice (``sched/switch`` is stamped before the
+    tick), where it lands in ``other``."""
+
+    wall_s: float
+    reports: dict[str, GoodputReport]
+    switches: int
+    slices: dict[str, int]   # workload -> number of scheduling slices
+    targets: Optional[dict] = None
+
+    @property
+    def shares(self) -> dict[str, float]:
+        """Each workload's fraction of the scheduler wall."""
+        return {k: (r.wall_s / self.wall_s if self.wall_s else 0.0)
+                for k, r in self.reports.items()}
+
+    def check(self, tol: float = 1e-6) -> None:
+        """Assert the two-level partition: every per-workload report's
+        buckets sum to its wall, and the walls sum to the scheduler
+        wall."""
+        for rep in self.reports.values():
+            rep.check(tol)
+        total = math.fsum(r.wall_s for r in self.reports.values())
+        if abs(total - self.wall_s) > tol * max(1.0, self.wall_s):
+            raise AssertionError(
+                f"per-workload walls sum {total} != scheduler wall "
+                f"{self.wall_s}"
+            )
+
+    def table(self) -> list[dict]:
+        """The arbitration table: one row per workload — slices, wall,
+        achieved share (vs the policy ``target`` and its ``share_err``
+        when targets are known), goodput fraction."""
+        shares = self.shares
+        rows = []
+        for name, rep in self.reports.items():
+            row = {
+                "workload": name,
+                "slices": self.slices.get(name, 0),
+                "wall_s": round(rep.wall_s, 6),
+                "share": round(shares[name], 6),
+                "goodput_fraction": round(rep.goodput_fraction, 6),
+            }
+            if self.targets and name in self.targets:
+                row["target"] = round(float(self.targets[name]), 6)
+                row["share_err"] = round(
+                    abs(shares[name] - float(self.targets[name])), 6)
+            rows.append(row)
+        return rows
+
+    def summary(self) -> str:
+        lines = [f"scheduler wall {self.wall_s:.3f} s, "
+                 f"{self.switches} switch(es)"]
+        for row in self.table():
+            line = (f"  {row['workload']:<10} {row['slices']:3d} slices  "
+                    f"wall {row['wall_s']:8.3f} s  "
+                    f"share {100 * row['share']:5.1f}%  "
+                    f"goodput {100 * row['goodput_fraction']:5.1f}%")
+            if "target" in row:
+                line += (f"  target {100 * row['target']:5.1f}% "
+                         f"(err {100 * row['share_err']:.1f}pt)")
+            lines.append(line)
+        return "\n".join(lines)
+
+
+def _parse_intervals(events: Sequence[dict]):
+    """``_account_group``'s per-event parse with the start stamp
+    UNCLAMPED (slice clipping owns the window): returns
+    ``([(start, end, parts, dur)], steps, tokens)``."""
+    intervals = []
+    steps = tokens = 0
+    seen_cc: Optional[float] = None
+    for rec in events:
+        kind = rec.get("event")
+        src = _DURATION_EVENTS.get(kind)
+        if src is None:
+            continue
+        field, bucket = src
+        dur = _num(rec, field)
+        end = _num(rec, "t")
+        if dur is None or end is None or dur <= 0:
+            continue
+        parts = {bucket: dur}
+        if kind in ("train/chunk", "halo/chunk", "solver/chunk"):
+            comp = _num(rec, "compile_s") or 0.0
+            comp = min(comp, parts["step"])
+            if comp > 0:
+                parts = {"step": parts["step"] - comp, "compile": comp}
+        elif kind == "serve/tick":
+            cc = ((_num(rec, "decode_compiles") or 0.0)
+                  + (_num(rec, "prefill_compiles") or 0.0))
+            ticked = cc > 0 if seen_cc is None else cc != seen_cc
+            seen_cc = cc
+            if ticked:
+                parts = {"compile": parts.pop("step")}
+        if kind == "train/chunk":
+            steps += int(_num(rec, "steps") or 0)
+            tk = _num(rec, "tokens")
+            if tk is None:
+                rate, cs = _num(rec, "tokens_per_s"), _num(rec, "chunk_s")
+                tk = rate * cs if rate is not None and cs is not None else 0
+            tokens += int(tk)
+        intervals.append((end - dur, end, parts, dur))
+    return intervals, steps, tokens
+
+
+def _account_slices(events: Sequence[dict],
+                    slices: Sequence[tuple[float, float]]) -> GoodputReport:
+    """One workload's report over ITS scheduling slices: every
+    attributed interval is clipped to the slices (an interval spilling
+    over a switch boundary only books the part inside — the rest of
+    that wall belongs to whoever held the mesh), overlaps clipped
+    earliest-claim-first, the remainder ``other`` — buckets sum to the
+    slice wall exactly, by the same construction as the whole-stream
+    report."""
+    wall = math.fsum(e - s for s, e in slices)
+    intervals, steps, tokens = _parse_intervals(events)
+    pieces = []
+    for start, end, parts, dur in intervals:
+        for s, e in slices:
+            cs, ce = max(start, s), min(end, e)
+            if ce > cs:
+                pieces.append((cs, ce, parts, dur))
+    pieces.sort(key=lambda p: p[0])
+    buckets = {k: 0.0 for k in BUCKETS}
+    cursor = None
+    for cs, ce, parts, dur in pieces:
+        s = cs if cursor is None else max(cs, cursor)
+        if ce <= s:
+            continue
+        frac = (ce - s) / dur
+        for b, v in parts.items():
+            buckets[b] += v * frac
+        cursor = ce if cursor is None else max(cursor, ce)
+    attributed = sum(buckets.values())
+    if attributed > wall > 0:
+        scale = wall / attributed
+        buckets = {k: v * scale for k, v in buckets.items()}
+        attributed = wall
+    buckets["other"] = max(wall - attributed, 0.0)
+    return GoodputReport(wall_s=wall, buckets=buckets, steps=steps,
+                         tokens=tokens)
+
+
+def by_workload(events: Sequence[dict], *,
+                targets: Optional[dict] = None) -> WorkloadGoodput:
+    """Split one (co-scheduled) event stream into per-workload goodput
+    reports, keyed on the ``workload=`` tag
+    ``runtime.chunked.WorkloadSink`` stamps.
+
+    With ``sched/switch`` events present, the scheduler's arbitration
+    window [first switch, ``sched/run``] is cut into slices — each
+    switch opens the named workload's slice, closed by the next switch
+    — and every workload is accounted ONLY inside its own slices, so
+    the per-workload walls partition the scheduler wall exactly
+    (:meth:`WorkloadGoodput.check`).  Without switches (solo or
+    back-to-back runs in one stream), each workload accounts its own
+    event window and the walls sum.  ``targets`` (workload -> intended
+    share) defaults to the ``sched/run`` event's ``targets`` field when
+    the policy published one; it feeds the ``table()`` ``share_err``
+    column."""
+    sw = [r for r in events
+          if r.get("event") == "sched/switch"
+          and _num(r, "t") is not None and isinstance(r.get("workload"), str)]
+    sw.sort(key=lambda r: _num(r, "t"))
+    runs = [r for r in events if r.get("event") == "sched/run"]
+    run_ev = runs[-1] if runs else None
+    if targets is None and run_ev is not None:
+        tg = run_ev.get("targets")
+        if isinstance(tg, dict):
+            targets = {str(k): float(v) for k, v in tg.items()}
+    if not sw:
+        # no arbitration in the stream: account each workload over its
+        # own window (the back-to-back solo baseline)
+        names: list[str] = []
+        for rec in events:
+            w = rec.get("workload")
+            if isinstance(w, str) and w not in names:
+                names.append(w)
+        reports = {}
+        for name in names:
+            w_, b, s, t = _account_group(
+                [r for r in events if r.get("workload") == name])
+            reports[name] = GoodputReport(wall_s=w_, buckets=b, steps=s,
+                                          tokens=t)
+        wall = math.fsum(r.wall_s for r in reports.values())
+        return WorkloadGoodput(wall_s=wall, reports=reports, switches=0,
+                               slices={k: 1 for k in reports},
+                               targets=targets)
+    end = _num(run_ev, "t") if run_ev is not None else None
+    if end is None:
+        ts = [t for t in (_num(r, "t") for r in events) if t is not None]
+        end = max(ts)
+    bounds = [_num(r, "t") for r in sw]
+    bounds.append(max(end, bounds[-1]))
+    slices: dict[str, list[tuple[float, float]]] = {}
+    for i, rec in enumerate(sw):
+        s, e = bounds[i], bounds[i + 1]
+        if e > s:
+            slices.setdefault(rec["workload"], []).append((s, e))
+    reports = {}
+    nslices = {}
+    for name, sl in slices.items():
+        reports[name] = _account_slices(
+            [r for r in events if r.get("workload") == name], sl)
+        nslices[name] = len(sl)
+    switches = run_ev.get("switches") if run_ev is not None else None
+    if not isinstance(switches, int) or isinstance(switches, bool):
+        switches = max(len(sw) - 1, 0)
+    return WorkloadGoodput(wall_s=bounds[-1] - bounds[0], reports=reports,
+                           switches=switches, slices=nslices,
+                           targets=targets)
+
+
+#: the classmethod-style spelling the satellite names:
+#: ``GoodputReport.by_workload(events)``
+GoodputReport.by_workload = staticmethod(by_workload)

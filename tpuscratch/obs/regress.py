@@ -104,10 +104,18 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 #: decode exists to amortize — exact engine counters over exact token
 #: counts, so they keep the tight static band; a dispatches/token
 #: creeping back toward 1 means the scan stopped covering the ticks.)
+#: (the config-18 co-scheduling row, ISSUE 16: ``share_err`` is the
+#: achieved-vs-target share error of the MeshScheduler's arbitration —
+#: drifting from the policy target is a scheduler regression;
+#: ``switch`` pins the per-context-switch overhead seconds.  The row's
+#: aggregate/solo goodput fractions ride the existing "goodput"
+#: _HIGHER entry; the raw ``switches`` COUNT is workload shape,
+#: skipped.)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
           "overhead", "bubble", "crossover", "prefill_frac", "degraded",
           "iterations", "cycles", "psum", "ppermute", "checkpoint",
-          "restart", "badput", "cold", "ttft", "dispatches", "host_sync")
+          "restart", "badput", "cold", "ttft", "dispatches", "host_sync",
+          "share_err", "switch")
 
 #: checked BEFORE _HIGHER: the config-15 per-SWEEP collective budget
 #: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would
@@ -116,8 +124,19 @@ _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
 _LOWER_FIRST = ("per_sweep",)
 #: fields that are identity/configuration, never compared
 #: (``replicas`` is the config-17 fleet size — workload shape, like dp)
+#: (``switches``/``workloads`` are the config-18 arbitration shape —
+#: how many context switches/jobs the policy produced at this quantum,
+#: not a cost; the per-switch overhead carries the direction.  Its
+#: achieved/target shares and raw walls are CONTEXT: ``share_err``
+#: carries the arbitration direction and the goodput fractions carry
+#: the wall story — ``share_solver``'s accidental ``_s`` substring and
+#: the wall clocks must not gate; a few-ms solver share swings tens of
+#: percent on the proxy with nothing regressed.)
 _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
-         "flops_per_token", "degenerate", "peak_hbm_gbps", "replicas"}
+         "flops_per_token", "degenerate", "peak_hbm_gbps", "replicas",
+         "switches", "workloads", "share_train", "share_solver",
+         "target_train", "target_solver", "wall_s_cosched",
+         "wall_s_solo"}
 
 #: per-field MEASURED-noise floors (fractional band, substring-matched
 #: like the direction tables; first match wins): wall-clock fields
@@ -159,6 +178,13 @@ _NOISE_FLOORS = (
                                # single-stream rate, the band above);
                                # the row's dispatch counters are
                                # static (no floor)
+    ("share_err", 0.50),       # achieved-vs-target share: a ratio of
+                               # measured busy walls on tiny CPU chunks
+    ("switch", 0.55),          # per-switch overhead: sub-ms residuals
+                               # of wall minus busy, scheduler-noise
+                               # dominated on the proxy
+    ("goodput", 0.40),         # goodput fractions of short CPU runs —
+                               # chunk walls in the ms regime
 )
 
 

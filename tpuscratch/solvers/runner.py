@@ -50,7 +50,8 @@ from tpuscratch.solvers.multigrid3d import (
     v_cycle3,
 )
 
-__all__ = ["SolveReport", "checkpointed_mg3d_solve", "supervised_mg3d_solve"]
+__all__ = ["SolveReport", "checkpointed_mg3d_solve", "mg3d_solve_program",
+           "supervised_mg3d_solve"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,17 +163,56 @@ def checkpointed_mg3d_solve(
     barrier drained before each snapshot, at preemption points, and at
     exit.
     """
+    return mg3d_solve_program(
+        b_world, ckpt_dir, mesh=mesh, levels=levels, tol=tol,
+        max_cycles=max_cycles, chunk_cycles=chunk_cycles, nu=nu,
+        coarse_sweeps=coarse_sweeps, omega=omega, smoother=smoother,
+        s_step=s_step, keep=keep, sink=sink, chaos=chaos, recorder=recorder,
+        log=log, reshard=reshard, async_ckpt=async_ckpt,
+    ).run()
+
+
+def mg3d_solve_program(
+    b_world: np.ndarray,
+    ckpt_dir: str,
+    *,
+    mesh=None,
+    levels: Optional[int] = None,
+    tol: float = 1e-5,
+    max_cycles: int = 50,
+    chunk_cycles: int = 4,
+    nu: int = 2,
+    coarse_sweeps: int = 32,
+    omega: float = 6 / 7,
+    smoother: str = "rbgs",
+    s_step: int = 1,
+    keep: int = 3,
+    sink=None,
+    chaos=None,
+    recorder=None,
+    log=lambda s: None,
+    reshard: bool = False,
+    async_ckpt: bool = False,
+    workload: str = "solver",
+):
+    """:func:`checkpointed_mg3d_solve` as a steppable
+    ``runtime.chunked.ChunkedProgram`` — same arguments, same
+    ``solver/*`` event stream, same bit-identical resume, but each
+    ``tick()`` is one compiled chunk of V-cycles, so a ``MeshScheduler``
+    can time-slice the solve against other workloads.  ``run()`` returns
+    ``(x_world, SolveReport)``; ``workload`` tags every emitted event."""
     from tpuscratch.obs.sink import NullSink
-    from tpuscratch.obs.trace import (
-        FlightRecorder,
-        emit_phase_totals,
-        file_flight_data,
-    )
+    from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
     from tpuscratch.runtime import checkpoint
+    from tpuscratch.runtime.chunked import (
+        ChunkedProgram,
+        ChunkResult,
+        WorkloadSink,
+    )
 
     if chunk_cycles < 1:
         raise ValueError(f"chunk_cycles must be >= 1, got {chunk_cycles}")
-    sink = sink if sink is not None else NullSink()
+    sink = WorkloadSink(sink if sink is not None else NullSink(), workload)
     rec = recorder if recorder is not None else FlightRecorder()
     mesh, dims, specs, axes, cells = _mg_prologue3(b_world, mesh, levels)
     misses = _mg3_chunk_program.cache_info().misses
@@ -221,134 +261,120 @@ def checkpointed_mg3d_solve(
         resumed_at=int(resumed_at),
     )
 
-    save_hook = None
+    save_policy = None
     if chaos is not None:
-        from tpuscratch.ft.chaos import bind_sink
+        from tpuscratch.ft.retry import DEFAULT_SAVE_RETRY
 
-        bind_sink(chaos, sink)
-        save_hook = chaos.save_hook()
-    ckp = None
-    if async_ckpt:
-        from tpuscratch.runtime.async_ckpt import AsyncCheckpointer
+        save_policy = DEFAULT_SAVE_RETRY
 
-        ckp = AsyncCheckpointer(chaos=chaos, sink=sink)
-
-    u = jnp.asarray(state["u"])
-    rs = jnp.asarray(state["rs"])
-    prev = jnp.asarray(state["prev"])
-    k = int(state["k"])
-    rs0 = None
-    chunks = 0
-    compiled_once = not fresh_program
+    sol = {
+        "u": jnp.asarray(state["u"]),
+        "rs": jnp.asarray(state["rs"]),
+        "prev": jnp.asarray(state["prev"]),
+        "rs0": None,
+        "k_prev": int(state["k"]),
+        "chunks": 0,
+        "compiled_once": not fresh_program,
+    }
     cells_total = float(np.prod(b_world.shape))
-    import contextlib
 
-    with file_flight_data(sink, rec), \
-            (ckp if ckp is not None else contextlib.nullcontext()):
-        while k < max_cycles:
-            if chaos is not None:
-                # a transient CommError here is the supervisor's
-                # restartable class; resume replays this chunk
-                chaos.maybe_fail("comm/solver_chunk", index=k,
-                                 op="solver_chunk")
-            fresh = not compiled_once
-            chunk_sp = rec.open_span("solver/chunk", cycle_begin=k)
-            u, rs, prev, k_arr, rs0 = jax.block_until_ready(
-                program(u, b_tiles, rs, prev, jnp.asarray(k, jnp.int32))
-            )
-            rec.close_span(chunk_sp)
-            compiled_once = True
-            k_new = int(k_arr)
-            advanced = k_new - k
-            chunk_s = chunk_sp.seconds
-            chunks += 1
-            sink.emit(
-                "solver/chunk",
-                cycle=k_new, chunk=advanced, wall_s=round(chunk_s, 6),
-                cell_updates_per_s=round(
-                    cells_total * max(advanced, 1) / chunk_s, 3),
-                relres2=float(rs) / max(float(rs0), 1e-30),
-                # the first chunk's bracket is compile-dominated wall —
-                # the halo driver's convention at chunk granularity
-                compile_s=round(chunk_s, 6) if fresh else 0.0,
-            )
+    def remake():
+        return mg3d_solve_program(
+            b_world, ckpt_dir, mesh=mesh, levels=levels, tol=tol,
+            max_cycles=max_cycles, chunk_cycles=chunk_cycles, nu=nu,
+            coarse_sweeps=coarse_sweeps, omega=omega, smoother=smoother,
+            s_step=s_step, keep=keep, sink=sink, chaos=chaos,
+            recorder=recorder, log=log, reshard=reshard,
+            async_ckpt=async_ckpt, workload=workload,
+        )
 
-            snap_state = {"u": np.asarray(u), "rs": np.asarray(rs),
-                          "prev": np.asarray(prev),
-                          "k": np.asarray(k_new, np.int32)}
-            snap_meta = {"solver": "mg3d", "tol": tol,
-                         "max_cycles": max_cycles}
-            if ckp is not None:
-                snap_sp = rec.open_span("ckpt/snapshot", cycle=k_new)
-                ckp.snapshot(ckpt_dir, k_new, snap_state,
-                             metadata=snap_meta, keep=keep)
-                rec.close_span(snap_sp)
-                sink.emit("ckpt/snapshot", step=k_new,
-                          wall_s=round(snap_sp.seconds, 6))
-            else:
-                def do_save(at=k_new, snap=snap_state):
-                    return checkpoint.save(ckpt_dir, at, snap,
-                                           metadata=snap_meta,
-                                           hook=save_hook)
+    def run_chunk(cp, pos):
+        fresh = not sol["compiled_once"]
+        u, rs, prev, k_arr, rs0 = jax.block_until_ready(
+            program(sol["u"], b_tiles, sol["rs"], sol["prev"],
+                    jnp.asarray(pos, jnp.int32))
+        )
+        sol.update(u=u, rs=rs, prev=prev, rs0=rs0, compiled_once=True)
+        return int(k_arr), fresh
 
-                save_sp = rec.open_span("ckpt/save", cycle=k_new)
-                if chaos is not None:
-                    from tpuscratch.ft.retry import (
-                        DEFAULT_SAVE_RETRY,
-                        retry,
-                    )
+    def make_event(cp, pos, payload, chunk_sp):
+        k_new, fresh = payload
+        advanced = k_new - pos
+        chunk_s = chunk_sp.seconds
+        sol["chunks"] += 1
+        sol["k_prev"] = pos
+        return ChunkResult(pos=k_new, event={
+            "cycle": k_new, "chunk": advanced, "wall_s": round(chunk_s, 6),
+            "cell_updates_per_s": round(
+                cells_total * max(advanced, 1) / chunk_s, 3),
+            "relres2": float(sol["rs"]) / max(float(sol["rs0"]), 1e-30),
+            # the first chunk's bracket is compile-dominated wall —
+            # the halo driver's convention at chunk granularity
+            "compile_s": round(chunk_s, 6) if fresh else 0.0,
+        })
 
-                    retry(do_save, DEFAULT_SAVE_RETRY, op="ckpt/save")
-                else:
-                    do_save()
-                checkpoint.prune(ckpt_dir, keep)
-                rec.close_span(save_sp)
-                sink.emit("ckpt/save", step=k_new,
-                          wall_s=round(save_sp.seconds, 6))
-            if chaos is not None:
-                # AFTER the save: the restarted run resumes exactly
-                # here (a fired preemption unwinds through the async
-                # checkpointer's context, which completes the in-flight
-                # write before the supervisor re-invokes)
-                chaos.maybe_preempt("solver/preempt", index=k_new)
-            stop2 = float(tol) ** 2 * float(rs0)
-            if float(rs) <= stop2:
-                k = k_new
-                break
-            if k_new < min(k + chunk_cycles, max_cycles):
-                # the in-program stagnation rule stopped the chunk short
-                log(f"stagnated at cycle {k_new} "
-                    f"(relres^2 {float(rs) / max(float(rs0), 1e-30):.3e})")
-                k = k_new
-                break
-            k = k_new
-    emit_phase_totals(sink, rec)
+    def snapshot(cp, pos):
+        snap_state = {"u": np.asarray(sol["u"]),
+                      "rs": np.asarray(sol["rs"]),
+                      "prev": np.asarray(sol["prev"]),
+                      "k": np.asarray(pos, np.int32)}
+        return snap_state, {"solver": "mg3d", "tol": tol,
+                            "max_cycles": max_cycles}
 
-    tiny = float(np.finfo(np.dtype(f32)).tiny)
-    if rs0 is None:
-        # resumed at/after max_cycles with nothing left to run: the
-        # restored rs is the state; rs0 is recomputed host-side (report
-        # only — stop decisions always use the device value)
-        f_host = b_world.astype(np.float64)
-        f_host = f_host - f_host.mean()
-        rs0 = float((f_host * f_host).sum())
-    relres = float(np.sqrt(float(rs) / max(float(rs0), tiny)))
-    converged = relres <= tol
-    report = SolveReport(
-        cycles=int(k), relres=relres, converged=converged,
-        chunks=chunks, resumed_at=int(resumed_at),
+    def post_boundary(cp, k_new):
+        # the stop rules run AFTER the preemption point, exactly where
+        # the legacy loop evaluated them
+        stop2 = float(tol) ** 2 * float(sol["rs0"])
+        if float(sol["rs"]) <= stop2:
+            return True
+        if k_new < min(sol["k_prev"] + chunk_cycles, max_cycles):
+            # the in-program stagnation rule stopped the chunk short
+            log(f"stagnated at cycle {k_new} "
+                f"(relres^2 "
+                f"{float(sol['rs']) / max(float(sol['rs0']), 1e-30):.3e})")
+            return True
+        return False
+
+    def epilogue(cp):
+        emit_phase_totals(cp.sink, cp.rec)
+        tiny = float(np.finfo(np.dtype(f32)).tiny)
+        rs0 = sol["rs0"]
+        if rs0 is None:
+            # resumed at/after max_cycles with nothing left to run: the
+            # restored rs is the state; rs0 is recomputed host-side
+            # (report only — stop decisions always use the device value)
+            f_host = b_world.astype(np.float64)
+            f_host = f_host - f_host.mean()
+            rs0 = float((f_host * f_host).sum())
+        relres = float(np.sqrt(float(sol["rs"]) / max(float(rs0), tiny)))
+        converged = relres <= tol
+        report = SolveReport(
+            cycles=int(cp.pos), relres=relres, converged=converged,
+            chunks=sol["chunks"], resumed_at=int(resumed_at),
+        )
+        cp.sink.emit(
+            "solver/run", cycles=report.cycles, relres=report.relres,
+            converged=report.converged, chunks=report.chunks,
+            resumed_at=report.resumed_at,
+        )
+        cp.sink.flush()
+        # mean projection on the HOST (deterministic either path): the
+        # assembled world minus its mean — the whole-solve program's
+        # final psum projection, reassembled-side
+        x = assemble3d_cores(np.asarray(sol["u"]))
+        return x - x.mean(dtype=np.float64).astype(x.dtype), report
+
+    return ChunkedProgram(
+        workload=workload, prefix="solver", total=max_cycles,
+        pos=int(state["k"]), run_chunk=run_chunk, make_event=make_event,
+        snapshot=snapshot, epilogue=epilogue, post_boundary=post_boundary,
+        span_args=lambda p: {"cycle_begin": p},
+        save_span_args=lambda p: {"cycle": p},
+        fail_site="comm/solver_chunk", fail_op="solver_chunk",
+        preempt_site="solver/preempt", ckpt_dir=ckpt_dir, keep=keep,
+        save_retry=save_policy, async_ckpt=async_ckpt, sink=sink,
+        recorder=rec, chaos=chaos, log=log, remake=remake,
     )
-    sink.emit(
-        "solver/run", cycles=report.cycles, relres=report.relres,
-        converged=report.converged, chunks=report.chunks,
-        resumed_at=report.resumed_at,
-    )
-    sink.flush()
-    # mean projection on the HOST (deterministic either path): the
-    # assembled world minus its mean — the whole-solve program's final
-    # psum projection, reassembled-side
-    x = assemble3d_cores(np.asarray(u))
-    return x - x.mean(dtype=np.float64).astype(x.dtype), report
 
 
 def supervised_mg3d_solve(
@@ -371,17 +397,15 @@ def supervised_mg3d_solve(
     plan in ``solve_kw['chaos']`` persists ACROSS restarts, so consumed
     one-shot faults stay consumed in the replay.  Returns the completing
     invocation's ``(x_world, SolveReport)``."""
-    from tpuscratch.ft.supervisor import RESTARTABLE, RestartBudget, supervise
+    from tpuscratch.ft.supervisor import supervise_program
 
-    budget = budget if budget is not None else RestartBudget()
-    restartable = restartable if restartable is not None else RESTARTABLE
-
-    def attempt():
-        return checkpointed_mg3d_solve(
+    def factory():
+        return mg3d_solve_program(
             b_world, ckpt_dir, sink=sink, recorder=recorder, log=log,
             **solve_kw,
         )
 
-    return supervise(attempt, budget=budget, restartable=restartable,
-                     sink=sink, metrics=metrics, recorder=recorder,
-                     log=log, sleep=sleep)
+    return supervise_program(factory, budget=budget,
+                             restartable=restartable, sink=sink,
+                             metrics=metrics, recorder=recorder,
+                             log=log, sleep=sleep)
