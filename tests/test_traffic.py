@@ -1,0 +1,532 @@
+"""Traffic harness (ISSUE 17): trace-generator determinism laws,
+bounded-memory TTFT reservoirs, replica-kill/stall chaos with
+zero-loss re-admission and chaos-vs-clean bit-identity, the
+byte-budgeted open loop, and the config-19 regress directions.
+
+The fleet tests reuse test_serve_router's compile-light shapes (same
+cfg/scfg values -> same jit cache entries within a tier-1 run)."""
+
+import itertools
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tpuscratch.bench.traffic import (
+    TenantSpec,
+    TraceGenerator,
+    TrafficConfig,
+    arrival_mix_requests,
+    fold_output,
+    odd_prefix_len,
+    run_traffic,
+)
+from tpuscratch.ft.chaos import ChaosPlan, Fault
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.obs import regress
+from tpuscratch.obs.metrics import MetricsRegistry, Reservoir, percentile
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import (
+    DisaggEngine,
+    FleetRouter,
+    Request,
+    RouterConfig,
+    SLOClass,
+    ServeConfig,
+    ServeEngine,
+)
+
+pytestmark = pytest.mark.traffic
+
+D = 32
+
+
+def cfg_for(**kw):
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=D, n_heads=4, n_experts=4, d_ff=48, n_layers=1, **kw
+    )
+
+
+def scfg_for(**kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("n_pages", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq", 24)
+    kw.setdefault("vocab", 16)
+    kw.setdefault("prefix_share", True)
+    return ServeConfig(**kw)
+
+
+def mesh_for(dims=(1, 1)):
+    return make_mesh(dims, ("dp", "sp"),
+                     jax.devices()[: dims[0] * dims[1]])
+
+
+def tenant_requests(n=6, max_new=3):
+    pre = {0: (1, 2, 3, 4, 5, 6, 7, 8, 9), 1: (9, 8, 7, 6, 5, 4, 3, 2, 1)}
+    return [
+        Request(rid=i, prompt=pre[i % 2] + (10 + i % 5,), max_new=max_new)
+        for i in range(n)
+    ]
+
+
+def fleet(n=3, rcfg=None, chaos=None, disagg=False, **scfg_kw):
+    cfg, scfg = cfg_for(), scfg_for(**scfg_kw)
+    mesh = mesh_for()
+    cls = DisaggEngine if disagg else ServeEngine
+    return FleetRouter([cls(mesh, cfg, scfg) for _ in range(n)],
+                       rcfg=rcfg, chaos=chaos)
+
+
+def check_churn_law(rep):
+    """The generalized fleet counter law (ISSUE 17): every submitted
+    or re-admitted prompt token was computed or served from a page."""
+    assert rep.prefill_tokens + rep.shared_tokens == \
+        rep.submitted_prompt_tokens + rep.readmitted_tokens
+    assert rep.dropped == 0
+
+
+def trace_cfg(**kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("tenants", (
+        TenantSpec("acme", cls="latency", weight=3.0),
+        TenantSpec("globex", cls="batch", weight=1.0, n_prefixes=2),
+    ))
+    kw.setdefault("vocab", 16)
+    kw.setdefault("prompt_len", 16)
+    kw.setdefault("tail_cap", 3)
+    kw.setdefault("out_cap", 3)
+    kw.setdefault("base_rate", 2.0)
+    kw.setdefault("burst_p", 0.05)
+    kw.setdefault("burst_len", 8)
+    kw.setdefault("burst_mult", 3.0)
+    return TrafficConfig(**kw)
+
+
+TWO_CLASSES = RouterConfig(classes=(SLOClass("latency", target="ttft"),
+                                    SLOClass("batch")))
+
+
+class TestReservoir:
+    def test_exact_while_under_k(self):
+        r = Reservoir(k=64, seed=0)
+        vals = [float((7 * i) % 13) for i in range(50)]
+        for v in vals:
+            r.observe(v)
+        assert r.exact and r.count == 50
+        assert r.percentile(50) == percentile(vals, 50)
+        assert r.percentile(99) == percentile(vals, 99)
+        assert r.min == min(vals) and r.max == max(vals)
+        assert abs(r.mean - sum(vals) / len(vals)) < 1e-12
+
+    def test_bounded_memory_past_k(self):
+        r = Reservoir(k=32, seed=3)
+        for i in range(10_000):
+            r.observe(float(i))
+        assert not r.exact
+        assert r.count == 10_000 and len(r.sample) == 32
+        # min/max/total stay EXACT whatever the sample dropped
+        assert r.min == 0.0 and r.max == 9999.0
+        assert r.mean == sum(range(10_000)) / 10_000
+        assert 0.0 <= r.percentile(50) <= 9999.0
+
+    def test_deterministic(self):
+        a, b = Reservoir(k=16, seed=5), Reservoir(k=16, seed=5)
+        for i in range(1000):
+            a.observe(float(i % 97))
+            b.observe(float(i % 97))
+        assert a.sample == b.sample
+
+    def test_registry_accessor_and_snapshot(self):
+        m = MetricsRegistry()
+        r = m.reservoir("serve/ttft")
+        assert m.reservoir("serve/ttft") is r
+        r.observe(2.0)
+        snap = r.snapshot()
+        assert snap["kind"] == "reservoir" and snap["count"] == 1
+        assert snap["p50"] == 2.0 and snap["exact"] is True
+
+    def test_validates(self):
+        with pytest.raises(ValueError):
+            Reservoir(k=0)
+
+
+class TestTraceDeterminism:
+    def test_same_seed_byte_identical(self):
+        cfg = trace_cfg()
+        a = [i.encode() for i in TraceGenerator(cfg).stream(200)]
+        b = [i.encode() for i in TraceGenerator(cfg).stream(200)]
+        assert a == b
+        assert TraceGenerator(cfg).digest(200) == \
+            TraceGenerator(cfg).digest(200)
+
+    def test_different_seed_differs(self):
+        assert TraceGenerator(trace_cfg(seed=1)).digest(100) != \
+            TraceGenerator(trace_cfg(seed=2)).digest(100)
+
+    def test_tenant_streams_interleave_independent(self):
+        """acme's k-th request content is keyed on (seed, acme, k) —
+        reshaping the REST of the population (weights, extra tenants)
+        must not change it."""
+        base = trace_cfg()
+        reshaped = trace_cfg(tenants=(
+            TenantSpec("acme", cls="latency", weight=3.0),
+            TenantSpec("globex", cls="batch", weight=9.0, n_prefixes=2),
+            TenantSpec("initech", cls="batch", weight=2.0),
+        ))
+
+        def acme(cfg, n):
+            it = (x for x in TraceGenerator(cfg).stream(100_000)
+                  if x.tenant == "acme")
+            return [(i.req.prompt, i.req.max_new)
+                    for i in itertools.islice(it, n)]
+
+        assert acme(base, 40) == acme(reshaped, 40)
+
+    def test_lazy_stream(self):
+        """A billion-request trace is one config object until
+        iterated — islice materializes exactly what it takes."""
+        it = TraceGenerator(trace_cfg()).stream(1_000_000_000)
+        assert len(list(itertools.islice(it, 5))) == 5
+
+    def test_arrivals_pure_function_of_tick(self):
+        gen = TraceGenerator(trace_cfg())
+        assert [gen.burst_active(t) for t in range(50)] == \
+            [gen.burst_active(t) for t in range(50)]
+        for t in (0, 17, 300):
+            assert gen.rate_at(t) >= 0.0
+            assert gen.rate_at(t) == TraceGenerator(trace_cfg()).rate_at(t)
+
+    def test_length_caps_and_classes(self):
+        cfg = trace_cfg()
+        for item in TraceGenerator(cfg).stream(300):
+            assert len(item.req.prompt) <= cfg.max_prompt_len
+            assert len(item.req.prompt) >= odd_prefix_len(cfg.prompt_len)
+            assert 1 <= item.req.max_new <= cfg.out_cap
+            assert item.cls == ("latency" if item.tenant == "acme"
+                                else "batch")
+
+    def test_zipf_prefix_reuse(self):
+        """The Zipf pool: rank-1 prefix takes at least as much traffic
+        as the last rank (seeded draws — no statistical flake)."""
+        cfg = trace_cfg(tenants=(
+            TenantSpec("acme", cls="latency", n_prefixes=4, zipf_a=1.5),
+        ))
+        gen = TraceGenerator(cfg)
+        pools = gen._pools["acme"]
+        plen = odd_prefix_len(cfg.prompt_len)
+        counts = {i: 0 for i in range(len(pools))}
+        for item in gen.stream(400):
+            counts[pools.index(item.req.prompt[:plen])] += 1
+        assert counts[0] > counts[len(pools) - 1]
+        assert sum(counts.values()) == 400
+
+    def test_rids_unique_and_ordered(self):
+        items = list(TraceGenerator(trace_cfg()).stream(100, rid_base=50))
+        assert [i.req.rid for i in items] == list(range(50, 150))
+        assert all(a.t <= b.t for a, b in zip(items, items[1:]))
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="duplicate tenant"):
+            trace_cfg(tenants=(TenantSpec("a"), TenantSpec("a")))
+        with pytest.raises(ValueError, match="diurnal_amp"):
+            trace_cfg(diurnal_amp=1.0)
+        with pytest.raises(ValueError, match="base_rate"):
+            trace_cfg(base_rate=0.0)
+        with pytest.raises(ValueError, match="weight"):
+            TenantSpec("a", weight=0.0)
+
+    def test_arrival_mix_delegate_unchanged(self):
+        """The one-definition move: decode_bench's name must still
+        produce the exact pre-move workload (config-17 rows are
+        recorded against it)."""
+        from tpuscratch.bench.decode_bench import (
+            arrival_mix_requests as via_decode,
+        )
+
+        a = via_decode([("latency", 3.0), ("batch", 1.0)], 8, 21, 16)
+        b = arrival_mix_requests([("latency", 3.0), ("batch", 1.0)],
+                                 8, 21, 16)
+        assert [(n, r.rid, r.prompt, r.max_new) for n, r in a] == \
+            [(n, r.rid, r.prompt, r.max_new) for n, r in b]
+        # the odd shared-prefix rule, now owned by traffic.py
+        assert odd_prefix_len(21) == 15 and odd_prefix_len(21) % 2 == 1
+
+
+class TestReplicaChaos:
+    def _tagged(self, n=10, max_new=3):
+        return [("latency" if i % 3 else "batch", r)
+                for i, r in enumerate(tenant_requests(n, max_new))]
+
+    def test_kill_zero_loss_and_bit_identity(self):
+        """A replica killed mid-stream loses NO requests and changes
+        NO tokens: the chaos drain's outputs equal the kill-free
+        drain's, and the generalized counter law reconciles the
+        re-prefilled legs exactly."""
+        clean = fleet(3, rcfg=TWO_CLASSES).run(self._tagged())
+        plan = ChaosPlan(seed=11, faults=(
+            Fault(site="serve/replica", at=(1,), key=0, kind="kill",
+                  down_ticks=4),
+        ))
+        chaos = fleet(3, rcfg=TWO_CLASSES, chaos=plan).run(self._tagged())
+        assert chaos.outputs == clean.outputs
+        assert chaos.kills == 1 and chaos.readmitted > 0
+        check_churn_law(chaos)
+        check_churn_law(clean)
+        assert clean.readmitted == 0 and clean.readmitted_tokens == 0
+        for c in clean.classes:
+            assert c.goodput_frac == 1.0
+        # the chaos drain recomputed work: SOME class paid for it
+        assert any(c.goodput_frac < 1.0 or c.readmitted > 0
+                   for c in chaos.classes) == (chaos.readmitted_tokens > 0
+                                               or chaos.lost_tokens > 0
+                                               or chaos.readmitted > 0)
+
+    def test_stall_freezes_without_loss(self):
+        plan = ChaosPlan(seed=3, faults=(
+            Fault(site="serve/replica", at=(1,), key=0, kind="stall",
+                  down_ticks=3),
+        ))
+        clean = fleet(3, rcfg=TWO_CLASSES).run(self._tagged())
+        stalled = fleet(3, rcfg=TWO_CLASSES, chaos=plan).run(self._tagged())
+        assert stalled.outputs == clean.outputs
+        assert stalled.stalls == 1 and stalled.kills == 0
+        # a stall loses no state: nothing re-admitted, nothing lost
+        assert stalled.readmitted == 0 and stalled.lost_tokens == 0
+        check_churn_law(stalled)
+
+    def test_killed_replica_rejoins(self):
+        """After the down window the killed replica takes new work
+        again — the elastic re-join."""
+        plan = ChaosPlan(seed=5, faults=(
+            Fault(site="serve/replica", at=(1,), key=0, kind="kill",
+                  down_ticks=2),
+        ))
+        router = fleet(2, rcfg=TWO_CLASSES, chaos=plan)
+        first = router.run(self._tagged())
+        assert first.kills == 1
+        assert router._down == [0, 0]
+        # distinct prompt families: no affinity pull, so least-loaded
+        # spreads them — the re-joined replica must take its share
+        more = [("batch", Request(rid=100 + i,
+                                  prompt=(11 + i, 2 + i, 3, 4, 5),
+                                  max_new=2)) for i in range(4)]
+        second = router.run(more)
+        assert second.completed == 4 and second.kills == 0
+        assert second.dispatched[0] > 0  # the re-joined replica works
+
+    def test_default_down_ticks_from_rcfg(self):
+        plan = ChaosPlan(seed=5, faults=(
+            Fault(site="serve/replica", at=(1,), key=0, kind="kill"),
+        ))
+        rcfg = RouterConfig(classes=TWO_CLASSES.classes, rejoin_ticks=3)
+        router = fleet(2, rcfg=rcfg, chaos=plan)
+        rep = router.run(self._tagged())
+        assert rep.kills == 1
+        check_churn_law(rep)
+
+    def test_disagg_fleet_rejects_kill_plan(self):
+        plan = ChaosPlan(seed=1, faults=(
+            Fault(site="serve/replica", at=(1,), kind="kill"),
+        ))
+        with pytest.raises(ValueError, match="evacuate"):
+            fleet(2, chaos=plan, disagg=True, prefix_share=False)
+
+    def test_evacuate_accounting(self):
+        """ServeEngine.evacuate returns exact owed triples: queued
+        requests owe their whole prompt, admitted slots owe nothing
+        prompt-side but lose their generated tokens."""
+        eng = ServeEngine(mesh_for(), cfg_for(), scfg_for())
+        reqs = tenant_requests(6, max_new=4)
+        for r in reqs:
+            eng.submit(r)
+        eng.step()  # admits up to n_slots, decodes one token each
+        owed = eng.evacuate()
+        assert sorted(rid for rid, _, _ in owed) == \
+            sorted(r.rid for r in reqs)
+        by_rid = {rid: (un, lost) for rid, un, lost in owed}
+        n_active_owed = sum(1 for un, _ in by_rid.values() if un == 0)
+        assert n_active_owed >= 1          # someone was admitted
+        for r in reqs:
+            un, lost = by_rid[r.rid]
+            assert un in (0, len(r.prompt))
+            if un == len(r.prompt):
+                assert lost == 0           # never ran: nothing to lose
+        assert eng.n_active == 0 and eng.n_queued == 0
+        # the engine object survives as the re-join replica
+        eng.submit(Request(rid=99, prompt=(1, 2, 3), max_new=2))
+        out = eng.run()
+        assert out.completed == 1
+
+
+class TestOpenLoop:
+    def test_chaos_vs_clean_digest_identity(self):
+        tcfg = trace_cfg(seed=3)
+        plan = ChaosPlan(seed=11, faults=(
+            Fault(site="serve/replica", at=(3,), key=0, kind="kill",
+                  down_ticks=5),
+            Fault(site="serve/replica", at=(9,), key=1, kind="stall",
+                  down_ticks=3),
+        ))
+        clean = run_traffic(fleet(3, rcfg=TWO_CLASSES),
+                            TraceGenerator(tcfg), 40, open_budget=12)
+        chaos = run_traffic(fleet(3, rcfg=TWO_CLASSES, chaos=plan),
+                            TraceGenerator(tcfg), 40, open_budget=12)
+        assert chaos.digest == clean.digest
+        assert chaos.submitted == clean.submitted == 40
+        assert chaos.report.dropped == 0
+        assert chaos.report.kills == 1 and chaos.report.stalls == 1
+        assert clean.peak_open <= 12 and chaos.peak_open <= 12
+        for c in clean.report.classes:
+            assert c.goodput_frac == 1.0
+
+    def test_budget_of_one_serializes(self):
+        tr = run_traffic(fleet(2, rcfg=TWO_CLASSES),
+                         TraceGenerator(trace_cfg(seed=9)), 6,
+                         open_budget=1)
+        assert tr.peak_open == 1 and tr.submitted == 6
+
+    def test_fold_output_order_independent(self):
+        a = fold_output(fold_output(0, 1, (4, 5)), 2, (6,))
+        b = fold_output(fold_output(0, 2, (6,)), 1, (4, 5))
+        assert a == b
+        assert fold_output(0, 1, (4, 5)) != fold_output(0, 1, (4, 6))
+
+    def test_validates_budget(self):
+        with pytest.raises(ValueError, match="open_budget"):
+            run_traffic(fleet(1), TraceGenerator(trace_cfg()), 2,
+                        open_budget=0)
+
+    @pytest.mark.slow
+    def test_100k_requests_under_replica_kill_chaos(self):
+        """The ISSUE-17 acceptance run: a seeded 100k-request trace
+        through a 3-replica fleet under a replica-kill ChaosPlan —
+        zero dropped requests, outputs bit-identical (digest) to the
+        chaos-free run, counter law exact under churn, memory bounded
+        by the open budget."""
+        cfg = cfg_for()
+        scfg = scfg_for(n_slots=16, n_pages=128)
+        tcfg = TrafficConfig(
+            seed=100, tenants=(
+                TenantSpec("acme", cls="latency", weight=3.0,
+                           n_prefixes=8, zipf_a=1.3),
+                TenantSpec("globex", cls="batch", weight=1.0,
+                           n_prefixes=4),
+            ), vocab=16, prompt_len=16, tail_cap=3, out_cap=3,
+            base_rate=48.0, diurnal_period=512, diurnal_amp=0.5,
+            burst_p=0.02, burst_len=16, burst_mult=2.0,
+        )
+        assert tcfg.max_total_len <= scfg.max_seq
+        plan = ChaosPlan(seed=17, faults=(
+            Fault(site="serve/replica", p=0.002, times=8, kind="kill",
+                  down_ticks=20),
+            Fault(site="serve/replica", p=0.001, times=4, kind="stall",
+                  down_ticks=10),
+        ))
+        mesh = mesh_for()
+
+        def router(chaos):
+            return FleetRouter(
+                [ServeEngine(mesh, cfg, scfg) for _ in range(3)],
+                rcfg=TWO_CLASSES, chaos=chaos,
+            )
+
+        N = 100_000
+        chaos = run_traffic(router(plan), TraceGenerator(tcfg), N,
+                            open_budget=512, max_steps=10_000_000)
+        clean = run_traffic(router(None), TraceGenerator(tcfg), N,
+                            open_budget=512, max_steps=10_000_000)
+        assert chaos.submitted == clean.submitted == N
+        assert chaos.report.dropped == 0
+        assert chaos.report.kills >= 1 and chaos.report.readmitted > 0
+        assert chaos.digest == clean.digest
+        check_churn_law(chaos.report)
+        check_churn_law(clean.report)
+        assert chaos.peak_open <= 512 and clean.peak_open <= 512
+        # bounded-memory tails: 100k completions through a 4096-slot
+        # reservoir — sampled, not silently truncated
+        for c in chaos.report.classes:
+            assert not c.ttft_exact
+            assert c.ttft_p50_s <= c.ttft_p99_s
+
+
+class TestConfig19Regress:
+    ROW = {
+        "config": 19, "metric": "traffic_chaos_tokens_per_s",
+        "value": 44.9, "tokens_per_s_clean": 42.4, "readmitted": 36,
+        "readmitted_tokens": 153, "dropped": 0, "kills": 2,
+        "stalls": 1, "replicas": 3, "requests": 96, "peak_open": 24,
+        "wall_s_chaos": 4.07, "wall_s_clean": 4.32,
+        "ttft_p99_s_latency": 0.62, "goodput_frac_latency": 0.887,
+        "ttft_p99_s_batch": 0.61, "goodput_frac_batch": 1.0,
+        "platform": "cpu",
+    }
+
+    def test_field_directions(self):
+        for name in ("ttft_p99_s_latency", "ttft_p50_s_batch",
+                     "dropped"):
+            assert regress.direction(name) == "lower", name
+        for name in ("traffic_chaos_tokens_per_s", "tokens_per_s_clean",
+                     "readmitted", "readmitted_tokens",
+                     "goodput_frac_latency"):
+            assert regress.direction(name) == "higher", name
+        for name in ("kills", "stalls", "requests", "peak_open",
+                     "wall_s_chaos", "wall_s_clean", "replicas"):
+            assert name in regress._SKIP, name
+
+    def test_canned_row_gates(self):
+        base = regress.index_rows([self.ROW])
+        ok = regress.index_rows([dict(self.ROW, value=43.0)])
+        assert not regress.has_regression(
+            regress.compare(base, ok, noise=0.1)
+        )
+        bad = regress.index_rows([dict(
+            self.ROW, dropped=3, readmitted=0,
+            goodput_frac_latency=0.40,
+        )])
+        bad_fields = {(f.metric, f.field) for f in
+                      regress.compare(base, bad, noise=0.1)
+                      if f.status == "regressed"}
+        assert ("traffic_chaos_tokens_per_s", "dropped") in bad_fields
+        assert ("traffic_chaos_tokens_per_s", "readmitted") in bad_fields
+        assert ("traffic_chaos_tokens_per_s",
+                "goodput_frac_latency") in bad_fields
+        # raw walls are context, never gated
+        wild = regress.index_rows([dict(self.ROW, wall_s_chaos=400.0)])
+        assert not regress.has_regression(
+            regress.compare(base, wild, noise=0.1)
+        )
+
+    def test_cli_subprocess_proof(self, tmp_path):
+        """The acceptance gate as a subprocess: config-19 clean pair
+        exits 0, injected dropped/goodput regression exits 1."""
+
+        def write(name, rows):
+            p = str(tmp_path / name)
+            with open(p, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+            return p
+
+        base = write("base.json", [self.ROW])
+        good = write("good.json", [dict(self.ROW, value=46.0,
+                                        ttft_p99_s_latency=0.70)])
+        bad = write("bad.json", [dict(self.ROW, dropped=5,
+                                      goodput_frac_latency=0.35)])
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, good],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, bad],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "REGRESSED" in r.stdout

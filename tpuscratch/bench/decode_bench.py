@@ -282,49 +282,13 @@ def bench_serve_stream(mesh, cfg, scfg, prompts, max_new: int = 8,
 def arrival_mix_requests(mix, n_requests: int, length: int, vocab: int,
                          seed: int = 0, max_new: int = 8,
                          pools_per_class: int = 1) -> list:
-    """A multi-tenant arrival stream: ``mix`` is ``[(class, rate),
-    ...]`` and the returned ``(class, Request)`` pairs interleave the
-    classes proportionally to their rates (seeded draws — the workload
-    is a pure function of its arguments, the config-12 rule).  Each
-    class owns ``pools_per_class`` shared-prefix pools (its "system
-    prompts"): every request draws one pool's prefix plus a private
-    tail, so same-class traffic shares pages and CROSS-class traffic
-    never does — the workload prefix-affine routing exists for.  The
-    prefix is ~3/4 of ``length``, forced odd so it is never
-    page-aligned — the sub-page boundary rung is always exercised."""
-    import numpy as np
+    """One-definition rule (ISSUE 17): request synthesis lives in
+    ``bench.traffic`` — config-17 rows and config-19 rows draw from
+    the same distributions.  This name survives as a delegate."""
+    from tpuscratch.bench.traffic import arrival_mix_requests as impl
 
-    from tpuscratch.serve import Request
-
-    if not mix:
-        raise ValueError("arrival mix needs at least one class:rate pair")
-    rng = np.random.default_rng(seed)
-    names = [name for name, _ in mix]
-    rates = np.array([float(r) for _, r in mix])
-    if (rates <= 0).any():
-        raise ValueError(f"rates must be positive: {mix}")
-    probs = rates / rates.sum()
-    # ~3/4 of length, forced ODD so the shared prefix can never be
-    # page-aligned (page sizes are even): every pool exercises the
-    # sub-page boundary rung and subpage_tokens stays observably > 0
-    prefix_len = max(1, (3 * length) // 4) | 1
-    pools = {
-        name: [
-            tuple(int(t) for t in rng.integers(0, vocab, prefix_len))
-            for _ in range(pools_per_class)
-        ]
-        for name in names
-    }
-    out = []
-    for i in range(n_requests):
-        name = names[int(rng.choice(len(names), p=probs))]
-        prefix = pools[name][int(rng.integers(0, pools_per_class))]
-        tail = tuple(
-            int(t) for t in rng.integers(0, vocab, length - prefix_len)
-        )
-        out.append((name, Request(rid=i, prompt=prefix + tail,
-                                  max_new=max_new)))
-    return out
+    return impl(mix, n_requests, length, vocab, seed=seed,
+                max_new=max_new, pools_per_class=pools_per_class)
 
 
 def bench_router(mesh, cfg, scfg, n_replicas: int, tagged, rcfg=None,

@@ -2159,6 +2159,103 @@ def config18_cosched(out: list) -> None:
     )
 
 
+def config19_traffic_chaos(out: list) -> None:
+    """SLO compliance under fleet chaos (ISSUE 17): the config-19
+    trace (``bench.traffic.traffic_chaos_setup`` — seeded tenants,
+    Zipf prefix reuse, diurnal + burst arrivals, long-tail lengths)
+    streamed OPEN-loop through a 3-replica FleetRouter twice per
+    repeat — once under the fixed replica-kill/stall ChaosPlan, once
+    clean — with the output DIGESTS asserted identical across every
+    arm and repeat (replica churn must not change one emitted token).
+    The headline is the under-churn aggregate tokens/s; the gated
+    fields are per-class p99 TTFT (``ttft`` lower, widened band),
+    per-class goodput fraction (``goodput`` higher — exact token
+    counters: delivered work over delivered + re-prefilled + killed),
+    and the zero-loss counters (``readmitted`` higher at the fixed
+    plan, ``dropped`` lower — recorded 0).  The generalized counter
+    law ``prefill + shared == submitted + readmitted`` is asserted
+    inside ``run_traffic`` on every arm."""
+    import dataclasses as _dc
+
+    import jax
+
+    from tpuscratch.bench.decode_bench import default_decode_setup
+    from tpuscratch.bench.traffic import bench_traffic, traffic_chaos_setup
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, _batches, _kw = default_decode_setup(on_tpu)
+    setup = traffic_chaos_setup(on_tpu, scfg.vocab)
+    scfg = _dc.replace(
+        scfg, prefix_share=True,
+        max_seq=max(scfg.max_seq, setup["tcfg"].max_total_len),
+    )
+    # interleaved median-of-3 per arm (the config-17 discipline):
+    # machine drift hits chaos and clean alike; static counters are
+    # identical across repeats, so one whole median run keeps the
+    # row's counters self-consistent
+    runs = {True: [], False: []}
+    for _rep in range(3):
+        for chaos in (True, False):
+            runs[chaos].append(
+                bench_traffic(mesh, cfg, scfg, setup, chaos=chaos)
+            )
+    digests = {r.pop("digest") for rs in runs.values() for r in rs}
+    if len(digests) != 1:
+        raise RuntimeError(
+            "config 19: output digests diverged across chaos/clean "
+            "arms — replica churn changed what was emitted"
+        )
+
+    def by_rate(r):
+        return r["tokens_per_s"]
+
+    ch = _median_of(runs[True], by_rate)
+    cl = _median_of(runs[False], by_rate)
+    per_class = {}
+    for name, c in sorted(ch["classes"].items()):
+        per_class[f"ttft_p99_s_{name}"] = c["ttft_p99_s"]
+        per_class[f"ttft_p50_s_{name}"] = c["ttft_p50_s"]
+        per_class[f"goodput_frac_{name}"] = c["goodput_frac"]
+    print(
+        f"# config 19: chaos {ch['tokens_per_s']:.3e} tok/s vs "
+        f"{cl['tokens_per_s']:.3e} clean over {ch['requests']} "
+        f"requests, {ch['kills']} kills/{ch['stalls']} stalls, "
+        f"{ch['readmitted']} readmitted ({ch['readmitted_tokens']} "
+        f"tok), {ch['dropped']} dropped, digests identical",
+        file=sys.stderr,
+    )
+    _emit(
+        out,
+        config=19,
+        metric="traffic_chaos_tokens_per_s",
+        value=ch["tokens_per_s"],
+        tokens_per_s_clean=cl["tokens_per_s"],
+        readmitted=ch["readmitted"],
+        readmitted_tokens=ch["readmitted_tokens"],
+        dropped=ch["dropped"],
+        kills=ch["kills"],
+        stalls=ch["stalls"],
+        replicas=ch["replicas"],
+        requests=ch["requests"],
+        peak_open=ch["peak_open"],
+        wall_s_chaos=ch["wall_s"],
+        wall_s_clean=cl["wall_s"],
+        **per_class,
+        detail=(
+            f"{ch['replicas']} replicas, {ch['requests']}-request "
+            f"open-loop trace (budget {ch['peak_open']} peak open), "
+            f"{ch['kills']} replica kills + {ch['stalls']} stall, "
+            f"{ch['readmitted']} requests re-admitted "
+            f"({ch['readmitted_tokens']} prompt tok re-prefilled, "
+            f"{ch['lost_tokens']} generated tok lost), 0 dropped, "
+            f"chaos/clean digests identical, "
+            f"{ch['tokens_per_s']:.3e}/{cl['tokens_per_s']:.3e} tok/s"
+        ),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -2178,13 +2275,14 @@ CONFIGS = {
     16: config16_elastic_goodput,
     17: config17_serve_router,
     18: config18_cosched,
+    19: config19_traffic_chaos,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
-                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18")
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

@@ -1,0 +1,591 @@
+"""Million-request traffic harness: trace-driven load for the fleet.
+
+Every serving claim so far rests on synthetic arrival mixes of a few
+hundred requests (``decode_bench.arrival_mix_requests``).  The
+reference's production reality is PBS/SLURM *job streams* — batch
+arrivals with diurnal shape, bursts, and faults that take whole ranks
+out (mpierr.h's answer: abort the world).  This module is that reality
+for the serving stack, in three pieces:
+
+1. :class:`TraceGenerator` — a seeded, deterministic trace: tenant
+   populations with Zipf-distributed shared-prefix reuse ("system
+   prompts" — a few prefixes take most of the traffic, the SOSP '23
+   sharing argument's actual shape), diurnal + Poisson-burst arrivals,
+   mixed SLO classes, and long-tail (geometric) prompt/output lengths.
+   Determinism is structural, not incidental:
+
+   - the WHOLE trace is a pure function of ``TrafficConfig`` — same
+     seed, byte-identical trace (no call-order state feeds any draw);
+   - each tenant's request CONTENT stream is keyed on
+     ``(seed, tenant, k)`` where ``k`` is the tenant's own sequence
+     number — NOT the global rid or arrival tick — so tenant streams
+     are independent of interleave: change another tenant's weight and
+     this tenant's k-th request is still the same request;
+   - arrivals are a pure function of the tick: Poisson draws at rate
+     ``base_rate x diurnal(t) x burst(t)``, where ``burst(t)`` is
+     computed from seeded per-tick ignition draws over a trailing
+     window — no ignition "state machine" whose phase could drift.
+
+2. :func:`run_traffic` — the byte-budgeted OPEN loop: the trace is
+   materialized lazily (a generator), at most ``open_budget`` requests
+   are live (submitted-but-unfinished) at once, and finished outputs
+   fold into an order-independent digest instead of accumulating — a
+   500k-request run holds O(open_budget) requests and O(1) outputs in
+   memory.  The digest is the fleet-scale bit-identity handle: a
+   chaos-churned run and a clean run of the same trace must fold to
+   the same digest (the house invariant, at scale).
+
+3. One-definition rule: this module owns request synthesis.
+   ``decode_bench.arrival_mix_requests`` (config 17's workload) now
+   delegates here, so config-17 and config-19 rows draw from the same
+   distributions — the odd shared-prefix rule (never page-aligned, so
+   the sub-page rung is always exercised) lives in ONE place
+   (:func:`odd_prefix_len`).
+
+Tests: tests/test_traffic.py (marker ``traffic``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import zlib
+from typing import Iterator, Optional
+
+import numpy as np
+
+from tpuscratch.serve.engine import Request
+
+# domain tags for the per-draw SeedSequences: distinct streams per
+# purpose so adding a draw to one never shifts another
+_ARRIVALS = zlib.crc32(b"traffic/arrivals")
+_BURST = zlib.crc32(b"traffic/burst")
+_REQ = zlib.crc32(b"traffic/req")
+_POOL = zlib.crc32(b"traffic/pool")
+
+
+def odd_prefix_len(length: int) -> int:
+    """The shared-prefix length rule (ONE definition): ~3/4 of
+    ``length``, forced ODD so the shared prefix can never be
+    page-aligned (page sizes are even) — every pool exercises the
+    sub-page boundary rung and ``subpage_tokens`` stays observably
+    positive."""
+    return max(1, (3 * length) // 4) | 1
+
+
+def arrival_mix_requests(mix, n_requests: int, length: int, vocab: int,
+                         seed: int = 0, max_new: int = 8,
+                         pools_per_class: int = 1) -> list:
+    """A multi-tenant arrival stream: ``mix`` is ``[(class, rate),
+    ...]`` and the returned ``(class, Request)`` pairs interleave the
+    classes proportionally to their rates (seeded draws — the workload
+    is a pure function of its arguments, the config-12 rule).  Each
+    class owns ``pools_per_class`` shared-prefix pools (its "system
+    prompts"): every request draws one pool's prefix plus a private
+    tail, so same-class traffic shares pages and CROSS-class traffic
+    never does — the workload prefix-affine routing exists for.  The
+    prefix is ~3/4 of ``length``, forced odd so it is never
+    page-aligned — the sub-page boundary rung is always exercised.
+
+    Config 17's fixed-size closed-loop workload; the open-loop,
+    stream-scale twin is :class:`TraceGenerator`."""
+    if not mix:
+        raise ValueError("arrival mix needs at least one class:rate pair")
+    rng = np.random.default_rng(seed)
+    names = [name for name, _ in mix]
+    rates = np.array([float(r) for _, r in mix])
+    if (rates <= 0).any():
+        raise ValueError(f"rates must be positive: {mix}")
+    probs = rates / rates.sum()
+    prefix_len = odd_prefix_len(length)
+    pools = {
+        name: [
+            tuple(int(t) for t in rng.integers(0, vocab, prefix_len))
+            for _ in range(pools_per_class)
+        ]
+        for name in names
+    }
+    out = []
+    for i in range(n_requests):
+        name = names[int(rng.choice(len(names), p=probs))]
+        prefix = pools[name][int(rng.integers(0, pools_per_class))]
+        tail = tuple(
+            int(t) for t in rng.integers(0, vocab, length - prefix_len)
+        )
+        out.append((name, Request(rid=i, prompt=prefix + tail,
+                                  max_new=max_new)))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant population in the trace.
+
+    ``weight`` sets the tenant's share of arrivals; ``cls`` names the
+    SLO class its requests are submitted under (must exist in the
+    router's ``RouterConfig.classes``).  Each tenant owns
+    ``n_prefixes`` shared prefixes ("system prompts") of
+    ``odd_prefix_len(prompt_len)`` tokens; requests pick one
+    Zipf-distributed with exponent ``zipf_a`` (prefix 1 takes most of
+    the traffic — the reuse distribution prefix-affine routing and
+    paged sharing are built for).  ``tail_p`` / ``out_p`` are the
+    geometric success rates for the private-tail length and the output
+    budget — the long-tail halves of the length distributions, capped
+    by the config so every request fits ``max_seq``."""
+
+    name: str
+    cls: str = "default"
+    weight: float = 1.0
+    n_prefixes: int = 4
+    zipf_a: float = 1.2
+    tail_p: float = 0.5
+    out_p: float = 0.5
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.n_prefixes < 1:
+            raise ValueError(
+                f"n_prefixes must be >= 1, got {self.n_prefixes}"
+            )
+        if self.zipf_a <= 0:
+            raise ValueError(f"zipf_a must be > 0, got {self.zipf_a}")
+        if not (0 < self.tail_p <= 1) or not (0 < self.out_p <= 1):
+            raise ValueError(
+                f"tail_p/out_p must be in (0, 1], got "
+                f"{self.tail_p}/{self.out_p}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficConfig:
+    """The trace is a pure function of this config (plus an item
+    count).  ``base_rate`` is mean arrivals per fleet tick; the
+    instantaneous rate is ``base_rate x (1 + diurnal_amp x
+    sin(2 pi t / diurnal_period)) x (burst_mult if a burst window
+    covers t)`` — the diurnal sine is the day cycle, the seeded
+    ignition process (probability ``burst_p`` per tick, each ignition
+    opening a ``burst_len``-tick window) is the thundering herd.
+    Lengths: prompts are ``odd_prefix_len(prompt_len)`` shared tokens
+    plus a geometric private tail in ``[1, tail_cap]``; output budgets
+    are geometric in ``[1, out_cap]`` — size ``max_seq`` at least
+    ``odd_prefix_len(prompt_len) + tail_cap + out_cap``."""
+
+    seed: int = 0
+    tenants: tuple[TenantSpec, ...] = (TenantSpec("t0"),)
+    vocab: int = 16
+    prompt_len: int = 16
+    tail_cap: int = 4
+    out_cap: int = 4
+    base_rate: float = 2.0
+    diurnal_period: int = 256
+    diurnal_amp: float = 0.5
+    burst_p: float = 0.02
+    burst_len: int = 16
+    burst_mult: float = 4.0
+
+    def __post_init__(self):
+        if not self.tenants:
+            raise ValueError("TrafficConfig needs at least one tenant")
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tenant names: {names}")
+        if self.vocab < 2:
+            raise ValueError(f"vocab must be >= 2, got {self.vocab}")
+        if self.prompt_len < 1 or self.tail_cap < 1 or self.out_cap < 1:
+            raise ValueError(
+                "prompt_len, tail_cap, out_cap must be >= 1"
+            )
+        if self.base_rate <= 0:
+            raise ValueError(
+                f"base_rate must be > 0, got {self.base_rate}"
+            )
+        if self.diurnal_period < 1 or self.burst_len < 1:
+            raise ValueError("diurnal_period and burst_len must be >= 1")
+        if not (0 <= self.diurnal_amp < 1):
+            raise ValueError(
+                f"diurnal_amp must be in [0, 1), got {self.diurnal_amp}"
+            )
+        if not (0 <= self.burst_p <= 1):
+            raise ValueError(
+                f"burst_p must be in [0, 1], got {self.burst_p}"
+            )
+        if self.burst_mult < 1:
+            raise ValueError(
+                f"burst_mult must be >= 1, got {self.burst_mult}"
+            )
+
+    @property
+    def max_prompt_len(self) -> int:
+        return odd_prefix_len(self.prompt_len) + self.tail_cap
+
+    @property
+    def max_total_len(self) -> int:
+        """Smallest ``max_seq`` that admits every possible request."""
+        return self.max_prompt_len + self.out_cap
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceItem:
+    """One arrival: tick, tenant, SLO class, and the materialized
+    :class:`Request`.  ``rid`` (inside ``req``) is the global arrival
+    index — unique fleet-wide, the PRNG-stream key."""
+
+    t: int
+    tenant: str
+    cls: str
+    req: Request
+
+    def encode(self) -> bytes:
+        """Canonical byte form — the unit the determinism law's
+        digest folds (same seed => byte-identical trace)."""
+        return repr((self.t, self.tenant, self.cls, self.req.rid,
+                     self.req.prompt, self.req.max_new)).encode()
+
+
+class TraceGenerator:
+    """Seeded deterministic trace: see the module docstring for the
+    three determinism properties.  ``stream(n)`` is a GENERATOR —
+    nothing is materialized until iterated, so the harness can hold a
+    million-request trace as one config object."""
+
+    def __init__(self, cfg: TrafficConfig):
+        self.cfg = cfg
+        w = np.array([t.weight for t in cfg.tenants])
+        self._tenant_probs = w / w.sum()
+        # per-tenant Zipf pmf over its prefix pool: pmf(k) ~ 1/k^a
+        self._zipf = {}
+        self._pools = {}
+        prefix_len = odd_prefix_len(cfg.prompt_len)
+        for spec in cfg.tenants:
+            ranks = np.arange(1, spec.n_prefixes + 1, dtype=np.float64)
+            pmf = ranks ** -spec.zipf_a
+            self._zipf[spec.name] = pmf / pmf.sum()
+            # the pool itself is keyed on (seed, tenant) only — part
+            # of the tenant's interleave-independent identity
+            rng = np.random.default_rng(np.random.SeedSequence(
+                [cfg.seed, _POOL, zlib.crc32(spec.name.encode())]
+            ))
+            self._pools[spec.name] = [
+                tuple(int(x) for x in rng.integers(0, cfg.vocab,
+                                                   prefix_len))
+                for _ in range(spec.n_prefixes)
+            ]
+        self._by_name = {t.name: t for t in cfg.tenants}
+
+    # ---- the arrival process (pure functions of the tick) ---------------
+
+    def burst_active(self, t: int) -> bool:
+        """True when any seeded ignition in the trailing ``burst_len``
+        window fired — burst state WITHOUT a state machine: the same
+        tick always answers the same way, whatever was queried before."""
+        cfg = self.cfg
+        if cfg.burst_p <= 0:
+            return False
+        for s in range(max(0, t - cfg.burst_len + 1), t + 1):
+            rng = np.random.default_rng(
+                np.random.SeedSequence([cfg.seed, _BURST, s])
+            )
+            if float(rng.random()) < cfg.burst_p:
+                return True
+        return False
+
+    def rate_at(self, t: int) -> float:
+        """Instantaneous arrival rate: diurnal sine x burst multiplier."""
+        cfg = self.cfg
+        diurnal = 1.0 + cfg.diurnal_amp * float(
+            np.sin(2.0 * np.pi * t / cfg.diurnal_period)
+        )
+        mult = cfg.burst_mult if self.burst_active(t) else 1.0
+        return cfg.base_rate * diurnal * mult
+
+    def _arrivals_at(self, t: int) -> list[str]:
+        """Tenant names arriving at tick ``t`` — Poisson count at
+        ``rate_at(t)``, tenants drawn by weight; one pure-fn rng per
+        tick, so the trace never depends on iteration history."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.cfg.seed, _ARRIVALS, t])
+        )
+        n = int(rng.poisson(self.rate_at(t)))
+        if n == 0:
+            return []
+        idx = rng.choice(len(self.cfg.tenants), size=n,
+                         p=self._tenant_probs)
+        return [self.cfg.tenants[int(i)].name for i in idx]
+
+    # ---- request content (pure function of (seed, tenant, k)) -----------
+
+    def _materialize(self, tenant: str, k: int, rid: int) -> Request:
+        """The tenant's ``k``-th request — content keyed on
+        ``(seed, tenant, k)``, NOT on rid or tick: the
+        interleave-independence law.  ``rid`` only names the request."""
+        cfg = self.cfg
+        spec = self._by_name[tenant]
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [cfg.seed, _REQ, zlib.crc32(tenant.encode()), k]
+        ))
+        pool_i = int(rng.choice(spec.n_prefixes, p=self._zipf[tenant]))
+        prefix = self._pools[tenant][pool_i]
+        # geometric long tails, capped so every request fits max_seq
+        tail_len = min(cfg.tail_cap, int(rng.geometric(spec.tail_p)))
+        tail = tuple(int(x) for x in rng.integers(0, cfg.vocab, tail_len))
+        max_new = min(cfg.out_cap, int(rng.geometric(spec.out_p)))
+        return Request(rid=rid, prompt=prefix + tail, max_new=max_new)
+
+    # ---- the stream ------------------------------------------------------
+
+    def stream(self, n_requests: int,
+               rid_base: int = 0) -> Iterator[TraceItem]:
+        """Lazily yield the first ``n_requests`` arrivals in tick
+        order.  rids are ``rid_base + arrival index``; per-tenant
+        sequence numbers count independently (the content key)."""
+        seq: dict[str, int] = {t.name: 0 for t in self.cfg.tenants}
+        rid = rid_base
+        t = 0
+        emitted = 0
+        while emitted < n_requests:
+            for tenant in self._arrivals_at(t):
+                if emitted >= n_requests:
+                    break
+                k = seq[tenant]
+                seq[tenant] = k + 1
+                req = self._materialize(tenant, k, rid)
+                yield TraceItem(t=t, tenant=tenant,
+                                cls=self._by_name[tenant].cls, req=req)
+                rid += 1
+                emitted += 1
+            t += 1
+
+    def digest(self, n_requests: int) -> int:
+        """Sequential CRC fold over the canonical byte form of the
+        first ``n_requests`` items — the "same seed => byte-identical
+        trace" law's O(1)-memory witness."""
+        h = 0
+        for item in self.stream(n_requests):
+            h = zlib.crc32(item.encode(), h)
+        return h
+
+
+# ---- the open-loop harness ----------------------------------------------
+
+
+def fold_output(digest: int, rid: int, toks: tuple) -> int:
+    """Order-INDEPENDENT output digest fold: per-request CRCs are
+    summed mod 2^64, so a chaos run (which finishes requests in a
+    different order) and a clean run of the same trace fold to the
+    same value exactly when every request emitted the same tokens —
+    the fleet-scale bit-identity handle that never holds the outputs."""
+    h = zlib.crc32(repr((rid, tuple(int(t) for t in toks))).encode())
+    return (digest + h) & 0xFFFFFFFFFFFFFFFF
+
+
+@dataclasses.dataclass(frozen=True)
+class TrafficReport:
+    """One open-loop run: the router's drain-window report plus the
+    stream-scale handles — the output digest (bit-identity), the peak
+    open-request count (the byte budget's witness: ``peak_open <=
+    open_budget`` always), and the tick count."""
+
+    report: object               # RouterReport for the whole window
+    digest: int
+    submitted: int
+    peak_open: int
+    ticks: int
+    wall_s: float
+
+
+def run_traffic(router, gen: TraceGenerator, n_requests: int, *,
+                open_budget: int, max_steps: int = 2_000_000,
+                check_law: bool = True,
+                rid_base: int = 0) -> TrafficReport:
+    """Stream ``n_requests`` of ``gen``'s trace through ``router``
+    under a byte-budgeted OPEN loop, then drain.
+
+    Each fleet tick admits every trace item whose arrival tick has
+    come — but never more than ``open_budget`` live (submitted-but-
+    unfinished) requests: when the fleet falls behind a burst, the
+    un-admitted tail of the trace stays UN-MATERIALIZED (the generator
+    simply isn't advanced), so memory is O(open_budget) whatever the
+    trace length.  Finished outputs fold into :func:`fold_output`'s
+    digest and are dropped.
+
+    The report is the router's own drain-window accounting
+    (:meth:`FleetRouter._begin_drain` / ``_drain_report`` — the same
+    definitions ``run`` uses), and when ``check_law`` is set the
+    generalized fleet counter law is asserted on it:
+    ``prefill + shared == submitted + readmitted_tokens`` — exact
+    under any replica-kill schedule (ServeEngine fleets)."""
+    if open_budget < 1:
+        raise ValueError(f"open_budget must be >= 1, got {open_budget}")
+    items = gen.stream(n_requests, rid_base=rid_base)
+    pending: Optional[TraceItem] = next(items, None)
+    snap = router._begin_drain()
+    digest = 0
+    submitted = finished = tokens = 0
+    peak_open = 0
+    ticks = 0
+    t0 = time.perf_counter()
+    while pending is not None or router.busy:
+        if ticks >= max_steps:
+            raise RuntimeError(
+                f"traffic run did not complete in {max_steps} ticks "
+                f"({submitted - finished} open, "
+                f"{pending is not None and 'trace remaining' or 'trace done'})"
+            )
+        # admit: every due arrival, while the byte budget holds
+        while (pending is not None and pending.t <= ticks
+               and submitted - finished < open_budget):
+            router.submit(pending.req, tenant=pending.cls)
+            submitted += 1
+            pending = next(items, None)
+        peak_open = max(peak_open, submitted - finished)
+        for rid, toks in router.step():
+            digest = fold_output(digest, rid, toks)
+            finished += 1
+            tokens += len(toks)
+        ticks += 1
+    wall = time.perf_counter() - t0
+    report = router._drain_report(snap, wall, completed=finished,
+                                  tokens=tokens)
+    if check_law:
+        lhs = report.prefill_tokens + report.shared_tokens
+        rhs = (report.submitted_prompt_tokens
+               + report.readmitted_tokens)
+        if lhs != rhs:
+            raise AssertionError(
+                f"fleet counter law violated under churn: prefill "
+                f"{report.prefill_tokens} + shared "
+                f"{report.shared_tokens} = {lhs} != submitted "
+                f"{report.submitted_prompt_tokens} + readmitted "
+                f"{report.readmitted_tokens} = {rhs}"
+            )
+    if finished != submitted:
+        raise AssertionError(
+            f"open loop lost requests: {submitted} submitted, "
+            f"{finished} finished"
+        )
+    return TrafficReport(report=report, digest=digest,
+                         submitted=submitted, peak_open=peak_open,
+                         ticks=ticks, wall_s=wall)
+
+
+# ---- the config-19 workload (one definition) -----------------------------
+
+
+def traffic_chaos_setup(on_tpu: bool, vocab: int) -> dict:
+    """The config-19 workload: trace config, fleet size, open budget,
+    SLO classes, and the fixed chaos plan's clauses — ONE definition
+    shared by ``bench.record`` config 19, ``examples/ex34_traffic``,
+    and the traffic tests (the ``router_mix_setup`` rule).  The chaos
+    schedule is tick-explicit (``at`` clauses, not rates): a fixed
+    plan makes the readmitted/dropped counters exact recorded values,
+    so regress can gate them as static counters."""
+    tenants = (
+        TenantSpec("acme", cls="latency", weight=3.0, n_prefixes=4),
+        TenantSpec("globex", cls="batch", weight=1.0, n_prefixes=2),
+    )
+    classes = (("latency", "ttft"), ("batch", "throughput"))
+    if on_tpu:
+        tcfg = TrafficConfig(
+            seed=19, tenants=tenants, vocab=vocab, prompt_len=64,
+            tail_cap=8, out_cap=8, base_rate=8.0, diurnal_period=256,
+            diurnal_amp=0.5, burst_p=0.02, burst_len=16, burst_mult=4.0,
+        )
+        return dict(tcfg=tcfg, n_requests=2000, open_budget=128,
+                    n_replicas=3, classes=classes,
+                    kills=((8, 0), (40, 1)), stall=(24, 2),
+                    down_ticks=8)
+    # CPU proxy: config 17's prompt scale (length 21 -> 15-token odd
+    # shared prefix).  The kills target replicas 0 and 1: affinity
+    # concentrates each tenant's prefix family on the replica its
+    # first request landed on (least-loaded order: acme -> 0,
+    # globex -> 1), so those are the replicas that are mid-stream
+    # when they die — a kill on the idle spare would re-admit nothing.
+    # Tick 9 is this trace's burst crest (replica 0 carries ~7 active
+    # decodes + a deep queue), so the first kill loses PREFILLED and
+    # GENERATED work, not just queued prompts — the goodput fraction
+    # has something real to charge
+    tcfg = TrafficConfig(
+        seed=19, tenants=tenants, vocab=vocab, prompt_len=21,
+        tail_cap=4, out_cap=4, base_rate=2.0, diurnal_period=64,
+        diurnal_amp=0.5, burst_p=0.05, burst_len=8, burst_mult=3.0,
+    )
+    return dict(tcfg=tcfg, n_requests=96, open_budget=24,
+                n_replicas=3, classes=classes,
+                kills=((9, 0), (13, 1)), stall=(7, 2), down_ticks=6)
+
+
+def chaos_plan_for(setup: dict):
+    """The setup's fixed replica-chaos plan (fresh per run — ``times``
+    budgets are consumed state)."""
+    from tpuscratch.ft.chaos import ChaosPlan, Fault
+
+    faults = [
+        Fault(site="serve/replica", at=(t,), key=rep, kind="kill",
+              down_ticks=setup["down_ticks"])
+        for t, rep in setup["kills"]
+    ]
+    t, rep = setup["stall"]
+    faults.append(Fault(site="serve/replica", at=(t,), key=rep,
+                        kind="stall", down_ticks=setup["down_ticks"]))
+    return ChaosPlan(seed=17, faults=faults)
+
+
+def bench_traffic(mesh, cfg, scfg, setup: dict, chaos: bool) -> dict:
+    """One open-loop traffic run over a FRESH fleet (fresh engines,
+    fresh plan — chaos budgets and reservoirs must not leak between
+    arms), chaos on or off, flattened to a row dict.  The zero-loss
+    law (``dropped == 0``), the generalized counter law, and (under
+    chaos) readmission actually happening are asserted HERE — every
+    consumer measures the same claims."""
+    from tpuscratch.serve.engine import ServeEngine
+    from tpuscratch.serve.router import FleetRouter, RouterConfig, SLOClass
+
+    rcfg = RouterConfig(classes=tuple(
+        SLOClass(n, target=t) for n, t in setup["classes"]
+    ))
+    router = FleetRouter(
+        [ServeEngine(mesh, cfg, scfg)
+         for _ in range(setup["n_replicas"])],
+        rcfg=rcfg,
+        chaos=chaos_plan_for(setup) if chaos else None,
+    )
+    tr = run_traffic(router, TraceGenerator(setup["tcfg"]),
+                     setup["n_requests"],
+                     open_budget=setup["open_budget"])
+    rep = tr.report
+    if rep.dropped != 0:
+        raise AssertionError(
+            f"zero-loss law violated: {rep.dropped} dropped"
+        )
+    if chaos and rep.readmitted == 0:
+        raise AssertionError(
+            "chaos arm re-admitted nothing — the kills fired on empty "
+            "replicas (workload/schedule drifted)"
+        )
+    row = {
+        "replicas": setup["n_replicas"],
+        "requests": tr.submitted,
+        "digest": tr.digest,
+        "peak_open": tr.peak_open,
+        "ticks": tr.ticks,
+        "wall_s": tr.wall_s,
+        "tokens_per_s": rep.tokens_per_s,
+        "kills": rep.kills,
+        "stalls": rep.stalls,
+        "readmitted": rep.readmitted,
+        "readmitted_tokens": rep.readmitted_tokens,
+        "lost_tokens": rep.lost_tokens,
+        "dropped": rep.dropped,
+        "classes": {
+            c.name: {
+                "completed": c.completed,
+                "ttft_p50_s": c.ttft_p50_s,
+                "ttft_p99_s": c.ttft_p99_s,
+                "goodput_frac": c.goodput_frac,
+                "readmitted": c.readmitted,
+            }
+            for c in rep.classes
+        },
+    }
+    return row

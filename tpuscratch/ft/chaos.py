@@ -32,6 +32,17 @@ Site vocabulary (what the instrumented layers query):
   the blocking path has.
 - ``"serve/prefill"`` — fail a request's prefill admission
   (``key=rid`` targets one request; ``times`` bounds transience).
+- ``"serve/replica"`` — REPLICA-scoped fleet chaos (ISSUE 17): the
+  fleet router queries this site once per (fleet tick, replica) with
+  ``index=tick`` and ``key=replica``.  ``kind="kill"`` tears the whole
+  ``ServeEngine`` down mid-stream (``ServeEngine.evacuate``) and the
+  router re-admits its in-flight + queued requests elsewhere with
+  deterministic replay; ``kind="stall"`` freezes the replica (no
+  ticks, no dispatches) without losing its state.  ``down_ticks``
+  sizes the outage in fleet ticks before the elastic re-join
+  (``None``: the router's ``rejoin_ticks`` default).  Explicit
+  ``index=tick`` keeps the schedule a pure function of the plan — the
+  chaos-vs-clean bit-identity runs fire at the same ticks.
 - ``"comm/<op>"``     — a transient :class:`InjectedFault` (a
   ``CommError``) raised from a collective wrapper around a compiled
   program (:meth:`ChaosPlan.wrap_collective`); the chunked drivers
@@ -97,8 +108,12 @@ class Fault:
     instead fires at a seeded rate per occurrence.  ``times`` bounds the
     TOTAL number of firings (``None`` = unlimited: a deterministic,
     never-healing fault — the quarantine test case); ``key`` restricts
-    the clause to one site key (e.g. a request rid); ``stage`` restricts
-    ``ckpt/save`` clauses to one named stage inside ``save``.
+    the clause to one site key (e.g. a request rid, a replica index);
+    ``stage`` restricts ``ckpt/save`` clauses to one named stage inside
+    ``save``.  ``down_ticks`` sizes a ``serve/replica`` outage in fleet
+    ticks (the tick-denominated twin of ``stall_s``: replica chaos is
+    scheduled in ticks so the fault matrix stays deterministic, not
+    wall-clocked).
     """
 
     site: str
@@ -109,6 +124,7 @@ class Fault:
     kind: str = "error"                  # error | nan | inf | stall | preempt | kill
     stage: Optional[str] = None          # ckpt/save stage selector
     stall_s: float = 0.0                 # sleep length for kind="stall"
+    down_ticks: Optional[int] = None     # serve/replica outage length
 
 
 class ChaosPlan:

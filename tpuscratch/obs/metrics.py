@@ -32,6 +32,7 @@ import collections
 import dataclasses
 import itertools
 import math
+import random
 import uuid
 from typing import Iterable, Sequence
 
@@ -42,6 +43,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "MeshSpan",
+    "Reservoir",
     "merge_snapshots",
     "mesh_reduce",
     "mesh_span",
@@ -162,6 +164,76 @@ class Histogram:
         return out
 
 
+class Reservoir:
+    """Uniform reservoir sample (Algorithm R) with exact
+    count/total/min/max — the bounded-memory tail for STREAM-scale
+    populations (ISSUE 17).
+
+    :class:`Histogram`'s deque window keeps the most RECENT samples, so
+    over a 500k-request drain its p99 describes the last 4096 finishes,
+    not the drain.  The reservoir instead keeps a uniform sample of the
+    WHOLE stream in the same bounded memory: every observation has
+    probability ``k/count`` of being in the sample, so the percentile
+    estimate covers the full population — and whenever ``count <= k``
+    the sample IS the population and the tails are exact (``.exact``),
+    which keeps small-drain reports bit-equal to the old per-request
+    lists.  Replacement draws come from a seeded generator: the same
+    observation stream reports the same percentiles on every run (the
+    chaos bit-identity discipline applied to metrics)."""
+
+    kind = "reservoir"
+
+    def __init__(self, k: int = 4096, seed: int = 0) -> None:
+        if k < 1:
+            raise ValueError(f"reservoir size must be >= 1, got {k}")
+        self.k = k
+        self.count = 0
+        self.total = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self.sample: list[float] = []
+        self._rng = random.Random(seed)
+
+    def observe(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        if v < self.min:
+            self.min = v
+        if v > self.max:
+            self.max = v
+        if len(self.sample) < self.k:
+            self.sample.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.k:
+                self.sample[j] = v
+
+    @property
+    def exact(self) -> bool:
+        """True while the sample still holds EVERY observation — the
+        percentiles are exact, not estimates."""
+        return self.count <= self.k
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else math.nan
+
+    def percentile(self, q: float) -> float:
+        """Percentile over the uniform sample (exact when ``.exact``)."""
+        return percentile(self.sample, q)
+
+    def snapshot(self) -> dict:
+        out = {
+            "kind": self.kind, "count": self.count, "total": self.total,
+            "min": self.min, "max": self.max, "mean": self.mean,
+            "exact": self.exact,
+        }
+        if self.sample:
+            out["p50"] = self.percentile(50)
+            out["p99"] = self.percentile(99)
+        return out
+
+
 #: registry id salt — snapshots of the SAME registry are cumulative (a
 #: newer one supersedes), snapshots of DIFFERENT registries are disjoint
 #: populations (they merge); the id is how a reader tells the two apart
@@ -200,6 +272,9 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
+
+    def reservoir(self, name: str) -> Reservoir:
+        return self._get(name, Reservoir)
 
     def names(self) -> list[str]:
         return sorted(self._metrics)

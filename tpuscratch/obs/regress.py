@@ -70,11 +70,16 @@ __all__ = ["Finding", "compare", "format_findings", "index_rows",
 #: its ``value`` is the T=16 token rate, higher; the row's static
 #: dispatch fields ride the ``dispatches``/``host_sync`` _LOWER
 #: entries with the tight band.)
+#: (the config-19 traffic-chaos row, ISSUE 17: ``readmitted`` counts
+#: replica-kill victims re-admitted through the quarantine/requeue
+#: path — at a FIXED chaos plan every victim must be re-admitted, so
+#: the count falling means requests started leaking into ``dropped``
+#: instead; its per-class goodput fractions ride "goodput".)
 _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
            "throughput", "updates", "tokens_per", "accept", "speedup",
            "achieved", "goodput", "resident", "users", "decode_spec",
            "decode_macro", "affinity_hit", "affinity_token", "shared",
-           "subpage")
+           "subpage", "readmitted")
 #: name substrings ⇒ smaller is better (checked after _HIGHER)
 #: (note the ordering: ``accept_len_mean`` and ``spec_speedup`` match
 #: _HIGHER before "ratio"/"bytes" substrings could ever mislabel them —
@@ -111,11 +116,14 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 #: aggregate/solo goodput fractions ride the existing "goodput"
 #: _HIGHER entry; the raw ``switches`` COUNT is workload shape,
 #: skipped.)
+#: (the config-19 row's ``dropped`` is the zero-loss law as a gated
+#: counter — any value above the recorded 0 is a lost request; its
+#: TTFT tails ride the existing "ttft" substring + widened floor.)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
           "overhead", "bubble", "crossover", "prefill_frac", "degraded",
           "iterations", "cycles", "psum", "ppermute", "checkpoint",
           "restart", "badput", "cold", "ttft", "dispatches", "host_sync",
-          "share_err", "switch")
+          "share_err", "switch", "dropped")
 
 #: checked BEFORE _HIGHER: the config-15 per-SWEEP collective budget
 #: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would
@@ -132,11 +140,17 @@ _LOWER_FIRST = ("per_sweep",)
 #: the wall story — ``share_solver``'s accidental ``_s`` substring and
 #: the wall clocks must not gate; a few-ms solver share swings tens of
 #: percent on the proxy with nothing regressed.)
+#: (``kills``/``stalls``/``requests``/``peak_open`` are the config-19
+#: chaos/workload shape — how much churn the fixed plan injected and
+#: how deep the open loop ran, not costs; its raw chaos/clean walls
+#: are context like config 18's — the median-of-3 token rates and the
+#: direction-gated counters carry the story.)
 _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
          "flops_per_token", "degenerate", "peak_hbm_gbps", "replicas",
          "switches", "workloads", "share_train", "share_solver",
          "target_train", "target_solver", "wall_s_cosched",
-         "wall_s_solo"}
+         "wall_s_solo", "kills", "stalls", "requests", "peak_open",
+         "wall_s_chaos", "wall_s_clean"}
 
 #: per-field MEASURED-noise floors (fractional band, substring-matched
 #: like the direction tables; first match wins): wall-clock fields

@@ -839,6 +839,73 @@ class ServeEngine:
         """{rid: last error} of requests dropped after the retry budget."""
         return dict(self._quarantined)
 
+    def evacuate(self) -> list[tuple[int, int, int]]:
+        """Kill this replica (fleet-scale chaos, ISSUE 17): tear down
+        every piece of SERVING state — slots, queue, buffered finishes,
+        cache pool, prefix tries, parked chains, the rid registry and
+        TTFT stamps — and return what the dead process still owed, one
+        ``(rid, unaccounted_prompt_tokens, lost_generated_tokens)``
+        triple per unfinished request:
+
+        - ``unaccounted``: the prompt suffix this engine never ran a
+          prefill/share program over (a queued request: its whole
+          prompt; a chunked slot mid-prefill: its pending tail; an
+          admitted slot or a buffered finish: 0).  The fleet router
+          re-admits victims from its OWN pending records, and the
+          counter law stays EXACT under churn: every token the dead
+          engine did account for (``len(prompt) - unaccounted``) is a
+          re-admitted leg the final drain computes again.
+        - ``lost_generated``: tokens this engine sampled and then
+          threw away with the pool — the decode-side waste the
+          goodput fraction charges to the kill.
+
+        The engine OBJECT survives as the re-join replica: compiled
+        programs are process state our simulation keeps (re-join cost
+        is modeled by the router's down window, not by recompiling),
+        but its scheduling state starts empty — ``_seen_rids`` clears
+        with it, since the fleet-level ``FleetRouter._seen`` set is
+        what guards rid uniqueness across the kill.  Lifetime counters
+        (prefill/shared/subpage, dispatches) are OUR accounting, not
+        the process's, and keep accumulating across the kill.
+
+        rids key the PRNG streams, so the re-admitted victims replay
+        bit-identically wherever they land — the ``_recover_cache``
+        determinism contract at fleet scope."""
+        owed: list[tuple[int, int, int]] = []
+        for s, st in enumerate(self._slots):
+            if st is None:
+                continue
+            owed.append((st.rid, len(st.pending), len(st.generated)))
+            if self._tiered:
+                # no parking: the trie is about to clear, and a parked
+                # copy of a page from a dead pool must not survive it
+                self._allocators[self._group_of(s)].free(st.pages)
+            else:
+                self._free_slot_pages(s, st)
+            self._slots[s] = None
+        for req in self._queue:
+            owed.append((req.rid, len(req.prompt), 0))
+        for rid, toks in self._finish_buf:
+            # complete but undelivered: the finish died with the
+            # process — fully accounted prompt, fully lost output
+            owed.append((rid, 0, len(toks)))
+        self._queue.clear()
+        self._finish_buf = []
+        if self._tries is not None:
+            for trie in self._tries:
+                trie.clear()
+        if self._tiered:
+            for a in self._allocators:
+                a.drop_parked()
+        self._kv = self._fresh_kv()
+        self._seen_rids.clear()
+        self._submit_t.clear()
+        self._ttft.clear()
+        self._poison_rid = None
+        self.metrics.counter("serve/evacuated").inc(len(owed))
+        self.sink.emit("serve/evacuate", owed=len(owed))
+        return owed
+
     def _group_of(self, slot: int) -> int:
         return slot // self._slots_per_group
 

@@ -43,15 +43,31 @@ deep:
    a matched prefix ending mid-page shares its full pages and copies
    the boundary page at the token frontier, so affinity wins are no
    longer quantized to ``page_size``.
+5. **fleet-scale chaos** (ISSUE 17) — a :class:`~tpuscratch.ft.chaos.
+   ChaosPlan` passed at construction is queried once per (fleet tick,
+   replica) at site ``serve/replica``: ``kind="kill"`` tears a whole
+   replica down mid-stream (``ServeEngine.evacuate``) and the router
+   RE-ADMITS its in-flight + queued requests at the head of the fleet
+   queue from its own pending records (original submit stamps kept, so
+   the outage is IN the reported TTFT), with the replica re-joining
+   empty after ``down_ticks``; ``kind="stall"`` freezes the replica
+   without losing state.  Zero requests are dropped, replay is
+   bit-identical (rids key the PRNG streams), and the counter law
+   generalizes: ``prefill + shared == submitted + readmitted_tokens``
+   — each re-admitted leg recomputes exactly the prompt tokens the
+   dead replica had already accounted.  The wasted legs plus the
+   generated tokens that died with the pool feed the per-class
+   goodput fraction (the MegaScale NSDI '24 accounting under churn).
 
 House invariant: greedy output is BIT-identical under any routing —
-1 replica or N, affinity on or off, any re-roling schedule — because a
-request's stream depends only on ``(seed, rid, prompt)``: sampling
-keys are ``request_key(seed, rid, position)`` draws and every engine
-path (share/spec/chunk/disagg/tiered, fp32/int8/fp8) is test-gated
+1 replica or N, affinity on or off, any re-roling schedule, any
+replica-kill schedule — because a request's stream depends only on
+``(seed, rid, prompt)``: sampling keys are
+``request_key(seed, rid, position)`` draws and every engine path
+(share/spec/chunk/disagg/tiered, fp32/int8/fp8) is test-gated
 batch-composition-independent.  Routing moves WHERE work runs and
 WHAT is recomputed, never what is emitted (tests/test_serve_router.py,
-marker ``router``).
+marker ``router``; tests/test_traffic.py, marker ``traffic``).
 """
 
 from __future__ import annotations
@@ -61,7 +77,8 @@ import dataclasses
 import time
 from typing import Optional, Sequence, Union
 
-from tpuscratch.obs.metrics import percentile
+from tpuscratch.ft.chaos import ChaosPlan
+from tpuscratch.obs.metrics import Reservoir, percentile
 from tpuscratch.serve.disagg import DisaggEngine
 from tpuscratch.serve.engine import Request, ServeEngine
 
@@ -126,6 +143,14 @@ class RouterConfig:
     # entries evict first — staleness only costs a routing choice,
     # never correctness
     index_cap: int = 4096
+    # replica chaos (ISSUE 17): default outage length in fleet ticks
+    # for a serve/replica kill/stall whose Fault has no down_ticks —
+    # the elastic re-join happens this many ticks after the fault
+    rejoin_ticks: int = 8
+    # per-class TTFT reservoir size: bounded-memory tails over a
+    # stream-scale drain (exact whenever a drain completes fewer
+    # requests than this — every pre-ISSUE-17 report is bit-equal)
+    ttft_reservoir: int = 4096
 
     def __post_init__(self):
         if not self.classes:
@@ -145,11 +170,28 @@ class RouterConfig:
             )
         if self.index_cap < 1:
             raise ValueError(f"index_cap must be >= 1, got {self.index_cap}")
+        if self.rejoin_ticks < 1:
+            raise ValueError(
+                f"rejoin_ticks must be >= 1, got {self.rejoin_ticks}"
+            )
+        if self.ttft_reservoir < 1:
+            raise ValueError(
+                f"ttft_reservoir must be >= 1, got {self.ttft_reservoir}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)
 class ClassReport:
-    """One SLO class's drain: completion, TTFT tail, token rate."""
+    """One SLO class's drain: completion, TTFT tail, token rate —
+    plus the churn accounting (ISSUE 17).  The TTFT percentiles come
+    from a bounded :class:`~tpuscratch.obs.metrics.Reservoir` (exact
+    while ``ttft_exact``; a uniform whole-drain sample past
+    ``RouterConfig.ttft_reservoir`` completions).  ``goodput_frac`` is
+    the MegaScale-style useful-work fraction: tokens the tenant got
+    (final-leg prompts + delivered outputs) over everything the fleet
+    computed for the class, including re-admitted prefill legs and
+    generated tokens that died with a killed replica — 1.0 exactly on
+    a chaos-free drain."""
 
     name: str
     completed: int
@@ -157,6 +199,9 @@ class ClassReport:
     ttft_p50_s: float
     ttft_p99_s: float
     tokens_per_s: float
+    ttft_exact: bool = True
+    readmitted: int = 0
+    goodput_frac: float = 1.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -168,8 +213,13 @@ class RouterReport:
     every submitted prompt token was either COMPUTED through some
     replica's prefill program or SERVED from a shared page — so
     ``prefill_frac`` dropping under affinity is arithmetic, not a
-    measurement.  (A disagg handoff that degrades to a local re-prefill
-    double-counts its prompt; chaos-free drains reconcile exactly.)"""
+    measurement.  Under replica churn (ISSUE 17) the law generalizes
+    exactly: ``prefill + shared == submitted + readmitted_tokens``,
+    where ``readmitted_tokens`` counts, per re-admitted victim, the
+    prompt tokens its dead replica had already accounted (the extra
+    leg the final drain computes again).  (A disagg handoff that
+    degrades to a local re-prefill double-counts its prompt;
+    chaos-free non-degraded drains reconcile exactly.)"""
 
     completed: int
     tokens_generated: int
@@ -196,6 +246,17 @@ class RouterReport:
     # (asserted live in ex32).  Lower-is-better in obs.regress.
     dispatches: int = 0
     host_syncs: int = 0
+    # replica-chaos accounting (ISSUE 17): kills/stalls are the churn
+    # the drain survived; readmitted counts re-admitted request legs
+    # (zero requests may be DROPPED — the dropped counter exists to be
+    # asserted zero: only a killed replica holding rids the router
+    # never routed, i.e. predispatched behind its back, can drop)
+    kills: int = 0
+    stalls: int = 0
+    readmitted: int = 0
+    readmitted_tokens: int = 0   # re-prefilled legs (the law's 4th term)
+    lost_tokens: int = 0         # generated tokens that died with a pool
+    dropped: int = 0
 
     @property
     def prefill_frac(self) -> float:
@@ -234,14 +295,26 @@ class FleetRouter:
     may differ per replica, and a heterogeneous chunked/unchunked mix
     is exactly how the SLO classes get their two admission paths).
     Every replica steps every tick (a decode-pool replica keeps
-    draining); only DISPATCH is role-gated."""
+    draining); only DISPATCH is role-gated — and a DOWN replica
+    (killed or stalled by a ``serve/replica`` chaos fault) neither
+    steps nor receives dispatches until its outage window elapses."""
 
     def __init__(self, replicas: Sequence[Union[ServeEngine, DisaggEngine]],
-                 rcfg: Optional[RouterConfig] = None):
+                 rcfg: Optional[RouterConfig] = None,
+                 chaos: Optional[ChaosPlan] = None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = list(replicas)
         self.rcfg = rcfg or RouterConfig()
+        self._chaos = chaos
+        if chaos is not None and any(
+            f.site == "serve/replica" and f.kind == "kill"
+            for f in chaos.faults
+        ) and any(not hasattr(r, "evacuate") for r in self.replicas):
+            raise ValueError(
+                "serve/replica kill faults need replicas exposing "
+                "evacuate() (plain ServeEngine fleets)"
+            )
         ref = self._scfg(self.replicas[0])
         for r in self.replicas[1:]:
             sc = self._scfg(r)
@@ -266,6 +339,10 @@ class FleetRouter:
         self._class_of: dict[int, str] = {}      # rid -> class name
         self._replica_of: dict[int, int] = {}    # rid -> replica index
         self._inflight: set[int] = set()         # dispatched, unfinished
+        # rid -> its _Pending while dispatched-but-unfinished: the
+        # re-admission record a replica kill re-queues from (bounded by
+        # in-flight depth, not trace length — the byte budget holds)
+        self._pending_of: dict[int, _Pending] = {}
         self._seen: set[int] = set()
         # per-(replica, class) dispatched-but-unfinished depth — the
         # backpressure quantity max_queue bounds
@@ -277,22 +354,42 @@ class FleetRouter:
         # pool roles (autoscale): True = accepts new dispatches
         self._prefill_role = [True] * len(self.replicas)
         self._cooldown = 0
+        # replica chaos (ISSUE 17): fleet tick counter (the chaos
+        # schedule's occurrence index) and per-replica outage windows
+        self._tick = 0
+        self._down = [0] * len(self.replicas)
         # run()-scoped accounting (lifetime counters, deltas at run)
         self._submitted_ptok = 0
         self._affinity_hits = 0
         self._affinity_tokens = 0
         self._backpressure_holds = 0
         self._reroles = 0
+        self._kills = 0
+        self._stalls = 0
+        self._readmitted = 0
+        self._readmitted_tokens = 0
+        self._lost_tokens = 0
+        self._dropped = 0
         self._dispatched = [0] * len(self.replicas)
-        self._ttft: dict[str, list[float]] = {
-            c.name: [] for c in self.rcfg.classes
-        }
-        self._class_tokens: dict[str, int] = {
-            c.name: 0 for c in self.rcfg.classes
-        }
-        self._class_done: dict[str, int] = {
-            c.name: 0 for c in self.rcfg.classes
-        }
+        names = [c.name for c in self.rcfg.classes]
+        self._ttft: dict[str, Reservoir] = {}
+        self._reset_ttft()
+        self._class_tokens: dict[str, int] = {n: 0 for n in names}
+        self._class_done: dict[str, int] = {n: 0 for n in names}
+        self._class_ptok: dict[str, int] = {n: 0 for n in names}
+        self._class_readmitted: dict[str, int] = {n: 0 for n in names}
+        self._class_readm_tok: dict[str, int] = {n: 0 for n in names}
+        self._class_lost: dict[str, int] = {n: 0 for n in names}
+
+    def _reset_ttft(self) -> None:
+        """Fresh per-class TTFT reservoirs — a drain window's tails
+        are THIS drain's (the prior per-request-list slicing semantics,
+        now in bounded memory); seeds are fixed per class so the same
+        drain reports the same percentiles."""
+        for ci, c in enumerate(self.rcfg.classes):
+            self._ttft[c.name] = Reservoir(
+                k=self.rcfg.ttft_reservoir, seed=ci
+            )
 
     @staticmethod
     def _scfg(replica):
@@ -343,6 +440,7 @@ class FleetRouter:
         self._seen.add(req.rid)
         self._class_of[req.rid] = tenant
         self._submitted_ptok += len(req.prompt)
+        self._class_ptok[tenant] += len(req.prompt)
         self._queue.append(_Pending(cls=tenant, req=req,
                                     t0=time.perf_counter()))
 
@@ -408,10 +506,12 @@ class FleetRouter:
 
     def _candidates(self, cls: SLOClass) -> list[int]:
         """Replicas this class may dispatch to, most-preferred subset
-        first: prefill-pool members, narrowed by the class target when
-        the fleet has both admission paths, minus replicas at the
-        class's max_queue depth."""
-        pool = [i for i, on in enumerate(self._prefill_role) if on]
+        first: prefill-pool members (minus DOWN replicas — a killed or
+        stalled replica takes no new work until re-join), narrowed by
+        the class target when the fleet has both admission paths,
+        minus replicas at the class's max_queue depth."""
+        pool = [i for i, on in enumerate(self._prefill_role)
+                if on and not self._down[i]]
         if cls.target == "ttft":
             pref = [i for i in pool
                     if self._scfg(self.replicas[i]).chunk_prefill > 0]
@@ -482,6 +582,7 @@ class FleetRouter:
             self._queue.remove(pend)
             self._replica_of[pend.req.rid] = i
             self._inflight.add(pend.req.rid)
+            self._pending_of[pend.req.rid] = pend
             self._depth[(i, pend.cls)] = (
                 self._depth.get((i, pend.cls), 0) + 1
             )
@@ -543,16 +644,94 @@ class FleetRouter:
             self._reroles += 1
             self._cooldown = self.rcfg.cooldown_ticks
 
+    # ---- replica chaos (ISSUE 17) ---------------------------------------
+
+    def _chaos_tick(self) -> None:
+        """Query the plan's ``serve/replica`` site once per live
+        replica at this fleet tick (``index=tick``, ``key=replica`` —
+        the explicit index keeps the schedule a pure function of the
+        plan, so a chaos-vs-clean pair fires at the same ticks)."""
+        t, self._tick = self._tick, self._tick + 1
+        if self._chaos is None:
+            return
+        for i in range(len(self.replicas)):
+            if self._down[i]:
+                continue  # already out: an outage can't compound
+            f = self._chaos.should_fire("serve/replica", index=t, key=i)
+            if f is None:
+                continue
+            down = (f.down_ticks if f.down_ticks is not None
+                    else self.rcfg.rejoin_ticks)
+            if f.kind == "kill":
+                self._kill_replica(i, down)
+            elif f.kind == "stall":
+                # frozen, not dead: state survives, requests just wait
+                # (their TTFT eats the outage — the SLO report sees it)
+                self._stalls += 1
+                self._down[i] = max(1, down)
+
+    def _kill_replica(self, i: int, down: int) -> None:
+        """Kill replica ``i`` mid-stream: evacuate the dead engine and
+        RE-ADMIT everything it owed at the head of the fleet queue (in
+        rid order, original submit stamps kept — the outage is in the
+        reported TTFT), through the same pending/queue machinery the
+        PR-14 quarantine path uses.  The replica re-joins EMPTY after
+        ``down`` ticks; rids key the PRNG streams, so the victims
+        replay bit-identically wherever they land next."""
+        rep = self.replicas[i]
+        owed = rep.evacuate()
+        self._kills += 1
+        self._down[i] = max(1, down)
+        victims: list[_Pending] = []
+        for rid, un_ptok, n_gen in owed:
+            self._inflight.discard(rid)
+            self._replica_of.pop(rid, None)
+            cls = self._class_of.get(rid)
+            if cls is not None:
+                self._depth[(i, cls)] = max(
+                    0, self._depth.get((i, cls), 0) - 1
+                )
+            pend = self._pending_of.pop(rid, None)
+            if pend is None:
+                # a rid the router never routed (predispatched behind
+                # its back): nothing to re-admit from — the one way a
+                # request can be DROPPED, surfaced as a counter the
+                # zero-loss law asserts on
+                self._dropped += 1
+                continue
+            self._readmitted += 1
+            leg = len(pend.req.prompt) - un_ptok
+            self._readmitted_tokens += leg
+            self._lost_tokens += n_gen
+            if cls is not None:
+                self._class_readmitted[cls] += 1
+                self._class_readm_tok[cls] += leg
+                self._class_lost[cls] += n_gen
+            victims.append(pend)
+        victims.sort(key=lambda p: p.req.rid)
+        for pend in reversed(victims):
+            self._queue.appendleft(pend)
+
     # ---- the tick -------------------------------------------------------
 
     def step(self) -> list[tuple[int, tuple[int, ...]]]:
-        """One fleet tick: autoscale roles, dispatch what routes, tick
-        EVERY replica, collect finishes (with per-class TTFT)."""
+        """One fleet tick: autoscale roles, dispatch what routes, fire
+        due replica chaos, tick every LIVE replica (a down one burns
+        an outage tick instead), collect finishes (with per-class
+        TTFT).  Chaos fires AFTER dispatch: a kill at tick t takes out
+        the replica WITH the work tick t just routed to it — the
+        mid-stream case the re-admission machinery exists for (a
+        before-dispatch kill would mostly find replicas drained by the
+        previous tick's finishes)."""
         if self.rcfg.autoscale:
             self._autoscale()
         self._dispatch()
+        self._chaos_tick()
         finished: list[tuple[int, tuple[int, ...]]] = []
         for i, rep in enumerate(self.replicas):
+            if self._down[i]:
+                self._down[i] -= 1  # the outage elapses in fleet ticks
+                continue
             try:
                 done = rep.step()
             except Exception as exc:
@@ -560,6 +739,7 @@ class FleetRouter:
                 continue
             for rid, toks in done:
                 self._inflight.discard(rid)
+                self._pending_of.pop(rid, None)
                 cls = self._class_of.get(rid)
                 if cls is not None:
                     self._depth[(i, cls)] = max(
@@ -569,7 +749,7 @@ class FleetRouter:
                     self._class_done[cls] += 1
                     ttft = rep.take_ttft(rid)
                     if ttft is not None:
-                        self._ttft[cls].append(ttft)
+                        self._ttft[cls].observe(ttft)
                 finished.append((rid, toks))
         # a QUARANTINED request never reaches the finish list — release
         # its backpressure depth here, or one poison request would pin
@@ -579,12 +759,142 @@ class FleetRouter:
                     if self.replicas[self._replica_of[r]]
                     .is_quarantined(r)]:
             self._inflight.discard(rid)
+            self._pending_of.pop(rid, None)
             i, cls = self._replica_of[rid], self._class_of.get(rid)
             if cls is not None:
                 self._depth[(i, cls)] = max(
                     0, self._depth.get((i, cls), 0) - 1
                 )
         return finished
+
+    @property
+    def busy(self) -> bool:
+        """Anything still owed: router-queued, replica-queued/active/
+        staged, or finishes parked by a raise-through — the drain
+        condition ``run`` and the traffic harness share."""
+        return bool(self._queue) or any(
+            r.n_queued or r.n_active or getattr(r, "n_staged", 0)
+            or r.has_buffered_finishes
+            for r in self.replicas
+        )
+
+    def _begin_drain(self) -> dict:
+        """Open a drain window: snapshot every lifetime counter the
+        report deltas against, and reset the per-class TTFT reservoirs
+        (this window's tails).  ``run`` and ``bench.traffic``'s
+        open-loop harness are the two drivers — ONE accounting
+        definition between them."""
+        self._reset_ttft()
+        return dict(
+            ptok=[self._prefill_of(r) for r in self.replicas],
+            stok=[self._shared_of(r) for r in self.replicas],
+            sub=[self._subpage_of(r) for r in self.replicas],
+            disp_decode=[r.dispatches for r in self.replicas],
+            hs=[r.host_syncs for r in self.replicas],
+            # the window's "submitted" leg: prompts still PENDING
+            # admission anywhere — the router queue plus every
+            # replica's own queue (a prior step() may have dispatched
+            # without draining; those prompts prefill during THIS
+            # window, so the counter law needs them).  Disagg
+            # handed-off requests sit in the INNER engine's queue
+            # already prefilled, so rep._queue (the front queue) is
+            # exactly the not-yet-prefilled set.
+            subm=self._submitted_ptok - sum(
+                len(p.req.prompt) for p in self._queue
+            ) - sum(len(q.prompt)
+                    for r in self.replicas for q in r._queue),
+            hits=self._affinity_hits, atok=self._affinity_tokens,
+            holds=self._backpressure_holds, rer=self._reroles,
+            kills=self._kills, stalls=self._stalls,
+            readm=self._readmitted, readm_tok=self._readmitted_tokens,
+            lost=self._lost_tokens, dropped=self._dropped,
+            disp=list(self._dispatched),
+            ctok=dict(self._class_tokens),
+            cdone=dict(self._class_done),
+            cptok=dict(self._class_ptok),
+            creadm=dict(self._class_readmitted),
+            creadm_tok=dict(self._class_readm_tok),
+            clost=dict(self._class_lost),
+        )
+
+    def _drain_report(self, snap: dict, wall: float,
+                      outputs: Optional[dict] = None,
+                      completed: Optional[int] = None,
+                      tokens: Optional[int] = None) -> RouterReport:
+        """Close a drain window opened by :meth:`_begin_drain`.  The
+        traffic harness passes ``completed``/``tokens`` instead of an
+        outputs map (a 500k-drain report must not hold 500k token
+        tuples — it folds a digest instead)."""
+        if outputs is not None:
+            completed = len(outputs)
+            tokens = sum(len(t) for t in outputs.values())
+        classes = []
+        for c in self.rcfg.classes:
+            res = self._ttft[c.name]
+            ctoks = self._class_tokens[c.name] - snap["ctok"][c.name]
+            cptok = self._class_ptok[c.name] - snap["cptok"][c.name]
+            readm_tok = (self._class_readm_tok[c.name]
+                         - snap["creadm_tok"][c.name])
+            lost = self._class_lost[c.name] - snap["clost"][c.name]
+            useful = ctoks + cptok
+            classes.append(ClassReport(
+                name=c.name,
+                completed=self._class_done[c.name]
+                - snap["cdone"][c.name],
+                tokens=ctoks,
+                ttft_p50_s=_percentile(res.sample, 50),
+                ttft_p99_s=_percentile(res.sample, 99),
+                tokens_per_s=ctoks / wall if wall else 0.0,
+                ttft_exact=res.exact,
+                readmitted=self._class_readmitted[c.name]
+                - snap["creadm"][c.name],
+                goodput_frac=(useful / (useful + readm_tok + lost)
+                              if useful + readm_tok + lost else 1.0),
+            ))
+        return RouterReport(
+            completed=completed or 0,
+            tokens_generated=tokens or 0,
+            wall_s=wall,
+            tokens_per_s=(tokens or 0) / wall if wall else 0.0,
+            outputs=(tuple(sorted(outputs.items()))
+                     if outputs is not None else ()),
+            classes=tuple(classes),
+            prefill_tokens=sum(
+                self._prefill_of(r) - p0
+                for r, p0 in zip(self.replicas, snap["ptok"])
+            ),
+            shared_tokens=sum(
+                self._shared_of(r) - s0
+                for r, s0 in zip(self.replicas, snap["stok"])
+            ),
+            submitted_prompt_tokens=self._submitted_ptok - snap["subm"],
+            subpage_tokens=sum(
+                self._subpage_of(r) - s0
+                for r, s0 in zip(self.replicas, snap["sub"])
+            ),
+            affinity_hits=self._affinity_hits - snap["hits"],
+            affinity_tokens=self._affinity_tokens - snap["atok"],
+            backpressure_holds=self._backpressure_holds - snap["holds"],
+            reroles=self._reroles - snap["rer"],
+            dispatched=tuple(
+                d - d0 for d, d0 in zip(self._dispatched, snap["disp"])
+            ),
+            dispatches=sum(
+                r.dispatches - d0
+                for r, d0 in zip(self.replicas, snap["disp_decode"])
+            ),
+            host_syncs=sum(
+                r.host_syncs - h0
+                for r, h0 in zip(self.replicas, snap["hs"])
+            ),
+            kills=self._kills - snap["kills"],
+            stalls=self._stalls - snap["stalls"],
+            readmitted=self._readmitted - snap["readm"],
+            readmitted_tokens=self._readmitted_tokens
+            - snap["readm_tok"],
+            lost_tokens=self._lost_tokens - snap["lost"],
+            dropped=self._dropped - snap["dropped"],
+        )
 
     def run(self, requests: Sequence = (),
             max_steps: int = 100_000) -> RouterReport:
@@ -598,35 +908,11 @@ class FleetRouter:
             else:
                 tenant, req = r
                 self.submit(req, tenant=tenant)
-        ptok0 = [self._prefill_of(r) for r in self.replicas]
-        stok0 = [self._shared_of(r) for r in self.replicas]
-        sub0 = [self._subpage_of(r) for r in self.replicas]
-        disp0_decode = [r.dispatches for r in self.replicas]
-        hs0 = [r.host_syncs for r in self.replicas]
-        # the drain's "submitted" leg: prompts still PENDING admission
-        # anywhere — the router queue plus every replica's own queue (a
-        # prior step() may have dispatched without draining; those
-        # prompts prefill during THIS drain, so the counter law needs
-        # them).  Disagg handed-off requests sit in the INNER engine's
-        # queue already prefilled, so rep._queue (the front queue) is
-        # exactly the not-yet-prefilled set.
-        subm0 = self._submitted_ptok - sum(
-            len(p.req.prompt) for p in self._queue
-        ) - sum(len(q.prompt) for r in self.replicas for q in r._queue)
-        hits0, atok0 = self._affinity_hits, self._affinity_tokens
-        holds0, rer0 = self._backpressure_holds, self._reroles
-        disp0 = list(self._dispatched)
-        ttft0 = {c: len(v) for c, v in self._ttft.items()}
-        ctok0 = dict(self._class_tokens)
-        cdone0 = dict(self._class_done)
+        snap = self._begin_drain()
         outputs: dict[int, tuple[int, ...]] = {}
         steps = 0
         t0 = time.perf_counter()
-        while self._queue or any(
-            r.n_queued or r.n_active or getattr(r, "n_staged", 0)
-            or r.has_buffered_finishes      # parked by a raise-through
-            for r in self.replicas
-        ):
+        while self.busy:
             if steps >= max_steps:
                 raise RuntimeError(
                     f"fleet did not drain in {max_steps} steps "
@@ -636,56 +922,7 @@ class FleetRouter:
                 outputs[rid] = toks
             steps += 1
         wall = time.perf_counter() - t0
-        tokens = sum(len(t) for t in outputs.values())
-        prefill = sum(
-            self._prefill_of(r) - p0
-            for r, p0 in zip(self.replicas, ptok0)
-        )
-        shared = sum(
-            self._shared_of(r) - s0
-            for r, s0 in zip(self.replicas, stok0)
-        )
-        classes = []
-        for c in self.rcfg.classes:
-            samples = self._ttft[c.name][ttft0[c.name]:]
-            ctoks = self._class_tokens[c.name] - ctok0[c.name]
-            classes.append(ClassReport(
-                name=c.name,
-                completed=self._class_done[c.name] - cdone0[c.name],
-                tokens=ctoks,
-                ttft_p50_s=_percentile(samples, 50),
-                ttft_p99_s=_percentile(samples, 99),
-                tokens_per_s=ctoks / wall if wall else 0.0,
-            ))
-        return RouterReport(
-            completed=len(outputs),
-            tokens_generated=tokens,
-            wall_s=wall,
-            tokens_per_s=tokens / wall if wall else 0.0,
-            outputs=tuple(sorted(outputs.items())),
-            classes=tuple(classes),
-            prefill_tokens=prefill,
-            shared_tokens=shared,
-            submitted_prompt_tokens=self._submitted_ptok - subm0,
-            subpage_tokens=sum(
-                self._subpage_of(r) - s0
-                for r, s0 in zip(self.replicas, sub0)
-            ),
-            affinity_hits=self._affinity_hits - hits0,
-            affinity_tokens=self._affinity_tokens - atok0,
-            backpressure_holds=self._backpressure_holds - holds0,
-            reroles=self._reroles - rer0,
-            dispatched=tuple(
-                d - d0 for d, d0 in zip(self._dispatched, disp0)
-            ),
-            dispatches=sum(
-                r.dispatches - d0
-                for r, d0 in zip(self.replicas, disp0_decode)
-            ),
-            host_syncs=sum(
-                r.host_syncs - h0 for r, h0 in zip(self.replicas, hs0)
-            ),
-        )
+        return self._drain_report(snap, wall, outputs=outputs)
 
     # ---- fleet counter taps ---------------------------------------------
 
