@@ -543,3 +543,173 @@ class TestConfigValidation:
     def test_empty_fleet_rejected(self):
         with pytest.raises(ValueError, match="at least one"):
             FleetRouter([])
+
+
+class TestOverloadShedding:
+    """ISSUE 18: SLO-aware load shedding — deadline sheds, displacement
+    protecting the top class, the ``max_open`` pressure valve, explicit
+    ``RequestShed`` outcomes, the request-count law, and window-delta
+    report semantics on a reused router.  All on the LOGICAL shed clock
+    (``tick_s=1.0``): the shed schedule is a pure function of the
+    workload, never of wall time."""
+
+    def one_class(self, **kw):
+        return RouterConfig(classes=(
+            SLOClass("batch", target="throughput", **kw),
+        ), tick_s=1.0)
+
+    def test_deadline_shed_explicit_outcome(self):
+        rcfg = self.one_class(shed_after_s=3.0, max_queue=1)
+        r = fleet(1, rcfg=rcfg)
+        rep = r.run([("batch", q) for q in tenant_requests(6)])
+        assert rep.shed > 0
+        assert rep.completed + rep.shed == 6
+        assert rep.shed_tokens > 0
+        outs = dict(rep.outputs)
+        log = r.take_shed()
+        assert len(log) == rep.shed
+        for s in log:
+            assert s.reason == "deadline" and s.cls == "batch"
+            assert s.waited_s > 3.0       # it really blew the budget
+            assert s.rid not in outs      # shed work never emits
+        assert r.take_shed() == []        # drain-and-swap
+        # the request-count law at drain: nothing open, nothing lost
+        assert r.open_requests == 0
+        assert r.submitted_requests == \
+            r.finished_requests + r.shed_requests
+        check_counter_law(rep)            # token law, shed leg excluded
+
+    def test_displacement_protects_top_class(self):
+        rcfg = RouterConfig(classes=(
+            SLOClass("latency", target="ttft", shed_after_s=2.0,
+                     max_queue=1),
+            SLOClass("batch", target="throughput", max_queue=1),
+        ), tick_s=1.0)
+        r = fleet(1, rcfg=rcfg)
+        # 3 queued top-class requests behind a deep batch backlog: the
+        # top class blows its deadline while batch has work to give up
+        reqs = [("latency" if i < 3 else "batch", q)
+                for i, q in enumerate(tenant_requests(11))]
+        rep = r.run(reqs)
+        by = {c.name: c for c in rep.classes}
+        # the top class blew deadlines — but BATCH paid, explicitly
+        assert by["latency"].shed == 0
+        assert by["batch"].shed > 0
+        assert {s.reason for s in r.take_shed()} == {"displaced"}
+        outs = dict(rep.outputs)
+        for tenant, q in reqs:
+            if tenant == "latency":
+                assert q.rid in outs      # every top request completed
+
+    def test_lowest_class_sheds_itself_without_lower_work(self):
+        # the inverse: when the deadline-blown class IS the lowest,
+        # there is nobody to displace — it sheds its own longest waiter
+        rcfg = RouterConfig(classes=(
+            SLOClass("latency", target="ttft", max_queue=1),
+            SLOClass("batch", target="throughput", shed_after_s=2.0,
+                     max_queue=1),
+        ), tick_s=1.0)
+        r = fleet(1, rcfg=rcfg)
+        rep = r.run([("batch", q) for q in tenant_requests(6)])
+        assert rep.shed > 0
+        assert {s.reason for s in r.take_shed()} == {"deadline"}
+
+    def test_max_open_pressure_valve(self):
+        rcfg = self.one_class(max_open=2)
+        r = fleet(1, rcfg=rcfg)
+        rep = r.run([("batch", q) for q in tenant_requests(6)])
+        # 6 submitted against a cap of 2: the first shed pass drops the
+        # 4 oldest queued excess before anything dispatches
+        assert rep.shed == 4 and rep.completed == 2
+        log = r.take_shed()
+        assert {s.reason for s in log} == {"over_open"}
+        assert sorted(s.rid for s in log) == [0, 1, 2, 3]
+
+    def test_inflight_work_never_sheds(self):
+        # max_open bites with everything already dispatched: nothing
+        # queued to give up, so the valve waits for the drain instead
+        # of killing in-flight work
+        rcfg = self.one_class(max_open=1)
+        r = fleet(1, rcfg=rcfg)
+        r.submit(Request(rid=0, prompt=(1, 2, 3), max_new=6),
+                 tenant="batch")
+        outs = dict(r.step())             # rid 0 dispatched, in flight
+        assert r._inflight == {0}         # mid-generation, not done
+        r.submit(Request(rid=1, prompt=(2, 3, 4), max_new=2),
+                 tenant="batch")
+        # over cap with rid 0 IN FLIGHT: only the queued rid 1 sheds
+        while r.busy:
+            outs.update(r.step())
+        assert sorted(outs) == [0]        # rid 0 completed untouched
+        log = r.take_shed()
+        assert [s.rid for s in log] == [1]
+        assert log[0].reason == "over_open"
+        assert r.submitted_requests == \
+            r.finished_requests + r.shed_requests == 2
+
+    def test_logical_clock_makes_sheds_deterministic(self):
+        def go():
+            r = fleet(1, rcfg=self.one_class(shed_after_s=3.0,
+                                             max_queue=1))
+            rep = r.run([("batch", q) for q in tenant_requests(6)])
+            return (dict(rep.outputs),
+                    [(s.rid, s.reason, s.waited_s)
+                     for s in r.take_shed()])
+        assert go() == go()
+
+    def test_shed_rid_can_resubmit_bit_identically(self):
+        # the retry contract: a shed rid leaves the seen-set, and the
+        # rid keys the PRNG stream — the retry leg emits the tokens the
+        # original would have
+        reqs = tenant_requests(3, max_new=3)
+        baseline_ = dict(fleet(1).run(reqs).outputs)
+        r = fleet(1, rcfg=self.one_class(max_open=0, shed_after_s=1.0,
+                                         max_queue=1))
+        r.run([("batch", q) for q in reqs])
+        shed_rids = [s.rid for s in r.take_shed()]
+        assert shed_rids, "workload drifted: nothing shed"
+        # retry ONE shed leg on the now-idle fleet: same rid => same
+        # PRNG stream => the tokens the original would have emitted
+        rid = shed_rids[0]
+        retry = next(q for q in reqs if q.rid == rid)
+        rep2 = r.run([("batch", retry)])
+        assert rep2.completed == 1 and rep2.shed == 0
+        assert dict(rep2.outputs)[rid] == baseline_[rid]
+
+    def test_reused_router_reports_window_deltas(self):
+        """ISSUE 18 satellite: shed/readmitted in a RouterReport are
+        THIS window's deltas — a reused router's second report does not
+        re-count the first window's storm."""
+        from tpuscratch.ft.chaos import ChaosPlan, Fault
+
+        rcfg = self.one_class(shed_after_s=3.0, max_queue=1)
+        plan = ChaosPlan(seed=2, faults=(
+            Fault(site="serve/replica", at=(1,), key=0, kind="kill",
+                  down_ticks=2),
+        ))
+        cfg, scfg, mesh = cfg_for(), scfg_for(), mesh_for()
+        r = FleetRouter([ServeEngine(mesh, cfg, scfg)
+                         for _ in range(2)], rcfg=rcfg, chaos=plan)
+        first = r.run([("batch", q) for q in tenant_requests(8)])
+        assert first.kills == 1 and first.readmitted > 0
+        assert first.shed > 0
+        assert len(r.take_shed()) == first.shed
+        lifetime = r.shed_requests
+        # second window: light load, the fault budget is spent
+        more = [("batch", Request(rid=50 + i, prompt=(1 + i, 2, 3),
+                                  max_new=2)) for i in range(2)]
+        second = r.run(more)
+        assert second.completed == 2
+        assert second.shed == 0 and second.shed_tokens == 0
+        assert second.kills == 0 and second.readmitted == 0
+        assert r.take_shed() == []
+        assert r.shed_requests == lifetime   # lifetime stays monotone
+        check_counter_law(second)
+
+    def test_shed_knob_validation(self):
+        with pytest.raises(ValueError, match="shed_after_s"):
+            SLOClass("x", shed_after_s=-1.0)
+        with pytest.raises(ValueError, match="max_open"):
+            SLOClass("x", max_open=-1)
+        with pytest.raises(ValueError, match="tick_s"):
+            RouterConfig(classes=(SLOClass("a"),), tick_s=-0.5)

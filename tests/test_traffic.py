@@ -3,6 +3,15 @@ bounded-memory TTFT reservoirs, replica-kill/stall chaos with
 zero-loss re-admission and chaos-vs-clean bit-identity, the
 byte-budgeted open loop, and the config-19 regress directions.
 
+Overload-control layer (ISSUE 18, marker ``overload``): closed-loop
+think-time clients with seeded retry storms (``run_traffic_closed``,
+``sheds == retries + abandoned``), correlated ``Fault(domain=)`` rack
+kills with a shared ignition budget, JSONL trace dump/replay
+round-trip, disagg kill-mid-handoff zero-loss, the 8-combo
+open/closed x shed x chaos counter-law sweep, the config-20 regress
+directions, and the slow-marked full-storm acceptance + record
+--check subprocess proof.
+
 The fleet tests reuse test_serve_router's compile-light shapes (same
 cfg/scfg values -> same jit cache entries within a tier-1 run)."""
 
@@ -17,15 +26,22 @@ import pytest
 jax = pytest.importorskip("jax")
 
 from tpuscratch.bench.traffic import (
+    ClosedLoopSpec,
+    RetryPolicy,
     TenantSpec,
     TraceGenerator,
     TrafficConfig,
+    _tenant_quotas,
     arrival_mix_requests,
+    bench_overload,
     fold_output,
     odd_prefix_len,
+    overload_setup,
+    replay_jsonl,
     run_traffic,
+    run_traffic_closed,
 )
-from tpuscratch.ft.chaos import ChaosPlan, Fault
+from tpuscratch.ft.chaos import ChaosPlan, Fault, rack_domains
 from tpuscratch.models.transformer import TransformerConfig
 from tpuscratch.obs import regress
 from tpuscratch.obs.metrics import MetricsRegistry, Reservoir, percentile
@@ -330,12 +346,54 @@ class TestReplicaChaos:
         assert rep.kills == 1
         check_churn_law(rep)
 
-    def test_disagg_fleet_rejects_kill_plan(self):
-        plan = ChaosPlan(seed=1, faults=(
-            Fault(site="serve/replica", at=(1,), kind="kill"),
+    def test_disagg_kill_mid_handoff_zero_loss_bit_identity(self):
+        """ISSUE 18 satellite: a DisaggEngine replica killed while
+        requests sit in every half — front queue, staged handoff,
+        finish buffer — loses NOTHING: ``DisaggEngine.evacuate`` owes
+        exact triples (a staged request's prompt is already counted in
+        ``stage_prefill_tokens``, so its re-admission leg is the whole
+        prompt), the router re-admits every victim, and the drain is
+        bit-identical to the kill-free disagg fleet's."""
+        clean = fleet(3, rcfg=TWO_CLASSES, disagg=True,
+                      prefix_share=False).run(self._tagged())
+        plan = ChaosPlan(seed=7, faults=(
+            Fault(site="serve/replica", at=(1,), key=0, kind="kill",
+                  down_ticks=4),
         ))
-        with pytest.raises(ValueError, match="evacuate"):
-            fleet(2, chaos=plan, disagg=True, prefix_share=False)
+        chaos = fleet(3, rcfg=TWO_CLASSES, chaos=plan, disagg=True,
+                      prefix_share=False).run(self._tagged())
+        assert chaos.outputs == clean.outputs
+        assert chaos.kills == 1 and chaos.dropped == 0
+        assert chaos.readmitted > 0
+        check_churn_law(chaos)
+        check_churn_law(clean)
+
+    def test_disagg_evacuate_accounting(self):
+        """DisaggEngine.evacuate owes every seen rid exactly once
+        across front queue, staging, finish buffer, and the inner
+        engine — and leaves the replica empty but alive."""
+        eng = DisaggEngine(mesh_for(), cfg_for(),
+                           scfg_for(prefix_share=False))
+        reqs = tenant_requests(6, max_new=3)
+        for r in reqs:
+            eng.submit(r)
+        eng.step()  # prefill a wave into staging / the inner engine
+        owed = eng.evacuate()
+        assert sorted(rid for rid, _, _ in owed) == \
+            sorted(r.rid for r in reqs)
+        by_rid = {rid: (un, lost) for rid, un, lost in owed}
+        for r in reqs:
+            un, lost = by_rid[r.rid]
+            # never-prefilled requests owe the whole prompt and can't
+            # have lost output; staged/admitted ones owe no prompt
+            assert un in (0, len(r.prompt))
+            if un == len(r.prompt):
+                assert lost == 0
+        assert eng.n_active == 0 and eng.n_queued == 0
+        assert eng.n_staged == 0
+        # the evacuated replica survives as the re-join target
+        eng.submit(Request(rid=99, prompt=(1, 2, 3), max_new=2))
+        assert eng.run().completed == 1
 
     def test_evacuate_accounting(self):
         """ServeEngine.evacuate returns exact owed triples: queued
@@ -530,3 +588,359 @@ class TestConfig19Regress:
         )
         assert r.returncode == 1, r.stdout + r.stderr
         assert "REGRESSED" in r.stdout
+
+
+class TestCorrelatedDomains:
+    """ISSUE 18: ``Fault.domain`` — one seeded ignition takes out every
+    member of a fault domain (a rack) in the SAME tick."""
+
+    def test_domain_fires_every_member_same_tick(self):
+        plan = ChaosPlan(seed=3, faults=(
+            Fault(site="serve/replica", at=(4,), domain=(0, 1),
+                  kind="kill", times=1),
+        ))
+        fired = {(t, k): plan.should_fire("serve/replica", index=t,
+                                          key=k) is not None
+                 for t in (3, 4, 5) for k in (0, 1, 2)}
+        # both rack members at tick 4, nobody else, ever
+        assert fired[(4, 0)] and fired[(4, 1)]
+        assert not any(v for (t, k), v in fired.items()
+                       if not (t == 4 and k in (0, 1)))
+        # ONE ignition consumed ONE budget, not one per member
+        assert plan._left == [0]
+
+    def test_domain_members_share_one_ignition_budget(self):
+        # with times=1 a per-member budget would let only the first
+        # member die; the whole rack must go down
+        plan = ChaosPlan(seed=3, faults=(
+            Fault(site="serve/replica", at=(2,), domain=(0, 1, 2),
+                  kind="kill", times=1),
+        ))
+        assert all(
+            plan.should_fire("serve/replica", index=2, key=k) is not None
+            for k in (0, 1, 2)
+        )
+
+    def test_rack_domains_helper(self):
+        assert rack_domains(5, 2) == ((0, 1), (2, 3), (4,))
+        assert rack_domains(4, 4) == ((0, 1, 2, 3),)
+        with pytest.raises(ValueError, match="rack_size"):
+            rack_domains(4, 0)
+
+    def test_key_and_domain_mutually_exclusive(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            Fault(site="serve/replica", key=0, domain=(0, 1))
+
+    def test_rack_kill_readmits_both_replicas_work(self):
+        """A 2-replica rack killed out of a 3-replica fleet mid-drain:
+        both die in the same tick, everything re-admits through the
+        survivor, outputs bit-identical to the kill-free fleet."""
+        reqs = tenant_requests(8, max_new=3)
+        clean = fleet(3, rcfg=TWO_CLASSES).run(
+            [("batch", r) for r in reqs])
+        plan = ChaosPlan(seed=5, faults=(
+            Fault(site="serve/replica", at=(1,), domain=(0, 1),
+                  kind="kill", down_ticks=6),
+        ))
+        chaos = fleet(3, rcfg=TWO_CLASSES, chaos=plan).run(
+            [("batch", r) for r in reqs])
+        assert chaos.kills == 2           # the whole rack, one tick
+        assert chaos.outputs == clean.outputs
+        assert chaos.dropped == 0
+        check_churn_law(chaos)
+
+
+class TestReplayJsonl:
+    """ISSUE 18 satellite: dump_jsonl / replay_jsonl round trip."""
+
+    def test_round_trip_digest_identical(self, tmp_path):
+        gen = TraceGenerator(trace_cfg(seed=4))
+        p = tmp_path / "trace.jsonl"
+        assert gen.dump_jsonl(p, 20) == 20
+        rp = replay_jsonl(p)
+        assert rp.digest(20) == gen.digest(20)
+        assert [i.encode() for i in rp.stream(20)] == \
+            [i.encode() for i in gen.stream(20)]
+
+    def test_replayed_run_bit_identical(self, tmp_path):
+        gen = TraceGenerator(trace_cfg(seed=4))
+        p = tmp_path / "trace.jsonl"
+        gen.dump_jsonl(p, 12)
+        a = run_traffic(fleet(2, rcfg=TWO_CLASSES), gen, 12,
+                        open_budget=8)
+        b = run_traffic(fleet(2, rcfg=TWO_CLASSES), replay_jsonl(p), 12,
+                        open_budget=8)
+        assert a.digest == b.digest
+        assert a.submitted == b.submitted == 12
+
+    def test_replay_prefix_and_blank_lines(self, tmp_path):
+        gen = TraceGenerator(trace_cfg(seed=4))
+        p = tmp_path / "trace.jsonl"
+        gen.dump_jsonl(p, 8)
+        with open(p, "a") as f:
+            f.write("\n")                 # trailing blank tolerated
+        rp = replay_jsonl(p)
+        assert len(rp.items) == 8
+        # a prefix read of a longer log is just the shorter trace
+        assert rp.digest(5) == gen.digest(5)
+
+
+SHED_TWO = RouterConfig(classes=(
+    SLOClass("latency", target="ttft"),
+    SLOClass("batch", shed_after_s=2.0, max_queue=1),
+), tick_s=1.0)
+
+
+class TestClosedLoop:
+    """ISSUE 18: the closed-loop client harness — think-time clients,
+    bounded concurrency, seeded retry."""
+
+    def test_repeat_runs_bit_identical(self):
+        def go():
+            tr = run_traffic_closed(
+                fleet(2, rcfg=TWO_CLASSES), TraceGenerator(trace_cfg()),
+                12, spec=ClosedLoopSpec(concurrency=2, think_p=0.6))
+            return (tr.digest, tr.submitted, tr.ticks, tr.sheds)
+        assert go() == go()
+
+    def test_open_set_bounded_by_client_population(self):
+        tr = run_traffic_closed(
+            fleet(1, rcfg=TWO_CLASSES), TraceGenerator(trace_cfg()), 10,
+            spec=ClosedLoopSpec(concurrency=1, think_p=0.6))
+        assert tr.peak_open <= 2          # 2 tenants x 1 client each
+        assert tr.submitted == 10 and tr.abandoned == 0
+
+    def test_quota_split_is_exact_and_proportional(self):
+        tenants = trace_cfg().tenants
+        spec = ClosedLoopSpec(concurrency=4,
+                              per_tenant=(("globex", 12),))
+        q = _tenant_quotas(tenants, spec, 100)
+        assert sum(q.values()) == 100
+        assert q["acme"] == 25 and q["globex"] == 75
+
+    def test_retry_storm_conserves_requests(self):
+        """Sheds either retry (same rid — same tokens) or abandon;
+        every request ends exactly one way and the per-tick law holds
+        throughout (asserted inside the harness)."""
+        spec = ClosedLoopSpec(
+            concurrency=1, per_tenant=(("globex", 6),), think_p=0.9,
+            retry=RetryPolicy(max_attempts=2, backoff_ticks=1,
+                              mult=1.0, jitter_ticks=0))
+        tr = run_traffic_closed(
+            fleet(1, rcfg=SHED_TWO), TraceGenerator(trace_cfg()), 16,
+            spec=spec)
+        assert tr.sheds > 0               # the storm materialized
+        assert tr.retries > 0             # and the clients fought back
+        # every shed leg was either re-submitted or terminal
+        assert tr.sheds == tr.retries + tr.abandoned
+        assert tr.submitted == 16
+
+    def test_shed_exclusion_pairs_with_uncommitted_fleet(self):
+        """The digest pairing law: a storm run's non-shed completions
+        are bit-identical to the same trace on a fleet that never
+        sheds, once the storm's terminally-shed rids are excluded."""
+        gen = TraceGenerator(trace_cfg())
+        spec = ClosedLoopSpec(concurrency=1,
+                              per_tenant=(("globex", 6),), think_p=0.9)
+        storm = run_traffic_closed(fleet(1, rcfg=SHED_TWO), gen, 16,
+                                   spec=spec)
+        assert storm.abandoned > 0        # retry=None: sheds terminal
+        clean = run_traffic_closed(
+            fleet(3, rcfg=TWO_CLASSES), gen, 16, spec=spec,
+            exclude_rids=frozenset(storm.shed_rids))
+        assert clean.sheds == 0
+        assert clean.digest == storm.digest
+
+    def test_validates(self):
+        with pytest.raises(ValueError, match="concurrency"):
+            ClosedLoopSpec(concurrency=0)
+        with pytest.raises(ValueError, match="think_p"):
+            ClosedLoopSpec(think_p=0.0)
+        with pytest.raises(ValueError, match="max_attempts"):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(ValueError, match="jitter"):
+            RetryPolicy(jitter_ticks=-1)
+
+
+class TestCounterLawSweep:
+    """ISSUE 18 satellite: the seeded property sweep — open/closed x
+    shed on/off x chaos on/off.  The harnesses assert the request law
+    ``submitted == finished + shed + open`` at EVERY tick and the token
+    law ``prefill + shared == submitted + readmitted`` at drain
+    (``check_law=True``); this pins the end state on every combo."""
+
+    @pytest.mark.parametrize("closed", [False, True])
+    @pytest.mark.parametrize("shed", [False, True])
+    @pytest.mark.parametrize("chaos", [False, True])
+    def test_laws_hold(self, closed, shed, chaos):
+        rcfg = SHED_TWO if shed else TWO_CLASSES
+        plan = ChaosPlan(seed=13, faults=(
+            Fault(site="serve/replica", at=(2,), key=0, kind="kill",
+                  down_ticks=3),
+        )) if chaos else None
+        router = fleet(2, rcfg=rcfg, chaos=plan)
+        gen = TraceGenerator(trace_cfg(seed=21))
+        if closed:
+            tr = run_traffic_closed(
+                router, gen, 12,
+                spec=ClosedLoopSpec(
+                    concurrency=2, think_p=0.7,
+                    retry=RetryPolicy(max_attempts=2, backoff_ticks=1,
+                                      mult=1.0, jitter_ticks=0)))
+        else:
+            tr = run_traffic(router, gen, 12, open_budget=6)
+        assert router.open_requests == 0
+        assert router.submitted_requests == \
+            router.finished_requests + router.shed_requests
+        check_churn_law(tr.report)
+        if chaos:
+            assert tr.report.kills == 1
+
+
+class TestConfig20Regress:
+    ROW = {
+        "config": 20, "metric": "overload_survival_tokens_per_s",
+        "value": 59.0, "tokens_per_s_clean": 39.5, "sheds": 7,
+        "sheds_clean": 0, "retries": 7, "abandoned": 0,
+        "shed_frac": 0.0, "readmitted": 8, "dropped": 0, "kills": 2,
+        "replicas": 3, "requests": 160, "peak_open": 16,
+        "completed_latency": 40, "completed_batch": 120,
+        "ticks_storm": 42, "ticks_clean": 18, "wall_s_storm": 5.17,
+        "wall_s_clean": 7.72, "ttft_p99_s_batch": 3.99,
+        "goodput_frac_batch": 0.932, "sheds_batch": 7,
+        "shed_frac_batch": 0.055, "ttft_p99_s_latency": 1.76,
+        "goodput_frac_latency": 0.951, "sheds_latency": 0,
+        "shed_frac_latency": 0.0, "platform": "cpu",
+    }
+
+    def test_field_directions(self):
+        for name in ("sheds", "sheds_latency", "sheds_batch",
+                     "shed_frac", "shed_frac_batch", "retries",
+                     "abandoned", "dropped", "ttft_p99_s_latency"):
+            assert regress.direction(name) == "lower", name
+        for name in ("overload_survival_tokens_per_s",
+                     "goodput_frac_latency", "readmitted"):
+            assert regress.direction(name) == "higher", name
+        for name in ("kills", "requests", "peak_open", "replicas",
+                     "wall_s_storm", "wall_s_clean", "ticks_storm",
+                     "ticks_clean", "completed_latency",
+                     "completed_batch"):
+            assert name in regress._SKIP, name
+
+    def test_canned_row_gates(self):
+        base = regress.index_rows([self.ROW])
+        ok = regress.index_rows([dict(self.ROW, value=57.0)])
+        assert not regress.has_regression(
+            regress.compare(base, ok, noise=0.1)
+        )
+        bad = regress.index_rows([dict(
+            self.ROW, sheds_latency=3, dropped=2, retries=25,
+        )])
+        bad_fields = {(f.metric, f.field) for f in
+                      regress.compare(base, bad, noise=0.1)
+                      if f.status == "regressed"}
+        m = "overload_survival_tokens_per_s"
+        assert (m, "sheds_latency") in bad_fields  # zero-top-shed gate
+        assert (m, "dropped") in bad_fields
+        assert (m, "retries") in bad_fields
+        # workload shape and raw walls never gate
+        wild = regress.index_rows([dict(self.ROW, wall_s_storm=500.0,
+                                        ticks_storm=9999)])
+        assert not regress.has_regression(
+            regress.compare(base, wild, noise=0.1)
+        )
+
+    def test_cli_subprocess_proof(self, tmp_path):
+        """The acceptance gate as a subprocess: config-20 clean pair
+        exits 0, injected top-class-shed/drop regression exits 1."""
+
+        def write(name, rows):
+            p = str(tmp_path / name)
+            with open(p, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+            return p
+
+        base = write("base.json", [self.ROW])
+        good = write("good.json", [dict(self.ROW, value=61.0,
+                                        ttft_p99_s_latency=1.9)])
+        bad = write("bad.json", [dict(self.ROW, sheds_latency=4,
+                                      dropped=3)])
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, good],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, bad],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "REGRESSED" in r.stdout
+
+
+@pytest.mark.overload
+class TestOverloadAcceptance:
+    """The ISSUE-18 acceptance scenario: diurnal burst crest + rack
+    kill + retry storm, survived with a bounded open queue, zero
+    top-class sheds while the batch class sheds, and bit-identical
+    digests for non-shed requests against the uncommitted fleet."""
+
+    @pytest.mark.slow
+    def test_full_storm_survival(self):
+        cfg, scfg, mesh = cfg_for(), scfg_for(), mesh_for()
+        setup = overload_setup(False, scfg.vocab)
+        # the kill tick really sits inside a seeded burst window — the
+        # storm hits at the crest by construction, not by luck
+        assert TraceGenerator(setup["tcfg"]).burst_active(
+            setup["kill_tick"])
+        storm = bench_overload(mesh, cfg, scfg, setup, storm=True)
+        again = bench_overload(mesh, cfg, scfg, setup, storm=True)
+        assert again["digest"] == storm["digest"]
+        assert again["shed_rids"] == storm["shed_rids"]
+        assert again["sheds"] == storm["sheds"]
+        clean = bench_overload(mesh, cfg, scfg, setup, storm=False,
+                               exclude_rids=frozenset(storm["shed_rids"]))
+        assert clean["digest"] == storm["digest"]
+        # survival facts (bench_overload asserts them; pin them here)
+        assert storm["kills"] == len(setup["rack"])
+        assert storm["dropped"] == 0 and clean["dropped"] == 0
+        assert storm["sheds"] > 0 and storm["retries"] > 0
+        assert storm["classes"]["latency"]["sheds"] == 0
+        assert clean["sheds"] == 0
+        # bounded top-class tail: the latency p99 under the storm
+        # stays within 4x the uncommitted fleet's
+        assert storm["classes"]["latency"]["ttft_p99_s"] <= \
+            4.0 * max(clean["classes"]["latency"]["ttft_p99_s"], 1e-3)
+
+    @pytest.mark.slow
+    def test_record_check_subprocess_proof(self, tmp_path):
+        """``record.py --check`` wired to config 20: a self-pair exits
+        0; an injected top-class-shed/drop regression exits 1."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        base = str(tmp_path / "base.json")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.bench.record",
+             "--configs", "20", "--json", base],
+            capture_output=True, text=True, env=env, timeout=560,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        with open(base) as f:
+            row = json.loads(f.readline())
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            f.write(json.dumps(dict(row, sheds_latency=5, dropped=3,
+                                    sheds=0, retries=0)) + "\n")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.bench.record",
+             "--configs", "20", "--check", base],
+            capture_output=True, text=True, env=env, timeout=560,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.bench.record",
+             "--configs", "20", "--check", bad],
+            capture_output=True, text=True, env=env, timeout=560,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr

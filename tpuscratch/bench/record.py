@@ -2256,6 +2256,128 @@ def config19_traffic_chaos(out: list) -> None:
     )
 
 
+def config20_overload(out: list) -> None:
+    """Overload survival (ISSUE 18): the config-20 storm
+    (``bench.traffic.overload_setup`` — an overcommitted closed loop
+    of think-time clients, diurnal + burst arrivals, seeded retry
+    policy) run twice per repeat — once on the 3-replica storm fleet
+    with a correlated RACK kill at the burst crest and SLO shedding
+    armed, once on the 5-replica clean fleet — with the clean arm's
+    digest (storm's terminally-shed rids excluded) asserted
+    bit-identical to the storm's: overload control may drop work, but
+    only EXPLICITLY, and everything else is untouched.  The survival
+    claims (zero drops, zero TOP-class sheds, batch sheds > 0, retry
+    storm live, rack kill fired, peak_open bounded by the client
+    population) are asserted inside ``bench_overload``; the gated
+    fields here are the shed/retry/abandon counters (``sheds`` lower —
+    deterministic on the logical shed clock, tight band;
+    ``sheds_latency`` recorded 0 is the zero-top-shed gate), per-class
+    p99 TTFT and goodput fraction, and the zero-loss counters.  The
+    request law ``submitted == finished + shed + open`` is asserted
+    every fleet tick inside ``run_traffic_closed``."""
+    import dataclasses as _dc
+
+    import jax
+
+    from tpuscratch.bench.decode_bench import default_decode_setup
+    from tpuscratch.bench.traffic import bench_overload, overload_setup
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, _batches, _kw = default_decode_setup(on_tpu)
+    setup = overload_setup(on_tpu, scfg.vocab)
+    scfg = _dc.replace(
+        scfg, prefix_share=True,
+        max_seq=max(scfg.max_seq, setup["tcfg"].max_total_len),
+    )
+    # interleaved median-of-3 per arm (the config-17 discipline), with
+    # the digest PAIRING checked per repeat: each clean run excludes
+    # exactly its paired storm run's terminally-shed rids
+    storms, cleans = [], []
+    for _rep in range(3):
+        st = bench_overload(mesh, cfg, scfg, setup, storm=True)
+        cl = bench_overload(mesh, cfg, scfg, setup, storm=False,
+                            exclude_rids=frozenset(st["shed_rids"]))
+        if cl["digest"] != st["digest"]:
+            raise RuntimeError(
+                "config 20: clean digest (shed rids excluded) differs "
+                "from the storm's — shedding changed a surviving "
+                "request's output"
+            )
+        storms.append(st)
+        cleans.append(cl)
+    if len({tuple(r.pop("shed_rids")) for r in storms + cleans}) > 2:
+        # storm repeats must shed the SAME rids (logical shed clock);
+        # clean repeats shed none — at most {storm set, ()} distinct
+        raise RuntimeError(
+            "config 20: shed sets diverged across repeats — the storm "
+            "is not deterministic"
+        )
+    digests = {r.pop("digest") for r in storms + cleans}
+    if len(digests) != 1:
+        raise RuntimeError(
+            "config 20: output digests diverged across repeats"
+        )
+
+    def by_rate(r):
+        return r["tokens_per_s"]
+
+    st = _median_of(storms, by_rate)
+    cl = _median_of(cleans, by_rate)
+    per_class = {}
+    for name, c in sorted(st["classes"].items()):
+        per_class[f"ttft_p99_s_{name}"] = c["ttft_p99_s"]
+        per_class[f"goodput_frac_{name}"] = c["goodput_frac"]
+        per_class[f"sheds_{name}"] = c["sheds"]
+        per_class[f"shed_frac_{name}"] = c["shed_frac"]
+    print(
+        f"# config 20: storm {st['tokens_per_s']:.3e} tok/s vs "
+        f"{cl['tokens_per_s']:.3e} clean over {st['requests']} "
+        f"requests, {st['kills']} rack kills, {st['sheds']} sheds "
+        f"(latency {per_class['sheds_latency']}), {st['retries']} "
+        f"retries, {st['abandoned']} abandoned, {st['dropped']} "
+        f"dropped, digests identical",
+        file=sys.stderr,
+    )
+    _emit(
+        out,
+        config=20,
+        metric="overload_survival_tokens_per_s",
+        value=st["tokens_per_s"],
+        tokens_per_s_clean=cl["tokens_per_s"],
+        sheds=st["sheds"],
+        sheds_clean=cl["sheds"],
+        retries=st["retries"],
+        abandoned=st["abandoned"],
+        shed_frac=st["shed_frac"],
+        readmitted=st["readmitted"],
+        dropped=st["dropped"],
+        kills=st["kills"],
+        replicas=st["replicas"],
+        requests=st["requests"],
+        peak_open=st["peak_open"],
+        completed_latency=st["completed_latency"],
+        completed_batch=st["completed_batch"],
+        ticks_storm=st["ticks"],
+        ticks_clean=cl["ticks"],
+        wall_s_storm=st["wall_s"],
+        wall_s_clean=cl["wall_s"],
+        **per_class,
+        detail=(
+            f"{st['replicas']}-replica storm vs {cl['replicas']}-"
+            f"replica clean, {st['requests']}-request closed loop "
+            f"(peak {st['peak_open']} open), rack kill of "
+            f"{st['kills']} replicas at the burst crest, "
+            f"{st['sheds']} batch sheds / 0 latency sheds, "
+            f"{st['retries']} retries, {st['abandoned']} abandoned, "
+            f"{st['readmitted']} readmitted, 0 dropped, digests "
+            f"identical with shed rids excluded, "
+            f"{st['tokens_per_s']:.3e}/{cl['tokens_per_s']:.3e} tok/s"
+        ),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -2276,13 +2398,15 @@ CONFIGS = {
     17: config17_serve_router,
     18: config18_cosched,
     19: config19_traffic_chaos,
+    20: config20_overload,
 }
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
-                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,19")
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
+                            "19,20")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

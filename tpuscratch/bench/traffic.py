@@ -35,14 +35,33 @@ for the serving stack, in three pieces:
    chaos-churned run and a clean run of the same trace must fold to
    the same digest (the house invariant, at scale).
 
-3. One-definition rule: this module owns request synthesis.
+3. :func:`run_traffic_closed` — the CLOSED loop (ISSUE 18): a bounded
+   population of per-tenant clients, each holding at most one open
+   request, thinking a seeded geometric number of ticks between
+   requests (think times compress with the diurnal/burst rate, so the
+   crest still crests), and RE-SUBMITTING a shed request after seeded
+   backoff (:class:`RetryPolicy`) — the retry-storm amplification loop
+   that makes naive shedding metastable.  The determinism laws carry
+   over: per-tenant request budgets make the request SET a pure
+   function of the config (not of fleet speed), content stays keyed on
+   ``(seed, tenant, k)``, rids are a pure function of ``(tenant, k)``,
+   and the digest over non-shed completions is order-independent — a
+   storm run and a clean run of the same trace fold to the same value
+   once the storm's terminally-shed rids are excluded.
+
+4. Trace record/replay: :meth:`TraceGenerator.dump_jsonl` writes the
+   production-format log (one JSON object per arrival) and
+   :func:`replay_jsonl` drives the same harnesses from the file,
+   round-trip digest-identical to the generator.
+
+5. One-definition rule: this module owns request synthesis.
    ``decode_bench.arrival_mix_requests`` (config 17's workload) now
    delegates here, so config-17 and config-19 rows draw from the same
    distributions — the odd shared-prefix rule (never page-aligned, so
    the sub-page rung is always exercised) lives in ONE place
    (:func:`odd_prefix_len`).
 
-Tests: tests/test_traffic.py (marker ``traffic``).
+Tests: tests/test_traffic.py (markers ``traffic``, ``overload``).
 """
 
 from __future__ import annotations
@@ -62,6 +81,8 @@ _ARRIVALS = zlib.crc32(b"traffic/arrivals")
 _BURST = zlib.crc32(b"traffic/burst")
 _REQ = zlib.crc32(b"traffic/req")
 _POOL = zlib.crc32(b"traffic/pool")
+_THINK = zlib.crc32(b"traffic/think")
+_RETRY = zlib.crc32(b"traffic/retry")
 
 
 def odd_prefix_len(length: int) -> int:
@@ -365,6 +386,71 @@ class TraceGenerator:
             h = zlib.crc32(item.encode(), h)
         return h
 
+    def dump_jsonl(self, path, n_requests: int, rid_base: int = 0) -> int:
+        """Record the first ``n_requests`` arrivals as a JSONL log —
+        one object per arrival, the production log format
+        :func:`replay_jsonl` replays.  Returns the item count written.
+        The round trip is LOSSLESS: a replayed trace's ``digest`` and
+        every harness run over it are bit-identical to the generator's
+        (tested), so a recorded production log and a synthetic config
+        are interchangeable drivers."""
+        import json
+
+        n = 0
+        with open(path, "w") as f:
+            for item in self.stream(n_requests, rid_base=rid_base):
+                f.write(json.dumps({
+                    "t": item.t, "tenant": item.tenant, "cls": item.cls,
+                    "rid": item.req.rid,
+                    "prompt": list(item.req.prompt),
+                    "max_new": item.req.max_new,
+                }) + "\n")
+                n += 1
+        return n
+
+
+class TraceReplay:
+    """A recorded trace, duck-typed to the :class:`TraceGenerator`
+    surface the harnesses use (``stream`` / ``digest``) — so
+    ``run_traffic``/``run_traffic_closed`` drive a production log and a
+    synthetic config through the same code path.  Recorded rids are
+    authoritative: ``stream``'s ``rid_base`` is accepted for interface
+    compatibility and ignored."""
+
+    def __init__(self, items: list):
+        self.items = list(items)
+
+    def stream(self, n_requests: int,
+               rid_base: int = 0) -> Iterator[TraceItem]:
+        yield from self.items[:n_requests]
+
+    def digest(self, n_requests: int) -> int:
+        h = 0
+        for item in self.items[:n_requests]:
+            h = zlib.crc32(item.encode(), h)
+        return h
+
+
+def replay_jsonl(path) -> TraceReplay:
+    """Load a :meth:`TraceGenerator.dump_jsonl` log (or any log in its
+    format) into a :class:`TraceReplay`."""
+    import json
+
+    items = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            items.append(TraceItem(
+                t=int(d["t"]), tenant=d["tenant"], cls=d["cls"],
+                req=Request(rid=int(d["rid"]),
+                            prompt=tuple(int(x) for x in d["prompt"]),
+                            max_new=int(d["max_new"])),
+            ))
+    return TraceReplay(items)
+
 
 # ---- the open-loop harness ----------------------------------------------
 
@@ -381,10 +467,19 @@ def fold_output(digest: int, rid: int, toks: tuple) -> int:
 
 @dataclasses.dataclass(frozen=True)
 class TrafficReport:
-    """One open-loop run: the router's drain-window report plus the
-    stream-scale handles — the output digest (bit-identity), the peak
-    open-request count (the byte budget's witness: ``peak_open <=
-    open_budget`` always), and the tick count."""
+    """One harness run (open or closed loop): the router's drain-window
+    report plus the stream-scale handles — the output digest
+    (bit-identity over non-shed completions), the peak open-request
+    count (the byte budget's witness: ``peak_open <= open_budget`` /
+    total client concurrency, always), and the tick count.
+
+    Overload fields (ISSUE 18): ``sheds`` counts RequestShed outcomes
+    (every shed leg, including later-retried ones), ``retries`` the
+    re-submissions the closed loop's :class:`RetryPolicy` issued,
+    ``abandoned`` the requests that exhausted their retry budget (the
+    TERMINAL sheds — ``shed_rids`` names them, the exclusion set a
+    clean-fleet digest pairing needs).  In the open loop every shed is
+    terminal (``retries == 0``, ``abandoned == sheds``)."""
 
     report: object               # RouterReport for the whole window
     digest: int
@@ -392,12 +487,31 @@ class TrafficReport:
     peak_open: int
     ticks: int
     wall_s: float
+    sheds: int = 0
+    retries: int = 0
+    abandoned: int = 0
+    shed_rids: tuple[int, ...] = ()
+
+
+def _check_request_law(router, where: str) -> None:
+    """The per-tick request-count law (ISSUE 18): every request the
+    router accepted is exactly one of finished, shed, or open —
+    asserted LIVE, every tick, not just at drain."""
+    sub = router.submitted_requests
+    fin = router.finished_requests
+    shed = router.shed_requests
+    open_ = router.open_requests
+    if sub != fin + shed + open_:
+        raise AssertionError(
+            f"request-count law violated at {where}: submitted {sub} "
+            f"!= finished {fin} + shed {shed} + open {open_}"
+        )
 
 
 def run_traffic(router, gen: TraceGenerator, n_requests: int, *,
                 open_budget: int, max_steps: int = 2_000_000,
-                check_law: bool = True,
-                rid_base: int = 0) -> TrafficReport:
+                check_law: bool = True, rid_base: int = 0,
+                exclude_rids: frozenset = frozenset()) -> TrafficReport:
     """Stream ``n_requests`` of ``gen``'s trace through ``router``
     under a byte-budgeted OPEN loop, then drain.
 
@@ -411,17 +525,22 @@ def run_traffic(router, gen: TraceGenerator, n_requests: int, *,
 
     The report is the router's own drain-window accounting
     (:meth:`FleetRouter._begin_drain` / ``_drain_report`` — the same
-    definitions ``run`` uses), and when ``check_law`` is set the
-    generalized fleet counter law is asserted on it:
-    ``prefill + shared == submitted + readmitted_tokens`` — exact
-    under any replica-kill schedule (ServeEngine fleets)."""
+    definitions ``run`` uses), and when ``check_law`` is set BOTH
+    counter laws are asserted: the token law ``prefill + shared ==
+    submitted + readmitted_tokens`` at drain (exact under any
+    replica-kill schedule, shed prompts excluded from the submitted
+    leg) and the request-count law ``submitted == finished + shed +
+    open`` at EVERY tick.  Open-loop sheds are terminal (no client to
+    retry them); ``exclude_rids`` skips those rids in the digest fold
+    so a clean run pairs bit-identically with a shedding storm run."""
     if open_budget < 1:
         raise ValueError(f"open_budget must be >= 1, got {open_budget}")
     items = gen.stream(n_requests, rid_base=rid_base)
     pending: Optional[TraceItem] = next(items, None)
     snap = router._begin_drain()
     digest = 0
-    submitted = finished = tokens = 0
+    submitted = finished = tokens = sheds = 0
+    shed_rids: list[int] = []
     peak_open = 0
     ticks = 0
     t0 = time.perf_counter()
@@ -429,20 +548,27 @@ def run_traffic(router, gen: TraceGenerator, n_requests: int, *,
         if ticks >= max_steps:
             raise RuntimeError(
                 f"traffic run did not complete in {max_steps} ticks "
-                f"({submitted - finished} open, "
+                f"({submitted - finished - sheds} open, "
                 f"{pending is not None and 'trace remaining' or 'trace done'})"
             )
-        # admit: every due arrival, while the byte budget holds
+        # admit: every due arrival, while the byte budget holds (shed
+        # requests are no longer live — their budget slots free up)
         while (pending is not None and pending.t <= ticks
-               and submitted - finished < open_budget):
+               and submitted - finished - sheds < open_budget):
             router.submit(pending.req, tenant=pending.cls)
             submitted += 1
             pending = next(items, None)
-        peak_open = max(peak_open, submitted - finished)
+        peak_open = max(peak_open, submitted - finished - sheds)
         for rid, toks in router.step():
-            digest = fold_output(digest, rid, toks)
+            if rid not in exclude_rids:
+                digest = fold_output(digest, rid, toks)
             finished += 1
             tokens += len(toks)
+        for s in router.take_shed():
+            sheds += 1
+            shed_rids.append(s.rid)
+        if check_law:
+            _check_request_law(router, f"tick {ticks}")
         ticks += 1
     wall = time.perf_counter() - t0
     report = router._drain_report(snap, wall, completed=finished,
@@ -459,14 +585,278 @@ def run_traffic(router, gen: TraceGenerator, n_requests: int, *,
                 f"{report.submitted_prompt_tokens} + readmitted "
                 f"{report.readmitted_tokens} = {rhs}"
             )
-    if finished != submitted:
+    if finished + sheds != submitted:
         raise AssertionError(
             f"open loop lost requests: {submitted} submitted, "
-            f"{finished} finished"
+            f"{finished} finished + {sheds} shed"
         )
     return TrafficReport(report=report, digest=digest,
                          submitted=submitted, peak_open=peak_open,
-                         ticks=ticks, wall_s=wall)
+                         ticks=ticks, wall_s=wall,
+                         sheds=sheds, retries=0, abandoned=sheds,
+                         shed_rids=tuple(shed_rids))
+
+
+# ---- the closed-loop harness (ISSUE 18) ----------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Seeded, tick-denominated client retry: a shed request
+    re-submits after ``backoff_ticks x mult^(attempt-1)`` ticks plus a
+    seeded jitter draw in ``[0, jitter_ticks]`` — keyed on
+    ``(seed, rid, attempt)``, so the retry storm is a pure function of
+    the trace, never of wall clock.  After ``max_attempts`` legs the
+    request is ABANDONED (terminal — the client gives up and moves
+    on).  Deliberately distinct from ``ft.retry.RetryPolicy``: that
+    one is the SERVER's wall-clock transient-fault absorber; this one
+    is the CLIENT behavior that amplifies overload (the metastable
+    loop shedding must survive)."""
+
+    max_attempts: int = 3        # total legs, first submission included
+    backoff_ticks: int = 2
+    mult: float = 2.0
+    jitter_ticks: int = 1
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.backoff_ticks < 1 or self.mult < 1:
+            raise ValueError("backoff_ticks and mult must be >= 1")
+        if self.jitter_ticks < 0:
+            raise ValueError(
+                f"jitter_ticks must be >= 0, got {self.jitter_ticks}"
+            )
+
+    def backoff_at(self, seed: int, rid: int, attempt: int) -> int:
+        """Ticks until the ``attempt``-th re-submission of ``rid``
+        (attempt 1 = first retry)."""
+        base = int(round(self.backoff_ticks * self.mult ** (attempt - 1)))
+        if self.jitter_ticks > 0:
+            rng = np.random.default_rng(np.random.SeedSequence(
+                [seed, _RETRY, rid, attempt]
+            ))
+            base += int(rng.integers(0, self.jitter_ticks + 1))
+        return max(1, base)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClosedLoopSpec:
+    """The client population: ``concurrency`` clients per tenant
+    (overridable per tenant), each holding at most ONE open request
+    and thinking a seeded geometric(``think_p``) number of ticks
+    between requests.  Think times DIVIDE by the trace's instantaneous
+    rate factor (``rate_at(t) / base_rate``), so the diurnal sine and
+    burst ignitions still shape closed-loop load — the crest still
+    crests.  ``retry`` re-submits shed requests after backoff (None:
+    a shed is immediately terminal)."""
+
+    concurrency: int = 4
+    per_tenant: tuple[tuple[str, int], ...] = ()
+    think_p: float = 0.5
+    retry: Optional[RetryPolicy] = None
+
+    def __post_init__(self):
+        if self.concurrency < 1:
+            raise ValueError(
+                f"concurrency must be >= 1, got {self.concurrency}"
+            )
+        if not (0 < self.think_p <= 1):
+            raise ValueError(
+                f"think_p must be in (0, 1], got {self.think_p}"
+            )
+
+    def clients_for(self, tenant: str) -> int:
+        for name, n in self.per_tenant:
+            if name == tenant:
+                return n
+        return self.concurrency
+
+
+def _tenant_quotas(tenants, spec: ClosedLoopSpec,
+                   n_requests: int) -> dict[str, int]:
+    """Per-tenant request budgets summing to ``n_requests``,
+    proportional to client counts with remainders to earlier tenants.
+    A FIXED split is what keeps the closed-loop request SET a pure
+    function of the config: which client starts a tenant's k-th
+    request depends on fleet speed, but the set of (tenant, k) pairs
+    — and therefore the rids and contents — never does."""
+    counts = {t.name: spec.clients_for(t.name) for t in tenants}
+    total = sum(counts.values())
+    quotas = {}
+    given = 0
+    for i, t in enumerate(tenants):
+        if i == len(tenants) - 1:
+            quotas[t.name] = n_requests - given
+        else:
+            q = (n_requests * counts[t.name]) // total
+            quotas[t.name] = q
+            given += q
+    return quotas
+
+
+def run_traffic_closed(router, gen: TraceGenerator, n_requests: int, *,
+                       spec: ClosedLoopSpec,
+                       max_steps: int = 2_000_000,
+                       check_law: bool = True, rid_base: int = 0,
+                       exclude_rids: frozenset = frozenset()
+                       ) -> TrafficReport:
+    """Drive ``router`` with a CLOSED loop of think-time clients over
+    ``gen``'s request content (the arrival process is the clients, not
+    the trace's Poisson stream — ``gen`` supplies tenants, classes,
+    and the ``(seed, tenant, k)``-keyed request contents).
+
+    Determinism: per-tenant quotas fix the request set
+    (:func:`_tenant_quotas`), rids are ``rid_base + k x n_tenants +
+    tenant_index`` (a pure function of the content key, so the same
+    request carries the same rid — and emits the same tokens — on any
+    fleet), think and backoff draws are seeded and tick-denominated.
+    With the router's logical shed clock (``RouterConfig.tick_s``) the
+    ENTIRE storm — who sheds, who retries, who abandons — is a pure
+    function of (config, fleet, plan): repeat runs are bit-identical.
+
+    A shed request re-submits under ``spec.retry`` with the SAME rid
+    (the router forgets shed rids, and rid keys the PRNG stream — the
+    retry leg emits identical tokens); after ``max_attempts`` legs it
+    is abandoned (terminal).  The digest folds non-shed completions,
+    order-independent; ``exclude_rids`` (a storm run's
+    ``shed_rids``) makes a clean-fleet pairing bit-comparable.  Both
+    counter laws are asserted under ``check_law``, the request-count
+    law at every tick."""
+    tenants = gen.cfg.tenants
+    names = [t.name for t in tenants]
+    cls_of = {t.name: t.cls for t in tenants}
+    quotas = _tenant_quotas(tenants, spec, n_requests)
+    seed = gen.cfg.seed
+    # one content counter per tenant, shared by its clients: which
+    # client starts request k is timing; WHAT request k is, is not
+    seq = {n: 0 for n in names}
+    # clients: (tenant, client_idx) -> dict(state); think stream keyed
+    # per client so client populations draw independently
+    clients = []
+    for ti, t in enumerate(tenants):
+        for c in range(spec.clients_for(t.name)):
+            clients.append({
+                "tenant": t.name, "idx": c, "draws": 0,
+                "ready_at": 0, "rid": None,
+            })
+
+    def think(client, tick: int) -> int:
+        """Seeded think duration starting at ``tick``: geometric
+        draw, compressed by the instantaneous rate factor so bursts
+        and the diurnal crest reach the closed loop."""
+        rng = np.random.default_rng(np.random.SeedSequence(
+            [seed, _THINK, zlib.crc32(client["tenant"].encode()),
+             client["idx"], client["draws"]]
+        ))
+        client["draws"] += 1
+        raw = int(rng.geometric(spec.think_p))
+        factor = gen.rate_at(tick) / gen.cfg.base_rate
+        return max(1, int(round(raw / max(factor, 1e-9))))
+
+    snap = router._begin_drain()
+    digest = 0
+    started = finished = tokens = sheds = retries = abandoned = 0
+    shed_rids: list[int] = []
+    owner: dict[int, dict] = {}        # rid -> waiting client
+    reqs: dict[int, object] = {}       # rid -> Request (for retries)
+    attempts: dict[int, int] = {}      # rid -> legs submitted
+    due: dict[int, list[int]] = {}     # tick -> rids to re-submit
+    peak_open = 0
+    ticks = 0
+    t0 = time.perf_counter()
+    while True:
+        if ticks >= max_steps:
+            raise RuntimeError(
+                f"closed loop did not complete in {max_steps} ticks "
+                f"({started} started, {finished} finished, "
+                f"{abandoned} abandoned)"
+            )
+        # 1) due retries first (rid order — deterministic), then new
+        # starts (tenant config order, client index order)
+        for rid in sorted(due.pop(ticks, ())):
+            router.submit(reqs[rid], tenant=cls_of[owner[rid]["tenant"]])
+            attempts[rid] += 1
+            retries += 1
+        for client in clients:
+            if client["rid"] is not None or client["ready_at"] > ticks:
+                continue
+            tn = client["tenant"]
+            if seq[tn] >= quotas[tn]:
+                continue
+            k = seq[tn]
+            seq[tn] = k + 1
+            rid = rid_base + k * len(names) + names.index(tn)
+            req = gen._materialize(tn, k, rid)
+            router.submit(req, tenant=cls_of[tn])
+            started += 1
+            client["rid"] = rid
+            owner[rid] = client
+            reqs[rid] = req
+            attempts[rid] = 1
+        peak_open = max(peak_open, router.open_requests)
+        # 2) one fleet tick; completions wake their clients
+        for rid, toks in router.step():
+            if rid not in exclude_rids:
+                digest = fold_output(digest, rid, toks)
+            finished += 1
+            tokens += len(toks)
+            client = owner.pop(rid)
+            client["rid"] = None
+            client["ready_at"] = ticks + think(client, ticks)
+            reqs.pop(rid, None)
+            attempts.pop(rid, None)
+        # 3) sheds: retry with backoff, or abandon (terminal)
+        for s in router.take_shed():
+            sheds += 1
+            rid = s.rid
+            legs = attempts[rid]
+            if (spec.retry is not None
+                    and legs < spec.retry.max_attempts):
+                back = spec.retry.backoff_at(seed, rid, legs)
+                due.setdefault(ticks + 1 + back, []).append(rid)
+            else:
+                abandoned += 1
+                shed_rids.append(rid)
+                client = owner.pop(rid)
+                client["rid"] = None
+                client["ready_at"] = ticks + think(client, ticks)
+                reqs.pop(rid, None)
+                attempts.pop(rid, None)
+        if check_law:
+            _check_request_law(router, f"tick {ticks}")
+        ticks += 1
+        if (not owner and not due and not router.busy
+                and all(seq[n] >= quotas[n] for n in names)):
+            break
+    wall = time.perf_counter() - t0
+    report = router._drain_report(snap, wall, completed=finished,
+                                  tokens=tokens)
+    if check_law:
+        lhs = report.prefill_tokens + report.shared_tokens
+        rhs = (report.submitted_prompt_tokens
+               + report.readmitted_tokens)
+        if lhs != rhs:
+            raise AssertionError(
+                f"fleet counter law violated: prefill "
+                f"{report.prefill_tokens} + shared "
+                f"{report.shared_tokens} = {lhs} != submitted "
+                f"{report.submitted_prompt_tokens} + readmitted "
+                f"{report.readmitted_tokens} = {rhs}"
+            )
+    if finished + abandoned != started:
+        raise AssertionError(
+            f"closed loop lost requests: {started} started, "
+            f"{finished} finished + {abandoned} abandoned"
+        )
+    return TrafficReport(report=report, digest=digest,
+                         submitted=started, peak_open=peak_open,
+                         ticks=ticks, wall_s=wall,
+                         sheds=sheds, retries=retries,
+                         abandoned=abandoned,
+                         shed_rids=tuple(shed_rids))
 
 
 # ---- the config-19 workload (one definition) -----------------------------
@@ -589,3 +979,195 @@ def bench_traffic(mesh, cfg, scfg, setup: dict, chaos: bool) -> dict:
         },
     }
     return row
+
+
+# ---- the config-20 workload (one definition) -----------------------------
+
+
+def overload_setup(on_tpu: bool, vocab: int) -> dict:
+    """The config-20 overload-survival workload: a deliberately
+    OVERCOMMITTED closed loop (client concurrency sized past the storm
+    fleet's slot capacity) with a rack-scale correlated kill at a
+    burst-crest tick, SLO-aware shedding on, retry storm on — one
+    definition shared by ``bench.record`` config 20 and the overload
+    tests.  The shed clock is LOGICAL (``tick_s=1.0``, deadlines in
+    fleet ticks), so the whole storm — who sheds, who retries, who
+    abandons, every digest — is a pure function of this setup.
+
+    ``kill_tick`` sits inside the trace's first burst window (seeded
+    ignition — verified by ``TraceGenerator.burst_active`` in the
+    tests), so the rack dies at the crest: the storm arm must survive
+    crest + rack loss + retry amplification with the TOP class intact
+    (zero latency sheds, bounded p99 TTFT) while the batch class
+    sheds.  The CLEAN pair is the same trace on an uncommitted fleet
+    (more replicas, no chaos): zero sheds, and — with the storm's
+    terminally-shed rids excluded — a bit-identical output digest."""
+    tenants = (
+        TenantSpec("acme", cls="latency", weight=3.0, n_prefixes=4),
+        TenantSpec("globex", cls="batch", weight=1.0, n_prefixes=2),
+    )
+    # class order IS priority: latency (index 0) is the top class —
+    # displacement protects it; its generous deadline makes the
+    # zero-top-shed gate a measured fact, not a vacuous default.
+    # max_queue is what makes overload VISIBLE to the shed layer: it
+    # bounds per-replica dispatch depth so excess work holds in the
+    # router queue (where it ages against shed_after_s) instead of
+    # disappearing into unbounded replica-internal queues
+    classes = (
+        dict(name="latency", target="ttft", shed_after_s=60.0,
+             max_queue=4),
+        dict(name="batch", target="throughput", shed_after_s=6.0,
+             max_queue=2),
+    )
+    retry = RetryPolicy(max_attempts=3, backoff_ticks=2, mult=2.0,
+                        jitter_ticks=1)
+    if on_tpu:
+        tcfg = TrafficConfig(
+            seed=20, tenants=tenants, vocab=vocab, prompt_len=64,
+            tail_cap=8, out_cap=8, base_rate=8.0, diurnal_period=256,
+            diurnal_amp=0.5, burst_p=0.02, burst_len=16, burst_mult=4.0,
+        )
+        return dict(tcfg=tcfg, n_requests=1200, classes=classes,
+                    spec=ClosedLoopSpec(
+                        concurrency=16,
+                        per_tenant=(("globex", 48),),
+                        think_p=0.6, retry=retry),
+                    n_replicas_storm=3, n_replicas_clean=5,
+                    rack=(0, 1), kill_tick=8, down_ticks=24,
+                    tick_s=1.0)
+    tcfg = TrafficConfig(
+        seed=20, tenants=tenants, vocab=vocab, prompt_len=21,
+        tail_cap=4, out_cap=4, base_rate=2.0, diurnal_period=64,
+        diurnal_amp=0.5, burst_p=0.05, burst_len=8, burst_mult=3.0,
+    )
+    return dict(tcfg=tcfg, n_requests=160, classes=classes,
+                spec=ClosedLoopSpec(
+                    concurrency=4,
+                    per_tenant=(("globex", 12),),
+                    think_p=0.6, retry=retry),
+                n_replicas_storm=3, n_replicas_clean=5,
+                rack=(0, 1), kill_tick=6, down_ticks=20,
+                tick_s=1.0)
+
+
+def overload_plan_for(setup: dict):
+    """The setup's correlated rack-kill plan (fresh per run — budgets
+    and domain ignitions are consumed state): ONE seeded ignition at
+    ``kill_tick`` takes out every replica in ``rack`` in the same
+    fleet tick."""
+    from tpuscratch.ft.chaos import ChaosPlan, Fault
+
+    return ChaosPlan(seed=20, faults=[
+        Fault(site="serve/replica", at=(setup["kill_tick"],),
+              domain=setup["rack"], kind="kill",
+              down_ticks=setup["down_ticks"]),
+    ])
+
+
+def overload_router(mesh, cfg, scfg, setup: dict, storm: bool):
+    """A fresh fleet for one config-20 arm: the overcommitted 3-replica
+    storm fleet (rack-kill plan armed) or the uncommitted clean fleet
+    (more replicas, no chaos)."""
+    from tpuscratch.serve.engine import ServeEngine
+    from tpuscratch.serve.router import FleetRouter, RouterConfig, SLOClass
+
+    rcfg = RouterConfig(
+        classes=tuple(SLOClass(**c) for c in setup["classes"]),
+        tick_s=setup["tick_s"],
+    )
+    n = setup["n_replicas_storm" if storm else "n_replicas_clean"]
+    return FleetRouter(
+        [ServeEngine(mesh, cfg, scfg) for _ in range(n)],
+        rcfg=rcfg,
+        chaos=overload_plan_for(setup) if storm else None,
+    )
+
+
+def bench_overload(mesh, cfg, scfg, setup: dict, storm: bool,
+                   exclude_rids: frozenset = frozenset()) -> dict:
+    """One config-20 arm, flattened to a row dict.  The survival
+    claims are asserted HERE (every consumer measures the same laws):
+    zero drops always; under the storm — the rack kill actually fired,
+    the retry storm actually looped, the BATCH class shed while the
+    LATENCY class shed ZERO, and ``peak_open`` stayed bounded by the
+    client population; on the clean fleet — zero sheds.  The row
+    carries ``shed_rids`` so the record config can pair the clean
+    arm's digest against the storm's (pop it before emitting)."""
+    tr = run_traffic_closed(
+        overload_router(mesh, cfg, scfg, setup, storm),
+        TraceGenerator(setup["tcfg"]), setup["n_requests"],
+        spec=setup["spec"], exclude_rids=exclude_rids,
+    )
+    rep = tr.report
+    if rep.dropped != 0:
+        raise AssertionError(
+            f"zero-loss law violated: {rep.dropped} dropped"
+        )
+    by_cls = {c.name: c for c in rep.classes}
+    n_clients = sum(
+        setup["spec"].clients_for(t.name) for t in setup["tcfg"].tenants
+    )
+    if tr.peak_open > n_clients:
+        raise AssertionError(
+            f"closed loop leaked: peak_open {tr.peak_open} > "
+            f"{n_clients} clients"
+        )
+    if storm:
+        if rep.kills != len(setup["rack"]):
+            raise AssertionError(
+                f"rack kill misfired: {rep.kills} kills, expected "
+                f"{len(setup['rack'])} (schedule drifted off the crest)"
+            )
+        if by_cls["latency"].shed != 0:
+            raise AssertionError(
+                f"TOP class shed {by_cls['latency'].shed} requests — "
+                "displacement failed while batch had work to give up"
+            )
+        if by_cls["batch"].shed == 0:
+            raise AssertionError(
+                "storm arm shed nothing — the overload never "
+                "materialized (workload drifted)"
+            )
+        if tr.retries == 0:
+            raise AssertionError(
+                "storm arm never retried — the retry storm is dead "
+                "(spec drifted)"
+            )
+    elif tr.sheds != 0:
+        raise AssertionError(
+            f"clean fleet shed {tr.sheds} requests — it is not "
+            "actually uncommitted (capacity drifted)"
+        )
+    done = {n: by_cls[n].completed for n in by_cls}
+    return {
+        "replicas": setup[
+            "n_replicas_storm" if storm else "n_replicas_clean"],
+        "requests": tr.submitted,
+        "digest": tr.digest,
+        "peak_open": tr.peak_open,
+        "ticks": tr.ticks,
+        "wall_s": tr.wall_s,
+        "tokens_per_s": rep.tokens_per_s,
+        "kills": rep.kills,
+        "readmitted": rep.readmitted,
+        "dropped": rep.dropped,
+        "sheds": tr.sheds,
+        "retries": tr.retries,
+        "abandoned": tr.abandoned,
+        "shed_rids": tr.shed_rids,
+        "shed_frac": (tr.abandoned / tr.submitted
+                      if tr.submitted else 0.0),
+        "classes": {
+            c.name: {
+                "completed": c.completed,
+                "ttft_p99_s": c.ttft_p99_s,
+                "goodput_frac": c.goodput_frac,
+                "sheds": c.shed,
+                "shed_frac": (c.shed / (c.completed + c.shed)
+                              if c.completed + c.shed else 0.0),
+            }
+            for c in rep.classes
+        },
+        "completed_latency": done.get("latency", 0),
+        "completed_batch": done.get("batch", 0),
+    }

@@ -43,6 +43,12 @@ Site vocabulary (what the instrumented layers query):
   (``None``: the router's ``rejoin_ticks`` default).  Explicit
   ``index=tick`` keeps the schedule a pure function of the plan — the
   chaos-vs-clean bit-identity runs fire at the same ticks.
+  ``Fault(domain=(0, 1, 2))`` makes the clause a CORRELATED fault
+  domain (ISSUE 18 — replicas sharing a rack/power feed/switch): one
+  seeded ignition at an occurrence index fires the clause for EVERY
+  key in the domain at that index, consuming ONE ``times`` budget per
+  ignition rather than one per member — a rack dies whole, in the
+  same fleet tick, off one draw.
 - ``"comm/<op>"``     — a transient :class:`InjectedFault` (a
   ``CommError``) raised from a collective wrapper around a compiled
   program (:meth:`ChaosPlan.wrap_collective`); the chunked drivers
@@ -113,7 +119,11 @@ class Fault:
     ``save``.  ``down_ticks`` sizes a ``serve/replica`` outage in fleet
     ticks (the tick-denominated twin of ``stall_s``: replica chaos is
     scheduled in ticks so the fault matrix stays deterministic, not
-    wall-clocked).
+    wall-clocked).  ``domain`` is the CORRELATED twin of ``key``: the
+    clause matches every key in the group, and one ignition at an
+    occurrence index fires for ALL of them at that index off a single
+    ``times`` budget — the rack / power-feed / switch failure unit.
+    ``key`` and ``domain`` are mutually exclusive.
     """
 
     site: str
@@ -125,6 +135,23 @@ class Fault:
     stage: Optional[str] = None          # ckpt/save stage selector
     stall_s: float = 0.0                 # sleep length for kind="stall"
     down_ticks: Optional[int] = None     # serve/replica outage length
+    domain: Optional[Sequence[int]] = None  # correlated key group (a rack)
+
+    def __post_init__(self):
+        if self.key is not None and self.domain is not None:
+            raise ValueError("Fault: key and domain are mutually exclusive")
+
+
+def rack_domains(n_replicas: int, rack_size: int) -> tuple[tuple[int, ...], ...]:
+    """Partition ``range(n_replicas)`` into contiguous racks of
+    ``rack_size`` — the conventional domain layout for ``Fault(domain=)``
+    clauses (the last rack may be short)."""
+    if rack_size <= 0:
+        raise ValueError("rack_domains: rack_size must be positive")
+    return tuple(
+        tuple(range(lo, min(lo + rack_size, n_replicas)))
+        for lo in range(0, n_replicas, rack_size)
+    )
 
 
 class ChaosPlan:
@@ -147,6 +174,7 @@ class ChaosPlan:
         self.faults = tuple(faults)
         self._left = [f.times for f in self.faults]
         self._occ: dict = {}
+        self._domain_fired: set = set()  # (fault_i, index) ignitions
         self.fired: dict[str, int] = {}
         self.sink = sink if sink is not None else NullSink()
 
@@ -165,7 +193,11 @@ class ChaosPlan:
                     stage: Optional[str] = None) -> Optional[Fault]:
         """First matching, unexhausted clause that fires at this
         occurrence — consumed from its ``times`` budget — or ``None``.
-        ``index=None`` auto-counts occurrences per (site, stage, key)."""
+        ``index=None`` auto-counts occurrences per (site, stage, key).
+        A ``domain`` clause consumes ONE budget unit per (clause, index)
+        ignition: the first domain member seen at an index pays; later
+        members at the same index fire free (even past exhaustion), so
+        every replica in the rack dies off the same draw."""
         if index is None:
             occ_key = (site, stage, key)
             index = self._occ.get(occ_key, 0)
@@ -175,9 +207,12 @@ class ChaosPlan:
                 continue
             if f.key is not None and key != f.key:
                 continue
+            if f.domain is not None and key not in tuple(f.domain):
+                continue
             if f.stage is not None and stage != f.stage:
                 continue
-            if self._left[i] == 0:
+            ignited = f.domain is not None and (i, index) in self._domain_fired
+            if self._left[i] == 0 and not ignited:
                 continue
             if f.at is not None:
                 fires = index in tuple(f.at)
@@ -185,8 +220,11 @@ class ChaosPlan:
                 fires = f.p > 0 and self._rate_fires(i, site, index)
             if not fires:
                 continue
-            if self._left[i] is not None:
-                self._left[i] -= 1
+            if not ignited:
+                if self._left[i] is not None:
+                    self._left[i] -= 1
+                if f.domain is not None:
+                    self._domain_fired.add((i, index))
             self.fired[site] = self.fired.get(site, 0) + 1
             self.sink.emit(
                 "ft/fault", site=site, index=index, kind=f.kind,

@@ -119,11 +119,21 @@ _HIGHER = ("per_s", "per_sec", "gbps", "tflops", "efficiency",
 #: (the config-19 row's ``dropped`` is the zero-loss law as a gated
 #: counter — any value above the recorded 0 is a lost request; its
 #: TTFT tails ride the existing "ttft" substring + widened floor.)
+#: (the config-20 overload row, ISSUE 18: ``sheds``/``shed_frac`` are
+#: the load-shedding counters at a FIXED storm — deterministic on the
+#: logical shed clock, so they keep the tight static band; more sheds
+#: at the same storm means capacity or scheduling regressed.  The
+#: per-class ``sheds_latency`` field doubles as the zero-top-shed gate:
+#: recorded 0, any value above it fails.  ``retries``/``abandoned``
+#: pin the retry-storm amplification — the closed loop resubmitting
+#: more, or giving up on more, at the same storm is a regression.
+#: ``sheds`` not a bare "shed": "shed" is a substring of "finished".)
 _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
           "overhead", "bubble", "crossover", "prefill_frac", "degraded",
           "iterations", "cycles", "psum", "ppermute", "checkpoint",
           "restart", "badput", "cold", "ttft", "dispatches", "host_sync",
-          "share_err", "switch", "dropped")
+          "share_err", "switch", "dropped", "sheds", "shed_frac",
+          "retries", "abandoned")
 
 #: checked BEFORE _HIGHER: the config-15 per-SWEEP collective budget
 #: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would
@@ -144,13 +154,21 @@ _LOWER_FIRST = ("per_sweep",)
 #: chaos/workload shape — how much churn the fixed plan injected and
 #: how deep the open loop ran, not costs; its raw chaos/clean walls
 #: are context like config 18's — the median-of-3 token rates and the
-#: direction-gated counters carry the story.)
+#: direction-gated counters carry the story.  Config 20's storm wall
+#: (``wall_s_storm``) and tick counts ride the same reasoning — the
+#: bounded-open-queue claim is asserted in ``bench_overload``, not
+#: gated here.)
 _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
          "flops_per_token", "degenerate", "peak_hbm_gbps", "replicas",
          "switches", "workloads", "share_train", "share_solver",
          "target_train", "target_solver", "wall_s_cosched",
          "wall_s_solo", "kills", "stalls", "requests", "peak_open",
-         "wall_s_chaos", "wall_s_clean"}
+         "wall_s_chaos", "wall_s_clean", "wall_s_storm",
+         "ticks_storm", "ticks_clean",
+         # per-class completion counts are the fixed closed-loop
+         # quotas, not costs — and "completed_latency" would otherwise
+         # ride the "latency" _LOWER substring upside down
+         "completed_latency", "completed_batch"}
 
 #: per-field MEASURED-noise floors (fractional band, substring-matched
 #: like the direction tables; first match wins): wall-clock fields

@@ -61,6 +61,7 @@ from tpuscratch.serve.kvcache import (  # noqa: F401
 from tpuscratch.serve.router import (  # noqa: F401
     ClassReport,
     FleetRouter,
+    RequestShed,
     RouterConfig,
     RouterReport,
     SLOClass,

@@ -324,6 +324,49 @@ class DisaggEngine:
                 return True
         return self.engine.drop_queued(rid)
 
+    def evacuate(self) -> list[tuple[int, int, int]]:
+        """Kill this replica (fleet-scale chaos — the
+        ``ServeEngine.evacuate`` contract, disagg front end included):
+        tear down the front queue, the handoff queue, the staging pool
+        and the wrapped decode engine, and return every owed
+        ``(rid, unaccounted_prompt_tokens, lost_generated_tokens)``
+        triple.  The staging pool's accounting mirrors the engine's:
+
+        - a FRONT-QUEUED request never touched a prefill program — its
+          whole prompt is unaccounted;
+        - a STAGED request (in the handoff queue) was fully prefilled
+          on the staging slice (``stage_prefill_tokens`` counted it,
+          and that counter feeds the router's prefill leg), so its
+          prompt is fully accounted — but the first token sampled at
+          staging dies with the pool: 1 lost generated token;
+        - a buffered finish is fully accounted prompt, fully lost
+          output (the engine's own rule);
+        - everything living INSIDE the decode engine (including
+          degraded requests in its queue) comes from
+          ``engine.evacuate()`` — no rid appears in both halves, by
+          the step() hand-over discipline.
+
+        The object survives as the re-join replica (compiled staging
+        and migration programs are process state); ``_seen`` clears
+        with the scheduling state — the router's fleet-level seen set
+        guards rid uniqueness across the kill.  Lifetime counters
+        (``stage_prefill_tokens``, handoffs) keep accumulating."""
+        owed: list[tuple[int, int, int]] = []
+        for req in self._queue:
+            owed.append((req.rid, len(req.prompt), 0))
+        for st in self._handoff:
+            owed.append((st.req.rid, 0, 1))
+        for rid, toks in self._finish_buf:
+            owed.append((rid, 0, len(toks)))
+        self._queue.clear()
+        self._handoff.clear()
+        self._finish_buf = []
+        self._stage_kv = self._fresh_stage_kv()
+        self._stage_alloc = PageAllocator(self.stage_geom.n_pages)
+        self._seen.clear()
+        owed.extend(self.engine.evacuate())
+        return owed
+
     @property
     def n_queued(self) -> int:
         return len(self._queue) + self.engine.n_queued

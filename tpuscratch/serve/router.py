@@ -58,6 +58,19 @@ deep:
    dead replica had already accounted.  The wasted legs plus the
    generated tokens that died with the pool feed the per-class
    goodput fraction (the MegaScale NSDI '24 accounting under churn).
+6. **SLO-aware load shedding** (ISSUE 18) — backpressure that can say
+   NO: each :class:`SLOClass` carries a queue-deadline budget
+   (``shed_after_s``) and a fleet-wide open-set cap (``max_open``);
+   instead of holding forever, the router sheds the lowest-class /
+   most-deadline-blown ROUTER-QUEUED work with an explicit
+   :class:`RequestShed` outcome (``take_shed``), displacement-first so
+   top-class work never sheds while a lower class has queued work to
+   give up.  The request-count law ``submitted == finished + shed +
+   open`` holds at every tick; shed prompts leave the token law's
+   submitted leg (they never prefill) and charge the shedding class's
+   goodput fraction.  ``RouterConfig.tick_s`` puts shed deadlines on
+   the logical fleet-tick clock so WHICH requests shed is a pure
+   function of the trace — repeat storms stay digest-identical.
 
 House invariant: greedy output is BIT-identical under any routing —
 1 replica or N, affinity on or off, any re-roling schedule, any
@@ -103,11 +116,26 @@ class SLOClass:
     is a candidate.  ``max_queue`` bounds the class's dispatched-but-
     unfinished depth PER replica (0 = unbounded): when every candidate
     is at the bound the request holds in the router queue — per-class
-    backpressure instead of unbounded replica queues."""
+    backpressure instead of unbounded replica queues.
+
+    Overload control (ISSUE 18): ``shed_after_s`` is the class's
+    deadline budget — router-queued work older than this SHEDS instead
+    of holding forever (0 = hold forever, the pre-ISSUE-18 behavior).
+    A deadline-blown request first looks for a STRICTLY lower-priority
+    queued victim to displace (priority = position in
+    ``RouterConfig.classes``, index 0 highest), so top-class work never
+    sheds while a lower class has queued work to give up.  ``max_open``
+    caps the class's OPEN set (router-queued + in-flight, fleet-wide,
+    0 = unbounded): exceeding it sheds the lowest-priority queued work
+    — the overload pressure valve.  Only router-QUEUED work ever
+    sheds; dispatched work always completes (no computed tokens are
+    thrown away)."""
 
     name: str
     target: str = "throughput"   # "ttft" | "throughput"
     max_queue: int = 0           # per-replica in-flight bound, 0 = off
+    shed_after_s: float = 0.0    # queue-wait deadline budget, 0 = hold
+    max_open: int = 0            # fleet-wide open-set cap, 0 = unbounded
 
     def __post_init__(self):
         if self.target not in ("ttft", "throughput"):
@@ -117,6 +145,14 @@ class SLOClass:
         if self.max_queue < 0:
             raise ValueError(
                 f"max_queue must be >= 0, got {self.max_queue}"
+            )
+        if self.shed_after_s < 0:
+            raise ValueError(
+                f"shed_after_s must be >= 0, got {self.shed_after_s}"
+            )
+        if self.max_open < 0:
+            raise ValueError(
+                f"max_open must be >= 0, got {self.max_open}"
             )
 
 
@@ -151,6 +187,13 @@ class RouterConfig:
     # stream-scale drain (exact whenever a drain completes fewer
     # requests than this — every pre-ISSUE-17 report is bit-equal)
     ttft_reservoir: int = 4096
+    # shed-deadline clock (ISSUE 18): > 0 makes queue-wait age a
+    # LOGICAL quantity — (fleet ticks held) × tick_s — so the shed
+    # schedule is a pure function of the trace and replica speed never
+    # changes WHICH requests shed (repeat runs stay digest-identical).
+    # 0 = wall-clock age (deadlines mean real seconds).  TTFT stays
+    # wall-clock either way.
+    tick_s: float = 0.0
 
     def __post_init__(self):
         if not self.classes:
@@ -178,6 +221,8 @@ class RouterConfig:
             raise ValueError(
                 f"ttft_reservoir must be >= 1, got {self.ttft_reservoir}"
             )
+        if self.tick_s < 0:
+            raise ValueError(f"tick_s must be >= 0, got {self.tick_s}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -189,9 +234,11 @@ class ClassReport:
     ``RouterConfig.ttft_reservoir`` completions).  ``goodput_frac`` is
     the MegaScale-style useful-work fraction: tokens the tenant got
     (final-leg prompts + delivered outputs) over everything the fleet
-    computed for the class, including re-admitted prefill legs and
-    generated tokens that died with a killed replica — 1.0 exactly on
-    a chaos-free drain."""
+    computed for the class, including re-admitted prefill legs,
+    generated tokens that died with a killed replica, and — ISSUE 18 —
+    prompt tokens the class submitted and then SHED (the tenant asked
+    and got nothing; shed waste is charged to the shedding class) —
+    1.0 exactly on a chaos-free, shed-free drain."""
 
     name: str
     completed: int
@@ -202,6 +249,8 @@ class ClassReport:
     ttft_exact: bool = True
     readmitted: int = 0
     goodput_frac: float = 1.0
+    shed: int = 0                # requests shed from this class
+    shed_tokens: int = 0         # their prompt tokens (never computed)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -257,6 +306,14 @@ class RouterReport:
     readmitted_tokens: int = 0   # re-prefilled legs (the law's 4th term)
     lost_tokens: int = 0         # generated tokens that died with a pool
     dropped: int = 0
+    # overload shedding (ISSUE 18): requests the router gave an
+    # explicit RequestShed outcome instead of holding forever.  Their
+    # prompts are EXCLUDED from submitted_prompt_tokens (they never
+    # prefill), keeping the token law exact under shedding; the
+    # request-count law is submitted == finished + shed + open at
+    # every tick (live properties on the router).
+    shed: int = 0
+    shed_tokens: int = 0
 
     @property
     def prefill_frac(self) -> float:
@@ -271,17 +328,39 @@ class RouterReport:
         return self.shared_tokens / total if total else 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class RequestShed:
+    """One shed outcome — the router's explicit "no" (ISSUE 18).
+    ``reason`` is ``"deadline"`` (blew its own ``shed_after_s`` with no
+    lower class to displace), ``"displaced"`` (gave way to a
+    deadline-blown higher class), or ``"over_open"`` (a class exceeded
+    ``max_open``).  ``waited_s`` is the queue age at shed time, on the
+    same clock the deadline used (logical under ``tick_s``).  The rid
+    is free to be RE-submitted — a shed clears it from the router's
+    seen-set, so a closed-loop client's seeded retry replays the same
+    (rid, prompt) and emits the same tokens wherever it finally
+    lands."""
+
+    rid: int
+    cls: str
+    reason: str
+    waited_s: float
+
+
 @dataclasses.dataclass
 class _Pending:
     """One routed-but-not-yet-dispatched request.  ``t0`` is the
     ROUTER-submit wall stamp: the TTFT clock starts here, so time held
     in the router queue (backpressure, candidate filtering) counts
     toward the per-class TTFT the router reports — ``max_queue`` must
-    never look free in the SLO report."""
+    never look free in the SLO report.  ``tick`` is the fleet-tick
+    twin: the logical submit stamp the shed deadline ages against when
+    ``RouterConfig.tick_s`` is set."""
 
     cls: str
     req: Request
     t0: float = 0.0
+    tick: int = 0
 
 
 class FleetRouter:
@@ -313,7 +392,7 @@ class FleetRouter:
         ) and any(not hasattr(r, "evacuate") for r in self.replicas):
             raise ValueError(
                 "serve/replica kill faults need replicas exposing "
-                "evacuate() (plain ServeEngine fleets)"
+                "evacuate() (ServeEngine and DisaggEngine both do)"
             )
         ref = self._scfg(self.replicas[0])
         for r in self.replicas[1:]:
@@ -372,6 +451,22 @@ class FleetRouter:
         self._dropped = 0
         self._dispatched = [0] * len(self.replicas)
         names = [c.name for c in self.rcfg.classes]
+        # overload shedding (ISSUE 18): class priority = position in
+        # rcfg.classes (0 = top); request-count law counters
+        # (submitted == finished + shed + open at every tick) and the
+        # shed outcome log drained via take_shed()
+        self._prio = {c.name: i for i, c in enumerate(self.rcfg.classes)}
+        self._shed_enabled = any(
+            c.shed_after_s > 0 or c.max_open > 0 for c in self.rcfg.classes
+        )
+        self._submitted = 0
+        self._finished = 0
+        self._shed = 0
+        self._shed_ptok = 0
+        self._open_by_class: dict[str, int] = {n: 0 for n in names}
+        self._class_shed: dict[str, int] = {n: 0 for n in names}
+        self._class_shed_tok: dict[str, int] = {n: 0 for n in names}
+        self._shed_log: list[RequestShed] = []
         self._ttft: dict[str, Reservoir] = {}
         self._reset_ttft()
         self._class_tokens: dict[str, int] = {n: 0 for n in names}
@@ -414,6 +509,38 @@ class FleetRouter:
     def reroles(self) -> int:
         return self._reroles
 
+    # ---- the request-count law (ISSUE 18) -------------------------------
+    # submitted == finished + shed + open, at EVERY fleet tick: every
+    # request the router accepted is exactly one of completed (incl.
+    # quarantined-terminal), explicitly shed, or still open (router-
+    # queued or in-flight).  Lifetime counters — harnesses assert the
+    # law live, per tick, not just at drain.
+
+    @property
+    def submitted_requests(self) -> int:
+        return self._submitted
+
+    @property
+    def finished_requests(self) -> int:
+        return self._finished
+
+    @property
+    def shed_requests(self) -> int:
+        return self._shed
+
+    @property
+    def open_requests(self) -> int:
+        """Router-queued + dispatched-but-unfinished — the law's open
+        term, and the bounded-queue quantity overload control exists
+        to bound."""
+        return len(self._queue) + len(self._inflight)
+
+    def take_shed(self) -> list[RequestShed]:
+        """Drain the shed outcomes since the last call — the closed
+        loop's retry trigger (the engine ``take_*`` idiom)."""
+        out, self._shed_log = self._shed_log, []
+        return out
+
     # ---- request lifecycle ----------------------------------------------
 
     def submit(self, req: Request, tenant: str = "default") -> None:
@@ -441,8 +568,11 @@ class FleetRouter:
         self._class_of[req.rid] = tenant
         self._submitted_ptok += len(req.prompt)
         self._class_ptok[tenant] += len(req.prompt)
+        self._submitted += 1
+        self._open_by_class[tenant] += 1
         self._queue.append(_Pending(cls=tenant, req=req,
-                                    t0=time.perf_counter()))
+                                    t0=time.perf_counter(),
+                                    tick=self._tick))
 
     # ---- the fleet prefix index -----------------------------------------
 
@@ -612,6 +742,100 @@ class FleetRouter:
         rep.drop_queued(rid)
         rep.quarantine(rid, f"{type(exc).__name__}: {exc}")
 
+    # ---- SLO-aware load shedding (ISSUE 18) -----------------------------
+
+    def _age(self, pend: _Pending) -> float:
+        """Queue-wait age on the configured shed clock: logical
+        (ticks held × tick_s — deterministic, trace-pure) when
+        ``RouterConfig.tick_s`` is set, else wall."""
+        if self.rcfg.tick_s > 0:
+            return (self._tick - pend.tick) * self.rcfg.tick_s
+        return time.perf_counter() - pend.t0
+
+    def _do_shed(self, pend: _Pending, reason: str) -> None:
+        """Give ``pend`` its explicit RequestShed outcome: out of the
+        queue, out of the seen-set (the rid may be re-submitted — a
+        retry replays the same (rid, prompt) stream bit-identically),
+        counted against its class."""
+        self._queue.remove(pend)
+        rid = pend.req.rid
+        self._seen.discard(rid)
+        self._class_of.pop(rid, None)
+        self._shed += 1
+        self._shed_ptok += len(pend.req.prompt)
+        self._class_shed[pend.cls] += 1
+        self._class_shed_tok[pend.cls] += len(pend.req.prompt)
+        self._open_by_class[pend.cls] -= 1
+        self._shed_log.append(RequestShed(
+            rid=rid, cls=pend.cls, reason=reason,
+            waited_s=self._age(pend),
+        ))
+
+    def _displacement_victim(self, pend: _Pending,
+                             shed_rids: set) -> Optional[_Pending]:
+        """The queued pending a deadline-blown ``pend`` displaces:
+        longest-waiting member of the LOWEST-priority class strictly
+        below ``pend``'s (queue order is submission order, so the
+        first hit per class is its longest-waiting), or None when no
+        strictly-lower class has queued work."""
+        my = self._prio[pend.cls]
+        best, best_prio = None, my
+        for p in self._queue:
+            if p.req.rid in shed_rids or p is pend:
+                continue
+            pr = self._prio[p.cls]
+            if pr > best_prio:
+                best, best_prio = p, pr
+        return best
+
+    def _lowest_queued_victim(self, shed_rids: set) -> Optional[_Pending]:
+        """Longest-waiting queued pending of the lowest-priority class
+        with queued work — the ``max_open`` pressure valve's victim."""
+        best, best_prio = None, -1
+        for p in self._queue:
+            if p.req.rid in shed_rids:
+                continue
+            pr = self._prio[p.cls]
+            if pr > best_prio:
+                best, best_prio = p, pr
+        return best
+
+    def _shed_tick(self) -> None:
+        """The overload-control pass, start of every fleet tick.
+        (1) deadline pass: a queued request older than its class's
+        ``shed_after_s`` sheds a strictly-lower-priority queued victim
+        if one exists (``"displaced"``) — top-class work never sheds
+        while a lower class has work to give up — else itself
+        (``"deadline"``).  (2) pressure valve: each class over its
+        ``max_open`` sheds up to the excess from the lowest-priority
+        queued work (``"over_open"``), bounded per tick.  Only queued
+        work sheds — dispatched work always completes."""
+        if not self._shed_enabled:
+            return
+        shed_rids: set[int] = set()
+        for pend in list(self._queue):
+            if pend.req.rid in shed_rids:
+                continue
+            c = self._classes[pend.cls]
+            if c.shed_after_s <= 0 or self._age(pend) <= c.shed_after_s:
+                continue
+            victim = self._displacement_victim(pend, shed_rids)
+            if victim is None:
+                victim = pend
+            self._do_shed(victim, "displaced" if victim is not pend
+                          else "deadline")
+            shed_rids.add(victim.req.rid)
+        for c in self.rcfg.classes:
+            if c.max_open <= 0:
+                continue
+            over = self._open_by_class[c.name] - c.max_open
+            for _ in range(over):
+                victim = self._lowest_queued_victim(shed_rids)
+                if victim is None:
+                    break  # nothing queued to give up: in-flight drains
+                self._do_shed(victim, "over_open")
+                shed_rids.add(victim.req.rid)
+
     # ---- autoscaling (disagg fleets) ------------------------------------
 
     def _autoscale(self) -> None:
@@ -722,7 +946,11 @@ class FleetRouter:
         the replica WITH the work tick t just routed to it — the
         mid-stream case the re-admission machinery exists for (a
         before-dispatch kill would mostly find replicas drained by the
-        previous tick's finishes)."""
+        previous tick's finishes).  Shedding runs FIRST: a request that
+        blew its deadline must not consume a dispatch slot this tick,
+        and the request-count law submitted == finished + shed + open
+        holds at every return from this method."""
+        self._shed_tick()
         if self.rcfg.autoscale:
             self._autoscale()
         self._dispatch()
@@ -747,6 +975,8 @@ class FleetRouter:
                     )
                     self._class_tokens[cls] += len(toks)
                     self._class_done[cls] += 1
+                    self._finished += 1
+                    self._open_by_class[cls] -= 1
                     ttft = rep.take_ttft(rid)
                     if ttft is not None:
                         self._ttft[cls].observe(ttft)
@@ -754,7 +984,9 @@ class FleetRouter:
         # a QUARANTINED request never reaches the finish list — release
         # its backpressure depth here, or one poison request would pin
         # its class's max_queue slot forever (the engine-side livelock
-        # lesson, router-level)
+        # lesson, router-level).  It is TERMINAL for the request-count
+        # law: the router is done with it, so it leaves the open set as
+        # finished (the law has no fourth outcome).
         for rid in [r for r in self._inflight
                     if self.replicas[self._replica_of[r]]
                     .is_quarantined(r)]:
@@ -765,6 +997,8 @@ class FleetRouter:
                 self._depth[(i, cls)] = max(
                     0, self._depth.get((i, cls), 0) - 1
                 )
+                self._finished += 1
+                self._open_by_class[cls] -= 1
         return finished
 
     @property
@@ -808,6 +1042,9 @@ class FleetRouter:
             kills=self._kills, stalls=self._stalls,
             readm=self._readmitted, readm_tok=self._readmitted_tokens,
             lost=self._lost_tokens, dropped=self._dropped,
+            shed=self._shed, shed_ptok=self._shed_ptok,
+            cshed=dict(self._class_shed),
+            cshed_tok=dict(self._class_shed_tok),
             disp=list(self._dispatched),
             ctok=dict(self._class_tokens),
             cdone=dict(self._class_done),
@@ -836,7 +1073,15 @@ class FleetRouter:
             readm_tok = (self._class_readm_tok[c.name]
                          - snap["creadm_tok"][c.name])
             lost = self._class_lost[c.name] - snap["clost"][c.name]
-            useful = ctoks + cptok
+            shed_tok = (self._class_shed_tok[c.name]
+                        - snap["cshed_tok"][c.name])
+            # shed prompts are waste the tenant asked for and never
+            # got: out of the useful leg (max() guards the window
+            # where the shed leg was submitted before the snapshot),
+            # INTO the denominator — shed waste charges the shedding
+            # class, the MegaScale accounting extended to overload
+            useful = ctoks + max(0, cptok - shed_tok)
+            waste = readm_tok + lost + shed_tok
             classes.append(ClassReport(
                 name=c.name,
                 completed=self._class_done[c.name]
@@ -848,8 +1093,10 @@ class FleetRouter:
                 ttft_exact=res.exact,
                 readmitted=self._class_readmitted[c.name]
                 - snap["creadm"][c.name],
-                goodput_frac=(useful / (useful + readm_tok + lost)
-                              if useful + readm_tok + lost else 1.0),
+                goodput_frac=(useful / (useful + waste)
+                              if useful + waste else 1.0),
+                shed=self._class_shed[c.name] - snap["cshed"][c.name],
+                shed_tokens=shed_tok,
             ))
         return RouterReport(
             completed=completed or 0,
@@ -867,7 +1114,12 @@ class FleetRouter:
                 self._shared_of(r) - s0
                 for r, s0 in zip(self.replicas, snap["stok"])
             ),
-            submitted_prompt_tokens=self._submitted_ptok - snap["subm"],
+            # shed prompts never prefill: excluded from the window's
+            # submitted leg (as a DELTA — a pre-window shed stays out),
+            # so prefill + shared == submitted + readmitted stays exact
+            # under shedding
+            submitted_prompt_tokens=(self._submitted_ptok - snap["subm"])
+            - (self._shed_ptok - snap["shed_ptok"]),
             subpage_tokens=sum(
                 self._subpage_of(r) - s0
                 for r, s0 in zip(self.replicas, snap["sub"])
@@ -894,6 +1146,8 @@ class FleetRouter:
             - snap["readm_tok"],
             lost_tokens=self._lost_tokens - snap["lost"],
             dropped=self._dropped - snap["dropped"],
+            shed=self._shed - snap["shed"],
+            shed_tokens=self._shed_ptok - snap["shed_ptok"],
         )
 
     def run(self, requests: Sequence = (),
