@@ -1,0 +1,494 @@
+"""Host-free decode (ISSUE 19, marker ``hostfree``).
+
+The lift: neither ``spec_k > 0`` nor ``kv_host_pages > 0`` clamps
+``macro_steps`` any more — speculation's propose/verify/accept rides
+the scan carry (``serve.decode.propose_draft_batch`` +
+``serve.sampling.accept_batch``), stop-token EOS is an in-carry mask
+folded into the early-exit psum, and the tiered wave prefetch is
+issued behind the running scan.  The correctness anchors:
+
+- **in-carry EOS**: a per-request ``stop_tokens`` hit mid-scan
+  truncates the stream bit-identically to the host-side budget path —
+  the stop token is EMITTED (closes the output) and nothing follows
+  it, across macro_steps x spec_k x the dtype ladder on the 1x1 and
+  2x2 meshes; garbage positions past a stop never reach the KV pool
+  (pages return to the free list exactly);
+- **composed bit-identity**: spec x macro, tiered x macro, and
+  spec x tiered x macro all reproduce the T=1 engine's greedy outputs,
+  with FEWER dispatches (the clamp is gone, not hidden);
+- **async macro tick** (``ServeConfig(async_macro=True)``): chaining
+  the next scan's dispatch behind the running one changes WHEN host
+  syncs happen, never what is computed — outputs and the dispatch /
+  host-sync counters are identical to the synchronous macro engine;
+- **device == host speculation**: ``propose_draft_batch`` matches the
+  host ``propose_draft`` rule position for position, and
+  ``accept_batch`` matches ``accept_speculative`` (greedy bit-pinned;
+  temperature draws off the same fold_in chains);
+- **config-21 regress gate**: the spec-x-macro and tiered-x-macro
+  record rows are direction-registered (dispatches/host-syncs LOWER
+  on the tight static band, tokens/s HIGHER behind the CPU noise
+  floor), a clean pair exits 0, an injected dispatch regression exits
+  1 (subprocess proof), and a ``--check`` against a PRE-PR artifact
+  reports the new rows as ``added`` only — never a failure.
+
+Shapes reuse test_serve_macro's cfg/scfg values (same jit cache
+entries within a tier-1 run).
+"""
+
+import dataclasses
+import json
+import math
+import os
+import subprocess
+import sys
+
+import pytest
+import jax
+
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.obs import regress
+from tpuscratch.serve import Request, ServeConfig, ServeEngine
+
+pytestmark = pytest.mark.hostfree
+
+
+def cfg_for(**kw):
+    kw.setdefault("n_layers", 1)
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=32, n_heads=4, n_experts=4, d_ff=48, **kw
+    )
+
+
+SCFG = ServeConfig(n_slots=4, n_pages=16, page_size=4, max_seq=24,
+                   vocab=16)
+
+REQS = [
+    Request(rid=i, prompt=tuple((3 * i + j) % 16 for j in range(2 + i % 5)),
+            max_new=2 + (i * 3) % 6)
+    for i in range(6)
+]
+
+
+def run_engine(dims=(1, 1), reqs=REQS, cfg=None, **scfg_kw):
+    cfg = cfg or cfg_for()
+    n = dims[0] * dims[1]
+    mesh = make_mesh(dims, ("dp", "sp"), jax.devices()[:n])
+    scfg = dataclasses.replace(SCFG, **scfg_kw)
+    eng = ServeEngine(mesh, cfg, scfg)
+    return eng, eng.run(reqs)
+
+
+_STOP_CACHE = {}
+
+
+def stop_reqs():
+    """REQS with deterministic per-request stop tokens: even rids stop
+    on a token their greedy stream actually emits mid-way (truncation
+    fires), odd rids stop on a token absent from their stream (the
+    mask rides along without firing) — derived once from the T=1
+    no-stop baseline, so every engine under test faces the same mix of
+    hit and miss stops."""
+    if "reqs" not in _STOP_CACHE:
+        _, base = run_engine()
+        outs = dict(base.outputs)
+        reqs = []
+        for r in REQS:
+            toks = outs[r.rid]
+            if r.rid % 2 == 0 and len(toks) >= 2:
+                stop = (toks[len(toks) // 2],)
+            else:
+                missing = next(
+                    (t for t in range(SCFG.vocab) if t not in toks), None
+                )
+                stop = (missing,) if missing is not None else ()
+            reqs.append(dataclasses.replace(r, stop_tokens=stop))
+        _STOP_CACHE["reqs"] = reqs
+    return _STOP_CACHE["reqs"]
+
+
+def stop_ref():
+    """The T=1 host-path reference run for :func:`stop_reqs` — one
+    engine run shared by every matrix cell."""
+    if "ref" not in _STOP_CACHE:
+        _, _STOP_CACHE["ref"] = run_engine(reqs=stop_reqs())
+    return _STOP_CACHE["ref"]
+
+
+class TestInCarryEOS:
+    def test_stop_truncates_like_the_budget_path(self):
+        # the EOS contract stated as an identity: stopping on the token
+        # at generated-index j produces EXACTLY the output of the same
+        # request budget-limited to max_new = j + 1 — the two "stop
+        # decoding here" mechanisms are one path
+        _, base = run_engine()
+        outs = dict(base.outputs)
+        rid = max(outs, key=lambda r: len(outs[r]))
+        toks = outs[rid]
+        assert len(toks) >= 3
+        tok = toks[len(toks) // 2]
+        idx = toks.index(tok)
+        req = next(r for r in REQS if r.rid == rid)
+        _, r_stop = run_engine(
+            reqs=[dataclasses.replace(req, stop_tokens=(tok,))]
+        )
+        _, r_budget = run_engine(
+            reqs=[dataclasses.replace(req, max_new=idx + 1)]
+        )
+        assert dict(r_stop.outputs)[rid] == toks[:idx + 1]
+        assert dict(r_budget.outputs)[rid] == toks[:idx + 1]
+        assert dict(r_stop.outputs)[rid][-1] == tok
+
+    @pytest.mark.parametrize(
+        "T,spec_k",
+        [(4, 0), (16, 0), (1, 3), (4, 3),
+         pytest.param(16, 3, marks=pytest.mark.slow)],
+    )
+    def test_eos_matrix_matches_t1(self, T, spec_k):
+        # the in-carry stop mask (macro scan / spec carry) truncates
+        # bit-identically to the T=1 host-side rule, hit and miss stops
+        # mixed in one bank
+        sreqs = stop_reqs()
+        ref = stop_ref()
+        eng, rep = run_engine(reqs=sreqs, macro_steps=T, spec_k=spec_k)
+        assert rep.outputs == ref.outputs
+        assert rep.tokens_generated == ref.tokens_generated
+        assert eng.free_pages() == [SCFG.n_pages]
+
+    @pytest.mark.parametrize(
+        "kv_dtype",
+        ["int8", pytest.param("fp8", marks=pytest.mark.slow)],
+    )
+    def test_eos_on_quantized_rungs(self, kv_dtype):
+        sreqs = stop_reqs()
+        _, ref = run_engine(reqs=sreqs, kv_dtype=kv_dtype)
+        _, rep = run_engine(reqs=sreqs, kv_dtype=kv_dtype, macro_steps=4,
+                            spec_k=3)
+        assert rep.outputs == ref.outputs
+
+    @pytest.mark.slow
+    def test_eos_on_2x2_mesh(self):
+        sreqs = stop_reqs()
+        _, ref = run_engine(dims=(2, 2), reqs=sreqs)
+        _, rep = run_engine(dims=(2, 2), reqs=sreqs, macro_steps=16,
+                            spec_k=3)
+        assert rep.outputs == ref.outputs
+
+    def test_garbage_never_escapes(self):
+        # positions past a mid-scan stop are write-suppressed: the stop
+        # token is the LAST emitted token of its stream, the full page
+        # pool returns to the free list, and no cached KV survives the
+        # drain — a leaked garbage write would hold pages or extend an
+        # output past its stop
+        sreqs = stop_reqs()
+        stops = {r.rid: set(r.stop_tokens) for r in sreqs}
+        eng, rep = run_engine(reqs=sreqs, macro_steps=4, spec_k=3)
+        hit = 0
+        for rid, toks in rep.outputs:
+            hits = [j for j, t in enumerate(toks) if t in stops[rid]]
+            if hits:
+                hit += 1
+                assert hits[0] == len(toks) - 1, (
+                    f"rid {rid}: tokens emitted past the stop token"
+                )
+        assert hit >= 1              # the derived mix truncates someone
+        assert eng.free_pages() == [SCFG.n_pages]
+        assert eng.cached_pages == 0
+
+    def test_out_of_vocab_stop_token_rejected(self):
+        with pytest.raises(ValueError):
+            run_engine(reqs=[dataclasses.replace(
+                REQS[0], stop_tokens=(SCFG.vocab,)
+            )])
+
+
+class TestHostfreeCompose:
+    def test_spec_tiered_macro_all_composed(self):
+        # the full composition the clamp used to forbid twice over:
+        # draft + verify in the scan carry AND wave prefetch behind the
+        # running scan, still bit-identical to the plain T=1 engine
+        sreqs = stop_reqs()
+        ref = stop_ref()
+        _, rep = run_engine(reqs=sreqs, macro_steps=4, spec_k=3,
+                            kv_host_pages=4)
+        assert rep.outputs == ref.outputs
+        assert rep.dispatches < ref.dispatches
+
+    def test_engine_event_reports_full_T_and_no_clamp_reason(self, tmp_path):
+        # satellite 1: the serve/engine event's macro_steps_effective
+        # is the configured T and the stale clamp reasons ("spec_k",
+        # "kv_host_pages") never appear — the key is OMITTED, not None
+        from tpuscratch.obs.sink import Sink
+
+        path = str(tmp_path / "ev.jsonl")
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        # construction alone emits the event (jit programs compile
+        # lazily — no run needed, so the odd T=8 shape costs nothing)
+        scfg = dataclasses.replace(SCFG, macro_steps=8, spec_k=2,
+                                   kv_host_pages=4)
+        with Sink(path) as sink:
+            eng = ServeEngine(mesh, cfg, scfg, sink=sink)
+        events = [json.loads(l) for l in open(path)]
+        ev = next(e for e in events if e["event"] == "serve/engine")
+        assert ev["macro_steps_effective"] == 8
+        assert "macro_clamped_by" not in ev
+        assert eng.macro_steps_effective == 8
+        assert eng.macro_clamped_by is None
+        assert eng.metrics.gauge("serve/macro_steps").value == 8
+
+    def test_async_macro_bit_identical(self):
+        eng_s, r_s = run_engine(macro_steps=4)
+        eng_a, r_a = run_engine(macro_steps=4, async_macro=True)
+        assert r_a.outputs == r_s.outputs
+        assert r_a.dispatches == r_s.dispatches
+        assert r_a.host_syncs == r_s.host_syncs
+        assert eng_a.free_pages() == eng_s.free_pages()
+
+    def test_async_macro_single_stream_identity(self):
+        # the ex24/ex32 dispatch identity survives chaining: the async
+        # engine issues the same ceil(slot_steps / T) dispatches, just
+        # without a host sync between them
+        req = Request(rid=0, prompt=(1, 2, 3), max_new=10)
+        for T in (4, 16):
+            _, rep = run_engine(reqs=[req], macro_steps=T,
+                                async_macro=True)
+            assert rep.slot_steps == 9
+            assert rep.dispatches == math.ceil(9 / T)
+            assert rep.host_syncs == rep.dispatches
+
+    def test_spec_macro_with_share_and_chunk(self):
+        kw = dict(prefix_share=True, chunk_prefill=2, kv_dtype="int8")
+        _, r1 = run_engine(**kw)
+        _, r4 = run_engine(macro_steps=4, spec_k=3, **kw)
+        assert r4.outputs == r1.outputs
+        assert (r4.prefill_tokens, r4.shared_tokens) == (
+            r1.prefill_tokens, r1.shared_tokens
+        )
+
+    def test_spec_macro_under_router(self):
+        from tpuscratch.serve import FleetRouter, RouterConfig
+
+        cfg = cfg_for()
+        mesh = make_mesh((1, 1), ("dp", "sp"), jax.devices()[:1])
+        reqs = [Request(rid=i, prompt=(1 + i, 2, 3), max_new=5)
+                for i in range(4)]
+
+        def run(**kw):
+            reps = [ServeEngine(mesh, cfg,
+                                dataclasses.replace(SCFG, **kw))
+                    for _ in range(2)]
+            return FleetRouter(reps, RouterConfig(affinity=False)).run(reqs)
+
+        r1 = run()
+        rc = run(macro_steps=4, spec_k=3)
+        assert rc.outputs == r1.outputs
+        assert 0 < rc.dispatches < r1.dispatches
+
+    def test_stops_under_disagg_macro(self):
+        from tpuscratch.serve import DisaggEngine
+
+        cfg = cfg_for()
+        mesh = make_mesh((2, 2), ("dp", "sp"), jax.devices()[:4])
+        reqs = [Request(rid=i, prompt=(1 + i, 2), max_new=4)
+                for i in range(4)]
+
+        def run(reqs, T):
+            eng = DisaggEngine(mesh, cfg,
+                               dataclasses.replace(SCFG, macro_steps=T))
+            return eng.run(reqs)
+
+        base = dict(run(reqs, 1).outputs)
+        # stop each stream on its second token: truncation crosses the
+        # prefill->decode handoff and the macro scan alike
+        sreqs = [dataclasses.replace(r, stop_tokens=(base[r.rid][1],))
+                 for r in reqs]
+        want = {r.rid: base[r.rid][:base[r.rid].index(r.stop_tokens[0]) + 1]
+                for r in sreqs}
+        for T in (1, 4):
+            got = dict(run(sreqs, T).outputs)
+            assert got == want, f"T={T}"
+
+    def test_async_macro_with_stops_falls_back_identically(self):
+        # stop-token slots disable the chain (their early exit needs
+        # the sync) — the fallback must be invisible in outputs
+        sreqs = stop_reqs()
+        _, r_s = run_engine(reqs=sreqs, macro_steps=4)
+        _, r_a = run_engine(reqs=sreqs, macro_steps=4, async_macro=True)
+        assert r_a.outputs == r_s.outputs
+
+
+class TestDeviceSpecHelpers:
+    def test_propose_draft_batch_matches_host_rule(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from tpuscratch.serve.decode import (
+            propose_draft,
+            propose_draft_batch,
+        )
+
+        rng = np.random.default_rng(0)
+        B, S, k, ngram = 8, 24, 3, 2
+        hist = np.zeros((B, S), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for b in range(B):
+            n = int(rng.integers(1, S + 1))
+            # vocab 5: suffixes repeat often enough that full matches,
+            # partial matches, and no-match all occur across the bank
+            hist[b, :n] = rng.integers(0, 5, size=n)
+            lens[b] = n
+        drafts, dlen = propose_draft_batch(
+            jnp.asarray(hist), jnp.asarray(lens), k, ngram=ngram
+        )
+        for b in range(B):
+            want = propose_draft(tuple(hist[b, :lens[b]]), k, ngram=ngram)
+            got = tuple(int(t) for t in drafts[b, :int(dlen[b])])
+            assert got == want, f"slot {b}: {got} != host {want}"
+            assert all(int(t) == 0 for t in drafts[b, int(dlen[b]):])
+
+    @pytest.mark.parametrize("temperature,top_k", [(0.0, 0), (0.8, 0),
+                                                   (0.7, 3)])
+    def test_accept_batch_matches_host_rule(self, temperature, top_k):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from tpuscratch.serve.sampling import (
+            accept_batch,
+            accept_speculative,
+        )
+
+        rng = np.random.default_rng(1)
+        seed, B, K, V = 11, 6, 4, 16
+        logits = rng.normal(size=(B, K, V)).astype(np.float32)
+        drafts = rng.integers(0, V, size=(B, K - 1)).astype(np.int32)
+        dlen = np.array([0, 1, 2, 3, 3, 2], np.int32)
+        rids = np.arange(B, dtype=np.int32)
+        pos0 = rng.integers(0, 8, size=(B,)).astype(np.int32)
+        n_acc, term = accept_batch(
+            jax.random.key(seed), jnp.asarray(rids), jnp.asarray(pos0),
+            jnp.asarray(logits), jnp.asarray(drafts), jnp.asarray(dlen),
+            temperature=temperature, top_k=top_k,
+        )
+        for b in range(B):
+            dl = int(dlen[b])
+            a, toks = accept_speculative(
+                seed, int(rids[b]), int(pos0[b]),
+                logits[b, :dl + 1], tuple(drafts[b, :dl]),
+                temperature=temperature, top_k=top_k,
+            )
+            assert int(n_acc[b]) == a, f"slot {b}: accept count"
+            assert int(term[b]) == toks[-1], f"slot {b}: terminal token"
+
+
+class TestHostfreeRegressGate:
+    ROW_SPEC = {
+        "config": 21, "metric": "serve_decode_spec_macro",
+        "platform": "cpu", "value": 7.8e3,
+        "tokens_per_s_t1": 2.1e3, "tokens_per_s_t4": 7.8e3,
+        "dispatches_per_token_t1": 0.2963,
+        "dispatches_per_token_t4": 0.0625,
+        "host_syncs_per_token_t4": 0.0625,
+        "accept_len_mean_t4": 3.0,
+    }
+    ROW_TIER = {
+        "config": 21, "metric": "serve_decode_macro_tiered",
+        "platform": "cpu", "value": 7.0e3,
+        "tokens_per_s_t1": 2.2e3, "tokens_per_s_t4": 7.0e3,
+        "dispatches_per_token_t1": 0.25,
+        "dispatches_per_token_t4": 0.0625,
+        "host_syncs_per_token_t4": 0.0625,
+    }
+
+    def test_directions_and_floors_registered(self):
+        for m in ("serve_decode_spec_macro", "serve_decode_macro_tiered"):
+            assert regress.direction(m) == "higher"
+            assert regress.noise_floor(m, "cpu") > 0
+            assert regress.noise_floor(m, "tpu") == 0
+        for f in ("dispatches_per_token_t1", "dispatches_per_token_t4",
+                  "host_syncs_per_token_t4"):
+            assert regress.direction(f) == "lower"
+            # static counters keep the TIGHT band (no floor)
+            assert regress.noise_floor(f, "cpu") == 0
+        assert regress.direction("accept_len_mean_t4") == "higher"
+        assert regress.direction("tokens_per_s_t4") == "higher"
+        assert regress.noise_floor("tokens_per_s_t4", "cpu") > 0
+
+    def test_clean_pair_passes_injected_fails(self):
+        rows = [dict(self.ROW_SPEC), dict(self.ROW_TIER)]
+        base = regress.index_rows([dict(r) for r in rows])
+        clean = regress.compare(
+            base, regress.index_rows([dict(r) for r in rows]), noise=0.05
+        )
+        assert not regress.has_regression(clean)
+
+        # the clamp coming back reads as dispatches/token at ~T=1
+        # levels: a static field, tight band, regresses immediately
+        bad = [dict(self.ROW_SPEC, dispatches_per_token_t4=0.2963),
+               dict(self.ROW_TIER)]
+        findings = regress.compare(base, regress.index_rows(bad),
+                                   noise=0.05)
+        assert regress.has_regression(findings)
+        names = {f.field for f in findings if f.status == "regressed"}
+        assert "dispatches_per_token_t4" in names
+
+        # accepted length collapsing (device proposer broken) regresses
+        worse = [dict(self.ROW_SPEC, accept_len_mean_t4=0.2),
+                 dict(self.ROW_TIER)]
+        assert regress.has_regression(
+            regress.compare(base, regress.index_rows(worse), noise=0.05)
+        )
+
+    def test_pre_pr_artifact_reports_added_only(self):
+        # --check against an artifact recorded BEFORE this PR: the two
+        # config-21 rows have no baseline — every finding they produce
+        # must be status "added" (informational), never a failure
+        pre = [{
+            "config": 12, "metric": "serve_decode_macro",
+            "platform": "cpu", "value": 1.5e4,
+            "tokens_per_s_t1": 1.2e3, "tokens_per_s_t16": 1.5e4,
+            "dispatches_per_token_t16": 0.0625,
+        }]
+        cur = [dict(r) for r in pre] + [dict(self.ROW_SPEC),
+                                        dict(self.ROW_TIER)]
+        findings = regress.compare(regress.index_rows(pre),
+                                   regress.index_rows(cur), noise=0.05)
+        assert not regress.has_regression(findings)
+        new = [f for f in findings
+               if f.metric in ("serve_decode_spec_macro",
+                               "serve_decode_macro_tiered")]
+        assert new and all(f.status == "added" for f in new)
+
+    def test_cli_subprocess_proof(self, tmp_path):
+        """The config-21 gate as a subprocess: a clean pair exits 0, an
+        injected dispatches-per-token regression exits 1."""
+
+        def write(name, rows):
+            p = str(tmp_path / name)
+            with open(p, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+            return p
+
+        base = write("base.json", [self.ROW_SPEC, self.ROW_TIER])
+        good = write("good.json", [
+            dict(self.ROW_SPEC, value=8.0e3, tokens_per_s_t4=8.0e3),
+            dict(self.ROW_TIER),
+        ])
+        bad = write("bad.json", [
+            dict(self.ROW_SPEC),
+            dict(self.ROW_TIER, dispatches_per_token_t4=0.25),
+        ])
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, good],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, bad],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "REGRESSED" in r.stdout

@@ -16,10 +16,13 @@ The correctness anchors:
 - **dispatch accounting**: ``GenerateReport.dispatches``/``host_syncs``
   drop ~T× at fixed token count, with the single-stream identity
   ``dispatches == ceil(slot_steps / macro_steps)`` exact;
-- **clamping**: speculative decode and tiered KV need per-token host
-  decisions and clamp the effective T to 1 — documented and
-  ledger-visible (``macro_steps_effective``/``macro_clamped_by``),
-  never a silent degrade;
+- **no clamping** (ISSUE 19 lift): speculative decode rides the scan
+  carry (device propose/verify/accept) and the tiered wave prefetch
+  overlaps the running scan, so ``spec_k > 0`` and
+  ``kv_host_pages > 0`` compose with ``macro_steps > 1`` at full T —
+  ``macro_steps_effective`` reports the configured T and
+  ``macro_clamped_by`` is always ``None`` (the stale ``"spec_k"`` /
+  ``"kv_host_pages"`` reasons must never reappear);
 - **one compiled sweep, reused**: the scan program's optimized HLO
   carries ONE copy of the sweep's collective pattern regardless of T
   (``obs.ledger`` instruction counts equal at T=4 and T=16), and
@@ -301,23 +304,27 @@ class TestDispatchAccounting:
         assert eng4.decode_rounds == 9       # same rounds, fewer dispatches
         assert eng4.dispatches == 3
 
-    def test_clamped_under_spec_and_tier(self):
-        # per-token host decisions (drafting, wave staging) clamp T to
-        # 1 — visible, not silent — and outputs match the unclamped
-        # spelling of the same config
-        eng_s, rep_s = run_engine(macro_steps=8, spec_k=2)
-        assert eng_s.macro_steps_effective == 1
-        assert eng_s.macro_clamped_by == "spec_k"
-        _, base_s = run_engine(spec_k=2)
+    def test_no_clamp_under_spec_and_tier(self):
+        # ISSUE 19: drafting moved into the scan carry and wave staging
+        # overlaps the running scan, so neither spec_k nor
+        # kv_host_pages clamps the macro width any more — the effective
+        # T is the configured T, the clamp reason is gone, and the
+        # composed outputs still match the T=1 spelling bit-for-bit
+        eng_s, rep_s = run_engine(macro_steps=4, spec_k=3)
+        assert eng_s.macro_steps_effective == 4
+        assert eng_s.macro_clamped_by is None
+        _, base_s = run_engine(spec_k=3)
         assert rep_s.outputs == base_s.outputs
+        assert rep_s.dispatches < base_s.dispatches
 
-        eng_t, rep_t = run_engine(macro_steps=8, kv_host_pages=4)
-        assert eng_t.macro_steps_effective == 1
-        assert eng_t.macro_clamped_by == "kv_host_pages"
+        eng_t, rep_t = run_engine(macro_steps=4, kv_host_pages=4)
+        assert eng_t.macro_steps_effective == 4
+        assert eng_t.macro_clamped_by is None
         _, base_t = run_engine(kv_host_pages=4)
         assert rep_t.outputs == base_t.outputs
-        # the clamp is ledger-visible: the gauge carries the effective T
-        assert eng_t.metrics.gauge("serve/macro_steps").value == 1
+        assert rep_t.dispatches < base_t.dispatches
+        # ledger-visible: the gauge carries the FULL configured T
+        assert eng_t.metrics.gauge("serve/macro_steps").value == 4
 
     def test_macro_steps_validation(self):
         cfg = cfg_for()
@@ -367,7 +374,11 @@ class TestMacroPrograms:
         i32 = lambda *s: jnp.zeros(s, jnp.int32)  # noqa: E731
         args = (params, kv, embed, kd,
                 i32(n, SCFG.max_pages), i32(n), i32(n), i32(n),
-                i32(n), i32(n))
+                i32(n), i32(n),
+                # ISSUE 19 carry: stop-token mask + in-carry
+                # stopped/emitted state (the host-free EOS path)
+                jnp.zeros((n, SCFG.vocab), bool), jnp.zeros((n,), bool),
+                i32(n))
 
         counts = {}
         for T in (4, 16):

@@ -563,13 +563,15 @@ def bench_budget(scfg, tokens_per_tick: int | None = None,
     — ONE definition (the +2 teardown margin and the tick ceiling),
     shared by the bench itself and every caller that must pre-check
     the page reservation.  ``tokens_per_tick`` defaults to the
-    config's own ceiling: max(spec_k + 1, CLAMP-AWARE macro_steps) —
-    the engine's ``serve.engine.macro_clamp`` rule, so a spec/tiered
-    config is never budgeted for a macro width it won't run."""
+    config's own ceiling: (spec_k + 1) × the engine's effective macro
+    width (``serve.engine.macro_clamp`` — the one shared rule; nothing
+    clamps since the host-free lift, so a COMPOSED spec × macro tick
+    can emit up to T·(spec_k+1) tokens per slot and the budget scales
+    by the product)."""
     if tokens_per_tick is None:
         from tpuscratch.serve.engine import macro_clamp
 
-        tokens_per_tick = max(scfg.spec_k + 1, macro_clamp(scfg)[0])
+        tokens_per_tick = (scfg.spec_k + 1) * macro_clamp(scfg)[0]
     return (warmup_steps + measure_steps + 2) * tokens_per_tick
 
 
@@ -649,6 +651,7 @@ def bench_decode(
     tokens0, slots0 = engine.tokens_generated, engine.slot_steps
     accepted0 = engine.spec_accepted
     disp0, sync0 = engine.dispatches, engine.host_syncs
+    rounds0 = engine.decode_rounds
     page_bytes = engine.scfg.page_size * engine.kv_bytes_per_token
     times, tick_tokens = [], []
     swept_bytes = 0.0
@@ -686,6 +689,43 @@ def bench_decode(
         )
     tokens = engine.tokens_generated - tokens0
     sweeps = engine.slot_steps - slots0
+    # the LIVE dispatch identities (ISSUE 19): the measured window is
+    # steady-state (every slot alive throughout — the warmup/teardown
+    # margins guarantee it), so the accounting laws hold EXACTLY and a
+    # bench row can never report a dispatch rate the engine didn't run
+    disp_d = engine.dispatches - disp0
+    sync_d = engine.host_syncs - sync0
+    rounds_d = engine.decode_rounds - rounds0
+    T = engine.macro_steps_effective
+    if sync_d != disp_d:
+        raise RuntimeError(
+            f"host_syncs delta {sync_d} != dispatches delta {disp_d}"
+        )
+    if rounds_d > disp_d * T:
+        raise RuntimeError(
+            f"{rounds_d} token rounds from {disp_d} dispatches at "
+            f"T={T} — a dispatch covered more rounds than its scan"
+        )
+    if scfg.kv_host_pages <= 0:
+        # untiered: one wave per tick and every round active mid-stream,
+        # so the identities are exact — dispatches == ceil(slot_steps /
+        # (T * bank)), the composed-path acceptance law (under spec the
+        # bank's sweeps per round replace raw tokens: tokens == sweeps
+        # + accepted varies with the accept rate, sweeps do not)
+        if rounds_d != disp_d * T:
+            raise RuntimeError(
+                f"rounds delta {rounds_d} != dispatches {disp_d} * T={T}"
+            )
+        if sweeps != rounds_d * scfg.n_slots:
+            raise RuntimeError(
+                f"slot_steps delta {sweeps} != rounds {rounds_d} * "
+                f"bank {scfg.n_slots}"
+            )
+        if disp_d != -(-sweeps // (T * scfg.n_slots)):
+            raise RuntimeError(
+                f"dispatches {disp_d} != ceil(slot_steps {sweeps} / "
+                f"(T={T} * bank {scfg.n_slots}))"
+            )
     accept_mean = (
         (engine.spec_accepted - accepted0) / sweeps
         if scfg.spec_k > 0 and sweeps else None
@@ -824,14 +864,15 @@ def main(argv=None) -> int:
                          "periodic prompt so the amortization regime "
                          "is what gets measured")
     ap.add_argument("--macro", type=int, default=1, metavar="T",
-                    help="device-resident macro-step decode: tokens "
-                         "per engine dispatch (1 = the per-token "
-                         "legacy program; T > 1 fuses T ticks into "
-                         "one compiled lax.scan — one dispatch + one "
-                         "host sync per T tokens, bit-identical "
-                         "greedy output; clamped to 1 under --spec / "
-                         "--kv-host-pages, which need per-token host "
-                         "decisions)")
+                    help="device-resident macro-step decode: token "
+                         "rounds per engine dispatch (1 = the "
+                         "per-token legacy program; T > 1 fuses T "
+                         "ticks into one compiled lax.scan — one "
+                         "dispatch + one host sync per T rounds, "
+                         "bit-identical greedy output; composes with "
+                         "--spec — up to T*(K+1) tokens per dispatch "
+                         "— and with --kv-host-pages, whose wave "
+                         "prefetch overlaps the running scan)")
     ap.add_argument("--share-ratio", default=None, metavar="R[,R...]",
                     help="run the PREFIX-SHARING stream workload at "
                          "these prompt share ratios (comma-separated, "
@@ -1025,10 +1066,12 @@ def main(argv=None) -> int:
             kwargs.pop("prompt_len", 8), scfg.vocab
         )
         # a speculative slot's budget (hence page reservation) scales by
-        # spec + 1; drop sweep points whose full bank cannot fit the
-        # pool — the admission watermark would (correctly) refuse them
+        # spec + 1 — times the macro width when composed (bench_budget's
+        # own product rule); drop sweep points whose full bank cannot
+        # fit the pool — the admission watermark would (correctly)
+        # refuse them
         need, fitting = fitting_batches(
-            scfg, batches, args.spec + 1,
+            scfg, batches,
             prompt_len=len(kwargs["prompt"]),
             measure_steps=kwargs.get("measure_steps", 32),
             warmup_steps=kwargs.get("warmup_steps", 4),
@@ -1044,11 +1087,12 @@ def main(argv=None) -> int:
                 "window"
             )
         batches = fitting
-    if args.macro > 1 and not args.spec and args.kv_host_pages <= 0:
+    if args.macro > 1 and not args.spec:
         # a macro slot's budget (hence page reservation) scales by T —
-        # the speculative fitting rule, clamp-aware through
-        # fitting_batches (under --spec / --kv-host-pages the engine
-        # runs T=1 and the spec block above already sized the bank)
+        # the speculative fitting rule, through fitting_batches (under
+        # --spec the block above already sized the composed bank;
+        # --kv-host-pages composes too since the host-free lift, same
+        # T-scaled reservation)
         need, fitting = fitting_batches(
             scfg, batches,
             prompt_len=kwargs.get("prompt_len", 8),

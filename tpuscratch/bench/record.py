@@ -2378,6 +2378,144 @@ def config20_overload(out: list) -> None:
     )
 
 
+def config21_hostfree(out: list) -> None:
+    """Host-free decode (ISSUE 19): the two compositions the old macro
+    clamp forbade, each measured at macro_steps T=1 vs T=4 on the SAME
+    workload and batch.
+
+    ``serve_decode_spec_macro``: speculative decoding (spec_k drafts,
+    accept-friendly periodic prompt) INSIDE the macro scan — the
+    in-carry propose/verify/accept path, up to T*(spec_k+1) token
+    rounds per dispatch.  ``serve_decode_macro_tiered``: a
+    host-offloaded KV tier (kv_host_pages) under the macro scan — the
+    next wave's prefetch is issued behind the running scan instead of
+    clamping it to T=1.
+
+    Each row's dispatches/token and host-syncs/token are EXACT engine
+    counters over exact token counts (static, tight regression band);
+    tokens/s is the measured wall-clock (median-of-3; CPU-proxy noise
+    floors apply off-TPU — the PR-14 discipline).  The direction claim
+    of the ISSUE — composed T=4 dispatches/token STRICTLY below the
+    T=1 baseline's — is asserted here (RuntimeError), not just left to
+    ``--check``: a rebuilt clamp cannot produce a quietly-flat row.
+    Greedy bit-identity of the composed paths to the T=1 engine is
+    test-gated (tests/test_serve_hostfree.py), not re-proven here."""
+    import dataclasses as _dc
+
+    import jax
+
+    from tpuscratch.bench.decode_bench import (
+        accept_friendly_prompt,
+        bench_decode,
+        default_decode_setup,
+        fitting_batches,
+    )
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, batches, kwargs = default_decode_setup(on_tpu)
+    fit_kw = dict(
+        prompt_len=kwargs.get("prompt_len", 8),
+        measure_steps=kwargs.get("measure_steps", 32),
+        warmup_steps=kwargs.get("warmup_steps", 4),
+    )
+
+    # --- spec x macro: one batch that fits the COMPOSED T=4 page
+    # reservation (bench_budget's (spec_k+1)*T product rule via
+    # fitting_batches — the one shared sizing arithmetic), same batch
+    # at T=1 so the comparison is apples-to-apples
+    spec_k = 4 if on_tpu else 3
+    spec4 = _dc.replace(scfg, spec_k=spec_k, macro_steps=4)
+    _, _fit = fitting_batches(spec4, batches, **fit_kw)
+    batch = max(_fit or (1,))
+    prompt = accept_friendly_prompt(kwargs.get("prompt_len", 8),
+                                    scfg.vocab)
+    kw = {k: v for k, v in kwargs.items() if k != "prompt_len"}
+    srows = {}
+    for T in (1, 4):
+        srows[T] = _median_run(
+            lambda T=T: bench_decode(
+                mesh, cfg,
+                _dc.replace(spec4, n_slots=batch, macro_steps=T),
+                prompt=prompt, **kw,
+            ),
+            key=lambda r: r.tokens_per_s,
+        )
+        print(f"# spec{spec_k} x macro T={T}: {srows[T].summary()}",
+              file=sys.stderr)
+    s1, s4 = srows[1], srows[4]
+    if not s4.dispatches_per_token < s1.dispatches_per_token:
+        raise RuntimeError(
+            "spec x macro dispatches/token did not drop: "
+            f"T=4 {s4.dispatches_per_token:.4f} vs "
+            f"T=1 {s1.dispatches_per_token:.4f} — the macro clamp "
+            "is back (ISSUE 19 lift regressed)"
+        )
+    _emit(
+        out,
+        config=21,
+        metric="serve_decode_spec_macro",
+        value=s4.tokens_per_s,
+        tokens_per_s_t1=s1.tokens_per_s,
+        tokens_per_s_t4=s4.tokens_per_s,
+        dispatches_per_token_t1=s1.dispatches_per_token,
+        dispatches_per_token_t4=s4.dispatches_per_token,
+        host_syncs_per_token_t4=s4.host_syncs_per_token,
+        accept_len_mean_t4=s4.accept_len_mean,
+        detail=(
+            f"spec_k={spec_k} x T=4: {s4.tokens_per_s:.3e} tok/s, "
+            f"dispatches/token {s1.dispatches_per_token:.4f} -> "
+            f"{s4.dispatches_per_token:.4f}, accept len "
+            f"{s4.accept_len_mean:.2f}/{spec_k}"
+        ),
+    )
+
+    # --- tiered x macro: host tier as deep as the device pool; the
+    # batch fits the T=4 DEVICE reservation (the host tier extends
+    # capacity, not the admission watermark)
+    tier4 = _dc.replace(scfg, kv_host_pages=scfg.n_pages, macro_steps=4)
+    _, _fit_t = fitting_batches(tier4, batches, **fit_kw)
+    tbatch = max(_fit_t or (1,))
+    trows = {}
+    for T in (1, 4):
+        trows[T] = _median_run(
+            lambda T=T: bench_decode(
+                mesh, cfg,
+                _dc.replace(tier4, n_slots=tbatch, macro_steps=T),
+                **kwargs,
+            ),
+            key=lambda r: r.tokens_per_s,
+        )
+        print(f"# tiered x macro T={T}: {trows[T].summary()}",
+              file=sys.stderr)
+    t1, t4 = trows[1], trows[4]
+    if not t4.dispatches_per_token < t1.dispatches_per_token:
+        raise RuntimeError(
+            "tiered x macro dispatches/token did not drop: "
+            f"T=4 {t4.dispatches_per_token:.4f} vs "
+            f"T=1 {t1.dispatches_per_token:.4f} — the macro clamp "
+            "is back (ISSUE 19 lift regressed)"
+        )
+    _emit(
+        out,
+        config=21,
+        metric="serve_decode_macro_tiered",
+        value=t4.tokens_per_s,
+        tokens_per_s_t1=t1.tokens_per_s,
+        tokens_per_s_t4=t4.tokens_per_s,
+        dispatches_per_token_t1=t1.dispatches_per_token,
+        dispatches_per_token_t4=t4.dispatches_per_token,
+        host_syncs_per_token_t4=t4.host_syncs_per_token,
+        detail=(
+            f"kv_host_pages={tier4.kv_host_pages} x T=4: "
+            f"{t4.tokens_per_s:.3e} tok/s, dispatches/token "
+            f"{t1.dispatches_per_token:.4f} -> "
+            f"{t4.dispatches_per_token:.4f}"
+        ),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -2399,6 +2537,7 @@ CONFIGS = {
     18: config18_cosched,
     19: config19_traffic_chaos,
     20: config20_overload,
+    21: config21_hostfree,
 }
 
 
@@ -2406,7 +2545,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
                     default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                            "19,20")
+                            "19,20,21")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

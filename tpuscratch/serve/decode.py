@@ -72,7 +72,11 @@ from tpuscratch.serve.kvcache import (
     kv_cache_spec,
     quantize_pages,
 )
-from tpuscratch.serve.sampling import request_keys, sample_batch
+from tpuscratch.serve.sampling import (
+    accept_batch,
+    request_keys,
+    sample_batch,
+)
 
 
 # promoted to the observability subsystem (recompile detection is not a
@@ -362,8 +366,9 @@ def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
     sweep itself is cheap).
 
     (params, kv, embed, key_data, tables, n_cached, rids, positions,
-    budgets, last_tok) -> ((T, B_loc) tokens, (T, B_loc) active mask,
-    kv').
+    budgets, last_tok, stop_mask, stopped, emitted) ->
+    ((T, B_loc) tokens, (T, B_loc) active mask, kv', n_cached',
+    positions', last_tok', emitted', stopped').
 
     Local shapes: tables (B_loc, max_pages) — each slot's FULL page
     list (prompt + reserved budget tail; the write frontier advances
@@ -373,14 +378,25 @@ def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
     positions advanced in-carry so draw ``i`` of a request is keyed
     identically to the per-token engine's; budgets (B_loc,) tokens this
     slot may still emit; last_tok (B_loc,) each slot's current token.
-    embed (V, d) and key_data (the engine seed key's
-    ``jax.random.key_data``) are replicated.
+    stop_mask (B_loc, V) bool — True at each slot's stop-token ids
+    (all-False rows for slots without stop tokens); stopped/emitted
+    (B_loc,) — the in-carry finish flag and tokens-already-emitted
+    count, passed IN (rather than zero-initialized) so the async macro
+    tick can chain one scan's final carry straight into the next
+    dispatch without a host round trip.  embed (V, d) and key_data (the
+    engine seed key's ``jax.random.key_data``) are replicated.
 
     Scan-step semantics are EXACTLY one legacy engine tick, so greedy
     output is bit-identical across macro_steps:
 
-    - a slot is ACTIVE while ``n_cached > 0`` and it has budget left;
-      a slot whose budget ends mid-scan flips to the legacy IDLE
+    - a slot is ACTIVE while ``n_cached > 0``, it has budget left, and
+      it has not emitted a stop token (the device-side EOS check, ISSUE
+      19: a sampled token hitting the slot's ``stop_mask`` row sets the
+      carried ``stopped`` flag AFTER the stop token itself is emitted,
+      so the stop token appears in the output exactly as the host-side
+      path records it and every later iteration sees the slot idle);
+      a slot whose budget or stop token ends it mid-scan flips to the
+      legacy IDLE
       contract for the remaining iterations — zero input vector,
       ``seq_len == 0`` (attention returns zeros, the MoE idle-last
       permutation sorts it out of capacity competition), sentinel
@@ -409,13 +425,13 @@ def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
     page_size, n_pages = geom.page_size, geom.n_pages
 
     def loop(params, kv, embed, key_data, tables, n_cached, rids,
-             positions, budgets, last_tok):
+             positions, budgets, last_tok, stop_mask, stopped, emitted):
         key = jax.random.wrap_key_data(key_data)
         B = tables.shape[0]
 
         def body(carry, _):
-            kv, n_cached, positions, last_tok, emitted = carry
-            active = (n_cached > 0) & (emitted < budgets)
+            kv, n_cached, positions, last_tok, emitted, stopped = carry
+            active = (n_cached > 0) & (emitted < budgets) & ~stopped
             # replicated early-exit predicate: every rank must agree
             # (the MoE FFN reduces over dp, attention output over sp)
             any_active = lax.psum(
@@ -423,7 +439,7 @@ def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
             ) > 0
 
             def tick(ops):
-                kv, n_cached, positions, last_tok, emitted = ops
+                kv, n_cached, positions, last_tok, emitted, stopped = ops
                 act_i = active.astype(n_cached.dtype)
                 x = jnp.where(active[:, None], embed[last_tok], 0.0)
                 seq = jnp.where(active, n_cached + 1, 0)
@@ -445,9 +461,17 @@ def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
                 toks = sample_batch(keys, logits, temperature=temperature,
                                     top_k=top_k)
                 toks = jnp.where(active, toks, 0)
+                # device-side EOS: the stop token itself is emitted
+                # (this iteration's toks/mask carry it), the flag idles
+                # the slot from the NEXT iteration on
+                hit = jnp.take_along_axis(
+                    stop_mask, toks[:, None], axis=1
+                )[:, 0]
+                stopped = stopped | (active & hit)
                 return (
                     (kv, n_cached + act_i, positions + act_i,
-                     jnp.where(active, toks, last_tok), emitted + act_i),
+                     jnp.where(active, toks, last_tok), emitted + act_i,
+                     stopped),
                     toks,
                 )
 
@@ -456,16 +480,15 @@ def decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
 
             carry, toks = lax.cond(
                 any_active, tick, skip,
-                (kv, n_cached, positions, last_tok, emitted),
+                (kv, n_cached, positions, last_tok, emitted, stopped),
             )
             return carry, (toks, active)
 
-        init = (kv, n_cached, positions, last_tok,
-                jnp.zeros_like(budgets))
-        (kv, *_), (toks, mask) = lax.scan(
-            body, init, None, length=macro_steps
-        )
-        return toks, mask, kv
+        init = (kv, n_cached, positions, last_tok, emitted, stopped)
+        (kv, n_cached, positions, last_tok, emitted, stopped), \
+            (toks, mask) = lax.scan(body, init, None, length=macro_steps)
+        return (toks, mask, kv, n_cached, positions, last_tok, emitted,
+                stopped)
 
     return loop
 
@@ -478,11 +501,15 @@ def build_decode_loop(mesh: Mesh, cfg: TransformerConfig,
                       quantized: bool = False, fused: bool | None = None):
     """Compiled device-resident macro-step decode over ``mesh``: jit'd
     fn(params, kv, embed, key_data, tables (B, max_pages), n_cached,
-    rids, positions, budgets, last_tok — all (B,) int32) ->
-    (tokens (T, B), active_mask (T, B), kv'), slots sharded P(dp),
-    embed/key replicated, cache donated.  ONE dispatch and ONE
-    host-sync per ``macro_steps`` generated tokens; the engine holds B
-    fixed at its slot count and T fixed at construction, so
+    rids, positions, budgets, last_tok — (B,) int32 — stop_mask (B, V)
+    bool, stopped (B,) bool, emitted (B,) int32) ->
+    (tokens (T, B), active_mask (T, B), kv', n_cached', positions',
+    last_tok', emitted', stopped'), slots sharded P(dp), embed/key
+    replicated, cache donated.  ONE dispatch and ONE host-sync per
+    ``macro_steps`` generated tokens; the final slot-state carry comes
+    BACK as device arrays, so the async macro tick can dispatch the
+    next scan on it without syncing first (ISSUE 19).  The engine holds
+    B fixed at its slot count and T fixed at construction, so
     steady-state macro decode never recompiles (``counter`` proves
     it).  See :func:`decode_loop_fn` for the per-iteration contract
     and the bit-identity argument."""
@@ -499,8 +526,10 @@ def build_decode_loop(mesh: Mesh, cfg: TransformerConfig,
     return run_spmd(
         mesh,
         body,
-        (pspec, kspec, P(), P(), P(dp), P(dp), P(dp), P(dp), P(dp), P(dp)),
-        (P(None, dp), P(None, dp), kspec),
+        (pspec, kspec, P(), P(), P(dp), P(dp), P(dp), P(dp), P(dp), P(dp),
+         P(dp), P(dp), P(dp)),
+        (P(None, dp), P(None, dp), kspec, P(dp), P(dp), P(dp), P(dp),
+         P(dp)),
         donate_argnums=(1,),
     )
 
@@ -542,6 +571,62 @@ def propose_draft(context: Sequence[int], k: int,
             if not partial:
                 partial = cont
     return partial
+
+
+def propose_draft_batch(hist: jax.Array, ctx_len: jax.Array, k: int,
+                        ngram: int = 2) -> tuple[jax.Array, jax.Array]:
+    """Device-resident :func:`propose_draft` for a whole slot bank: the
+    suffix-ngram lookup as a batched gather over each slot's
+    device-resident token history, so draft proposal can live INSIDE
+    the macro scan carry (ISSUE 19) instead of forcing a host sync per
+    speculation round.
+
+    ``hist`` (B, S) int32 — each slot's prompt + generated tokens so
+    far, zero-padded past ``ctx_len``; ``ctx_len`` (B,) — live history
+    length per slot.  Returns ``(drafts (B, k) int32, draft_len (B,))``
+    with tokens past each slot's draft length zeroed.
+
+    Equivalence to the host proposer's most-recent-match descent,
+    position by position: a candidate start ``i`` matches iff
+    ``hist[i:i+ngram]`` equals the final ``ngram`` tokens, restricted
+    to ``i <= n - ngram - 1`` (the host loop's range); the LARGEST
+    matching ``i`` whose continuation is a full ``k`` tokens
+    (``i <= n - ngram - k``) wins, else the largest matching ``i``
+    with its truncated continuation — exactly the host rule that the
+    first full match found during the high-to-low descent beats every
+    partial, and the first partial is the highest-index match.  The
+    comparison window reads from a ``-1``-padded copy of the history so
+    out-of-range positions can never equal a real (non-negative) token
+    id."""
+    if k < 1 or ngram < 1:
+        raise ValueError(f"need k >= 1 and ngram >= 1, got {k}, {ngram}")
+    B, S = hist.shape
+    pad = jnp.full((B, ngram + k), -1, hist.dtype)
+    hist_pad = jnp.concatenate([hist, pad], axis=1)
+    idx = jnp.arange(S)[None, :]
+    n = ctx_len[:, None]
+    match = jnp.ones((B, S), bool)
+    for j in range(ngram):
+        suffix_j = jnp.take_along_axis(
+            hist, jnp.clip(n - ngram + j, 0, S - 1), axis=1
+        )
+        match = match & (hist_pad[:, j:j + S] == suffix_j)
+    cand = match & (idx <= n - ngram - 1)
+    full = cand & (idx <= n - ngram - k)
+    i_part = jnp.max(jnp.where(cand, idx, -1), axis=1)
+    i_full = jnp.max(jnp.where(full, idx, -1), axis=1)
+    i0 = jnp.where(i_full >= 0, i_full, i_part)
+    dlen = jnp.where(
+        i_full >= 0, k,
+        jnp.where(i_part >= 0, ctx_len - i_part - ngram, 0),
+    )
+    dlen = jnp.where((ctx_len >= ngram + 1) & (i0 >= 0), dlen, 0)
+    gat = i0[:, None] + ngram + jnp.arange(k)[None, :]
+    drafts = jnp.take_along_axis(
+        hist_pad, jnp.clip(gat, 0, S + ngram + k - 1), axis=1
+    )
+    drafts = jnp.where(jnp.arange(k)[None, :] < dlen[:, None], drafts, 0)
+    return drafts.astype(jnp.int32), dlen.astype(jnp.int32)
 
 
 def verify_step_fn(cfg: TransformerConfig, n_draft: int, sp: str = "sp",
@@ -649,6 +734,219 @@ def build_verify_step(mesh: Mesh, cfg: TransformerConfig,
         body,
         (pspec, kspec, P(dp), P(dp), P(dp), P(dp), P(dp)),
         (P(dp), kspec),
+        donate_argnums=(1,),
+    )
+
+
+def spec_decode_loop_fn(cfg: TransformerConfig, geom: CacheGeometry,
+                        macro_steps: int, spec_k: int,
+                        temperature: float = 0.0, top_k: int = 0,
+                        ngram: int = 2, sp: str = "sp", dp: str = "dp",
+                        quantized: bool = False, fused: bool | None = None):
+    """The SPECULATIVE macro-step shard_map body (ISSUE 19): T whole
+    speculation rounds — suffix-ngram draft proposal
+    (:func:`propose_draft_batch`), the K-position verify forward
+    (:func:`verify_step_fn`'s program), Leviathan accept/resample
+    (``serve.sampling.accept_batch``), KV/frontier/history advance —
+    fused into ONE ``lax.scan``, so ``spec_k > 0`` COMPOSES with
+    ``macro_steps > 1`` instead of clamping it: one dispatch covers up
+    to ``T * (spec_k + 1)`` token rounds.
+
+    (params, kv, embed, key_data, tables, n_cached, rids, positions,
+    budgets, last_tok, hist, stop_mask, stopped) ->
+    ((T, B_loc, K) tokens, (T, B_loc) n_emit, (T, B_loc) draft_len,
+    kv') with ``K = spec_k + 1``.
+
+    Local shapes follow :func:`decode_loop_fn` plus: hist (B_loc, S) —
+    each slot's prompt + generated token history (the proposer's
+    gather window, length ``n_cached + 1`` live entries including the
+    current token), extended in-carry as tokens are accepted;
+    stop_mask (B_loc, V) / stopped (B_loc,) — the device-side EOS
+    state.  Row ``r`` of the outputs is round ``r``: the slot emitted
+    ``n_emit[r, s]`` tokens (``tokens[r, s, :n_emit[r, s]]`` — the
+    accepted draft prefix plus the terminal token, truncated at a stop
+    hit) after proposing ``draft_len[r, s]`` draft tokens.
+
+    Round semantics are EXACTLY one legacy ``_spec_sweep`` tick, so
+    greedy output is bit-identical across macro_steps:
+
+    - the draft is clamped to ``remaining_budget - 1`` (the sweep can
+      emit at most ``draft_len + 1``, never past the budget) and to
+      the host proposer's gating;
+    - position 0 scores the slot's current token, positions 1..dlen
+      its draft; beyond-draft positions carry zero vectors and the
+      write sentinel (the verify step's padding contract);
+    - acceptance draws key off the SAME
+      ``fold_in(request_key, _SUB_ACCEPT/_SUB_RESAMPLE)`` chains as
+      the host rule, with ``position0 = positions`` (the
+      generated-stream index of the round's first emitted token);
+      greedy is pure argmax — the bit-pinned contract;
+    - a stop token anywhere in the emitted run truncates it there
+      (``n_emit`` shrinks to include the stop token) and idles the
+      slot — the device-side EOS rule;
+    - rejected-draft and post-stop KV entries follow the legacy
+      verify-step garbage contract: length-masked now, overwritten by
+      the next round's K fresh writes at the accepted frontier.
+
+    The same replicated early-exit psum as the plain loop skips
+    all-done iterations.  ``emitted`` is zero-initialized here (the
+    spec path never async-chains: its per-round token count is
+    data-dependent, so the host must read ``n_emit`` before it can
+    know completion)."""
+    if macro_steps < 1:
+        raise ValueError(f"macro_steps must be >= 1, got {macro_steps}")
+    if spec_k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    K = spec_k + 1
+    step = verify_step_fn(cfg, spec_k, sp=sp, dp=dp, quantized=quantized,
+                          fused=fused)
+    page_size, n_pages = geom.page_size, geom.n_pages
+
+    def loop(params, kv, embed, key_data, tables, n_cached, rids,
+             positions, budgets, last_tok, hist, stop_mask, stopped):
+        key = jax.random.wrap_key_data(key_data)
+        B = tables.shape[0]
+        S = hist.shape[1]
+        jpos = jnp.arange(K)[None, :]
+
+        def body(carry, _):
+            kv, hist, n_cached, positions, last_tok, emitted, stopped = carry
+            active = (n_cached > 0) & (emitted < budgets) & ~stopped
+            any_active = lax.psum(
+                jnp.any(active).astype(jnp.int32), (dp, sp)
+            ) > 0
+
+            def tick(ops):
+                kv, hist, n_cached, positions, last_tok, emitted, \
+                    stopped = ops
+                ctx_len = n_cached + 1
+                drafts, dlen = propose_draft_batch(
+                    hist, ctx_len, spec_k, ngram
+                )
+                # the sweep emits n_acc + 1 <= dlen + 1 tokens: clamp
+                # the draft so a slot can never overrun its budget
+                remaining = budgets - emitted
+                dlen = jnp.minimum(dlen, remaining - 1)
+                dlen = jnp.where(active, jnp.maximum(dlen, 0), 0)
+                drafts = jnp.where(
+                    jnp.arange(spec_k)[None, :] < dlen[:, None], drafts, 0
+                )
+                toks_in = jnp.concatenate(
+                    [last_tok[:, None], drafts], axis=1
+                )
+                live = active[:, None] & (jpos <= dlen[:, None])
+                x = jnp.where(live[..., None], embed[toks_in], 0.0)
+                wpos = n_cached[:, None] + jpos
+                pidx = jnp.clip(wpos // page_size, 0, tables.shape[1] - 1)
+                wp = jnp.where(
+                    live, jnp.take_along_axis(tables, pidx, axis=1),
+                    n_pages,
+                )
+                woff = jnp.where(live, wpos % page_size, 0)
+                seq = jnp.where(active, n_cached + 1, 0)
+                out, kv = step(params, kv, x, tables, wp, woff, seq)
+                logits = out @ embed.T
+                n_acc, term = accept_batch(
+                    key, rids, positions, logits, drafts, dlen,
+                    temperature=temperature, top_k=top_k,
+                )
+                n_acc = jnp.where(active, n_acc, 0)
+                term = jnp.where(active, term, 0)
+                drafts_pad = jnp.concatenate(
+                    [drafts, jnp.zeros((B, 1), drafts.dtype)], axis=1
+                )
+                toks_k = jnp.where(
+                    jpos < n_acc[:, None], drafts_pad,
+                    jnp.where(jpos == n_acc[:, None], term[:, None], 0),
+                )
+                toks_k = jnp.where(active[:, None], toks_k, 0)
+                n_emit = jnp.where(active, n_acc + 1, 0)
+                # device-side EOS: truncate the emitted run at the
+                # first stop hit (the stop token itself is kept)
+                is_stop = jnp.take_along_axis(
+                    stop_mask, toks_k, axis=1
+                ) & (jpos < n_emit[:, None])
+                has_stop = jnp.any(is_stop, axis=1)
+                j_stop = jnp.argmax(is_stop, axis=1)
+                n_emit = jnp.where(has_stop, j_stop + 1, n_emit)
+                toks_k = jnp.where(jpos < n_emit[:, None], toks_k, 0)
+                stopped = stopped | (active & has_stop)
+                # extend the proposer's history window in-carry
+                wpos_h = jnp.where(
+                    jpos < n_emit[:, None], ctx_len[:, None] + jpos, S
+                )
+                hist = jax.vmap(
+                    lambda h, p, t: h.at[p].set(t, mode="drop")
+                )(hist, wpos_h, toks_k)
+                last_idx = jnp.clip(n_emit - 1, 0, K - 1)
+                new_last = jnp.take_along_axis(
+                    toks_k, last_idx[:, None], axis=1
+                )[:, 0]
+                last_tok = jnp.where(active, new_last, last_tok)
+                return (
+                    (kv, hist, n_cached + n_emit, positions + n_emit,
+                     last_tok, emitted + n_emit, stopped),
+                    (toks_k, n_emit, dlen),
+                )
+
+            def skip(ops):
+                return ops, (jnp.zeros((B, K), jnp.int32),
+                             jnp.zeros((B,), jnp.int32),
+                             jnp.zeros((B,), jnp.int32))
+
+            carry, out = lax.cond(
+                any_active, tick, skip,
+                (kv, hist, n_cached, positions, last_tok, emitted,
+                 stopped),
+            )
+            return carry, out
+
+        init = (kv, hist, n_cached, positions, last_tok,
+                jnp.zeros_like(budgets), stopped)
+        (kv, *_), (toks, n_emit, dlen) = lax.scan(
+            body, init, None, length=macro_steps
+        )
+        return toks, n_emit, dlen, kv
+
+    return loop
+
+
+def build_spec_decode_loop(mesh: Mesh, cfg: TransformerConfig,
+                           geom: CacheGeometry, macro_steps: int,
+                           spec_k: int, temperature: float = 0.0,
+                           top_k: int = 0, ngram: int = 2,
+                           dp: str = "dp", sp: str = "sp",
+                           counter: CompileCounter | None = None,
+                           quantized: bool = False,
+                           fused: bool | None = None):
+    """Compiled device-resident SPECULATIVE macro-step decode over
+    ``mesh``: jit'd fn(params, kv, embed, key_data, tables
+    (B, max_pages), n_cached, rids, positions, budgets, last_tok —
+    (B,) int32 — hist (B, S) int32, stop_mask (B, V) bool, stopped (B,)
+    bool) -> (tokens (T, B, K), n_emit (T, B), draft_len (T, B), kv'),
+    slots sharded P(dp), embed/key replicated, cache donated.  ONE
+    dispatch and ONE host-sync per T speculation rounds — up to
+    ``T * (spec_k + 1)`` tokens; B, T and K are fixed at construction,
+    so steady-state speculative macro decode never recompiles
+    (``counter`` proves it).  See :func:`spec_decode_loop_fn` for the
+    per-round contract and the bit-identity argument."""
+    check_serve_mesh(mesh, cfg, dp, sp)
+    _check_geometry(cfg, geom)
+    body = spec_decode_loop_fn(
+        cfg, geom, macro_steps, spec_k, temperature=temperature,
+        top_k=top_k, ngram=ngram, sp=sp, dp=dp, quantized=quantized,
+        fused=fused,
+    )
+    if counter is not None:
+        body = counter.wrap(body)
+    pspec = param_spec(cfg, dp)
+    kspec = kv_cache_spec(dp, sp, quantized)
+    return run_spmd(
+        mesh,
+        body,
+        (pspec, kspec, P(), P(), P(dp), P(dp), P(dp), P(dp), P(dp), P(dp),
+         P(dp), P(dp), P(dp)),
+        (P(None, dp), P(None, dp), P(None, dp), kspec),
         donate_argnums=(1,),
     )
 

@@ -613,10 +613,11 @@ class DisaggEngine:
             if staged is None:
                 break
             req = self._queue.popleft()
-            if req.max_new == 1:
-                # budget spent at prefill (the monolithic engine's
-                # evict-at-admission case): nothing to decode, nothing
-                # to migrate — the staged pages retire right here
+            if req.max_new == 1 or staged.first_token in req.stop_tokens:
+                # finished at prefill (the monolithic engine's
+                # evict-at-admission case): budget of one, or the first
+                # token hit a stop token — nothing to decode, nothing
+                # to migrate; the staged pages retire right here
                 self._stage_alloc.free(staged.pages)
                 self.engine._tokens_generated += 1
                 finished.append((req.rid, (staged.first_token,)))

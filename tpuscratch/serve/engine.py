@@ -47,6 +47,7 @@ from tpuscratch.serve.decode import (
     build_decode_loop,
     build_decode_step,
     build_prefill,
+    build_spec_decode_loop,
     build_verify_step,
     check_serve_mesh,
     plan_sweep_waves,
@@ -138,26 +139,39 @@ class ServeConfig:
     # their whole length inside one tick — one long admission stops
     # blocking every resident decode stream (bounds per-token p99)
     chunk_prefill: int = 0
-    # device-resident macro-step decode (ISSUE 15): tokens generated
+    # device-resident macro-step decode (ISSUE 15, clamps lifted by
+    # ISSUE 19): tokens — token ROUNDS, under speculation — generated
     # per engine dispatch.  1 (default) runs the EXACT legacy per-token
     # program; N > 1 fuses N whole engine ticks — decode sweep,
     # unembed, sample, KV write, frontier/length advance — into ONE
     # compiled lax.scan carrying all slot state on device, so the
     # engine pays ONE XLA dispatch and ONE sampling host-sync per N
-    # tokens instead of per token (the dominant un-attacked term on
+    # rounds instead of per round (the dominant un-attacked term on
     # the decode hot path once the sweep itself is cheap).  Greedy
     # output is bit-identical at any N; insert/evict/admission,
     # chunked-prefill advancement and router re-roling happen at
-    # macro-tick boundaries; a done-mask suppresses writes for slots
-    # whose budget ends mid-scan and an in-program early-exit mask
-    # skips the tail of an all-done bank.  Paths that need PER-TOKEN
-    # host decisions CLAMP the effective N to 1 rather than silently
-    # degrading: speculative decode (spec_k > 0 — the draft proposer
-    # is a host-side scan) and tiered KV (kv_host_pages > 0 — wave
-    # staging/prefetch are host-side); the clamp is ledger-visible
-    # (serve/macro_steps gauge, macro_steps_effective in the
-    # serve/engine event, engine.macro_steps_effective).
+    # macro-tick boundaries; in-carry done/stop masks suppress writes
+    # for slots whose budget or stop token ends them mid-scan and an
+    # in-program early-exit mask skips the tail of an all-done bank.
+    # COMPOSES with both former clamp paths (ISSUE 19): spec_k > 0
+    # moves draft proposal + Leviathan accept/resample into the scan
+    # carry (one dispatch covers up to N * (spec_k + 1) token rounds)
+    # and kv_host_pages > 0 wave-partitions the macro scan with
+    # next-wave prefetch behind the running dispatch; nothing clamps
+    # (macro_steps_effective == macro_steps, macro_clamped_by None).
     macro_steps: int = 1
+    # async macro tick (ISSUE 19, plain macro path): when the bank is
+    # in pure steady-state decode — untiered, unspeculated, unshared,
+    # empty queue, no prefilling slots, no stop tokens — chain ALL
+    # remaining scans for the resident requests back-to-back on the
+    # device-side final carry (budgets/stop state ride the scan
+    # outputs), syncing their sampled tokens only after the last scan
+    # is dispatched: the host never sits between consecutive scans.
+    # Exact-continuation equivalent to one longer scan, so output and
+    # the dispatch identity (dispatches == ceil(slot_steps / T)) are
+    # unchanged; any condition above failing falls back to the
+    # one-scan-per-tick path for that tick.
+    async_macro: bool = False
     # tiered KV memory (0 = off): N host-tier page slots PER dp group
     # (serve/kvcache.HostPageStore over native/hostpool pinned buffers).
     # Cold pages — idle reserve tails, old chunks past the residency
@@ -182,6 +196,13 @@ class Request:
     rid: int                  # unique per engine (keys the PRNG stream)
     prompt: tuple[int, ...]   # token ids
     max_new: int              # generation budget (>= 1)
+    # per-request stop tokens (device-side EOS, ISSUE 19): generation
+    # ends early when a sampled token is in this set — the stop token
+    # itself IS emitted (it closes the output), then the slot finishes.
+    # Checked in-carry on the macro paths (no host sync to decide) and
+    # host-side on the per-token paths; () keeps the budget-only
+    # contract byte-for-byte.
+    stop_tokens: tuple[int, ...] = ()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -268,6 +289,10 @@ class _Slot:
     # a slot with pending tokens is PREFILLING — it advances one chunk
     # per tick and joins the decode bank when the tail drains
     pending: tuple[int, ...] = ()
+    # per-request stop tokens (device-side EOS, ISSUE 19): the stop
+    # token itself IS emitted (it closes the output), then the slot
+    # finishes; () keeps the budget-only contract byte-for-byte
+    stop: tuple[int, ...] = ()
 
 
 #: profiling spans kept on the engine's Timeline — a recent window, not
@@ -285,18 +310,18 @@ DEFAULT_SPILL_RETRY = RetryPolicy(max_attempts=3, base_s=0.005, max_s=0.05,
 
 
 def macro_clamp(scfg: ServeConfig) -> tuple[int, Optional[str]]:
-    """(effective macro_steps, clamping field or None) — THE clamp
-    rule, one definition: paths that need per-token host decisions run
-    T=1 (speculative drafting is a host-side scan, tiered wave
-    staging/prefetch are host-side).  The engine applies it at
-    construction and reports it (``macro_steps_effective`` /
-    ``macro_clamped_by``); the bench sizes slot budgets and page
-    reservations by the same rule so it can never reserve a ~T×
-    bank for an engine that serves one token per tick."""
-    if scfg.macro_steps > 1 and scfg.spec_k > 0:
-        return 1, "spec_k"
-    if scfg.macro_steps > 1 and scfg.kv_host_pages > 0:
-        return 1, "kv_host_pages"
+    """(effective macro_steps, clamping field or None) — THE macro
+    width rule, one definition, shared by the engine's construction
+    report and the bench's budget/page arithmetic.  Since the
+    host-free lift (ISSUE 19) NOTHING clamps: speculative drafting and
+    Leviathan accept/resample run inside the scan carry
+    (``serve.decode.build_spec_decode_loop``) and tiered wave
+    staging/prefetch overlap the running scan, so ``spec_k > 0`` and
+    ``kv_host_pages > 0`` compose with ``macro_steps > 1`` instead of
+    forcing per-token dispatch.  The tuple shape survives so every
+    ledger/bench call site keeps one rule; the reason leg is always
+    None — a stale ``"spec_k"`` / ``"kv_host_pages"`` reason must
+    never reappear (test-gated)."""
     return scfg.macro_steps, None
 
 
@@ -318,6 +343,8 @@ def validate_request(req: Request, scfg: ServeConfig) -> None:
         )
     if any(t < 0 or t >= scfg.vocab for t in req.prompt):
         raise ValueError(f"request {req.rid}: token id out of vocab")
+    if any(t < 0 or t >= scfg.vocab for t in req.stop_tokens):
+        raise ValueError(f"request {req.rid}: stop token id out of vocab")
 
 
 def init_embed(seed: int, vocab: int, d_model: int) -> jax.Array:
@@ -493,9 +520,10 @@ class ServeEngine:
         bind_sink(chaos, self.sink)  # injected ft/fault events join the stream
         self._tick = 0
         # effective macro-step width (macro_clamp — the one shared
-        # rule): paths that need PER-TOKEN host decisions clamp to 1
-        # rather than silently degrading; the clamp is ledger-visible
-        # below (gauge + engine event + macro_steps_effective)
+        # rule): nothing clamps since the host-free lift (ISSUE 19);
+        # the gauge + engine event + macro_steps_effective stay
+        # ledger-visible so a regression back to per-token dispatch
+        # would be caught by the existing assertions
         self._macro_T, self._macro_clamp = macro_clamp(scfg)
         self.metrics.gauge("serve/macro_steps").set(self._macro_T)
         self.sink.emit(
@@ -515,9 +543,22 @@ class ServeEngine:
         # speculation swaps the one-token decode program for ONE fixed
         # (spec_k + 1)-token verify program — still a single compile,
         # still counted by decode_counter; macro_steps > 1 swaps it for
-        # ONE fixed T-token scan program, same discipline
+        # ONE fixed T-token scan program, same discipline.  Composed
+        # spec × macro (ISSUE 19) is a third program: one T-round scan
+        # whose carry drafts, verifies, and accept/resamples on device
+        # (up to T·(spec_k+1) token rounds per dispatch).
         self._decode_loop = None
-        if scfg.spec_k > 0:
+        self._spec_loop = None
+        if self._macro_T > 1 and scfg.spec_k > 0:
+            self._decode = None
+            self._spec_loop = build_spec_decode_loop(
+                mesh, cfg, self.geom, self._macro_T, scfg.spec_k,
+                temperature=scfg.temperature, top_k=scfg.top_k,
+                ngram=scfg.spec_ngram, dp=dp, sp=sp,
+                counter=self.decode_counter, quantized=self._quantized,
+                fused=self._fused,
+            )
+        elif scfg.spec_k > 0:
             self._decode = build_verify_step(
                 mesh, cfg, self.geom, scfg.spec_k, dp=dp, sp=sp,
                 counter=self.decode_counter, quantized=self._quantized,
@@ -644,9 +685,11 @@ class ServeEngine:
 
     @property
     def macro_clamped_by(self) -> Optional[str]:
-        """The config field that clamped ``macro_steps`` to 1 (None
-        when the requested width runs) — the ledger-visible half of
-        the documented clamp contract."""
+        """The config field that clamped ``macro_steps`` to 1 — always
+        None since the host-free lift (ISSUE 19; ``spec_k`` and
+        ``kv_host_pages`` compose with macro scans now).  Kept as the
+        ledger-visible half of the old contract so a stale reason
+        reappearing is test-detectable."""
         return self._macro_clamp
 
     @property
@@ -1232,7 +1275,8 @@ class ServeEngine:
                 self._free_slot_pages(s, st)
             self._slots[s] = None
             self._queue.appendleft(
-                Request(rid=st.rid, prompt=st.prompt, max_new=st.max_new)
+                Request(rid=st.rid, prompt=st.prompt, max_new=st.max_new,
+                        stop_tokens=st.stop)
             )
         if self._tries is not None:
             for trie in self._tries:
@@ -1291,6 +1335,7 @@ class ServeEngine:
             rid=req.rid, prompt=req.prompt, pages=list(pages),
             n_cached=len(req.prompt), max_new=req.max_new,
             last_token=first_token, generated=[first_token],
+            stop=req.stop_tokens,
         )
 
     def _share_plan(self, req: Request,
@@ -1499,6 +1544,7 @@ class ServeEngine:
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_tok,
             max_new=req.max_new, last_token=tok, generated=[tok],
+            stop=req.stop_tokens,
         )
         return True
 
@@ -1562,7 +1608,7 @@ class ServeEngine:
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_cached,
             max_new=req.max_new, last_token=0, generated=[],
-            pending=req.prompt[n_cached:],
+            pending=req.prompt[n_cached:], stop=req.stop_tokens,
         )
         self._prefill_count += 1
         if scfg.chunk_prefill == 0:
@@ -1671,7 +1717,7 @@ class ServeEngine:
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=pages, n_cached=n_cached,
             max_new=req.max_new, last_token=0, generated=[],
-            pending=req.prompt[n_cached:],
+            pending=req.prompt[n_cached:], stop=req.stop_tokens,
         )
         self._prefill_count += 1
         if scfg.chunk_prefill == 0:
@@ -1904,10 +1950,19 @@ class ServeEngine:
             self._mark_first_token(st.rid)
             if self._tries is not None:
                 self._tries[self._group_of(s)].insert(st.prompt, st.pages)
-            if len(st.generated) >= st.max_new:
+            if self._done(st):
                 out_pair = self._evict(s)
                 if finished is not None:
                     finished.append(out_pair)
+
+    def _done(self, st: _Slot) -> bool:
+        """Finish rule, ONE definition for every sweep path: budget
+        exhausted, or the last emitted token is one of the request's
+        stop tokens (the stop token itself closes the output — it is
+        emitted, then the slot finishes)."""
+        return (len(st.generated) >= st.max_new
+                or bool(st.stop and st.generated
+                        and st.generated[-1] in st.stop))
 
     def _evict(self, slot: int) -> tuple[int, tuple[int, ...]]:
         st = self._slots[slot]
@@ -2015,11 +2070,11 @@ class ServeEngine:
                     break
                 continue  # quarantined: the slot stays free
             st = self._slots[slot]
-            # budget spent at prefill (an admission that already drained
-            # its pending tail and emitted its one token); a chunked
-            # admission still prefilling is evicted by _ctx_step later
+            # finished at prefill (budget of one, or the first token hit
+            # a stop token); a chunked admission still prefilling is
+            # evicted by _ctx_step later
             if (st is not None and not st.pending and st.generated
-                    and req.max_new == 1):
+                    and self._done(st)):
                 finished.append(self._evict(slot))
         if self._tiered:
             self._update_pins()  # fresh admissions joined the window
@@ -2036,10 +2091,14 @@ class ServeEngine:
         active = [s for s, st in enumerate(self._slots)
                   if st is not None and not st.pending and st.generated]
         if active:
-            if self.scfg.spec_k > 0:
-                self._spec_tick(active, finished)
-            elif self._macro_T > 1:
+            # macro-first: since the host-free lift (ISSUE 19) a macro
+            # width composes with speculation AND the tier — the scan
+            # program drafts/verifies in-carry and waves prefetch behind
+            # the running dispatch, so nothing falls back to per-token
+            if self._macro_T > 1:
                 self._macro_tick(active, finished)
+            elif self.scfg.spec_k > 0:
+                self._spec_tick(active, finished)
             else:
                 self._decode_tick(active, finished)
         if self._tiered:
@@ -2058,7 +2117,12 @@ class ServeEngine:
                       if st is not None and st.pending]
         active = [s for s, st in enumerate(self._slots)
                   if st is not None and not st.pending and st.generated]
-        k_of = (self._spec_k_of if self.scfg.spec_k > 0 else self._one)
+        if self._macro_T > 1:
+            k_of = self._macro_k_of
+        elif self.scfg.spec_k > 0:
+            k_of = self._spec_k_of
+        else:
+            k_of = self._one
         nxt = prefilling + active
         if not nxt:
             return
@@ -2158,86 +2222,229 @@ class ServeEngine:
             st.last_token = int(toks[s])
             st.generated.append(st.last_token)
             self._tokens_generated += 1
-            if len(st.generated) >= st.max_new:
+            if self._done(st):
                 finished.append(self._evict(s))
+
+    def _macro_k_of(self, s: int) -> int:
+        """k_new bound for a macro sweep's wave planning and staging:
+        one dispatch advances a slot's write frontier by at most
+        ``min(T * (spec_k + 1), remaining budget)`` tokens (each round
+        emits at most ``draft_len + 1 <= remaining``, and ``remaining``
+        bounds the whole scan — the admission-time page reservation
+        stays valid), so staging this span past the cached frontier
+        covers every page the dispatch can touch."""
+        st = self._slots[s]
+        return min(self._macro_T * (self.scfg.spec_k + 1),
+                   st.max_new - len(st.generated))
 
     def _macro_tick(self, active: list[int],
                     finished: list[tuple[int, tuple[int, ...]]]) -> None:
-        """One device-resident MACRO tick (ISSUE 15): up to
-        ``macro_steps`` whole token rounds for every active slot in
-        ONE compiled ``lax.scan`` dispatch and ONE host sync — the
-        scan carries page tables, write frontiers, lengths, PRNG
-        fold-in positions and budget done-masks on device
-        (``serve.decode.build_decode_loop``), so per-token host
-        orchestration disappears from the hot path.  Each scan
-        iteration reproduces one legacy engine tick bit-for-bit (a
-        slot whose budget ends mid-scan flips to the legacy idle
-        contract, write-suppressed); admission/eviction stay host-side
-        at THIS boundary.  Unreachable under the tier or speculation —
-        both clamp ``macro_steps`` to 1 at construction."""
+        """One device-resident MACRO tick (ISSUE 15, host-free since
+        ISSUE 19): up to ``macro_steps`` whole token rounds — or
+        speculation rounds when ``spec_k > 0`` composes — for every
+        active slot in ONE compiled ``lax.scan`` dispatch and ONE host
+        sync.  Wave-partitioned under the tier exactly like
+        ``_decode_tick`` (each wave's scan runs while the next wave's
+        cold pages prefetch behind it), one wave — the whole bank —
+        untiered."""
+        waves = self._plan_waves(active, self._macro_k_of)
+        rounds = 0
+        for i, wave in enumerate(waves):
+            nxt = waves[i + 1] if i + 1 < len(waves) else None
+            rounds = max(rounds,
+                         self._macro_sweep(wave, finished, prefetch=nxt))
+        # token ROUNDS the bank ran this tick: waves partition SLOTS,
+        # not rounds, so the bank-level count is the longest wave's
+        # (the _decode_tick += 1 rule, scan-widened)
+        self._decode_rounds += rounds
+
+    def _macro_sweep(self, active: list[int],
+                     finished: list[tuple[int, tuple[int, ...]]],
+                     prefetch: Optional[list] = None) -> int:
+        """One macro-scan dispatch for one wave: the scan carries page
+        tables, write frontiers, lengths, PRNG fold-in positions,
+        budget/stop done-masks — and under speculation the proposer's
+        token-history window — on device (``serve.decode``'s
+        ``build_decode_loop`` / ``build_spec_decode_loop``), so
+        per-token AND per-round host orchestration disappear from the
+        hot path.  Each scan iteration reproduces one legacy engine
+        tick bit-for-bit; admission/eviction stay host-side at THIS
+        boundary.
+
+        The ASYNC macro tick (``scfg.async_macro``, plain path only):
+        when the host has nothing to decide between scans — untiered,
+        unshared, empty queue, no prefilling slot, no stop tokens in
+        the wave — ALL remaining scans dispatch back-to-back, each fed
+        the previous scan's device-side final carry, and the host syncs
+        their token blocks once at the end: the halo driver's
+        double-buffer idiom applied to the dispatch pipeline itself.
+        Every chained scan has >= 1 active round (no stop tokens, and
+        the chain length is ``ceil(max remaining / T)``), so the
+        ``dispatches == ceil(slot_steps / T)`` identity is preserved
+        exactly."""
         scfg, geom = self.scfg, self.geom
         n, T = scfg.n_slots, self._macro_T
+        spec = self._spec_loop is not None
         tables = np.full((n, scfg.max_pages), geom.n_pages, np.int32)
         n_cached = np.zeros((n,), np.int32)
         rids = np.zeros((n,), np.int32)
         positions = np.zeros((n,), np.int32)
         budgets = np.zeros((n,), np.int32)
         last_tok = np.zeros((n,), np.int32)
-        spans: dict[int, int] = {}
+        stop_mask = np.zeros((n, scfg.vocab), bool)
+        stopped0 = np.zeros((n,), bool)
+        emitted0 = np.zeros((n,), np.int32)
+        hist = np.zeros((n, scfg.max_seq), np.int32) if spec else None
         for s in active:
             st = self._slots[s]
-            span = min(T, st.max_new - len(st.generated))
-            spans[s] = span
             if self._tries is not None:
                 # CoW guard over the WHOLE write span up front (the
                 # speculative sweep's rule): the scan's frontier may
                 # cross into shared pages mid-dispatch, and the copy
                 # must precede the tables snapshot
                 for pi in range(st.n_cached // geom.page_size,
-                                (st.n_cached + span - 1)
+                                (st.n_cached + self._macro_k_of(s) - 1)
                                 // geom.page_size + 1):
                     self._ensure_private(s, pi)
+        self._stage_wave(active, self._macro_k_of)  # sync cold-hit path
         for s in active:
             st = self._slots[s]
-            tables[s, : len(st.pages)] = st.pages
+            group = self._group_of(s)
+            k_of = self._macro_k_of(s)
+            row = self._sweep_row(group, st, k_of)
+            tables[s, : len(row)] = row
             n_cached[s] = st.n_cached
             rids[s] = st.rid
             positions[s] = len(st.generated)
             budgets[s] = st.max_new - len(st.generated)
             last_tok[s] = st.last_token
+            for t in st.stop:
+                stop_mask[s, t] = True
+            if spec:
+                ctx = st.prompt + tuple(st.generated)
+                hist[s, : len(ctx)] = ctx
+            if self._tiered:
+                first = st.n_cached // geom.page_size
+                last = (st.n_cached + k_of - 1) // geom.page_size
+                self._allocators[group].mark_written(
+                    st.pages[first:last + 1]
+                )
+        n_scans = 1
         try:
             with self.timeline.span("serve/decode"):
-                toks, _mask, self._kv = self._decode_loop(
-                    self.params, self._kv, self.embed,
-                    self._seed_key_data,
-                    jnp.asarray(tables), jnp.asarray(n_cached),
-                    jnp.asarray(rids), jnp.asarray(positions),
-                    jnp.asarray(budgets), jnp.asarray(last_tok),
-                )
-                toks = np.asarray(toks)  # ONE host sync per T tokens
+                if spec:
+                    toks_d, n_emit_d, dlen_d, self._kv = self._spec_loop(
+                        self.params, self._kv, self.embed,
+                        self._seed_key_data,
+                        jnp.asarray(tables), jnp.asarray(n_cached),
+                        jnp.asarray(rids), jnp.asarray(positions),
+                        jnp.asarray(budgets), jnp.asarray(last_tok),
+                        jnp.asarray(hist), jnp.asarray(stop_mask),
+                        jnp.asarray(stopped0),
+                    )
+                    if prefetch:
+                        # double-buffered: the NEXT wave's pages land
+                        # while this wave's compiled scan runs
+                        self._stage_wave(prefetch, self._macro_k_of,
+                                         best_effort=True,
+                                         hold=tuple(active))
+                    # ONE host sync per T speculation rounds
+                    toks = np.asarray(toks_d)
+                    n_emit = np.asarray(n_emit_d)
+                    dlen = np.asarray(dlen_d)
+                else:
+                    chain = (scfg.async_macro and not self._tiered
+                             and self._tries is None and not self._queue
+                             and prefetch is None
+                             and not any(st is not None and st.pending
+                                         for st in self._slots)
+                             and all(not self._slots[s].stop
+                                     for s in active))
+                    if chain:
+                        n_scans = max(
+                            -(-int(budgets[s]) // T) for s in active
+                        )
+                    nc = jnp.asarray(n_cached)
+                    po = jnp.asarray(positions)
+                    lt = jnp.asarray(last_tok)
+                    em = jnp.asarray(emitted0)
+                    sp_ = jnp.asarray(stopped0)
+                    tables_j = jnp.asarray(tables)
+                    rids_j = jnp.asarray(rids)
+                    budg_j = jnp.asarray(budgets)
+                    stop_j = jnp.asarray(stop_mask)
+                    toks_parts, mask_parts = [], []
+                    for _ in range(n_scans):
+                        (toks_d, mask_d, self._kv, nc, po, lt, em,
+                         sp_) = self._decode_loop(
+                            self.params, self._kv, self.embed,
+                            self._seed_key_data, tables_j, nc, rids_j,
+                            po, budg_j, lt, stop_j, sp_, em,
+                        )
+                        toks_parts.append(toks_d)
+                        mask_parts.append(mask_d)
+                    if prefetch:
+                        self._stage_wave(prefetch, self._macro_k_of,
+                                         best_effort=True,
+                                         hold=tuple(active))
+                    # ONE host sync per T tokens (per chained scan) —
+                    # issued AFTER every scan in the chain dispatched
+                    toks = np.concatenate(
+                        [np.asarray(t) for t in toks_parts], axis=0
+                    )
+                    mask = np.concatenate(
+                        [np.asarray(m) for m in mask_parts], axis=0
+                    )
         except Exception:
             self._recover_cache()  # donated kv may be consumed; replay
             raise
         self._decode_s += self._last_span_s()
-        self._decode_steps += 1
-        self._dispatches += 1
-        self._host_syncs += 1
-        # rounds actually run before the early-exit mask idled the
-        # bank: the longest span (other slots rode it, write-suppressed
-        # once done — the done-mask law the boundary tests pin)
-        self._decode_rounds += max(spans.values())
-        for s in active:
-            st = self._slots[s]
-            steps = spans[s]
-            out = [int(t) for t in toks[:steps, s]]
-            st.n_cached += steps
-            st.generated.extend(out)
-            st.last_token = out[-1]
-            self._slot_steps += steps
-            self._fresh_tokens += steps
-            self._tokens_generated += steps
-            if len(st.generated) >= st.max_new:
-                finished.append(self._evict(s))
+        self._decode_steps += n_scans
+        self._dispatches += n_scans
+        self._host_syncs += n_scans
+        if spec:
+            accept_hist = self.metrics.histogram("serve/accept_len")
+            # rounds actually run before the early-exit psum idled the
+            # bank (a round every slot skipped emitted nothing)
+            rounds = int((n_emit > 0).any(axis=1).sum())
+            for s in active:
+                st = self._slots[s]
+                for r in range(n_emit.shape[0]):
+                    ne = int(n_emit[r, s])
+                    if ne == 0:
+                        # active is monotone: later rounds are all idle
+                        break
+                    out = [int(t) for t in toks[r, s, :ne]]
+                    st.generated.extend(out)
+                    st.last_token = out[-1]
+                    st.n_cached += ne
+                    accept_hist.observe(ne - 1)
+                    self._spec_drafted += int(dlen[r, s])
+                    self._spec_accepted += ne - 1
+                    self._slot_steps += 1
+                    self._fresh_tokens += ne
+                    self._tokens_generated += ne
+                if self._done(st):
+                    finished.append(self._evict(s))
+        else:
+            # rounds actually run before the early-exit mask idled the
+            # bank (per-slot active masks are prefixes, so the longest
+            # column IS the any-active iteration count)
+            rounds = int(mask.any(axis=1).sum())
+            for s in active:
+                st = self._slots[s]
+                steps = int(mask[:, s].sum())
+                out = [int(t) for t in toks[:steps, s]]
+                st.n_cached += steps
+                st.generated.extend(out)
+                if out:
+                    st.last_token = out[-1]
+                self._slot_steps += steps
+                self._fresh_tokens += steps
+                self._tokens_generated += steps
+                if self._done(st):
+                    finished.append(self._evict(s))
+        return rounds
 
     def _spec_k_of(self, _s: int) -> int:
         """k_new bound for a speculative sweep: the full draft budget
@@ -2341,15 +2548,26 @@ class ServeEngine:
                 scfg.seed, st.rid, len(st.generated), logits[s], drafts[s],
                 scfg.temperature, scfg.top_k,
             )
-            accept_hist.observe(a)
+            if st.stop:
+                # host-side EOS, the device rule mirrored: truncate the
+                # emitted run at the first stop hit (the stop token
+                # itself is kept); tokens past it were never emitted, so
+                # the accepted count shrinks with the run — post-stop
+                # K/V garbage follows the rejected-draft contract
+                for j, t in enumerate(toks):
+                    if t in st.stop:
+                        toks = toks[: j + 1]
+                        break
+            a_eff = len(toks) - 1
+            accept_hist.observe(a_eff)
             self._spec_drafted += len(drafts[s])
-            self._spec_accepted += a
-            self._fresh_tokens += a + 1
-            st.n_cached += a + 1
+            self._spec_accepted += a_eff
+            self._fresh_tokens += a_eff + 1
+            st.n_cached += a_eff + 1
             st.generated.extend(toks)
             st.last_token = toks[-1]
             self._tokens_generated += len(toks)
-            if len(st.generated) >= st.max_new:
+            if self._done(st):
                 finished.append(self._evict(s))
 
     def run(self, requests: Sequence[Request] = (),

@@ -480,9 +480,12 @@ class PrefixCache:
 # prefetch back ahead of the decode sweep, so the device pool holds only
 # the pages the next sweeps touch while the host tier holds everything
 # resident.  The engine drives WHEN (serve/engine.py: wave scheduling,
-# prefetch one tick ahead, synchronous cold-hit fallback); this module
-# owns WHAT: the host store, the cross-tier refcount laws, and the
-# residency policy.
+# prefetch one tick ahead, synchronous cold-hit fallback — and since
+# ISSUE 19 the next wave's swap-in overlaps the RUNNING macro scan,
+# issued after the dispatch and before its host sync, so the tier no
+# longer clamps macro_steps to per-token dispatch); this module owns
+# WHAT: the host store, the cross-tier refcount laws, and the residency
+# policy.
 
 
 class HostTierError(RuntimeError):

@@ -292,7 +292,11 @@ class RouterReport:
     # macro-step replicas (``ServeConfig(macro_steps=T)``) both drop
     # ~T× at fixed token count; per single-stream replica the identity
     # dispatches == ceil(slot_steps / macro_steps) holds exactly
-    # (asserted live in ex32).  Lower-is-better in obs.regress.
+    # (asserted live in ex32).  Since the host-free lift (ISSUE 19)
+    # macro replicas compose with spec_k/kv_host_pages too — a fleet of
+    # speculating or tiered replicas keeps the same ~T× drop (up to
+    # T·(spec_k+1) token rounds per dispatch under speculation).
+    # Lower-is-better in obs.regress.
     dispatches: int = 0
     host_syncs: int = 0
     # replica-chaos accounting (ISSUE 17): kills/stalls are the churn

@@ -143,6 +143,88 @@ def target_probs(logits: np.ndarray, temperature: float,
     return e / e.sum()
 
 
+@functools.partial(jax.jit, static_argnames=("temperature", "top_k"))
+def accept_batch(seed_key: jax.Array, rids: jax.Array, pos0: jax.Array,
+                 logits: jax.Array, drafts: jax.Array,
+                 draft_len: jax.Array, temperature: float = 0.0,
+                 top_k: int = 0) -> tuple[jax.Array, jax.Array]:
+    """Batched DEVICE-side :func:`accept_speculative` for a whole slot
+    bank (ISSUE 19): decide every slot's verify sweep in one compiled
+    program, so the Leviathan accept/resample rule can live inside the
+    macro scan carry instead of forcing a host round trip per
+    speculation round.
+
+    ``logits`` (B, K, V) — row ``j`` of slot ``b`` scores the position
+    after accepting ``j`` draft tokens; ``drafts`` (B, K-1) with
+    ``draft_len`` (B,) live tokens per slot; ``pos0`` (B,) — each
+    slot's generated-stream index for the round's first emitted token.
+    Returns ``(n_accepted (B,), terminal (B,))`` int32: the accepted
+    draft prefix length and the one extra token the surviving position
+    emits (residual-resampled correction on rejection, base-sampler
+    bonus after a full accept).
+
+    PRNG contract: identical fold_in chains to the host rule —
+    accept uniforms off ``fold_in(request_key(seed, rid, pos0+j),
+    _SUB_ACCEPT)``, the residual categorical off ``_SUB_RESAMPLE``,
+    the bonus off the plain ``request_key`` stream — so replay keys
+    match position for position.  Greedy (``temperature == 0``) is
+    pure argmax-equality, bit-identical to the host path; at
+    temperature > 0 the acceptance thresholds come from the device
+    softmax where the host rule materializes a numpy one — same
+    distribution, documented host-vs-device exp ulp tolerance (greedy
+    is the bit-pinned contract)."""
+    B, K, _ = logits.shape
+    k = drafts.shape[1]
+    jk = jnp.arange(k)
+    if temperature == 0.0:
+        am = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        ok = (am[:, :k] == drafts) & (jk[None, :] < draft_len[:, None])
+        n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+        term = jnp.take_along_axis(am, n_acc[:, None], axis=1)[:, 0]
+        return n_acc.astype(jnp.int32), term
+    scaled = logits.astype(jnp.float32) / temperature
+    if top_k:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled >= kth, scaled, NEG_INF)
+    probs = jax.nn.softmax(scaled, axis=-1)
+
+    def one(rid, p0, pr, scl, d, dl):
+        def u_of(j):
+            base = jax.random.fold_in(
+                jax.random.fold_in(seed_key, rid), p0 + j
+            )
+            return jax.random.uniform(
+                jax.random.fold_in(base, _SUB_ACCEPT)
+            )
+        us = jax.vmap(u_of)(jk)
+        pd = jnp.take_along_axis(pr[:k], d[:, None], axis=1)[:, 0]
+        ok = (us < pd) & (jk < dl)
+        n_acc = jnp.sum(jnp.cumprod(ok.astype(jnp.int32)))
+        # rejection terminal: residual distribution at position n_acc
+        d_rej = d[jnp.clip(n_acc, 0, k - 1)]
+        res = pr[n_acc].at[d_rej].set(0.0)
+        tot = jnp.sum(res)
+        lg = jnp.where(res > 0.0, jnp.log(res), NEG_INF)
+        base = jax.random.fold_in(
+            jax.random.fold_in(seed_key, rid), p0 + n_acc
+        )
+        tok_rej = jnp.where(
+            tot > 0.0,
+            jax.random.categorical(
+                jax.random.fold_in(base, _SUB_RESAMPLE), lg
+            ).astype(jnp.int32),
+            d_rej,
+        )
+        # full-accept bonus: the base sampler's draw at pos0 + n_acc
+        tok_bonus = jax.random.categorical(
+            base, scl[n_acc]
+        ).astype(jnp.int32)
+        term = jnp.where(n_acc < dl, tok_rej, tok_bonus)
+        return n_acc.astype(jnp.int32), term
+
+    return jax.vmap(one)(rids, pos0, probs, scaled, drafts, draft_len)
+
+
 def accept_speculative(
     seed: int,
     rid: int,
