@@ -1,0 +1,833 @@
+"""Fleet-wide request tracing (ISSUE 20): causal span trees with an
+EXACT per-request latency decomposition.
+
+The correctness anchors:
+- the per-request goodput law: every collected ``RequestTrace``'s
+  bucket decomposition (queue, shed_wait, prefill, handoff, decode,
+  waste, other) sums to its end-to-end wall EXACTLY
+  (``RequestTrace.check``), under overlap clipping, failed legs,
+  shed->retry resubmits, kill->re-admission, and handoff degrade;
+- observes-never-perturbs: a fully traced fleet drain (chaos
+  included) emits BIT-identical outputs to the untraced drain;
+- chaos lineage (the satellite): a rack-kill victim's trace carries
+  the kill mark, the evacuation/re-admission wait (waste), and the
+  re-prefill leg on a fresh attempt — decomposition still exact;
+  shed->retry->complete chains link attempts across resubmits;
+- the Perfetto export: the span forest passes the EXTENDED
+  ``validate_chrome_trace`` (async b/e roots, s/f flow chains with
+  pid+tid on every step), plus the golden-schema subprocess proof;
+- seeded per-rid sampling is a pure function of (rid, rate, salt),
+  and the rotating sink bounds the JSONL artifact's disk footprint;
+- the config-22 regress directions (``decomp_*`` lower via
+  _LOWER_FIRST so a tenant class named "throughput" cannot invert
+  its buckets) and the clean-pair-0 / injected-1 subprocess proof.
+
+The fleet tests reuse test_traffic's compile-light shapes (same
+cfg/scfg values -> same jit cache entries within a tier-1 run)."""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from tpuscratch.ft.chaos import ChaosPlan, Fault, bind_tracer
+from tpuscratch.models.transformer import TransformerConfig
+from tpuscratch.obs import regress
+from tpuscratch.obs.report import (
+    decompose,
+    load_events,
+    request_waterfall,
+    summarize,
+)
+from tpuscratch.obs.reqtrace import (
+    REQ_BUCKETS,
+    NullReqTracer,
+    ReqTracer,
+    RequestTrace,
+    rid_sampled,
+)
+from tpuscratch.obs.sink import Sink
+from tpuscratch.obs.trace import validate_chrome_trace
+from tpuscratch.runtime.mesh import make_mesh
+from tpuscratch.serve import (
+    DisaggEngine,
+    FleetRouter,
+    Request,
+    RouterConfig,
+    SLOClass,
+    ServeConfig,
+    ServeEngine,
+)
+from tpuscratch.serve.decode import macro_occupancy
+
+pytestmark = pytest.mark.reqtrace
+
+D = 32
+
+
+def cfg_for(**kw):
+    kw.setdefault("capacity_factor", 4.0)
+    return TransformerConfig(
+        d_model=D, n_heads=4, n_experts=4, d_ff=48, n_layers=1, **kw
+    )
+
+
+def scfg_for(**kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("n_pages", 16)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq", 24)
+    kw.setdefault("vocab", 16)
+    kw.setdefault("prefix_share", True)
+    return ServeConfig(**kw)
+
+
+def mesh_for(dims=(1, 1)):
+    return make_mesh(dims, ("dp", "sp"),
+                     jax.devices()[: dims[0] * dims[1]])
+
+
+def tenant_requests(n=6, max_new=3):
+    pre = {0: (1, 2, 3, 4, 5, 6, 7, 8, 9), 1: (9, 8, 7, 6, 5, 4, 3, 2, 1)}
+    return [
+        Request(rid=i, prompt=pre[i % 2] + (10 + i % 5,), max_new=max_new)
+        for i in range(n)
+    ]
+
+
+def tagged(n=10, max_new=3):
+    return [("latency" if i % 3 else "batch", r)
+            for i, r in enumerate(tenant_requests(n, max_new))]
+
+
+def fleet(n=3, rcfg=None, chaos=None, tracer=None, disagg=False,
+          **scfg_kw):
+    cfg, scfg = cfg_for(), scfg_for(**scfg_kw)
+    mesh = mesh_for()
+    cls = DisaggEngine if disagg else ServeEngine
+    return FleetRouter([cls(mesh, cfg, scfg) for _ in range(n)],
+                       rcfg=rcfg, chaos=chaos, tracer=tracer)
+
+
+TWO_CLASSES = RouterConfig(classes=(SLOClass("latency", target="ttft"),
+                                    SLOClass("batch")))
+
+
+def buckets_of(tr):
+    return {b: tr.buckets.get(b, 0.0) for b in REQ_BUCKETS}
+
+
+class TestSampling:
+    def test_pure_function_of_rid(self):
+        for rid in range(64):
+            assert rid_sampled(rid, 0.3) == rid_sampled(rid, 0.3)
+        assert all(rid_sampled(r, 1.0) for r in range(100))
+        assert not any(rid_sampled(r, 0.0) for r in range(100))
+
+    def test_rate_is_approximately_honored(self):
+        n = 4000
+        hit = sum(rid_sampled(r, 0.25) for r in range(n))
+        assert 0.18 < hit / n < 0.32
+
+    def test_salt_reshuffles_selection(self):
+        a = [rid_sampled(r, 0.5, salt=0) for r in range(256)]
+        b = [rid_sampled(r, 0.5, salt=1) for r in range(256)]
+        assert a != b
+
+    def test_tracer_skips_unsampled_rids(self):
+        tr = ReqTracer(sample_rate=0.5, salt=3)
+        for rid in range(40):
+            tr.begin(rid, 0.0, cls="x")
+            tr.finish(rid, 1.0)
+        got = {t.rid for t in tr.collect()}
+        want = {r for r in range(40) if rid_sampled(r, 0.5, salt=3)}
+        assert got == want and 0 < len(got) < 40
+
+    def test_validates_rate(self):
+        with pytest.raises(ValueError):
+            ReqTracer(sample_rate=1.5)
+        with pytest.raises(ValueError):
+            ReqTracer(sample_rate=-0.1)
+
+    def test_null_tracer_is_inert(self):
+        nt = NullReqTracer()
+        assert not nt.enabled and not nt.sampled(1)
+        nt.begin(1, 0.0)
+        nt.work(1, "prefill", 0.0, 1.0)
+        nt.finish(1, 2.0)
+        assert nt.collect() == []
+
+
+class TestExactDecomposition:
+    """Pure-tracer laws on synthetic stamps: the cursor-clipping
+    attribution sweep makes the buckets sum to the wall by
+    construction — ``other`` is the exact remainder."""
+
+    def _collect_one(self, tr, rid):
+        got = {t.rid: t for t in tr.collect()}
+        t = got[rid]
+        t.check()
+        return t
+
+    def test_simple_lifecycle(self):
+        tr = ReqTracer()
+        tr.begin(1, 0.0, cls="latency")
+        tr.work(1, "prefill", 1.0, 2.0, tokens=8)
+        tr.mark(1, "first_token", 2.0)
+        tr.work(1, "decode", 2.0, 3.5)
+        tr.finish(1, 4.0)
+        t = self._collect_one(tr, 1)
+        b = buckets_of(t)
+        assert b["queue"] == pytest.approx(1.0)
+        assert b["prefill"] == pytest.approx(1.0)
+        assert b["decode"] == pytest.approx(1.5)
+        assert b["other"] == pytest.approx(0.5)  # exact remainder
+        assert b["shed_wait"] == b["handoff"] == b["waste"] == 0.0
+        assert t.e2e_s == pytest.approx(4.0)
+        assert t.ttft_s == pytest.approx(2.0)
+        assert t.attempts == 1 and t.killed == ()
+        assert sum(b.values()) == pytest.approx(t.e2e_s)
+
+    def test_overlapping_claims_are_clipped_disjoint(self):
+        tr = ReqTracer()
+        tr.begin(2, 0.0)
+        tr.work(2, "prefill", 1.0, 2.0)
+        tr.work(2, "decode", 2.0, 3.0)
+        tr.work(2, "decode", 2.5, 3.5)  # overlaps the previous claim
+        tr.finish(2, 4.0)
+        t = self._collect_one(tr, 2)
+        b = buckets_of(t)
+        assert b["decode"] == pytest.approx(1.5)  # NOT 2.0
+        assert sum(b.values()) == pytest.approx(4.0)
+        # segments are disjoint and time-ordered
+        segs = [(s, e) for _a, _b, s, e in t.segments]
+        for (s0, e0), (s1, e1) in zip(segs, segs[1:]):
+            assert e0 <= s1
+
+    def test_shed_retry_complete_chain(self):
+        tr = ReqTracer()
+        tr.begin(7, 0.0, cls="latency")
+        tr.shed(7, 2.0, reason="deadline")
+        tr.begin(7, 5.0)          # the closed-loop resubmit
+        tr.work(7, "prefill", 6.0, 7.0)
+        tr.finish(7, 8.0)
+        t = self._collect_one(tr, 7)
+        b = buckets_of(t)
+        # pre-shed queue wait AND the shed->resubmit gap both charge
+        # shed_wait: 2.0 + 3.0
+        assert b["shed_wait"] == pytest.approx(5.0)
+        assert b["queue"] == pytest.approx(1.0)   # resubmit->prefill
+        assert b["prefill"] == pytest.approx(1.0)
+        assert b["other"] == pytest.approx(1.0)
+        assert t.attempts == 2
+        assert [k for k, _t, _a in t.marks if k == "shed"] == ["shed"]
+        # the shed mark carries its reason
+        assert any(k == "shed" and (a or {}).get("reason") == "deadline"
+                   for k, _t, a in t.marks)
+        # the retry's prefill rides the SECOND attempt
+        assert any(a == 1 and bk == "prefill"
+                   for a, bk, _s, _e in t.segments)
+
+    def test_kill_readmit_lineage(self):
+        tr = ReqTracer()
+        tr.begin(3, 0.0, cls="batch")
+        tr.work(3, "prefill", 1.0, 2.0)
+        tr.killed(3, 3.0, lost_tokens=2)
+        tr.work(3, "prefill", 4.0, 5.0)   # the re-prefill leg
+        tr.work(3, "decode", 5.0, 6.0)
+        tr.finish(3, 6.0)
+        t = self._collect_one(tr, 3)
+        b = buckets_of(t)
+        # waste = the killed attempt's prefill (1.0) + the
+        # kill->re-prefill re-admission wait (1.0)
+        assert b["waste"] == pytest.approx(2.0)
+        assert b["prefill"] == pytest.approx(1.0)  # surviving leg only
+        assert b["decode"] == pytest.approx(1.0)
+        assert b["queue"] == pytest.approx(1.0)
+        assert b["other"] == pytest.approx(1.0)    # prefill-end -> kill
+        assert t.killed == (0,) and t.attempts == 2
+        assert "kill" in [k for k, _t, _a in t.marks]
+        assert any(a == 1 and bk == "prefill"
+                   for a, bk, _s, _e in t.segments)
+        assert sum(b.values()) == pytest.approx(t.e2e_s)
+
+    def test_killed_idempotent_per_attempt(self):
+        tr = ReqTracer()
+        tr.begin(4, 0.0)
+        tr.killed(4, 1.0)
+        tr.killed(4, 1.5)  # same attempt observed by a second layer
+        tr.work(4, "prefill", 2.0, 3.0)
+        tr.finish(4, 3.0)
+        t = self._collect_one(tr, 4)
+        assert [k for k, _t, _a in t.marks].count("kill") == 1
+        assert t.attempts == 2 and t.killed == (0,)
+
+    def test_failed_work_is_waste(self):
+        tr = ReqTracer()
+        tr.begin(5, 0.0)
+        tr.work(5, "handoff", 1.0, 2.0, failed=True, try_n=1)
+        tr.work(5, "handoff", 2.0, 3.0, try_n=2)
+        tr.finish(5, 3.0)
+        t = self._collect_one(tr, 5)
+        b = buckets_of(t)
+        assert b["waste"] == pytest.approx(1.0)
+        assert b["handoff"] == pytest.approx(1.0)
+
+    def test_degrade_retags_the_attempt(self):
+        tr = ReqTracer()
+        tr.begin(9, 0.0)
+        tr.work(9, "handoff", 1.0, 2.0, failed=True)
+        tr.degrade(9, 2.5)
+        tr.work(9, "prefill", 3.0, 4.0)   # local monolithic re-prefill
+        tr.work(9, "decode", 4.0, 5.0)
+        tr.finish(9, 5.0)
+        t = self._collect_one(tr, 9)
+        b = buckets_of(t)
+        # failed handoff (1.0) + degrade->re-prefill wait (0.5)
+        assert b["waste"] == pytest.approx(1.5)
+        assert b["prefill"] == pytest.approx(1.0)
+        assert b["decode"] == pytest.approx(1.0)
+        assert b["other"] == pytest.approx(0.5)
+        assert t.attempts == 2
+        assert "degrade" in [k for k, _t, _a in t.marks]
+
+    def test_work_batch_traces_every_rid(self):
+        tr = ReqTracer()
+        for rid in (1, 2, 3):
+            tr.begin(rid, 0.0)
+        tr.work_batch((1, 2, 3), "decode", 1.0, 2.0)
+        for rid in (1, 2, 3):
+            tr.finish(rid, 2.0)
+        for t in tr.collect():
+            t.check()
+            assert buckets_of(t)["decode"] == pytest.approx(1.0)
+
+    def test_check_rejects_broken_decomposition(self):
+        with pytest.raises(ValueError, match="buckets sum"):
+            RequestTrace(1, "x", 0.0, 2.0, "finished", 1, (),
+                         {"queue": 1.0}, (), ()).check()
+        with pytest.raises(ValueError, match="negative bucket"):
+            RequestTrace(1, "x", 0.0, 2.0, "finished", 1, (),
+                         {"queue": -0.5, "other": 2.5}, (), ()).check()
+
+    def test_decomposition_stats_per_class(self):
+        tr = ReqTracer()
+        for rid, cls in ((1, "latency"), (2, "latency"), (3, "batch")):
+            tr.begin(rid, 0.0, cls=cls)
+            tr.work(rid, "prefill", 1.0, 2.0)
+            tr.finish(rid, 2.0)
+        tr.collect()
+        d = tr.decomposition()
+        assert set(d) == {"latency", "batch"}
+        st = d["latency"]["queue"]
+        assert st["count"] == 2
+        assert st["mean"] == pytest.approx(1.0)
+        assert st["p50"] == pytest.approx(1.0)
+        assert d["latency"]["e2e"]["mean"] == pytest.approx(2.0)
+
+
+class TestChromeExport:
+    def _lineage_tracer(self):
+        tr = ReqTracer()
+        tr.begin(1, 0.0, cls="latency")
+        tr.shed(1, 1.0, reason="deadline")
+        tr.begin(1, 2.0)
+        tr.work(1, "prefill", 3.0, 4.0)
+        tr.killed(1, 5.0)
+        tr.work(1, "prefill", 6.0, 7.0)
+        tr.mark(1, "first_token", 7.0)
+        tr.work(1, "decode", 7.0, 8.0)
+        tr.finish(1, 9.0)
+        tr.begin(2, 0.5, cls="batch")
+        tr.work(2, "decode", 1.5, 2.5)
+        tr.finish(2, 3.0)
+        tr.collect()
+        return tr
+
+    def test_export_passes_extended_validator(self):
+        trace = self._lineage_tracer().chrome_trace(pid=3)
+        assert json.loads(json.dumps(trace)) == trace  # round-trips
+        validate_chrome_trace(trace)
+        evs = trace["traceEvents"]
+        phs = [e["ph"] for e in evs]
+        # async roots, stack segments, instant marks, flow edges
+        assert phs.count("b") == 2 and phs.count("e") == 2
+        assert phs.count("B") == phs.count("E") >= 4
+        assert "i" in phs
+        # one s->f flow per attempt transition (shed + kill for rid 1)
+        flows = [e for e in evs if e["ph"] in ("s", "f")]
+        assert {e["name"] for e in flows} == {"shed", "kill"}
+        assert {e["id"] for e in flows} == {"1.0", "1.1"}
+        for e in flows:
+            assert e.get("pid") is not None and e.get("tid") is not None
+        # one lane (tid) per rid; the root carries the outcome
+        roots = {e["id"]: e for e in evs if e["ph"] == "b"}
+        assert roots[1]["args"]["attempts"] == 3
+        assert roots[2]["tid"] == 2
+
+    def test_empty_tracer_exports_meta_only(self):
+        trace = ReqTracer().chrome_trace()
+        validate_chrome_trace(trace)
+        assert [e["ph"] for e in trace["traceEvents"]] == ["M"]
+
+    def test_validator_rejects_broken_flow_chains(self):
+        trace = self._lineage_tracer().chrome_trace()
+        evs = trace["traceEvents"]
+        # a flow started but never finished
+        no_f = [e for e in evs if not (e["ph"] == "f"
+                                       and e["id"] == "1.0")]
+        with pytest.raises(ValueError, match="unterminated flow"):
+            validate_chrome_trace(dict(trace, traceEvents=no_f))
+        # a finish without its start
+        no_s = [e for e in evs if not (e["ph"] == "s"
+                                       and e["id"] == "1.0")]
+        with pytest.raises(ValueError, match="without open s"):
+            validate_chrome_trace(dict(trace, traceEvents=no_s))
+        # a flow step missing its lane anchor
+        naked = [dict(e) for e in evs]
+        for e in naked:
+            if e["ph"] == "s":
+                del e["tid"]
+                break
+        with pytest.raises(ValueError, match="without pid/tid"):
+            validate_chrome_trace(dict(trace, traceEvents=naked))
+
+    def test_validator_rejects_unclosed_async_root(self):
+        trace = self._lineage_tracer().chrome_trace()
+        evs = [e for e in trace["traceEvents"]
+               if not (e["ph"] == "e" and e.get("id") == 2)]
+        with pytest.raises(ValueError, match="unclosed async"):
+            validate_chrome_trace(dict(trace, traceEvents=evs))
+
+    def test_golden_schema_subprocess_proof(self, tmp_path):
+        """The acceptance gate as a subprocess: the exported span
+        forest validates from a cold interpreter; a corrupted copy is
+        rejected nonzero."""
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(self._lineage_tracer().chrome_trace()))
+        bad_trace = self._lineage_tracer().chrome_trace()
+        bad_trace["traceEvents"] = [
+            e for e in bad_trace["traceEvents"] if e["ph"] != "E"
+        ]
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(bad_trace))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        prog = ("import json, sys; "
+                "from tpuscratch.obs.trace import validate_chrome_trace; "
+                "validate_chrome_trace(json.load(open(sys.argv[1])))")
+        r = subprocess.run([sys.executable, "-c", prog, str(good)],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run([sys.executable, "-c", prog, str(bad)],
+                           capture_output=True, text=True, env=env,
+                           timeout=120)
+        assert r.returncode != 0
+        assert "unclosed" in r.stderr
+
+
+class TestEngineLineage:
+    def test_engine_drain_traces_every_request(self):
+        tracer = ReqTracer()
+        eng = ServeEngine(mesh_for(), cfg_for(), scfg_for(),
+                          tracer=tracer)
+        reqs = tenant_requests()
+        rep = eng.run(reqs)
+        assert rep.completed == len(reqs)
+        tracer.collect()
+        assert set(tracer.traces) == {r.rid for r in reqs}
+        for t in tracer.traces.values():
+            t.check()
+            b = buckets_of(t)
+            assert b["prefill"] > 0 and b["decode"] > 0
+            assert b["waste"] == 0.0 and b["shed_wait"] == 0.0
+            assert t.outcome == "finished" and t.attempts == 1
+            assert t.ttft_s is not None and 0 < t.ttft_s <= t.e2e_s
+        validate_chrome_trace(tracer.chrome_trace())
+
+    def test_traced_engine_output_identical(self):
+        reqs = tenant_requests()
+        base = ServeEngine(mesh_for(), cfg_for(), scfg_for()).run(reqs)
+        rep = ServeEngine(mesh_for(), cfg_for(), scfg_for(),
+                          tracer=ReqTracer()).run(reqs)
+        assert rep.outputs == base.outputs
+
+    def test_disagg_handoff_spans(self):
+        tracer = ReqTracer()
+        d = DisaggEngine(mesh_for(), cfg_for(),
+                         scfg_for(prefix_share=False), tracer=tracer)
+        reqs = tenant_requests()
+        rep = d.run(reqs)
+        assert rep.handoffs > 0
+        tracer.collect()
+        assert set(tracer.traces) == {r.rid for r in reqs}
+        for t in tracer.traces.values():
+            t.check()
+            b = buckets_of(t)
+            assert b["prefill"] > 0 and b["handoff"] > 0
+
+    def test_disagg_degrade_lineage(self):
+        """A never-healing serve/handoff fault for ONE rid: its trace
+        carries the chaos fault marks, the degrade edge, the wasted
+        staged/handoff legs, and still sums exactly."""
+        tracer = ReqTracer()
+        plan = ChaosPlan(0, [Fault(site="serve/handoff", key=2, p=1.0,
+                                   times=None)])
+        d = DisaggEngine(mesh_for(), cfg_for(),
+                         scfg_for(prefix_share=False), chaos=plan,
+                         tracer=tracer)
+        rep = d.run(tenant_requests())
+        assert rep.degraded == 1
+        tracer.collect()
+        t = tracer.traces[2]
+        t.check()
+        kinds = [k for k, _t, _a in t.marks]
+        assert "degrade" in kinds and "fault" in kinds
+        assert t.attempts >= 2
+        assert buckets_of(t)["waste"] > 0
+        # the post-degrade attempt re-prefilled locally
+        assert any(a >= 1 and bk == "prefill"
+                   for a, bk, _s, _e in t.segments)
+        # everyone else was untouched
+        for rid, tr in tracer.traces.items():
+            if rid != 2:
+                assert tr.attempts == 1 and buckets_of(tr)["waste"] == 0
+
+    def test_bind_tracer_respects_existing_and_disabled(self):
+        plan = ChaosPlan(0, [])
+        bind_tracer(plan, NullReqTracer())
+        assert plan.tracer is None          # disabled never binds
+        tr = ReqTracer()
+        bind_tracer(plan, tr)
+        assert plan.tracer is tr
+        bind_tracer(plan, ReqTracer())
+        assert plan.tracer is tr            # first binding wins
+
+    def test_macro_occupancy_helper(self):
+        mask = np.array([[True, False], [True, False], [False, False]])
+        rounds, occ = macro_occupancy(mask)
+        assert rounds == 2
+        assert occ.tolist() == [2, 0]
+
+
+class TestFleetLineage:
+    KILL = dict(site="serve/replica", at=(1,), key=0, kind="kill",
+                down_ticks=4)
+
+    def test_traced_chaos_drain_bit_identical(self):
+        """Observes-never-perturbs, live: the fully traced chaos drain
+        emits exactly the untraced drain's tokens."""
+        plan = lambda: ChaosPlan(seed=11, faults=(Fault(**self.KILL),))
+        base = fleet(3, rcfg=TWO_CLASSES, chaos=plan()).run(tagged())
+        tracer = ReqTracer()
+        rep = fleet(3, rcfg=TWO_CLASSES, chaos=plan(),
+                    tracer=tracer).run(tagged())
+        assert rep.outputs == base.outputs
+        assert rep.kills == 1 and rep.readmitted > 0
+        tracer.collect()
+        assert len(tracer.traces) == len(tagged())
+        for t in tracer.traces.values():
+            t.check()
+
+    def test_rack_kill_victim_lineage(self):
+        """ISSUE 20 satellite: the kill victim's trace carries the
+        kill, the evacuation/re-admission wait, and the re-prefill
+        span — and its decomposition still sums exactly."""
+        tracer = ReqTracer()
+        plan = ChaosPlan(seed=11, faults=(Fault(**self.KILL),))
+        router = fleet(3, rcfg=TWO_CLASSES, chaos=plan, tracer=tracer)
+        rep = router.run(tagged())
+        assert rep.kills == 1 and rep.readmitted > 0
+        tracer.collect()
+        victims = [t for t in tracer.traces.values() if t.killed]
+        assert victims
+        for t in victims:
+            t.check()
+            kinds = [k for k, _t, _a in t.marks]
+            assert "kill" in kinds and "dispatch" in kinds
+            assert t.attempts >= 2
+            b = buckets_of(t)
+            # the evacuated leg + re-admission wait charge waste
+            assert b["waste"] > 0
+            # the re-prefill leg rides a post-kill attempt
+            assert any(a > max(t.killed) and bk == "prefill"
+                       for a, bk, _s, _e in t.segments)
+            assert t.outcome == "finished"
+        # the survivors paid nothing
+        clean = [t for t in tracer.traces.values() if not t.killed]
+        assert all(buckets_of(t)["waste"] == 0.0 for t in clean)
+        validate_chrome_trace(tracer.chrome_trace())
+
+    def test_shed_retry_complete_links_across_resubmits(self):
+        """A deadline-shed request resubmitted by its client completes
+        with ONE trace spanning both attempts: the shed mark, the
+        charged shed_wait, and the retry flow edge in the export."""
+        tracer = ReqTracer()
+        rcfg = RouterConfig(
+            classes=(SLOClass("latency", target="ttft", max_queue=1,
+                              shed_after_s=2.0),),
+            tick_s=1.0,
+        )
+        router = fleet(1, rcfg=rcfg, tracer=tracer)
+        reqs = tenant_requests(3, max_new=6)
+        by_rid = {r.rid: r for r in reqs}
+        pending = [("latency", r) for r in reqs]
+        done, shed_rids = 0, set()
+        for _round in range(8):
+            rep = router.run(pending)
+            done += rep.completed
+            shed = router.take_shed()
+            if not shed:
+                break
+            shed_rids |= {s.rid for s in shed}
+            pending = [("latency", by_rid[s.rid]) for s in shed]
+        assert done == len(reqs) and shed_rids
+        tracer.collect()
+        for rid in shed_rids:
+            t = tracer.traces[rid]
+            t.check()
+            assert t.outcome == "finished"
+            assert t.attempts >= 2
+            assert "shed" in [k for k, _t, _a in t.marks]
+            assert buckets_of(t)["shed_wait"] > 0
+        trace = tracer.chrome_trace()
+        validate_chrome_trace(trace)
+        rid = min(shed_rids)
+        assert any(e["ph"] == "s" and e["name"] == "shed"
+                   and str(e["id"]).startswith(f"{rid}.")
+                   for e in trace["traceEvents"])
+
+    def test_sampled_fleet_traces_subset_only(self):
+        tracer = ReqTracer(sample_rate=0.5, salt=10)
+        rep = fleet(2, rcfg=TWO_CLASSES, tracer=tracer).run(tagged())
+        assert rep.completed == len(tagged())
+        tracer.collect()
+        want = {r.rid for _c, r in tagged()
+                if rid_sampled(r.rid, 0.5, salt=10)}
+        assert set(tracer.traces) == want
+        assert 0 < len(want) < len(tagged())
+        for t in tracer.traces.values():
+            t.check()
+
+
+class TestSinkRotation:
+    def test_rotation_bounds_disk_and_keeps_run_lines(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        s = Sink(p, run={"job": "rot"}, flush_every=1,
+                 rotate_bytes=400, max_segments=3)
+        for i in range(200):
+            s.emit("x", i=i, pad="p" * 40)
+        s.close()
+        assert s.rotations > 3
+        segs = [f"{p}.{i}" for i in (1, 2, 3)]
+        assert all(os.path.exists(q) for q in segs)
+        assert not os.path.exists(f"{p}.4")  # oldest dropped
+        for q in segs + [p]:
+            with open(q) as f:
+                first = json.loads(f.readline())
+            assert first["event"] == "run" and first["job"] == "rot"
+        # newest rotated segment holds the newest rotated data
+        with open(f"{p}.1") as f:
+            rows = [json.loads(x) for x in f][1:]
+        with open(f"{p}.3") as f:
+            older = [json.loads(x) for x in f][1:]
+        assert rows[0]["i"] > older[0]["i"]
+        total = sum(os.path.getsize(q) for q in segs + [p])
+        assert total <= 4 * (400 + 120)  # (max_segments+1) segments
+
+    def test_rotation_off_by_default(self, tmp_path):
+        p = str(tmp_path / "ev.jsonl")
+        s = Sink(p, flush_every=1)
+        for i in range(500):
+            s.emit("x", i=i)
+        s.close()
+        assert s.rotations == 0 and not os.path.exists(f"{p}.1")
+
+    def test_max_segments_clamped_to_one(self, tmp_path):
+        p = str(tmp_path / "e.jsonl")
+        s = Sink(p, flush_every=1, rotate_bytes=200, max_segments=0)
+        for i in range(100):
+            s.emit("x", i=i)
+        s.close()
+        assert s.rotations > 1
+        assert os.path.exists(f"{p}.1") and not os.path.exists(f"{p}.2")
+
+
+class TestReportDecomposition:
+    def _sinked_run(self, path):
+        s = Sink(str(path), run={"job": "rt"})
+        tr = ReqTracer(sink=s)
+        tr.begin(5, 0.0, cls="latency")
+        tr.work(5, "prefill", 1.0, 2.0, tokens=8)
+        tr.mark(5, "first_token", 2.0)
+        tr.work(5, "decode", 2.0, 3.0)
+        tr.finish(5, 4.0)
+        tr.begin(6, 0.5, cls="batch")
+        tr.work(6, "decode", 1.0, 3.0)
+        tr.finish(6, 3.0)
+        tr.collect()
+        s.close()
+
+    def test_decompose_and_summary_table(self, tmp_path):
+        p = tmp_path / "run.jsonl"
+        self._sinked_run(p)
+        events = load_events([str(p)])
+        d = decompose(events)
+        assert set(d) == {"latency", "batch"}
+        assert d["latency"]["prefill_s"]["mean"] == pytest.approx(1.0)
+        assert d["latency"]["e2e_s"]["mean"] == pytest.approx(4.0)
+        out = summarize(events)
+        assert out["decomposition"]["batch"]["decode_s"]["count"] == 1
+
+    def test_waterfall_is_exact(self, tmp_path):
+        p = tmp_path / "run.jsonl"
+        self._sinked_run(p)
+        events = load_events([str(p)])
+        text = request_waterfall(events, 5)
+        assert "request 5" in text and "latency" in text
+        assert "prefill" in text and "decode" in text
+        assert "exact" in text and "BROKEN" not in text
+        assert "no reqtrace/request event" in request_waterfall(events,
+                                                                99)
+
+    def test_cli_request_flag(self, tmp_path):
+        p = tmp_path / "run.jsonl"
+        self._sinked_run(p)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.report", str(p),
+             "--request", "5"],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "request 5" in r.stdout and "exact" in r.stdout
+
+
+class TestConfig22Regress:
+    ROW = {
+        "config": 22, "metric": "request_trace_decomposition",
+        "value": 41.8, "tokens_per_s_untraced": 42.4,
+        "trace_overhead_frac": 0.011, "n_traces": 96,
+        "waste_traces": 35, "kills": 2, "readmitted": 8,
+        "requests": 96, "replicas": 3, "ticks": 44,
+        "wall_s_traced": 6.41, "wall_s_untraced": 6.33,
+        "decomp_queue_s_latency": 0.021, "decomp_shed_wait_s_latency": 0.0,
+        "decomp_prefill_s_latency": 0.105, "decomp_handoff_s_latency": 0.0,
+        "decomp_decode_s_latency": 0.388, "decomp_waste_s_latency": 0.033,
+        "decomp_other_s_latency": 0.061, "decomp_queue_s_batch": 0.030,
+        "decomp_shed_wait_s_batch": 0.0, "decomp_prefill_s_batch": 0.117,
+        "decomp_handoff_s_batch": 0.0, "decomp_decode_s_batch": 0.401,
+        "decomp_waste_s_batch": 0.050, "decomp_other_s_batch": 0.066,
+        "platform": "cpu",
+    }
+
+    def test_field_directions(self):
+        for name in ("decomp_queue_s_latency", "decomp_waste_s_batch",
+                     "decomp_handoff_s_batch", "decomp_other_s_latency",
+                     # _LOWER_FIRST: a tenant class named "throughput"
+                     # must not drag its buckets into _HIGHER
+                     "decomp_decode_s_throughput"):
+            assert regress.direction(name) == "lower", name
+        for name in ("tokens_per_s_untraced", "readmitted"):
+            assert regress.direction(name) == "higher", name
+        for name in ("n_traces", "waste_traces", "ticks", "kills",
+                     "requests", "replicas", "wall_s_traced",
+                     "wall_s_untraced", "trace_overhead_frac"):
+            assert name in regress._SKIP, name
+        # the headline rides the untraced-rate gate plus the in-config
+        # hard gates (digest identity, overhead < 2%) — its own name
+        # carries no direction
+        assert regress.direction("request_trace_decomposition") is None
+        # bucket means sit on the wall-clock noise floor
+        assert regress.noise_floor("decomp_waste_s_latency") >= 0.5
+
+    def test_canned_row_gates(self):
+        base = regress.index_rows([self.ROW])
+        ok = regress.index_rows([dict(
+            self.ROW, tokens_per_s_untraced=43.0,
+            decomp_waste_s_latency=0.040,   # inside the 55% floor
+        )])
+        assert not regress.has_regression(
+            regress.compare(base, ok, noise=0.1)
+        )
+        bad = regress.index_rows([dict(
+            self.ROW, decomp_waste_s_latency=0.20,   # 6x the base
+            decomp_shed_wait_s_latency=0.05,         # zero-base gate
+            tokens_per_s_untraced=20.0,
+        )])
+        bad_fields = {(f.metric, f.field) for f in
+                      regress.compare(base, bad, noise=0.1)
+                      if f.status == "regressed"}
+        m = "request_trace_decomposition"
+        assert (m, "decomp_waste_s_latency") in bad_fields
+        assert (m, "decomp_shed_wait_s_latency") in bad_fields
+        assert (m, "tokens_per_s_untraced") in bad_fields
+        # walls/shape/overhead are context, never gated
+        wild = regress.index_rows([dict(self.ROW, wall_s_traced=500.0,
+                                        trace_overhead_frac=0.9,
+                                        n_traces=1)])
+        assert not regress.has_regression(
+            regress.compare(base, wild, noise=0.1)
+        )
+
+    def test_cli_subprocess_proof(self, tmp_path):
+        """The acceptance gate as a subprocess: config-22 clean pair
+        exits 0, injected waste-bucket/throughput regression exits 1."""
+
+        def write(name, rows):
+            p = str(tmp_path / name)
+            with open(p, "w") as f:
+                for r in rows:
+                    f.write(json.dumps(r) + "\n")
+            return p
+
+        base = write("base.json", [self.ROW])
+        good = write("good.json", [dict(self.ROW, value=42.3,
+                                        decomp_decode_s_latency=0.41)])
+        bad = write("bad.json", [dict(self.ROW,
+                                      decomp_waste_s_latency=0.25,
+                                      tokens_per_s_untraced=19.0)])
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, good],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        r = subprocess.run(
+            [sys.executable, "-m", "tpuscratch.obs.regress", base, bad],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "REGRESSED" in r.stdout
+
+
+@pytest.mark.slow
+class TestConfig22Acceptance:
+    def test_traced_pair_overhead_and_exactness(self):
+        """One config-22 pair end to end on the chaos workload: digest
+        identity, every decomposition exact (asserted inside
+        bench_reqtrace), the decomp_* fields populated per class."""
+        from tpuscratch.bench.traffic import (
+            bench_reqtrace,
+            traffic_chaos_setup,
+        )
+
+        setup = traffic_chaos_setup(False, 16)
+        cfg = cfg_for()
+        scfg = scfg_for(max_seq=max(scfg_for().max_seq,
+                                    setup["tcfg"].max_total_len))
+        mesh = mesh_for()
+        un = bench_reqtrace(mesh, cfg, scfg, setup, traced=False)
+        td = bench_reqtrace(mesh, cfg, scfg, setup, traced=True)
+        assert td["digest"] == un["digest"]
+        assert td["n_traces"] > 0 and td["waste_traces"] > 0
+        assert any(k.startswith("decomp_") and k.endswith("_latency")
+                   for k in td)
+        assert any(k.startswith("decomp_") and k.endswith("_batch")
+                   for k in td)

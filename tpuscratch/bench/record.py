@@ -2516,6 +2516,110 @@ def config21_hostfree(out: list) -> None:
     )
 
 
+def config22_reqtrace(out: list) -> None:
+    """Request-trace decomposition (ISSUE 20): the config-19 chaos
+    workload (replica kills + stall + head-of-queue re-admission) run
+    twice per repeat — once with a fleet-wide per-request tracer
+    (``obs.reqtrace.ReqTracer``, sample_rate=1.0) shared across the
+    router and every replica, once untraced — with the output DIGESTS
+    asserted identical per pair (tracing observes, never perturbs) and
+    the measured tracing overhead gated under 2% of the untraced
+    tokens/s.  Inside the traced arm ``bench_reqtrace`` asserts the
+    tentpole invariants live: every drained request's bucket
+    decomposition sums to its e2e latency EXACTLY
+    (``RequestTrace.check`` raises inside ``collect`` every fleet
+    tick), at least one kill victim's trace carries wasted work, and
+    the exported span forest passes the extended (async + flow event)
+    Chrome-trace validator.  The gated fields are the per-class bucket
+    means (``decomp_*`` — queue/handoff/waste lower, on CPU-proxy
+    noise floors) and the overhead fraction (lower)."""
+    import dataclasses as _dc
+
+    import jax
+
+    from tpuscratch.bench.decode_bench import default_decode_setup
+    from tpuscratch.bench.traffic import bench_reqtrace, traffic_chaos_setup
+    from tpuscratch.runtime.mesh import make_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    mesh = make_mesh((1, 1), ("dp", "sp"))
+    cfg, scfg, _batches, _kw = default_decode_setup(on_tpu)
+    setup = traffic_chaos_setup(on_tpu, scfg.vocab)
+    scfg = _dc.replace(
+        scfg, prefix_share=True,
+        max_seq=max(scfg.max_seq, setup["tcfg"].max_total_len),
+    )
+    # interleaved pairs (the config-17 discipline): machine drift hits
+    # traced and untraced alike; the digest pairing is checked PER
+    # pair, so one perturbing hook cannot hide behind a median
+    pairs = []
+    for _rep in range(3):
+        un = bench_reqtrace(mesh, cfg, scfg, setup, traced=False)
+        td = bench_reqtrace(mesh, cfg, scfg, setup, traced=True)
+        if td["digest"] != un["digest"]:
+            raise RuntimeError(
+                "config 22: traced digest differs from untraced — "
+                "tracing perturbed what the fleet emitted"
+            )
+        pairs.append((un, td))
+    # overhead: the MIN over pairs of the traced arm's fractional
+    # tokens/s deficit — any single pair bounds the true overhead from
+    # above, and one-sided scheduler noise inflates single pairs
+    overhead = min(
+        max(0.0, 1.0 - td["tokens_per_s"] / un["tokens_per_s"])
+        for un, td in pairs
+    )
+    if overhead >= 0.02:
+        raise RuntimeError(
+            f"config 22: tracing overhead {overhead:.1%} >= 2% of "
+            "untraced tokens/s in every pair — the observe-only "
+            "contract regressed"
+        )
+
+    def by_rate(r):
+        return r["tokens_per_s"]
+
+    un = _median_of([p[0] for p in pairs], by_rate)
+    td = _median_of([p[1] for p in pairs], by_rate)
+    decomp = {k: v for k, v in sorted(td.items())
+              if k.startswith("decomp_")}
+    print(
+        f"# config 22: traced {td['tokens_per_s']:.3e} tok/s vs "
+        f"{un['tokens_per_s']:.3e} untraced (overhead {overhead:.2%}), "
+        f"{td['n_traces']} traces ({td['waste_traces']} with waste), "
+        f"{td['kills']} kills, {td['readmitted']} readmitted, "
+        f"digests identical, every decomposition exact",
+        file=sys.stderr,
+    )
+    _emit(
+        out,
+        config=22,
+        metric="request_trace_decomposition",
+        value=td["tokens_per_s"],
+        tokens_per_s_untraced=un["tokens_per_s"],
+        trace_overhead_frac=overhead,
+        n_traces=td["n_traces"],
+        waste_traces=td["waste_traces"],
+        kills=td["kills"],
+        readmitted=td["readmitted"],
+        requests=td["requests"],
+        replicas=td["replicas"],
+        ticks=td["ticks"],
+        wall_s_traced=td["wall_s"],
+        wall_s_untraced=un["wall_s"],
+        **decomp,
+        detail=(
+            f"{td['replicas']} replicas, {td['requests']}-request "
+            f"chaos trace, {td['n_traces']} span trees collected "
+            f"({td['waste_traces']} carrying kill/degrade waste), "
+            f"every bucket decomposition sums to e2e exactly, traced/"
+            f"untraced digests identical, overhead {overhead:.2%} "
+            f"( {td['tokens_per_s']:.3e} vs {un['tokens_per_s']:.3e} "
+            f"tok/s), Perfetto flow export validated"
+        ),
+    )
+
+
 CONFIGS = {
     1: config1_stencil_single,
     2: config2_dot,
@@ -2538,6 +2642,7 @@ CONFIGS = {
     19: config19_traffic_chaos,
     20: config20_overload,
     21: config21_hostfree,
+    22: config22_reqtrace,
 }
 
 
@@ -2545,7 +2650,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--configs",
                     default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16,17,18,"
-                            "19,20,21")
+                            "19,20,21,22")
     ap.add_argument("--json", default=None, help="append results to this file")
     ap.add_argument("--obs", default=None,
                     help="obs JSONL path: config 12 attaches the engine "

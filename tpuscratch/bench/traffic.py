@@ -1171,3 +1171,86 @@ def bench_overload(mesh, cfg, scfg, setup: dict, storm: bool,
         "completed_latency": done.get("latency", 0),
         "completed_batch": done.get("batch", 0),
     }
+
+
+# ---- the config-22 workload (one definition) -----------------------------
+
+
+def bench_reqtrace(mesh, cfg, scfg, setup: dict, traced: bool) -> dict:
+    """One config-22 arm: the config-19 chaos workload (replica kills +
+    stall + head-of-queue re-admission) over a fresh fleet, with or
+    without a fleet-wide :class:`~tpuscratch.obs.reqtrace.ReqTracer`
+    attached.  The tentpole claims are asserted HERE (one definition
+    for the record config and the tests): every drained request's
+    bucket decomposition sums to its e2e latency EXACTLY
+    (``RequestTrace.check`` raises inside ``collect`` every fleet tick
+    — the live half of the gate, re-asserted over the full forest at
+    drain), at least one kill victim's trace carries wasted work, and
+    the exported span forest passes the extended (async + flow event)
+    Chrome-trace validator.  Digest bit-identity between a traced and
+    an untraced arm — tracing observes, never perturbs — is the record
+    config's cross-arm check; the row carries the digest for it."""
+    from tpuscratch.obs.reqtrace import ReqTracer
+    from tpuscratch.obs.trace import validate_chrome_trace
+    from tpuscratch.serve.engine import ServeEngine
+    from tpuscratch.serve.router import FleetRouter, RouterConfig, SLOClass
+
+    rcfg = RouterConfig(classes=tuple(
+        SLOClass(n, target=t) for n, t in setup["classes"]
+    ))
+    tracer = ReqTracer(sample_rate=1.0) if traced else None
+    router = FleetRouter(
+        [ServeEngine(mesh, cfg, scfg)
+         for _ in range(setup["n_replicas"])],
+        rcfg=rcfg,
+        chaos=chaos_plan_for(setup),
+        tracer=tracer,
+    )
+    tr = run_traffic(router, TraceGenerator(setup["tcfg"]),
+                     setup["n_requests"],
+                     open_budget=setup["open_budget"])
+    rep = tr.report
+    if rep.dropped != 0:
+        raise AssertionError(
+            f"zero-loss law violated: {rep.dropped} dropped"
+        )
+    if rep.readmitted == 0:
+        raise AssertionError(
+            "chaos arm re-admitted nothing — the kills fired on empty "
+            "replicas (workload/schedule drifted)"
+        )
+    row = {
+        "traced": int(traced),
+        "replicas": setup["n_replicas"],
+        "requests": tr.submitted,
+        "digest": tr.digest,
+        "peak_open": tr.peak_open,
+        "ticks": tr.ticks,
+        "wall_s": tr.wall_s,
+        "tokens_per_s": rep.tokens_per_s,
+        "kills": rep.kills,
+        "readmitted": rep.readmitted,
+    }
+    if traced:
+        tracer.collect()
+        traces = list(tracer.traces.values())
+        if not traces:
+            raise AssertionError("traced arm collected zero traces")
+        for t in traces:
+            t.check()  # exact decomposition, re-asserted over the forest
+        if not any(t.buckets["waste"] > 0 for t in traces):
+            raise AssertionError(
+                "no trace carries wasted work — the kill victims' "
+                "re-prefill legs went missing (lineage drifted)"
+            )
+        validate_chrome_trace(tracer.chrome_trace())
+        row["n_traces"] = len(traces)
+        row["waste_traces"] = sum(
+            1 for t in traces if t.buckets["waste"] > 0
+        )
+        for cls, fields in tracer.decomposition().items():
+            for name, st in fields.items():
+                if name in ("e2e", "ttft"):
+                    continue
+                row[f"decomp_{name}_s_{cls}"] = st["mean"]
+    return row

@@ -105,6 +105,18 @@ def bind_sink(plan: Optional["ChaosPlan"], sink) -> None:
         plan.sink = sink
 
 
+def bind_tracer(plan: Optional["ChaosPlan"], tracer) -> None:
+    """The ``bind_sink`` rule for the per-request tracer
+    (:mod:`tpuscratch.obs.reqtrace`): a rid-keyed firing then drops a
+    ``fault`` mark into that request's span tree, so an injected
+    handoff/prefill fault shows up INSIDE the victim's causal trace
+    rather than only in the fleet-wide ``ft/fault`` stream.  Only an
+    unbound plan is rebound, and only to an enabled tracer."""
+    if plan is not None and plan.tracer is None and tracer is not None \
+            and tracer.enabled:
+        plan.tracer = tracer
+
+
 @dataclasses.dataclass
 class Fault:
     """One fault clause of a plan.
@@ -177,6 +189,7 @@ class ChaosPlan:
         self._domain_fired: set = set()  # (fault_i, index) ignitions
         self.fired: dict[str, int] = {}
         self.sink = sink if sink is not None else NullSink()
+        self.tracer = None  # bound via bind_tracer (obs.reqtrace)
 
     # ---- the schedule --------------------------------------------------
 
@@ -231,6 +244,14 @@ class ChaosPlan:
                 **({"key": key} if key is not None else {}),
                 **({"stage": stage} if stage is not None else {}),
             )
+            if (self.tracer is not None and key is not None
+                    and site != "serve/replica"
+                    and site.startswith(("serve/", "comm/"))):
+                # rid-keyed serve-path sites only (serve/replica keys on
+                # the REPLICA index, which could collide with a rid);
+                # the tracer drops marks for rids it is not following
+                self.tracer.mark(key, "fault", time.perf_counter(),
+                                 site=site, fault=f.kind)
             return f
         return None
 

@@ -37,6 +37,12 @@ recompile detection):
 - **regress** — ``python -m tpuscratch.obs.regress BASE.json NEW.json``
   diffs two ``bench/record`` artifacts against a noise band and exits
   nonzero on regression (also ``bench/record --check BASE.json``).
+- **reqtrace** — fleet-wide per-request causal tracing: every lifecycle
+  edge (submit, queue, shed, dispatch, prefill, handoff, decode
+  occupancy, kill/evacuate/re-admit, finish) lands in one span tree per
+  request, and each drained request's bucket decomposition sums to its
+  end-to-end latency EXACTLY (``RequestTrace.check``); exports the tree
+  as Perfetto flow-event JSON through the ``trace`` validator.
 """
 
 from tpuscratch.obs.metrics import (  # noqa: F401
@@ -78,4 +84,11 @@ from tpuscratch.obs.goodput import (  # noqa: F401
     BUCKETS,
     GoodputReport,
     goodput_report,
+)
+from tpuscratch.obs.reqtrace import (  # noqa: F401
+    REQ_BUCKETS,
+    NullReqTracer,
+    ReqTracer,
+    RequestTrace,
+    rid_sampled,
 )

@@ -139,7 +139,14 @@ _LOWER = ("latency", "p50", "p99", "bytes", "ratio", "_s", "seconds",
 #: fields ("ppermutes_per_sweep", "halo_bytes_per_sweep") would
 #: otherwise be mislabeled higher-is-better by _HIGHER's "per_s"
 #: substring (meant for per-second rates) — these are costs, down.
-_LOWER_FIRST = ("per_sweep",)
+#: (``decomp_`` pins the config-22 per-class latency-decomposition
+#: bucket means, ISSUE 20: ``decomp_<bucket>_s_<class>`` — every
+#: bucket second (queue wait, shed wait, handoff, kill/degrade WASTE,
+#: stall remainder) is a cost at the fixed chaos workload, down.
+#: Registered FIRST on purpose: the class suffix is a tenant-chosen
+#: name, and a class called e.g. "throughput" would otherwise drag its
+#: buckets into _HIGHER upside down.)
+_LOWER_FIRST = ("per_sweep", "decomp_")
 #: fields that are identity/configuration, never compared
 #: (``replicas`` is the config-17 fleet size — workload shape, like dp)
 #: (``switches``/``workloads`` are the config-18 arbitration shape —
@@ -165,6 +172,15 @@ _SKIP = {"config", "dp", "n_devices", "steps", "accum", "host",
          "wall_s_solo", "kills", "stalls", "requests", "peak_open",
          "wall_s_chaos", "wall_s_clean", "wall_s_storm",
          "ticks_storm", "ticks_clean",
+         # config 22 (ISSUE 20): trace/workload shape and context —
+         # n_traces/waste_traces are deterministic chaos-schedule
+         # counts, the walls/ticks are context like config 19's, and
+         # trace_overhead_frac is HARD-gated in-config (RuntimeError
+         # at >= 2%); its recorded value is often exactly 0.0 (min
+         # over interleaved pairs), and a zero base would inf-trip the
+         # delta on any nonzero re-measurement
+         "n_traces", "waste_traces", "ticks", "wall_s_traced",
+         "wall_s_untraced", "trace_overhead_frac",
          # per-class completion counts are the fixed closed-loop
          # quotas, not costs — and "completed_latency" would otherwise
          # ride the "latency" _LOWER substring upside down
@@ -217,6 +233,9 @@ _NOISE_FLOORS = (
                                # dominated on the proxy
     ("goodput", 0.40),         # goodput fractions of short CPU runs —
                                # chunk walls in the ms regime
+    ("decomp_", 0.55),         # per-class bucket MEANS (config 22):
+                               # wall-clock waits/work seconds in the
+                               # scheduler-noise regime, same as ttft
 )
 
 

@@ -35,7 +35,8 @@ from typing import Iterable, Optional
 from tpuscratch.obs.metrics import merge_snapshots, percentile
 from tpuscratch.obs.trace import detect_stragglers, fold_phase_events
 
-__all__ = ["load_events", "stragglers", "summarize", "format_table", "main"]
+__all__ = ["load_events", "stragglers", "summarize", "decompose",
+           "request_waterfall", "format_table", "main"]
 
 
 def load_events(paths: Iterable[str]) -> list[dict]:
@@ -152,7 +153,98 @@ def summarize(events: list[dict],
         }
     if last_snapshot:
         out["metrics"] = merge_snapshots(last_snapshot.values())
+    # the per-class latency decomposition (reqtrace/request events) —
+    # unfiltered summaries only, same rule as the skew table
+    if only_event is None:
+        decomp = decompose(events)
+        if decomp:
+            out["decomposition"] = decomp
     return out
+
+
+def decompose(events: list[dict]) -> dict:
+    """Per-class latency decomposition from ``reqtrace/request`` events
+    (``obs.reqtrace.ReqTracer.collect``): {class: {field: stats}} over
+    every traced request's bucket seconds plus e2e/ttft — the artifact
+    twin of ``ReqTracer.decomposition()`` (reservoir-bounded, live)
+    rebuilt exactly from the JSONL (unbounded, post-mortem)."""
+    per_cls: dict[str, dict[str, list[float]]] = {}
+    for rec in events:
+        if rec.get("event") != "reqtrace/request":
+            continue
+        fields = per_cls.setdefault(str(rec.get("cls", "")), {})
+        for key, val in rec.items():
+            if not key.endswith("_s") or isinstance(val, bool) \
+                    or not isinstance(val, (int, float)):
+                continue
+            fields.setdefault(key, []).append(float(val))
+    return {
+        cls: {
+            key: {
+                "count": len(vals),
+                "mean": sum(vals) / len(vals),
+                "p50": percentile(vals, 50),
+                "p99": percentile(vals, 99),
+            }
+            for key, vals in sorted(fields.items())
+        }
+        for cls, fields in sorted(per_cls.items())
+    }
+
+
+def request_waterfall(events: list[dict], rid: int) -> str:
+    """One request's causal span tree as an ASCII waterfall: every
+    attributed segment (attempt-grouped, submit-relative) as a scaled
+    bar, every lifecycle mark on its own line, the bucket totals, and
+    the exact-sum line (``sum(buckets) == e2e`` — the
+    ``RequestTrace.check`` invariant, re-checked from the artifact).
+    The NEWEST ``reqtrace/request`` event for ``rid`` wins (a retried
+    fleet run may trace the rid twice)."""
+    rec = None
+    for r in events:
+        if r.get("event") == "reqtrace/request" and r.get("rid") == rid:
+            rec = r
+    if rec is None:
+        return f"no reqtrace/request event for rid {rid}"
+    e2e = float(rec.get("e2e_s", 0.0))
+    scale = 40.0 / e2e if e2e > 0 else 0.0
+    lines = [
+        f"request {rid}  class={rec.get('cls', '')!r}  "
+        f"outcome={rec.get('outcome', '?')}  attempts={rec.get('attempts')}"
+        f"  e2e {_fmt(e2e)} s"
+        + (f"  ttft {_fmt(rec['ttft_s'])} s" if "ttft_s" in rec else "")
+    ]
+    segs = [tuple(s) for s in rec.get("segments", [])]
+    width = max([len(str(b)) for _a, b, _t0, _t1 in segs] or [6])
+    last_attempt = None
+    for attempt, bucket, t0, t1 in segs:
+        if attempt != last_attempt:
+            lines.append(f"  attempt {attempt}:")
+            last_attempt = attempt
+        pad = int(round(t0 * scale))
+        bar = max(1, int(round((t1 - t0) * scale)))
+        lines.append(
+            f"    {str(bucket).ljust(width)}  "
+            f"[{_fmt(t0):>10} .. {_fmt(t1):>10}] "
+            f"{' ' * pad}{'#' * bar}"
+        )
+    marks = [tuple(m) for m in rec.get("marks", [])]
+    if marks:
+        lines.append("  marks:")
+        for kind, t in marks:
+            lines.append(f"    {str(kind).ljust(width)}  at {_fmt(t)} s")
+    lines.append("  buckets:")
+    total = 0.0
+    for key in sorted(k for k in rec if k.endswith("_s")
+                      and k not in ("e2e_s", "ttft_s")):
+        total += float(rec[key])
+        lines.append(f"    {key.ljust(width + 2)}  {_fmt(rec[key])} s")
+    ok = abs(total - e2e) <= 1e-5 * max(1.0, e2e) + 1e-5
+    lines.append(
+        f"  sum(buckets) {_fmt(total)} s == e2e {_fmt(e2e)} s: "
+        f"{'exact' if ok else 'BROKEN'}"
+    )
+    return "\n".join(lines)
 
 
 def _fmt(v: float) -> str:
@@ -204,6 +296,22 @@ def format_table(summary: dict) -> str:
                 f"{_fmt(r['max_s'])} s vs host {r['fastest']} "
                 f"{_fmt(r['min_s'])} s  (skew {skew_txt})"
             )
+    decomp = summary.get("decomposition")
+    if decomp:
+        lines.append("\nper-class latency decomposition (reqtrace)")
+        for cls, fields in decomp.items():
+            lines.append(f"  class {cls!r}")
+            width = max(len(k) for k in fields)
+            lines.append(
+                f"    {'field'.ljust(width)}  {'n':>6} {'mean':>12} "
+                f"{'p50':>12} {'p99':>12}"
+            )
+            for key, st in fields.items():
+                lines.append(
+                    f"    {key.ljust(width)}  {st['count']:>6} "
+                    f"{_fmt(st['mean']):>12} {_fmt(st['p50']):>12} "
+                    f"{_fmt(st['p99']):>12}"
+                )
     metrics = summary.get("metrics")
     if metrics:
         lines.append("\nmetrics (final snapshot, merged across hosts)")
@@ -236,7 +344,13 @@ def main(argv=None) -> int:
                     help="only summarize this event kind")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
+    ap.add_argument("--request", type=int, default=None, metavar="RID",
+                    help="print one traced request's span-tree waterfall "
+                         "(reqtrace/request events) instead of the summary")
     args = ap.parse_args(argv)
+    if args.request is not None:
+        print(request_waterfall(load_events(args.paths), args.request))
+        return 0
     summary = summarize(load_events(args.paths), only_event=args.event)
     if args.json:
         print(json.dumps(summary))

@@ -1,0 +1,623 @@
+"""Per-request causal span trees with EXACT latency decomposition.
+
+The fleet sheds, retries, kills racks and re-admits victims, but the
+only latency truth so far was aggregate per-class TTFT percentiles —
+when one request blows its SLO nothing could say WHERE the time went.
+This module is Dapper-style request-scoped tracing (Sigelman et al.,
+2010) composed with the MegaScale exact wall-partition discipline that
+``obs.goodput`` already applies run-scoped: every lifecycle edge of a
+request (router submit → queue → shed/retry → dispatch → prefill or
+staged-disagg prefill → handoff → per-macro-tick decode occupancy →
+finish/evict/quarantine, including the chaos legs kill → evacuate →
+re-admission → re-prefill) lands as a causally-linked span keyed by
+rid, and each drained request yields a :class:`RequestTrace` whose
+bucket decomposition sums to its end-to-end latency EXACTLY
+(:meth:`RequestTrace.check` — the goodput law applied per request).
+
+Design points:
+
+- **Observes, never perturbs.**  Hooks append host-side
+  ``perf_counter`` stamps to per-rid lists — no device syncs, no
+  scheduling decisions, no RNG draws — so a traced fleet's output
+  digest is bit-identical to the untraced fleet's (asserted by record
+  config 22).  ``NullReqTracer`` is the disabled path: every hook is a
+  constant-time no-op, so instrumented layers hold a tracer
+  unconditionally (the ``NullSink`` idiom).
+- **Exact by construction.**  Attribution runs the goodput clipping
+  sweep per request: claims (work spans, closed wait intervals) sort
+  by start, clip to ``[cursor, finish_t]``, and advance the cursor —
+  so attributed intervals are disjoint and inside the request wall,
+  the ``other`` bucket is the exact remainder, and the buckets sum to
+  the wall by construction, not by hope.
+- **Waste is explicit.**  Work spans recorded under an attempt that a
+  replica kill invalidated (and staged prefills a handoff degrade
+  threw away) re-bucket to ``waste`` at attribution — a victim's
+  trace SHOWS its re-prefill cost instead of smearing it into queue
+  time.  Shed → resubmit gaps are ``shed_wait``; post-kill
+  re-admission waits are ``waste``.
+- **Seeded sampling.**  :func:`rid_sampled` is a pure function of
+  (rid, sample_rate, salt) — the same rid samples identically on
+  every replica and every run, so a sampled request's tree is always
+  complete (no half-traced requests) and the 100k-request acceptance
+  run can trace 1% affordably.
+- **Perfetto export.**  :meth:`ReqTracer.chrome_trace` renders one
+  lane per request: a ``b``/``e`` async root spanning submit→finish,
+  the CLIPPED bucket intervals as ``B``/``E`` pairs (disjoint, so the
+  validator's stack pairing holds), marks as ``i`` instants, and
+  ``s``/``f`` flow events linking shed→retry and kill→re-admission
+  attempt chains — validated by the extended
+  ``obs.trace.validate_chrome_trace``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from tpuscratch.obs.metrics import Reservoir
+
+__all__ = [
+    "REQ_BUCKETS",
+    "NullReqTracer",
+    "ReqTracer",
+    "RequestTrace",
+    "rid_sampled",
+]
+
+#: the per-request wall partition, in waterfall order.  ``waste`` is
+#: stall/re-admission waste: killed-attempt work, degraded staged
+#: prefills, post-kill re-admission waits.  ``other`` is the exact
+#: unattributed remainder (host orchestration between spans).
+REQ_BUCKETS = (
+    "queue", "shed_wait", "prefill", "handoff", "decode", "waste", "other",
+)
+
+_WORK_BUCKET = {"prefill": "prefill", "handoff": "handoff",
+                "decode": "decode"}
+_WAIT_BUCKET = {"queue": "queue", "shed": "shed_wait", "readmit": "waste"}
+
+
+def rid_sampled(rid: int, sample_rate: float, salt: int = 0) -> bool:
+    """Pure sampling decision: a seeded hash of (rid, salt) against
+    ``sample_rate`` — no call-order state, so every layer that asks
+    about a rid gets the same answer and a sampled request's tree is
+    always complete.  ``>= 1`` always samples, ``<= 0`` never.
+
+    The mix is splitmix64, NOT a CRC: CRC32 is linear, so two equal-
+    length ``f"{rid}:{salt}"`` strings differ by a CONSTANT xor and
+    nearby salts would select (nearly) the same rid population — a
+    salt exists precisely to draw an independent sample."""
+    if sample_rate >= 1.0:
+        return True
+    if sample_rate <= 0.0:
+        return False
+    m = (1 << 64) - 1
+    x = (int(rid) + (int(salt) + 1) * 0x9E3779B97F4A7C15) & m
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & m
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & m
+    x ^= x >> 31
+    return (x / 2**64) < sample_rate
+
+
+class _Span:
+    """One recorded claim on the request's wall: a work span (prefill /
+    handoff / decode) or a closed wait interval."""
+
+    __slots__ = ("kind", "t0", "t1", "attempt", "bucket", "waste", "args")
+
+    def __init__(self, kind: str, t0: float, t1: float, attempt: int,
+                 bucket: str, waste: bool = False,
+                 args: Optional[dict] = None):
+        self.kind = kind
+        self.t0 = t0
+        self.t1 = t1
+        self.attempt = attempt
+        self.bucket = bucket
+        self.waste = waste
+        self.args = args
+
+
+class _Live:
+    """Mutable per-rid tracing state between ``begin`` and ``collect``."""
+
+    __slots__ = ("rid", "cls", "submit_t", "spans", "marks", "attempt",
+                 "killed", "wait", "state", "shed_t", "finish_t",
+                 "outcome", "links")
+
+    def __init__(self, rid: int, cls: Optional[str], submit_t: float):
+        self.rid = rid
+        self.cls = cls
+        self.submit_t = submit_t
+        self.spans: list[_Span] = []
+        self.marks: list[tuple[str, float, Optional[dict]]] = []
+        self.attempt = 0
+        self.killed: set[int] = set()
+        # the one open wait interval: (t0, tag) or None
+        self.wait: Optional[tuple[float, str]] = (submit_t, "queue")
+        self.state = "open"  # open | shed
+        self.shed_t = 0.0
+        self.finish_t: Optional[float] = None
+        self.outcome = ""
+        # (from_attempt, to_attempt, reason) — the flow-event edges
+        self.links: list[tuple[int, int, str]] = []
+
+
+class RequestTrace:
+    """One drained request's causal tree: the bucket decomposition (sums
+    to the end-to-end wall exactly), the clipped segments behind it, and
+    the instant marks — everything the waterfall view and the Perfetto
+    export render."""
+
+    __slots__ = ("rid", "cls", "submit_t", "finish_t", "outcome",
+                 "attempts", "killed", "buckets", "segments", "marks")
+
+    def __init__(self, rid: int, cls: Optional[str], submit_t: float,
+                 finish_t: float, outcome: str, attempts: int,
+                 killed: tuple[int, ...], buckets: dict[str, float],
+                 segments: tuple, marks: tuple):
+        self.rid = rid
+        self.cls = cls
+        self.submit_t = submit_t
+        self.finish_t = finish_t
+        self.outcome = outcome
+        self.attempts = attempts
+        self.killed = killed
+        self.buckets = buckets
+        #: ((attempt, bucket, t0, t1), ...) — clipped, disjoint, in
+        #: time order, all inside [submit_t, finish_t]
+        self.segments = segments
+        #: ((kind, t, args), ...)
+        self.marks = marks
+
+    @property
+    def e2e_s(self) -> float:
+        return self.finish_t - self.submit_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        for kind, t, _args in self.marks:
+            if kind == "first_token":
+                return t - self.submit_t
+        return None
+
+    def check(self, tol: float = 1e-6) -> None:
+        """The per-request goodput law: buckets non-negative and summing
+        to the end-to-end wall exactly (tolerance covers float
+        re-association only).  Raises ``ValueError`` on violation."""
+        for name in REQ_BUCKETS:
+            v = self.buckets.get(name, 0.0)
+            if v < -tol:
+                raise ValueError(
+                    f"rid {self.rid}: negative bucket {name}={v:.9f}"
+                )
+        total = sum(self.buckets.values())
+        wall = self.e2e_s
+        if abs(total - wall) > tol * max(1.0, wall):
+            raise ValueError(
+                f"rid {self.rid}: buckets sum {total:.9f} != e2e "
+                f"{wall:.9f} (diff {total - wall:.3e})"
+            )
+
+
+class NullReqTracer:
+    """The disabled tracer: accepts every hook, records nothing —
+    instrumented layers hold one unconditionally (the ``NullSink``
+    idiom), so the untraced hot path costs a no-op method call."""
+
+    enabled = False
+
+    def sampled(self, rid: int) -> bool:
+        return False
+
+    def begin(self, rid, t, cls=None) -> None:
+        pass
+
+    def shed(self, rid, t, reason="") -> None:
+        pass
+
+    def killed(self, rid, t, **args) -> None:
+        pass
+
+    def work(self, rid, kind, t0, t1, **args) -> None:
+        pass
+
+    def work_batch(self, rids, kind, t0, t1, **args) -> None:
+        pass
+
+    def mark(self, rid, kind, t, **args) -> None:
+        pass
+
+    def degrade(self, rid, t) -> None:
+        pass
+
+    def finish(self, rid, t, outcome="finished") -> None:
+        pass
+
+    def collect(self) -> list:
+        return []
+
+
+class ReqTracer:
+    """The live tracer: rid-keyed span trees, exact decomposition at
+    drain, per-class reservoir aggregation, Perfetto export.
+
+    One tracer is SHARED by the router and every replica (the router's
+    constructor propagates it), so a request's tree stays whole as it
+    moves between layers.  All hooks are idempotent where two layers
+    can observe the same edge (router begin + engine begin, router
+    kill + engine evacuate)."""
+
+    enabled = True
+
+    def __init__(self, sample_rate: float = 1.0, salt: int = 0,
+                 sink=None, reservoir_k: int = 4096, seed: int = 0):
+        if not (0.0 <= sample_rate <= 1.0):
+            raise ValueError(
+                f"sample_rate must be in [0, 1], got {sample_rate}"
+            )
+        self.sample_rate = float(sample_rate)
+        self.salt = int(salt)
+        self.sink = sink
+        self._reservoir_k = reservoir_k
+        self._seed = seed
+        self._live: dict[int, _Live] = {}
+        self._pending_done: list[int] = []
+        #: {rid: RequestTrace} of every collected request
+        self.traces: dict[int, RequestTrace] = {}
+        # per-(cls, bucket) decomposition reservoirs + per-cls e2e/ttft
+        self._res: dict[tuple, Reservoir] = {}
+
+    # ---- lifecycle hooks ----------------------------------------------
+
+    def sampled(self, rid: int) -> bool:
+        return rid_sampled(rid, self.sample_rate, self.salt)
+
+    def begin(self, rid: int, t: float, cls: Optional[str] = None) -> None:
+        """Router/engine submit.  New rid: open its tree with a queue
+        wait.  A SHED rid resubmitting (retry storms reuse the rid):
+        close the shed→resubmit gap as ``shed_wait``, bump the attempt,
+        link the chain, reopen the queue wait.  An already-open rid
+        (engine submit after router submit): no-op."""
+        lv = self._live.get(rid)
+        if lv is None:
+            if rid in self.traces or not self.sampled(rid):
+                return
+            self._live[rid] = _Live(rid, cls, t)
+            return
+        if lv.cls is None and cls is not None:
+            lv.cls = cls
+        if lv.state == "shed":
+            if t > lv.shed_t:
+                lv.spans.append(_Span("wait:shed", lv.shed_t, t,
+                                      lv.attempt, "shed_wait"))
+            lv.links.append((lv.attempt, lv.attempt + 1, "retry"))
+            lv.attempt += 1
+            lv.state = "open"
+            lv.wait = (t, "queue")
+
+    def shed(self, rid: int, t: float, reason: str = "") -> None:
+        """Router shed: the open queue wait closes as ``shed_wait`` (the
+        time was spent waiting for a dispatch that never came) and the
+        tree parks until a retry resubmits or the client abandons."""
+        lv = self._live.get(rid)
+        if lv is None:
+            return
+        if lv.wait is not None:
+            w0, tag = lv.wait
+            if t > w0:
+                # a doomed queue wait is shed_wait; a post-kill
+                # re-admission wait that ends in a shed stays waste
+                bucket = ("waste" if tag == "readmit" else "shed_wait")
+                lv.spans.append(_Span(f"wait:{tag}", w0, t, lv.attempt,
+                                      bucket))
+            lv.wait = None
+        lv.state = "shed"
+        lv.shed_t = t
+        lv.marks.append(("shed", t, {"reason": reason} if reason else None))
+
+    def killed(self, rid: int, t: float, **args) -> None:
+        """Replica kill / evacuation: the current attempt's work spans
+        re-bucket to ``waste`` at attribution, the open wait closes at
+        the kill, and the re-admission wait (also ``waste``) opens.
+        Idempotent per attempt — the router and the engine may both
+        report the same victim."""
+        lv = self._live.get(rid)
+        if lv is None or lv.attempt in lv.killed:
+            return
+        if lv.wait is not None and lv.wait[1] == "readmit":
+            # still waiting out the previous kill/degrade: a second
+            # layer reporting the same victim, not a new attempt
+            return
+        if lv.wait is not None:
+            w0, tag = lv.wait
+            if t > w0:
+                lv.spans.append(_Span(f"wait:{tag}", w0, t, lv.attempt,
+                                      _WAIT_BUCKET.get(tag, "other")))
+            lv.wait = None
+        lv.killed.add(lv.attempt)
+        lv.marks.append(("kill", t, dict(args) if args else None))
+        lv.links.append((lv.attempt, lv.attempt + 1, "readmit"))
+        lv.attempt += 1
+        lv.wait = (t, "readmit")
+
+    def work(self, rid: int, kind: str, t0: float, t1: float,
+             **args) -> None:
+        """One work span (``prefill`` / ``handoff`` / ``decode``).  The
+        open wait interval closes at the work's start — waits end where
+        real work begins.  ``failed=True`` marks the span waste (an
+        in-engine retry's burned attempt)."""
+        lv = self._live.get(rid)
+        if lv is None:
+            return
+        if lv.wait is not None:
+            w0, tag = lv.wait
+            if t0 > w0:
+                lv.spans.append(_Span(f"wait:{tag}", w0, t0, lv.attempt,
+                                      _WAIT_BUCKET.get(tag, "other")))
+            lv.wait = None
+        failed = bool(args.pop("failed", False))
+        lv.spans.append(_Span(kind, t0, t1, lv.attempt,
+                              _WORK_BUCKET.get(kind, "other"),
+                              waste=failed, args=args or None))
+
+    def work_batch(self, rids: Sequence[int], kind: str, t0: float,
+                   t1: float, **args) -> None:
+        """One sweep's span fanned out to every participating rid — the
+        per-macro-tick decode occupancy stamp (each rid's lane shows the
+        sweeps it rode; clipping de-overlaps at attribution)."""
+        for rid in rids:
+            self.work(rid, kind, t0, t1, **args)
+
+    def mark(self, rid: int, kind: str, t: float, **args) -> None:
+        """A zero-duration lifecycle instant (dispatch, first_token,
+        admit_prefilled, fault, replay)."""
+        lv = self._live.get(rid)
+        if lv is None:
+            return
+        lv.marks.append((kind, t, dict(args) if args else None))
+
+    def degrade(self, rid: int, t: float) -> None:
+        """Disagg handoff degrade: the staged prefill + handoff attempts
+        are thrown away and the request re-enters the decode engine's
+        queue — their spans re-bucket to waste, and the wait until the
+        re-prefill is re-admission waste."""
+        lv = self._live.get(rid)
+        if lv is None:
+            return
+        for sp in lv.spans:
+            if sp.attempt == lv.attempt and sp.bucket in ("prefill",
+                                                          "handoff"):
+                sp.waste = True
+        lv.marks.append(("degrade", t, None))
+        lv.links.append((lv.attempt, lv.attempt + 1, "degrade"))
+        lv.attempt += 1
+        lv.wait = (t, "readmit")
+
+    def finish(self, rid: int, t: float, outcome: str = "finished") -> None:
+        """Terminal edge (evict / quarantine / front-retire): stamp the
+        end of the wall and queue the tree for collection."""
+        lv = self._live.get(rid)
+        if lv is None or lv.finish_t is not None:
+            return
+        if lv.wait is not None:
+            w0, tag = lv.wait
+            if t > w0:
+                lv.spans.append(_Span(f"wait:{tag}", w0, t, lv.attempt,
+                                      _WAIT_BUCKET.get(tag, "other")))
+            lv.wait = None
+        lv.finish_t = t
+        lv.outcome = outcome
+        self._pending_done.append(rid)
+
+    # ---- collection ----------------------------------------------------
+
+    def collect(self) -> list[RequestTrace]:
+        """Materialize every finished tree: run the exact attribution,
+        ASSERT the per-request law (``RequestTrace.check`` — the live
+        half of the config-22 gate), fold the buckets into the
+        per-class reservoirs, and emit one ``reqtrace/request`` sink
+        event per request.  Called at every engine/router tick end;
+        cheap when nothing finished."""
+        if not self._pending_done:
+            return []
+        out = []
+        for rid in self._pending_done:
+            lv = self._live.pop(rid, None)
+            if lv is None:
+                continue
+            tr = self._attribute(lv)
+            tr.check()
+            cls = tr.cls or ""
+            for name in REQ_BUCKETS:
+                self._reservoir((cls, name)).observe(tr.buckets[name])
+            self._reservoir((cls, "e2e")).observe(tr.e2e_s)
+            ttft = tr.ttft_s
+            if ttft is not None:
+                self._reservoir((cls, "ttft")).observe(ttft)
+            self.traces[rid] = tr
+            out.append(tr)
+            if self.sink is not None and self.sink.enabled:
+                self.sink.emit(
+                    "reqtrace/request",
+                    rid=tr.rid, cls=cls, outcome=tr.outcome,
+                    attempts=tr.attempts, e2e_s=round(tr.e2e_s, 6),
+                    **({"ttft_s": round(ttft, 6)}
+                       if ttft is not None else {}),
+                    **{f"{b}_s": round(tr.buckets[b], 6)
+                       for b in REQ_BUCKETS},
+                    segments=[
+                        [a, b, round(t0 - tr.submit_t, 6),
+                         round(t1 - tr.submit_t, 6)]
+                        for a, b, t0, t1 in tr.segments
+                    ],
+                    marks=[[k, round(t - tr.submit_t, 6)]
+                           for k, t, _a in tr.marks],
+                )
+        self._pending_done.clear()
+        return out
+
+    def _reservoir(self, key: tuple) -> Reservoir:
+        r = self._res.get(key)
+        if r is None:
+            r = self._res[key] = Reservoir(self._reservoir_k,
+                                           seed=self._seed)
+        return r
+
+    def _attribute(self, lv: _Live) -> RequestTrace:
+        """The goodput clipping sweep, per request: claims sort by
+        start, clip to ``[cursor, finish_t]``, advance the cursor — so
+        attributed intervals are disjoint and inside the wall, and the
+        ``other`` bucket is the exact remainder."""
+        finish_t = lv.finish_t if lv.finish_t is not None else lv.submit_t
+        wall = finish_t - lv.submit_t
+        claims = []
+        for sp in lv.spans:
+            # a killed attempt's WORK is waste (it will be redone);
+            # its waits keep their bucket — queue time is queue time,
+            # and hiding it under waste would mask backpressure
+            wasted = sp.waste or (sp.attempt in lv.killed
+                                  and sp.bucket in _WORK_BUCKET.values())
+            claims.append((sp.t0, sp.t1, sp.attempt,
+                           "waste" if wasted else sp.bucket))
+        claims.sort(key=lambda c: (c[0], c[1]))
+        buckets = {name: 0.0 for name in REQ_BUCKETS}
+        segments = []
+        cursor = lv.submit_t
+        attributed = 0.0
+        for t0, t1, attempt, bucket in claims:
+            s = max(t0, cursor)
+            e = min(t1, finish_t)
+            if e <= s:
+                continue
+            buckets[bucket] += e - s
+            attributed += e - s
+            segments.append((attempt, bucket, s, e))
+            cursor = e
+        buckets["other"] = max(0.0, wall - attributed)
+        return RequestTrace(
+            rid=lv.rid, cls=lv.cls, submit_t=lv.submit_t,
+            finish_t=finish_t, outcome=lv.outcome or "finished",
+            attempts=lv.attempt + 1, killed=tuple(sorted(lv.killed)),
+            buckets=buckets, segments=tuple(segments),
+            marks=tuple(lv.marks),
+        )
+
+    # ---- aggregation ---------------------------------------------------
+
+    def decomposition(self) -> dict[str, dict[str, dict[str, float]]]:
+        """{class: {bucket|e2e|ttft: {count, mean, p50, p99}}} over every
+        collected request — the per-class TTFT/E2E decomposition
+        percentiles, bounded by the reservoirs."""
+        out: dict[str, dict[str, dict[str, float]]] = {}
+        for (cls, name), res in sorted(self._res.items()):
+            if res.count == 0:
+                continue
+            out.setdefault(cls, {})[name] = {
+                "count": res.count,
+                "mean": res.mean,
+                "p50": res.percentile(50),
+                "p99": res.percentile(99),
+            }
+        return out
+
+    # ---- Perfetto export -----------------------------------------------
+
+    def chrome_trace(self, pid: int = 0) -> dict:
+        """Every collected request as Chrome trace-event JSON: one lane
+        (tid) per rid holding a ``b``/``e`` async root over the whole
+        wall, the clipped bucket segments as ``B``/``E`` pairs (disjoint
+        by construction, so the validator's stack pairing holds), marks
+        as ``i`` instants, and ``s``/``f`` flows linking the attempt
+        chain across sheds/kills/degrades.  Timestamps are microseconds
+        relative to the earliest submit; ties break on the op-seq
+        counter (record order), the ``obs.trace`` rule."""
+        traces = sorted(self.traces.values(), key=lambda tr: tr.rid)
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": "requests"},
+        }]
+        if not traces:
+            return {"traceEvents": meta, "displayTimeUnit": "ms"}
+        t0 = min(tr.submit_t for tr in traces)
+
+        def us(t: float) -> float:
+            return (t - t0) * 1e6
+
+        out = []  # (tid, ts, seq, event)
+        seq = 0
+        for tr in traces:
+            tid = tr.rid
+            root = f"request {tr.rid}"
+            base = {"pid": pid, "tid": tid}
+            out.append((tid, us(tr.submit_t), seq, dict(
+                base, name=root, ph="b", cat="request", id=tr.rid,
+                ts=us(tr.submit_t),
+                args={"cls": tr.cls or "", "outcome": tr.outcome,
+                      "attempts": tr.attempts},
+            )))
+            seq += 1
+            for attempt, bucket, s, e in tr.segments:
+                out.append((tid, us(s), seq, dict(
+                    base, name=bucket, ph="B", ts=us(s),
+                    args={"attempt": attempt},
+                )))
+                seq += 1
+                out.append((tid, us(e), seq,
+                            dict(base, name=bucket, ph="E", ts=us(e))))
+                seq += 1
+            for kind, t, margs in tr.marks:
+                out.append((tid, us(t), seq, dict(
+                    base, name=kind, ph="i", s="t", ts=us(t),
+                    args=dict(margs) if margs else {},
+                )))
+                seq += 1
+            # flow chain across attempts: one s→f edge per transition,
+            # anchored at the transition instant in this request's lane
+            for i, (_src, dst, reason) in enumerate(
+                    _attempt_edges(tr)):
+                flow_id = f"{tr.rid}.{i}"
+                t_edge = _edge_time(tr, i)
+                out.append((tid, us(t_edge[0]), seq, dict(
+                    base, name=reason, ph="s", cat="attempt",
+                    id=flow_id, ts=us(t_edge[0]),
+                )))
+                seq += 1
+                out.append((tid, us(t_edge[1]), seq, dict(
+                    base, name=reason, ph="f", bp="e", cat="attempt",
+                    id=flow_id, ts=us(t_edge[1]),
+                )))
+                seq += 1
+            out.append((tid, us(tr.finish_t), seq, dict(
+                base, name=root, ph="e", cat="request", id=tr.rid,
+                ts=us(tr.finish_t),
+            )))
+            seq += 1
+        out.sort(key=lambda e: e[:3])
+        return {
+            "traceEvents": meta + [e[3] for e in out],
+            "displayTimeUnit": "ms",
+        }
+
+
+def _attempt_edges(tr: RequestTrace) -> list[tuple[int, int, str]]:
+    """The attempt-transition edges of a collected trace, recovered
+    from its marks (shed / kill / degrade each advance the attempt)."""
+    edges = []
+    a = 0
+    for kind, _t, _args in tr.marks:
+        if kind in ("shed", "kill", "degrade"):
+            edges.append((a, a + 1, kind))
+            a += 1
+    return edges
+
+
+def _edge_time(tr: RequestTrace, i: int) -> tuple[float, float]:
+    """(source, target) stamps of attempt edge ``i``: the transition
+    mark and the next recorded point after it (the resubmit/re-prefill
+    landing), falling back to the finish stamp."""
+    ts = [t for kind, t, _a in tr.marks
+          if kind in ("shed", "kill", "degrade")]
+    t_src = ts[i]
+    candidates = [s for _a, _b, s, _e in tr.segments if s > t_src]
+    candidates += [t for _k, t, _a in tr.marks if t > t_src]
+    t_dst = min(candidates) if candidates else tr.finish_t
+    return t_src, max(t_dst, t_src)

@@ -94,12 +94,22 @@ class Sink:
     checkpointer's background writer stamps its ``ckpt/write`` event
     from its own thread at the moment the write actually finishes — the
     goodput end-stamp convention — while the step loop keeps emitting.
+
+    ``rotate_bytes`` bounds the file: when a flush leaves the active
+    segment at or past the threshold, segments shift logrotate-style
+    (``path`` -> ``path.1`` -> ... -> ``path.<max_segments>``, the
+    oldest dropped) and a fresh active file opens with its own ``run``
+    first line, so every segment stays self-describing and total disk
+    is bounded by ``(max_segments + 1) * ~rotate_bytes`` instead of
+    growing without bound over a long-lived fleet.  0 (the default)
+    disables rotation.
     """
 
     enabled = True
 
     def __init__(self, path: str, run: Optional[dict] = None,
-                 host: int = 0, flush_every: int = 64) -> None:
+                 host: int = 0, flush_every: int = 64,
+                 rotate_bytes: int = 0, max_segments: int = 8) -> None:
         if host:
             root, ext = os.path.splitext(path)
             path = f"{root}.h{host}{ext or '.jsonl'}"
@@ -108,6 +118,10 @@ class Sink:
         self._lock = threading.Lock()
         self._buf: list[str] = []
         self._flush_every = max(1, flush_every)
+        self._rotate_bytes = max(0, int(rotate_bytes))
+        self._max_segments = max(1, int(max_segments))
+        self._run_meta = dict(run or {})
+        self.rotations = 0
         self._t0 = time.time()
         parent = os.path.dirname(path)
         if parent:
@@ -161,6 +175,33 @@ class Sink:
         if self._buf:
             self._f.write("\n".join(self._buf) + "\n")
             self._buf.clear()
+        self._f.flush()
+        if self._rotate_bytes and self._f.tell() >= self._rotate_bytes:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        """Shift segments and reopen — caller holds the lock (so the
+        fresh segment's ``run`` line is written directly, not via
+        ``emit``, which would deadlock on the non-reentrant lock)."""
+        self._finalizer.detach()  # the old finalizer must not re-close
+        self._f.close()
+        oldest = f"{self.path}.{self._max_segments}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for i in range(self._max_segments - 1, 0, -1):
+            seg = f"{self.path}.{i}"
+            if os.path.exists(seg):
+                os.replace(seg, f"{self.path}.{i + 1}")
+        os.replace(self.path, f"{self.path}.1")
+        self._f = open(self.path, "a")
+        self.rotations += 1
+        self._finalizer = weakref.finalize(
+            self, _close_file, self._f, self._buf, self._lock
+        )
+        rec = {"event": "run", "t": round(time.time() - self._t0, 6),
+               "host": self.host, "segment": self.rotations}
+        rec.update(self._run_meta)
+        self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
 
     def flush(self) -> None:

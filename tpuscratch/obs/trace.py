@@ -313,9 +313,15 @@ def merge_chrome_traces(
 
 def validate_chrome_trace(trace: dict) -> int:
     """The golden schema check: JSON-serializable, every ``B`` paired
-    with a same-name ``E`` in stack order per (pid, tid) lane, and
-    timestamps non-decreasing per lane.  Returns the number of data
-    events checked; raises ``ValueError`` on the first violation."""
+    with a same-name ``E`` in stack order per (pid, tid) lane, async
+    ``b``/``e`` pairs nested per (pid, id), flow chains (``s`` →
+    ``t``* → ``f``) complete per flow id with pid AND tid on every
+    step, and timestamps non-decreasing per lane.  Returns the number
+    of data events checked; raises ``ValueError`` on the first
+    violation.  Equal timestamps rely on the writer's op-seq tiebreak
+    (``FlightRecorder.chrome_trace`` sorts on it), so TRUE record
+    order survives coarse clocks — the stack pairing here is what that
+    rule protects."""
     import json
 
     json.dumps(trace)  # must be serializable as-is
@@ -323,6 +329,9 @@ def validate_chrome_trace(trace: dict) -> int:
     if not isinstance(events, list):
         raise ValueError("traceEvents missing or not a list")
     stacks: dict[tuple, list] = {}
+    async_stacks: dict[tuple, list] = {}
+    # flow id -> state: "open" after s (t keeps it open), closed = gone
+    flows: dict = {}
     last_ts: dict[tuple, float] = {}
     n = 0
     for ev in events:
@@ -351,12 +360,61 @@ def validate_chrome_trace(trace: dict) -> int:
                     f"mispaired span in lane {lane}: E {ev['name']!r} "
                     f"closes B {top!r}"
                 )
+        elif ph in ("b", "e"):
+            # async-nestable pair: matched per (pid, id), names must
+            # pair in stack order (a request's root span in obs.reqtrace)
+            if "id" not in ev:
+                raise ValueError(f"async event without id: {ev!r}")
+            key = (ev.get("pid"), ev["id"])
+            if ph == "b":
+                async_stacks.setdefault(key, []).append(ev["name"])
+            else:
+                stack = async_stacks.get(key) or []
+                if not stack:
+                    raise ValueError(
+                        f"unmatched async e for id {ev['id']!r}: {ev!r}"
+                    )
+                top = stack.pop()
+                if top != ev["name"]:
+                    raise ValueError(
+                        f"mispaired async span id {ev['id']!r}: "
+                        f"e {ev['name']!r} closes b {top!r}"
+                    )
+        elif ph in ("s", "t", "f"):
+            # flow chain: starts with s, continues with t, ends with f;
+            # every step needs BOTH pid and tid (the viewer anchors flow
+            # arrows to lane points — an unpaired step renders nowhere)
+            if ev.get("pid") is None or ev.get("tid") is None:
+                raise ValueError(f"flow event without pid/tid: {ev!r}")
+            if "id" not in ev:
+                raise ValueError(f"flow event without id: {ev!r}")
+            fid = ev["id"]
+            if ph == "s":
+                if fid in flows:
+                    raise ValueError(f"flow id {fid!r} started twice")
+                flows[fid] = "open"
+            else:
+                if flows.get(fid) != "open":
+                    raise ValueError(
+                        f"flow {ph!r} for id {fid!r} without open s"
+                    )
+                if ph == "f":
+                    del flows[fid]
         elif ph not in ("i", "I", "X"):
             raise ValueError(f"unknown phase {ph!r}: {ev!r}")
         n += 1
     for lane, stack in stacks.items():
         if stack:
             raise ValueError(f"unclosed span(s) in lane {lane}: {stack}")
+    for key, stack in async_stacks.items():
+        if stack:
+            raise ValueError(
+                f"unclosed async span(s) for id {key[1]!r}: {stack}"
+            )
+    if flows:
+        raise ValueError(
+            f"unterminated flow chain(s): {sorted(map(repr, flows))}"
+        )
     return n
 
 

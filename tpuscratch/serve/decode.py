@@ -54,6 +54,7 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -532,6 +533,20 @@ def build_decode_loop(mesh: Mesh, cfg: TransformerConfig,
          P(dp)),
         donate_argnums=(1,),
     )
+
+
+def macro_occupancy(mask) -> tuple[int, "np.ndarray"]:
+    """The macro-boundary stamp: fold a macro scan's per-round activity
+    mask ``(T, B)`` — the plain loop's emit mask, or ``n_emit > 0``
+    under speculation — into ``(bank_rounds, per_slot_rounds)``.
+    ``bank_rounds`` is the number of rounds any slot ran before the
+    early-exit psum idled the bank (per-slot active masks are prefixes,
+    so the longest column IS the any-active iteration count — the
+    ``_decode_rounds`` rule, scan-widened); ``per_slot_rounds[s]`` is
+    how many of them slot ``s`` occupied — what the request tracer
+    stamps on each rid's per-macro-tick decode span."""
+    m = np.asarray(mask, dtype=bool)
+    return int(m.any(axis=1).sum()), m.sum(axis=0).astype(np.int64)
 
 
 # ---- speculative decoding: self-drafting proposer + batched verify -------

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import time
 from typing import Optional, Sequence
 
 import jax.numpy as jnp
@@ -188,7 +189,8 @@ class DisaggEngine:
                  sink=None, chaos=None, recorder=None,
                  prefill_group: int = 0,
                  stage_pages: Optional[int] = None,
-                 handoff_retry: RetryPolicy = DEFAULT_HANDOFF_RETRY):
+                 handoff_retry: RetryPolicy = DEFAULT_HANDOFF_RETRY,
+                 tracer=None):
         if scfg.prefix_share or scfg.chunk_prefill:
             raise ValueError(
                 "DisaggEngine stages MONOLITHIC prefills; run prefix "
@@ -196,7 +198,7 @@ class DisaggEngine:
             )
         self.engine = ServeEngine(
             mesh, cfg, scfg, params=params, embed=embed, dp=dp, sp=sp,
-            sink=sink, chaos=chaos, recorder=recorder,
+            sink=sink, chaos=chaos, recorder=recorder, tracer=tracer,
         )
         self.mesh, self.cfg, self.scfg = mesh, cfg, scfg
         self._dp, self._sp = dp, sp
@@ -313,6 +315,15 @@ class DisaggEngine:
     def is_quarantined(self, rid: int) -> bool:
         return self.engine.is_quarantined(rid)
 
+    # the per-request tracer lives on the decode engine (one tracer per
+    # replica, both halves) — the router's set_tracer contract
+    @property
+    def tracer(self):
+        return self.engine.tracer
+
+    def set_tracer(self, tracer) -> None:
+        self.engine.set_tracer(tracer)
+
     @property
     def has_buffered_finishes(self) -> bool:
         return bool(self._finish_buf) or self.engine.has_buffered_finishes
@@ -358,6 +369,12 @@ class DisaggEngine:
             owed.append((st.req.rid, 0, 1))
         for rid, toks in self._finish_buf:
             owed.append((rid, 0, len(toks)))
+        if self.engine.tracer.enabled and owed:
+            # front-half victims (the decode engine marks its own in
+            # engine.evacuate below; killed() is idempotent per attempt)
+            now = time.perf_counter()
+            for rid, _unaccounted, lost in owed:
+                self.engine.tracer.killed(rid, now, lost_tokens=lost)
         self._queue.clear()
         self._handoff.clear()
         self._finish_buf = []
@@ -408,6 +425,7 @@ class DisaggEngine:
         # decode-side admission (the engine's stamp_submit setdefault
         # keeps this when the request re-enters a degraded handoff)
         self.engine.stamp_submit(req.rid, t0)
+        self.engine.tracer.begin(req.rid, self.engine._submit_t[req.rid])
         self._queue.append(req)
 
     def _stage_prefill(self, req: Request) -> Optional[_Staged]:
@@ -447,12 +465,18 @@ class DisaggEngine:
             # the queue for deterministic replay (the engine recovery
             # contract, staging-side).  ``req`` itself is still at the
             # queue head — the caller only pops on success
+            if eng.tracer.enabled:
+                eng._trace_span((req.rid,), "prefill", staged=True,
+                                failed=True)
             self._recover_stage()
             self._stage_alloc = PageAllocator(geom.n_pages)
             raise
         self._stage_count += 1
         self._stage_tokens += n_tok
         self._stage_s += eng._last_span_s()
+        if eng.tracer.enabled:
+            eng._trace_span((req.rid,), "prefill", staged=True,
+                            tokens=n_tok)
         eng._mark_first_token(req.rid)  # TTFT: first token exists HERE
         return _Staged(req=req, pages=pages, first_token=tok)
 
@@ -565,8 +589,14 @@ class DisaggEngine:
                 # the donated decode pool may be consumed mid-program:
                 # reset it (in-flight decode requests replay) so the
                 # NEXT attempt migrates into a valid pool
+                if eng.tracer.enabled:
+                    eng._trace_span((req.rid,), "handoff", failed=True,
+                                    try_n=attempts["n"])
                 eng._recover_cache()
                 raise
+            if eng.tracer.enabled:
+                eng._trace_span((req.rid,), "handoff",
+                                try_n=attempts["n"])
 
         try:
             retry(attempt, self._retry, op="serve/handoff")
@@ -584,6 +614,10 @@ class DisaggEngine:
                 "ft/degrade", rid=req.rid, attempts=attempts["n"],
                 error=f"{type(exc).__name__}: {exc}",
             )
+            # the staged prefill + every handoff attempt was wasted
+            # work: re-tag it before the engine's own admission opens
+            # the request's next attempt (begin() is then a no-op)
+            eng.tracer.degrade(req.rid, time.perf_counter())
             eng.submit(req)
             return True
         self._retried += attempts["n"] - 1
@@ -620,6 +654,8 @@ class DisaggEngine:
                 # to migrate; the staged pages retire right here
                 self._stage_alloc.free(staged.pages)
                 self.engine._tokens_generated += 1
+                if self.engine.tracer.enabled:
+                    self.engine.tracer.finish(req.rid, time.perf_counter())
                 finished.append((req.rid, (staged.first_token,)))
                 continue
             self._handoff.append(staged)

@@ -35,10 +35,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
-from tpuscratch.ft.chaos import bind_sink
+from tpuscratch.ft.chaos import bind_sink, bind_tracer
 from tpuscratch.ft.retry import RetryPolicy, retry as ft_retry
 from tpuscratch.models.transformer import TransformerConfig, init_params
 from tpuscratch.obs.metrics import CompileCounter, MetricsRegistry
+from tpuscratch.obs.reqtrace import NullReqTracer
 from tpuscratch.obs.sink import NullSink
 from tpuscratch.obs.trace import FlightRecorder, emit_phase_totals
 from tpuscratch.runtime.profiling import Timeline
@@ -50,6 +51,7 @@ from tpuscratch.serve.decode import (
     build_spec_decode_loop,
     build_verify_step,
     check_serve_mesh,
+    macro_occupancy,
     plan_sweep_waves,
     propose_draft,
 )
@@ -403,7 +405,7 @@ class ServeEngine:
                  params: Optional[dict] = None,
                  embed: Optional[jax.Array] = None,
                  dp: str = "dp", sp: str = "sp",
-                 sink=None, chaos=None, recorder=None):
+                 sink=None, chaos=None, recorder=None, tracer=None):
         check_serve_mesh(mesh, cfg, dp, sp)
         self._dp_size = mesh.shape[dp]
         if scfg.n_slots % self._dp_size:
@@ -517,7 +519,12 @@ class ServeEngine:
         # watermark, tick latency, insert/evict counts, compile counts
         self.metrics = MetricsRegistry()
         self.sink = sink if sink is not None else NullSink()
+        # per-request causal tracing (obs.reqtrace): the NullReqTracer
+        # path is a no-op method call per hook, so the engine holds one
+        # unconditionally — the NullSink idiom
+        self.tracer = tracer if tracer is not None else NullReqTracer()
         bind_sink(chaos, self.sink)  # injected ft/fault events join the stream
+        bind_tracer(chaos, self.tracer)  # rid-keyed faults mark span trees
         self._tick = 0
         # effective macro-step width (macro_clamp — the one shared
         # rule): nothing clamps since the host-free lift (ISSUE 19);
@@ -761,9 +768,9 @@ class ServeEngine:
         request, keeps the original stamp)."""
         t0 = self._submit_t.pop(rid, None)
         if rid not in self._ttft:
-            self._ttft[rid] = (
-                time.perf_counter() - t0 if t0 is not None else 0.0
-            )
+            now = time.perf_counter()
+            self._ttft[rid] = now - t0 if t0 is not None else 0.0
+            self.tracer.mark(rid, "first_token", now)
             if len(self._ttft) > 4096:
                 # bounded for step()-driven serving loops that never
                 # read TTFT (run() pops at report, the router pops per
@@ -874,6 +881,8 @@ class ServeEngine:
         self._quarantined[rid] = reason
         self._submit_t.pop(rid, None)
         self.metrics.counter("serve/quarantined").inc()
+        self.tracer.finish(rid, time.perf_counter(),
+                           outcome="quarantined")
         self.sink.emit("ft/quarantine", rid=rid, attempts=attempts,
                        error=reason)
 
@@ -946,6 +955,12 @@ class ServeEngine:
         self._ttft.clear()
         self._poison_rid = None
         self.metrics.counter("serve/evacuated").inc(len(owed))
+        if self.tracer.enabled and owed:
+            # the kill edge of every victim's trace: the current
+            # attempt's spans become waste, the re-admission wait opens
+            now = time.perf_counter()
+            for rid, _unaccounted, lost in owed:
+                self.tracer.killed(rid, now, lost_tokens=lost)
         self.sink.emit("serve/evacuate", owed=len(owed))
         return owed
 
@@ -959,6 +974,21 @@ class ServeEngine:
         if len(self.timeline.spans) > _MAX_SPANS:
             del self.timeline.spans[: -_MAX_SPANS]
         return s
+
+    def set_tracer(self, tracer) -> None:
+        """Attach a per-request tracer (``obs.reqtrace.ReqTracer``) —
+        the fleet router propagates ONE shared tracer to every replica
+        so a request's tree stays whole across dispatch and
+        re-admission."""
+        self.tracer = tracer if tracer is not None else NullReqTracer()
+        bind_tracer(self._chaos, self.tracer)
+
+    def _trace_span(self, rids: Sequence[int], kind: str, **args) -> None:
+        """Fan the timeline span just closed out to ``rids`` as one
+        work span each — the tracer reuses the Timeline's perf_counter
+        stamps, so tracing adds NO clock reads to the hot path."""
+        sp = self.timeline.spans[-1]
+        self.tracer.work_batch(rids, kind, sp.begin, sp.end, **args)
 
     def _fresh_kv(self) -> dict:
         """A zeroed pool committed to the canonical cache sharding."""
@@ -1278,6 +1308,8 @@ class ServeEngine:
                 Request(rid=st.rid, prompt=st.prompt, max_new=st.max_new,
                         stop_tokens=st.stop)
             )
+            if self.tracer.enabled:
+                self.tracer.mark(st.rid, "replay", time.perf_counter())
         if self._tries is not None:
             for trie in self._tries:
                 trie.clear()
@@ -1304,6 +1336,9 @@ class ServeEngine:
             raise ValueError(f"request id {req.rid} already used")
         self._seen_rids.add(req.rid)
         self.stamp_submit(req.rid, t0)
+        # idempotent for rids the router already began; cls stays the
+        # router's when one was set there
+        self.tracer.begin(req.rid, self._submit_t[req.rid])
         self._queue.append(req)
 
     def admit_prefilled(self, req: Request, slot: int, pages: list[int],
@@ -1330,6 +1365,7 @@ class ServeEngine:
             )
         self._seen_rids.add(req.rid)
         self._tokens_generated += 1
+        self.tracer.mark(req.rid, "admit_prefilled", time.perf_counter())
         self._mark_first_token(req.rid)
         self._slots[slot] = _Slot(
             rid=req.rid, prompt=req.prompt, pages=list(pages),
@@ -1506,6 +1542,10 @@ class ServeEngine:
                 # reset the (possibly donated-and-consumed) cache — every
                 # in-flight request requeues for deterministic replay
                 self._allocators[group].free(pages)
+                if self.tracer.enabled:
+                    # the span context manager committed the failed
+                    # bracket before re-raising: charge it as waste
+                    self._trace_span((req.rid,), "prefill", failed=True)
                 self._queue.appendleft(req)
                 self._recover_cache()
                 self._poison_rid = req.rid
@@ -1519,6 +1559,9 @@ class ServeEngine:
                     break
                 except Exception as exc:
                     self.metrics.counter("serve/prefill_failures").inc()
+                    if self.tracer.enabled:
+                        self._trace_span((req.rid,), "prefill",
+                                         failed=True, attempt=a)
                     # the donated cache may be consumed: reset it and
                     # requeue every IN-FLIGHT request (rids key the PRNG
                     # streams, so their replay is byte-identical); THIS
@@ -1536,6 +1579,8 @@ class ServeEngine:
                                        attempt=a + 1,
                                        error=f"{type(exc).__name__}: {exc}")
         self._prefill_s += self._last_span_s()
+        if self.tracer.enabled:
+            self._trace_span((req.rid,), "prefill", tokens=n_tok)
         self._prefill_count += 1
         self._tokens_generated += 1
         self._mark_first_token(req.rid)
@@ -1934,6 +1979,9 @@ class ServeEngine:
             self._recover_cache()  # donated kv may be consumed; replay
             raise
         self._prefill_s += self._last_span_s()
+        if self.tracer.enabled:
+            self._trace_span([self._slots[s].rid for s in slots],
+                             "prefill", chunked=True)
         for s in slots:
             st = self._slots[s]
             take = takes[s]
@@ -1969,6 +2017,8 @@ class ServeEngine:
         assert st is not None
         self._free_slot_pages(slot, st)
         self._slots[slot] = None
+        if self.tracer.enabled:  # THE terminal edge of every sweep path
+            self.tracer.finish(st.rid, time.perf_counter())
         return st.rid, tuple(st.generated)
 
     # ---- the tick ------------------------------------------------------
@@ -1994,6 +2044,10 @@ class ServeEngine:
             accepted=self._spec_accepted - accepted0,
             prefill_tokens=self._prefill_tokens - ptok0,
         )
+        if self.tracer.enabled:
+            # materialize finished trees now: the exact-decomposition
+            # law (RequestTrace.check) asserts live at every tick end
+            self.tracer.collect()
         return finished
 
     def _observe_tick(self, tick_s: float, inserted: int, evicted: int,
@@ -2211,6 +2265,9 @@ class ServeEngine:
             self._recover_cache()  # donated kv may be consumed; replay
             raise
         self._decode_s += self._last_span_s()
+        if self.tracer.enabled:
+            self._trace_span([self._slots[s].rid for s in active],
+                             "decode", rounds=1)
         self._decode_steps += 1
         self._dispatches += 1
         self._host_syncs += 1
@@ -2406,7 +2463,15 @@ class ServeEngine:
             accept_hist = self.metrics.histogram("serve/accept_len")
             # rounds actually run before the early-exit psum idled the
             # bank (a round every slot skipped emitted nothing)
-            rounds = int((n_emit > 0).any(axis=1).sum())
+            rounds, occ = macro_occupancy(n_emit > 0)
+            if self.tracer.enabled:
+                # per-macro-tick decode occupancy, one span per rid
+                # riding this scan, stamped with ITS round count
+                sp_ev = self.timeline.spans[-1]
+                for s in active:
+                    self.tracer.work(self._slots[s].rid, "decode",
+                                     sp_ev.begin, sp_ev.end,
+                                     rounds=int(occ[s]), scans=n_scans)
             for s in active:
                 st = self._slots[s]
                 for r in range(n_emit.shape[0]):
@@ -2430,10 +2495,16 @@ class ServeEngine:
             # rounds actually run before the early-exit mask idled the
             # bank (per-slot active masks are prefixes, so the longest
             # column IS the any-active iteration count)
-            rounds = int(mask.any(axis=1).sum())
+            rounds, occ = macro_occupancy(mask)
+            if self.tracer.enabled:
+                sp_ev = self.timeline.spans[-1]
+                for s in active:
+                    self.tracer.work(self._slots[s].rid, "decode",
+                                     sp_ev.begin, sp_ev.end,
+                                     rounds=int(occ[s]), scans=n_scans)
             for s in active:
                 st = self._slots[s]
-                steps = int(mask[:, s].sum())
+                steps = int(occ[s])
                 out = [int(t) for t in toks[:steps, s]]
                 st.n_cached += steps
                 st.generated.extend(out)
@@ -2537,6 +2608,9 @@ class ServeEngine:
             self._recover_cache()  # donated kv may be consumed; replay
             raise
         self._decode_s += self._last_span_s()
+        if self.tracer.enabled:
+            self._trace_span([self._slots[s].rid for s in active],
+                             "decode", rounds=1, spec=True)
         self._decode_steps += 1
         self._dispatches += 1
         self._host_syncs += 1

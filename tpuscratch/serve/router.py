@@ -90,8 +90,9 @@ import dataclasses
 import time
 from typing import Optional, Sequence, Union
 
-from tpuscratch.ft.chaos import ChaosPlan
+from tpuscratch.ft.chaos import ChaosPlan, bind_tracer
 from tpuscratch.obs.metrics import Reservoir, percentile
+from tpuscratch.obs.reqtrace import NullReqTracer
 from tpuscratch.serve.disagg import DisaggEngine
 from tpuscratch.serve.engine import Request, ServeEngine
 
@@ -384,12 +385,22 @@ class FleetRouter:
 
     def __init__(self, replicas: Sequence[Union[ServeEngine, DisaggEngine]],
                  rcfg: Optional[RouterConfig] = None,
-                 chaos: Optional[ChaosPlan] = None):
+                 chaos: Optional[ChaosPlan] = None,
+                 tracer=None):
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
         self.replicas = list(replicas)
         self.rcfg = rcfg or RouterConfig()
         self._chaos = chaos
+        # ONE shared per-request tracer (obs.reqtrace) across the router
+        # and every replica, so a request's span tree stays whole as it
+        # moves between layers; None leaves each replica's own tracer
+        # (NullReqTracer by default) untouched
+        self.tracer = tracer if tracer is not None else NullReqTracer()
+        if tracer is not None:
+            bind_tracer(chaos, tracer)
+            for r in self.replicas:
+                r.set_tracer(tracer)
         if chaos is not None and any(
             f.site == "serve/replica" and f.kind == "kill"
             for f in chaos.faults
@@ -574,8 +585,9 @@ class FleetRouter:
         self._class_ptok[tenant] += len(req.prompt)
         self._submitted += 1
         self._open_by_class[tenant] += 1
-        self._queue.append(_Pending(cls=tenant, req=req,
-                                    t0=time.perf_counter(),
+        t0 = time.perf_counter()
+        self.tracer.begin(req.rid, t0, cls=tenant)
+        self._queue.append(_Pending(cls=tenant, req=req, t0=t0,
                                     tick=self._tick))
 
     # ---- the fleet prefix index -----------------------------------------
@@ -713,6 +725,9 @@ class FleetRouter:
             # t0 back-dates the engine's TTFT clock to the ROUTER
             # submit: queue-held wall is part of what the tenant waited
             self.replicas[i].submit(pend.req, t0=pend.t0)
+            if self.tracer.enabled:
+                self.tracer.mark(pend.req.rid, "dispatch",
+                                 time.perf_counter(), replica=i)
             self._queue.remove(pend)
             self._replica_of[pend.req.rid] = i
             self._inflight.add(pend.req.rid)
@@ -774,6 +789,7 @@ class FleetRouter:
             rid=rid, cls=pend.cls, reason=reason,
             waited_s=self._age(pend),
         ))
+        self.tracer.shed(rid, time.perf_counter(), reason)
 
     def _displacement_victim(self, pend: _Pending,
                              shed_rids: set) -> Optional[_Pending]:
@@ -1003,6 +1019,8 @@ class FleetRouter:
                 )
                 self._finished += 1
                 self._open_by_class[cls] -= 1
+        if self.tracer.enabled:
+            self.tracer.collect()
         return finished
 
     @property
